@@ -1,0 +1,84 @@
+"""The five stage interfaces of the pipeline API.
+
+Parity with the reference (``flink-ml-core/.../ml/api/``):
+  - ``Stage`` = WithParams + save/load (``Stage.java:34-44``),
+  - ``AlgoOperator.transform(*tables)`` (``AlgoOperator.java:31-38``),
+  - ``Transformer`` marker (``Transformer.java:32``),
+  - ``Model`` adds ``set_model_data``/``get_model_data`` (``Model.java:38-50``),
+  - ``Estimator.fit(*tables) -> Model`` (``Estimator.java:31-38``).
+
+TPU-first difference: tables are in-memory columnar batches (`Table`), and
+fit/transform execute eagerly (JAX jit caching makes repeated execution cheap)
+instead of lazily building a dataflow graph — the laziness in the reference
+exists to serve Flink's deployment model, not the ML semantics.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Tuple
+
+from flinkml_tpu.io import read_write
+from flinkml_tpu.params import WithParams
+from flinkml_tpu.table import Table
+
+
+class Stage(WithParams, abc.ABC):
+    """Base class for nodes in a Pipeline or Graph; save/load-able.
+
+    Saving follows the reference convention (``Stage.java:34-44``): a stage
+    directory holds a JSON ``metadata`` file; subclasses with model data add
+    arrays under ``data/``. ``load`` is a classmethod; the generic loader
+    (``flinkml_tpu.io.read_write.load_stage``) dispatches on the recorded
+    class name, mirroring the static-load reflection convention.
+    """
+
+    def save(self, path: str) -> None:
+        read_write.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Stage":
+        meta = read_write.load_metadata(path)
+        return read_write.instantiate_with_params(cls, meta["paramMap"])
+
+
+class AlgoOperator(Stage):
+    """A Stage that computes output tables from input tables.
+
+    Parity: ``AlgoOperator.java:31-38``.
+    """
+
+    @abc.abstractmethod
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        """Apply the operator to the inputs; returns a tuple of result tables."""
+
+
+class Transformer(AlgoOperator):
+    """An AlgoOperator with the semantics of a feature engineering /
+    prediction step. Parity: ``Transformer.java:32``."""
+
+
+class Model(Transformer):
+    """A Transformer parameterized by fitted model data.
+
+    Parity: ``Model.java:31-50`` — model data is exposed as tables so it can
+    be inspected, transferred, and persisted independently of the stage.
+    """
+
+    def set_model_data(self, *inputs: Table) -> "Model":
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support set_model_data"
+        )
+
+    def get_model_data(self) -> List[Table]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support get_model_data"
+        )
+
+
+class Estimator(Stage):
+    """Fits a Model from training tables. Parity: ``Estimator.java:31-38``."""
+
+    @abc.abstractmethod
+    def fit(self, *inputs: Table) -> Model:
+        ...

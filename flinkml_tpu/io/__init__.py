@@ -1,0 +1,15 @@
+from flinkml_tpu.io.read_write import (
+    load_metadata,
+    load_stage,
+    save_metadata,
+    save_model_arrays,
+    load_model_arrays,
+)
+
+__all__ = [
+    "load_metadata",
+    "load_stage",
+    "save_metadata",
+    "save_model_arrays",
+    "load_model_arrays",
+]

@@ -1,0 +1,152 @@
+"""Columnar Table — the data-plane analog of Flink's ``Table``.
+
+The reference moves data as row streams (``Table`` ↔ ``DataStream<Row>``,
+e.g. ``LogisticRegression.java:111-130`` maps rows to POJOs one at a time).
+On TPU, per-record processing wastes the MXU; the native representation is a
+batched columnar store: each column is a host numpy array with leading axis =
+rows (feature columns are 2-D ``[rows, dim]``), shipped to device HBM as
+batches via ``jax.device_put``. This single type replaces the reference's
+Table conversions and record-at-a-time operators.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+
+class Table:
+    """Immutable named-column container backed by host numpy arrays.
+
+    All columns share the same leading dimension (row count). Columns may be:
+      - 1-D arrays (scalar columns: labels, weights, categories),
+      - N-D arrays (vector/matrix columns: features ``[rows, dim]``),
+      - object arrays (ragged data, e.g. sparse vectors before densify).
+    """
+
+    def __init__(self, columns: Mapping[str, Any]):
+        if not columns:
+            raise ValueError("Table requires at least one column")
+        conv: Dict[str, np.ndarray] = {}
+        n_rows: Optional[int] = None
+        for name, col in columns.items():
+            arr = col if isinstance(col, np.ndarray) else _to_array(col)
+            if arr.ndim == 0:
+                # Scalar columns become single-row columns so every column
+                # supports row slicing uniformly.
+                arr = arr.reshape(1)
+            if n_rows is None:
+                n_rows = arr.shape[0]
+            elif arr.shape[0] != n_rows:
+                raise ValueError(
+                    f"Column {name!r} has {arr.shape[0]} rows, expected {n_rows}"
+                )
+            conv[name] = arr
+        self._columns = conv
+        self._num_rows = int(n_rows or 0)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_columns(**columns: Any) -> "Table":
+        return Table(columns)
+
+    @staticmethod
+    def from_rows(rows: Iterable[Mapping[str, Any]]) -> "Table":
+        rows = list(rows)
+        if not rows:
+            raise ValueError("Table.from_rows requires at least one row")
+        names = list(rows[0].keys())
+        return Table({n: _to_array([r[n] for r in rows]) for n in names})
+
+    # -- schema ------------------------------------------------------------
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._columns.keys())
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def column(self, name: str) -> np.ndarray:
+        if name not in self._columns:
+            raise KeyError(
+                f"Column {name!r} not in table (has {self.column_names})"
+            )
+        return self._columns[name]
+
+    __getitem__ = column
+
+    # -- relational ops ----------------------------------------------------
+    def select(self, *names: str) -> "Table":
+        return Table({n: self.column(n) for n in names})
+
+    def with_column(self, name: str, values: Any) -> "Table":
+        cols = dict(self._columns)
+        cols[name] = _to_array(values) if not isinstance(values, np.ndarray) else values
+        return Table(cols)
+
+    def drop(self, *names: str) -> "Table":
+        cols = {n: c for n, c in self._columns.items() if n not in names}
+        return Table(cols)
+
+    def rename(self, mapping: Mapping[str, str]) -> "Table":
+        return Table({mapping.get(n, n): c for n, c in self._columns.items()})
+
+    def take(self, indices: np.ndarray) -> "Table":
+        return Table({n: c[indices] for n, c in self._columns.items()})
+
+    def slice(self, start: int, stop: int) -> "Table":
+        return Table({n: c[start:stop] for n, c in self._columns.items()})
+
+    def concat(self, other: "Table") -> "Table":
+        if set(self.column_names) != set(other.column_names):
+            raise ValueError("concat requires identical column sets")
+        return Table(
+            {n: np.concatenate([self._columns[n], other.column(n)]) for n in self.column_names}
+        )
+
+    # -- iteration ---------------------------------------------------------
+    def batches(self, batch_size: int, drop_remainder: bool = False) -> Iterator["Table"]:
+        """Yield consecutive row slices of at most ``batch_size`` rows."""
+        n = self._num_rows
+        stop = (n // batch_size) * batch_size if drop_remainder else n
+        for start in range(0, stop, batch_size):
+            yield self.slice(start, min(start + batch_size, n))
+
+    def to_rows(self) -> List[Dict[str, Any]]:
+        return [
+            {n: c[i] for n, c in self._columns.items()} for i in range(self._num_rows)
+        ]
+
+    def __repr__(self) -> str:  # pragma: no cover
+        cols = ", ".join(
+            f"{n}:{c.dtype}{list(c.shape[1:])}" for n, c in self._columns.items()
+        )
+        return f"Table[{self._num_rows} rows; {cols}]"
+
+
+def _to_array(values: Any) -> np.ndarray:
+    """Convert a python sequence to a numpy column, keeping ragged data as object."""
+    try:
+        arr = np.asarray(values)
+        if arr.dtype == object and arr.ndim == 0:
+            arr = np.asarray([values])
+    except ValueError:
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+    if arr.dtype == object:
+        # Ragged rows (e.g. variable-length lists / sparse vectors).
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+    return arr

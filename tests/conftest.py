@@ -1,0 +1,27 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+This is the MiniCluster analog from SURVEY.md §4: the reference runs its
+system tests on a 2-TM × 2-slot MiniCluster; we run ours on
+``--xla_force_host_platform_device_count=8`` CPU devices so every collective
+and sharding path is exercised multi-device without TPU hardware.
+
+Must set env vars before the first jax import anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(2024)

@@ -1,0 +1,64 @@
+"""Hand-written fixture stages for Pipeline/Graph tests.
+
+Mirrors the reference's ``ExampleStages`` fixtures
+(``flink-ml-core/src/test/java/.../api/ExampleStages.java``): ``SumEstimator``
+fits a ``SumModel`` whose delta is the sum of the train column; the model
+adds its delta to every input value.
+"""
+
+from typing import List, Tuple
+
+import numpy as np
+
+from flinkml_tpu.api import AlgoOperator, Estimator, Model, Transformer
+from flinkml_tpu.io import read_write
+from flinkml_tpu.params import IntParam
+from flinkml_tpu.table import Table
+
+
+class SumModel(Model):
+    """Adds a fitted delta to the 'value' column."""
+
+    DELTA = IntParam("delta", "value added to inputs", 0)
+
+    def __init__(self):
+        super().__init__()
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        delta = self.get(SumModel.DELTA)
+        return (table.with_column("value", table["value"] + delta),)
+
+    def set_model_data(self, *inputs: Table) -> "SumModel":
+        (table,) = inputs
+        self.set(SumModel.DELTA, int(table["delta"][0]))
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"delta": np.array([self.get(SumModel.DELTA)])})]
+
+
+class SumEstimator(Estimator):
+    """Fits SumModel with delta = sum of the 'value' column."""
+
+    def __init__(self):
+        super().__init__()
+
+    def fit(self, *inputs: Table) -> SumModel:
+        (table,) = inputs
+        model = SumModel()
+        model.set(SumModel.DELTA, int(np.sum(table["value"])))
+        return model
+
+
+class UnionAlgoOperator(AlgoOperator):
+    """Concatenates all input tables — a multi-input AlgoOperator fixture."""
+
+    def __init__(self):
+        super().__init__()
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = out.concat(t)
+        return (out,)

@@ -1,0 +1,152 @@
+"""Graph/GraphBuilder tests — mirrors the reference's GraphTest
+(``flink-ml-core/src/test/java/.../builder/GraphTest.java``)."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.graph import Graph, GraphBuilder, GraphModel
+from flinkml_tpu.table import Table
+
+from tests.example_stages import SumEstimator, SumModel, UnionAlgoOperator
+
+
+def make_table(values):
+    return Table({"value": np.asarray(values)})
+
+
+def test_linear_graph_fit_transform():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    est = SumEstimator()
+    out1 = b.add_estimator(est, src)
+    model2 = SumModel().set_delta(7)
+    out2 = b.add_algo_operator(model2, out1[0])
+    graph = b.build_estimator([src], [out2[0]])
+
+    gm = graph.fit(make_table([1, 2, 3]))  # delta 6
+    (out,) = gm.transform(make_table([0]))
+    assert out["value"][0] == 13  # 0 + 6 + 7
+
+
+def test_dag_with_union():
+    b = GraphBuilder()
+    a = b.create_table_id()
+    c = b.create_table_id()
+    union = UnionAlgoOperator()
+    merged = b.add_algo_operator(union, a, c)
+    est = SumEstimator()
+    out = b.add_estimator(est, merged[0])
+    graph = b.build_estimator([a, c], [out[0]])
+    gm = graph.fit(make_table([1]), make_table([2, 3]))
+    (res,) = gm.transform(make_table([0]), make_table([0]))
+    # fit: union=[1,2,3], delta=6; transform: union of [0],[0] + 6 each.
+    assert np.array_equal(res["value"], [6, 6])
+
+
+def test_graph_model_data_wiring():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    est = SumEstimator()
+    out = b.add_estimator(est, src)
+    model_data = b.get_model_data_from_estimator(est)
+    graph = b.build_estimator([src], [out[0]], output_model_data=[model_data[0]])
+    gm = graph.fit(make_table([1, 2, 3]))
+    data = gm.get_model_data()
+    assert int(data[0]["delta"][0]) == 6
+
+
+def test_get_model_data_returns_only_wired_tables():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    m1 = SumModel().set_delta(1)
+    m2 = SumModel().set_delta(2)
+    o1 = b.add_algo_operator(m1, src)
+    o2 = b.add_algo_operator(m2, o1[0])
+    d2 = b.get_model_data_from_model(m2)
+    # Only m2's model data is wired out.
+    gm = b.build_model([src], [o2[0]], output_model_data=[d2[0]])
+    gm.transform(make_table([0]))
+    data = gm.get_model_data()
+    assert len(data) == 1 and int(data[0]["delta"][0]) == 2
+
+
+def test_get_model_data_unwired_raises():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    out = b.add_algo_operator(SumModel().set_delta(1), src)
+    gm = b.build_model([src], [out[0]])
+    with pytest.raises(ValueError):
+        gm.get_model_data()
+
+
+def test_set_model_data_arity_checked():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    md = b.create_table_id()
+    model = SumModel()
+    out = b.add_algo_operator(model, src)
+    b.set_model_data_on_model(model, md)
+    gm = b.build_model([src], [out[0]], input_model_data=[md])
+    with pytest.raises(ValueError):
+        gm.set_model_data(
+            Table({"delta": np.array([1])}), Table({"delta": np.array([2])})
+        )
+
+
+def test_graph_set_model_data():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    model_data_in = b.create_table_id()
+    model = SumModel()
+    b.add_algo_operator(model, src)
+    b.set_model_data_on_model(model, model_data_in)
+    out_ids = b._stage_nodes[id(model)].output_ids
+    gm = b.build_model([src], [out_ids[0]], input_model_data=[model_data_in])
+    gm.set_model_data(Table({"delta": np.array([42])}))
+    (out,) = gm.transform(make_table([1]))
+    assert out["value"][0] == 43
+
+
+def test_build_model_rejects_estimator_nodes():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    out = b.add_estimator(SumEstimator(), src)
+    with pytest.raises(ValueError):
+        b.build_model([src], [out[0]])
+
+
+def test_unreachable_input_raises():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    orphan = b.create_table_id()
+    out = b.add_algo_operator(SumModel().set_delta(1), orphan)
+    graph = b.build_estimator([src], [out[0]])
+    with pytest.raises(ValueError):
+        graph.fit(make_table([1]))
+
+
+def test_graph_save_load(tmp_path):
+    b = GraphBuilder()
+    src = b.create_table_id()
+    out1 = b.add_estimator(SumEstimator(), src)
+    out2 = b.add_algo_operator(SumModel().set_delta(7), out1[0])
+    graph = b.build_estimator([src], [out2[0]])
+    p = str(tmp_path / "graph")
+    graph.save(p)
+    loaded = Graph.load(p)
+    gm = loaded.fit(make_table([1, 2, 3]))
+    (out,) = gm.transform(make_table([0]))
+    assert out["value"][0] == 13
+
+
+def test_graph_model_save_load(tmp_path):
+    b = GraphBuilder()
+    src = b.create_table_id()
+    out1 = b.add_estimator(SumEstimator(), src)
+    graph = b.build_estimator([src], [out1[0]])
+    gm = graph.fit(make_table([1, 2, 3]))
+    p = str(tmp_path / "gm")
+    gm.save(p)
+    loaded = GraphModel.load(p)
+    (out,) = loaded.transform(make_table([10]))
+    assert out["value"][0] == 16

@@ -1,0 +1,123 @@
+"""Param system tests — mirrors the reference's StageTest param coverage
+(``flink-ml-core/src/test/java/.../api/StageTest.java``)."""
+
+import pytest
+
+from flinkml_tpu.params import (
+    BoolParam,
+    FloatArrayParam,
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    Param,
+    ParamValidators,
+    StringArrayParam,
+    StringParam,
+    WithParams,
+)
+
+
+class MyStage(WithParams):
+    BOOLEAN_PARAM = BoolParam("booleanParam", "Description", False)
+    INT_PARAM = IntParam("intParam", "Description", 1, ParamValidators.lt_eq(100))
+    FLOAT_PARAM = FloatParam("floatParam", "Description", 3.0, ParamValidators.lt_eq(100.0))
+    STRING_PARAM = StringParam("stringParam", "Description", "5")
+    INT_ARRAY_PARAM = IntArrayParam("intArrayParam", "Description", [6, 7])
+    FLOAT_ARRAY_PARAM = FloatArrayParam("floatArrayParam", "Description", [10.0, 11.0])
+    STRING_ARRAY_PARAM = StringArrayParam("stringArrayParam", "Description", ["14", "15"])
+
+    def __init__(self):
+        super().__init__()
+
+
+def test_defaults():
+    s = MyStage()
+    assert s.get(MyStage.BOOLEAN_PARAM) is False
+    assert s.get(MyStage.INT_PARAM) == 1
+    assert s.get(MyStage.FLOAT_PARAM) == 3.0
+    assert s.get(MyStage.STRING_PARAM) == "5"
+    assert s.get(MyStage.INT_ARRAY_PARAM) == [6, 7]
+
+
+def test_set_get_and_chaining():
+    s = MyStage()
+    assert s.set(MyStage.INT_PARAM, 7) is s
+    assert s.get(MyStage.INT_PARAM) == 7
+
+
+def test_snake_case_sugar():
+    s = MyStage()
+    s.set_int_param(42)
+    assert s.get_int_param() == 42
+    s.set_string_array_param(["a", "b"])
+    assert s.get_string_array_param() == ["a", "b"]
+    with pytest.raises(AttributeError):
+        s.set_nonexistent_param(1)
+
+
+def test_validator_rejects():
+    s = MyStage()
+    with pytest.raises(ValueError):
+        s.set(MyStage.INT_PARAM, 101)
+
+
+def test_invalid_default_rejected():
+    with pytest.raises(ValueError):
+        IntParam("bad", "d", 200, ParamValidators.lt_eq(100))
+
+
+def test_validators():
+    v = ParamValidators
+    assert v.gt(5)(6) and not v.gt(5)(5)
+    assert v.gt_eq(5)(5) and not v.gt_eq(5)(4)
+    assert v.lt(5)(4) and not v.lt(5)(5)
+    assert v.lt_eq(5)(5) and not v.lt_eq(5)(6)
+    assert v.in_range(0, 1)(0.5) and not v.in_range(0, 1)(2)
+    assert not v.in_range(0, 1, lower_inclusive=False)(0)
+    assert not v.in_range(0, 1, upper_inclusive=False)(1)
+    assert v.in_array(["a", "b"])("a") and not v.in_array(["a", "b"])("c")
+    assert v.not_null()(0) and not v.not_null()(None)
+    assert v.non_empty_array()([1]) and not v.non_empty_array()([])
+    assert not v.gt(5)(None)
+
+
+def test_json_round_trip():
+    s = MyStage()
+    s.set(MyStage.INT_PARAM, 9)
+    s.set(MyStage.FLOAT_ARRAY_PARAM, [1.5, 2.5])
+    encoded = s.get_param_map_json()
+    restored = MyStage().load_param_map_json(encoded)
+    for p in MyStage.params():
+        assert restored.get(p) == s.get(p), p.name
+
+
+def test_json_decode_coerces_types():
+    s = MyStage().load_param_map_json({"intParam": 3.0, "floatParam": 7})
+    assert s.get(MyStage.INT_PARAM) == 3 and isinstance(s.get(MyStage.INT_PARAM), int)
+    assert s.get(MyStage.FLOAT_PARAM) == 7.0 and isinstance(s.get(MyStage.FLOAT_PARAM), float)
+
+
+def test_unknown_json_params_tolerated():
+    MyStage().load_param_map_json({"unknownParam": 1})
+
+
+def test_param_inheritance():
+    class Child(MyStage):
+        EXTRA = IntParam("extraParam", "d", 0)
+
+    c = Child()
+    names = [p.name for p in Child.params()]
+    assert "intParam" in names and "extraParam" in names
+    assert c.get(Child.EXTRA) == 0
+
+
+def test_get_undefined_param_raises():
+    foreign = IntParam("foreign", "d", 0)
+    with pytest.raises(ValueError):
+        MyStage().get(foreign)
+
+
+def test_set_undefined_param_raises():
+    foreign = IntParam("foreign", "d", 0)
+    with pytest.raises(ValueError):
+        MyStage().set(foreign, 5)

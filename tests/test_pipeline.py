@@ -1,0 +1,75 @@
+"""Pipeline semantics tests — mirrors the reference's PipelineTest
+(``flink-ml-core/src/test/java/.../api/PipelineTest.java``) and the Python
+``test_pipeline.py``."""
+
+import numpy as np
+
+from flinkml_tpu.pipeline import Pipeline, PipelineModel
+from flinkml_tpu.table import Table
+
+from tests.example_stages import SumEstimator, SumModel, UnionAlgoOperator
+
+
+def make_table(values):
+    return Table({"value": np.asarray(values)})
+
+
+def test_pipeline_model_transform_chains():
+    # Two SumModels with deltas 10 and 20: input + 30.
+    m1 = SumModel().set_delta(10)
+    m2 = SumModel().set_delta(20)
+    pm = PipelineModel([m1, m2])
+    (out,) = pm.transform(make_table([1, 2, 3]))
+    assert np.array_equal(out["value"], [31, 32, 33])
+
+
+def test_pipeline_fit_transforms_up_to_last_estimator():
+    # Reference semantics (Pipeline.java:79-107): inputs advance through a
+    # stage only while an Estimator remains downstream.
+    # Stage 0: SumEstimator fit on [1,2,3] -> delta 6; transforms inputs to
+    # [7,8,9] because stage 2 is an Estimator.
+    # Stage 1: SumModel(delta=1): [8,9,10].
+    # Stage 2: SumEstimator fit on [8,9,10] -> delta 27. No estimator after,
+    # so inputs stop advancing.
+    pipeline = Pipeline([SumEstimator(), SumModel().set_delta(1), SumEstimator()])
+    model = pipeline.fit(make_table([1, 2, 3]))
+    stages = model.stages
+    assert stages[0].get_delta() == 6
+    assert stages[2].get_delta() == 27
+    # Full PipelineModel.transform applies all three: x + 6 + 1 + 27.
+    (out,) = model.transform(make_table([0]))
+    assert out["value"][0] == 34
+
+
+def test_pipeline_save_load(tmp_path):
+    pipeline = Pipeline([SumEstimator(), SumModel().set_delta(5)])
+    p = str(tmp_path / "pipeline")
+    pipeline.save(p)
+    loaded = Pipeline.load(p)
+    assert len(loaded.stages) == 2
+    assert isinstance(loaded.stages[0], SumEstimator)
+    assert loaded.stages[1].get_delta() == 5
+
+
+def test_pipeline_model_save_load(tmp_path):
+    pm = PipelineModel([SumModel().set_delta(10), SumModel().set_delta(20)])
+    p = str(tmp_path / "pm")
+    pm.save(p)
+    loaded = PipelineModel.load(p)
+    (out,) = loaded.transform(make_table([1]))
+    assert out["value"][0] == 31
+
+
+def test_nested_pipeline():
+    inner = Pipeline([SumEstimator()])
+    outer = Pipeline([inner, SumModel().set_delta(100)])
+    model = outer.fit(make_table([1, 2]))
+    (out,) = model.transform(make_table([0]))
+    # inner delta = 3, then +100.
+    assert out["value"][0] == 103
+
+
+def test_multi_input_algo_operator():
+    op = UnionAlgoOperator()
+    (out,) = op.transform(make_table([1]), make_table([2, 3]))
+    assert out.num_rows == 3

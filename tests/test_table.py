@@ -1,0 +1,55 @@
+import numpy as np
+import pytest
+
+from flinkml_tpu.table import Table
+
+
+def test_basic_columns():
+    t = Table({"x": np.arange(10), "y": np.ones((10, 3))})
+    assert t.num_rows == 10
+    assert t.column_names == ["x", "y"]
+    assert t["y"].shape == (10, 3)
+
+
+def test_row_count_mismatch():
+    with pytest.raises(ValueError):
+        Table({"x": np.arange(10), "y": np.arange(9)})
+
+
+def test_from_rows_and_to_rows():
+    rows = [{"a": 1, "b": [1.0, 2.0]}, {"a": 2, "b": [3.0, 4.0]}]
+    t = Table.from_rows(rows)
+    assert t.num_rows == 2
+    assert t["b"].shape == (2, 2)
+    back = t.to_rows()
+    assert back[1]["a"] == 2
+
+
+def test_select_drop_rename_with_column():
+    t = Table({"x": np.arange(5), "y": np.arange(5) * 2})
+    assert t.select("x").column_names == ["x"]
+    assert t.drop("x").column_names == ["y"]
+    assert t.rename({"x": "z"}).column_names == ["z", "y"]
+    t2 = t.with_column("w", np.zeros(5))
+    assert "w" in t2 and "w" not in t
+
+
+def test_slice_take_concat():
+    t = Table({"x": np.arange(10)})
+    assert t.slice(2, 5).num_rows == 3
+    assert np.array_equal(t.take(np.array([1, 3]))["x"], [1, 3])
+    assert t.concat(t).num_rows == 20
+
+
+def test_batches():
+    t = Table({"x": np.arange(10)})
+    sizes = [b.num_rows for b in t.batches(4)]
+    assert sizes == [4, 4, 2]
+    sizes = [b.num_rows for b in t.batches(4, drop_remainder=True)]
+    assert sizes == [4, 4]
+
+
+def test_ragged_object_column():
+    t = Table({"v": [[1, 2], [3, 4, 5]]})
+    assert t["v"].dtype == object
+    assert list(t["v"][1]) == [3, 4, 5]
