@@ -38,7 +38,8 @@ class Stage(WithParams, abc.ABC):
 
     @classmethod
     def load(cls, path: str) -> "Stage":
-        meta = read_write.load_metadata(path)
+        expected = f"{cls.__module__}.{cls.__qualname__}"
+        meta = read_write.load_metadata(path, expected_class_name=expected)
         return read_write.instantiate_with_params(cls, meta["paramMap"])
 
 

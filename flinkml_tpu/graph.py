@@ -133,7 +133,13 @@ class _ExecutionContext:
 
     def set_tables(self, ids: Sequence[TableId], tables: Sequence[Table]) -> None:
         # A node may declare more output slots than the stage actually
-        # produces (max_output_table_num); extra slots stay unassigned.
+        # produces (max_output_table_num); extra slots stay unassigned. The
+        # reverse — more tables than slots — is a misconfiguration.
+        if len(tables) > len(ids):
+            raise ValueError(
+                f"stage produced {len(tables)} tables but only {len(ids)} "
+                "output slots are allocated; raise set_max_output_table_num"
+            )
         for tid, tbl in zip(ids, tables):
             self.tables[tid] = tbl
 
@@ -431,7 +437,12 @@ class GraphModel(Model):
             )
         ctx = _ExecutionContext()
         ctx.set_tables(self._input_ids, inputs)
-        if self._input_model_data_ids is not None and self._pending_model_data is not None:
+        if self._input_model_data_ids is not None:
+            if self._pending_model_data is None:
+                raise ValueError(
+                    "This GraphModel requires model data; call set_model_data "
+                    "before transform"
+                )
             ctx.set_tables(self._input_model_data_ids, self._pending_model_data)
         _execute_nodes(self._nodes, ctx, fit_mode=False)
         self._capture_model_data(ctx)

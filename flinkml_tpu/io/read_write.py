@@ -81,17 +81,29 @@ def instantiate_with_params(cls: type, param_map_json: Mapping[str, Any]) -> Any
 
 
 def _resolve_class(dotted: str) -> type:
-    module_name, _, qualname = dotted.rpartition(".")
-    # qualname may be nested (Outer.Inner): walk attributes.
+    module_name = dotted.rpartition(".")[0]
+    # The class may be nested (pkg.mod.Outer.Inner): try the longest module
+    # prefix first, falling back to shorter prefixes with attribute walks.
     while module_name:
         try:
             mod = importlib.import_module(module_name)
-            obj: Any = mod
+        except ModuleNotFoundError as e:
+            # Only swallow "this prefix is not a module" — a missing
+            # dependency raised from *inside* the module must surface.
+            if e.name and (
+                module_name == e.name or module_name.startswith(e.name + ".")
+            ):
+                module_name = module_name.rpartition(".")[0]
+                continue
+            raise
+        obj: Any = mod
+        try:
             for part in dotted[len(module_name) + 1 :].split("."):
                 obj = getattr(obj, part)
-            return obj
-        except (ImportError, AttributeError):
-            module_name, _, _ = module_name.rpartition(".")
+        except AttributeError:
+            module_name = module_name.rpartition(".")[0]
+            continue
+        return obj
     raise ImportError(f"Cannot resolve stage class {dotted!r}")
 
 

@@ -1,0 +1,5 @@
+from flinkml_tpu.ops import blas
+from flinkml_tpu.ops.distance import DistanceMeasure, EuclideanDistanceMeasure
+from flinkml_tpu.ops.sparse import BatchedCSR
+
+__all__ = ["blas", "DistanceMeasure", "EuclideanDistanceMeasure", "BatchedCSR"]

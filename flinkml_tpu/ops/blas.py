@@ -1,0 +1,89 @@
+"""BLAS facade — the numeric kernel layer.
+
+Parity: ``flink-ml-core/.../ml/linalg/BLAS.java:26-91`` exposes
+``asum/axpy/dot/norm2/scal/gemv`` over ``double[]`` via pure-Java netlib;
+that facade is the *entire* kernel layer of the reference. Here every op is
+a jax.numpy expression: XLA fuses elementwise chains and maps matmuls onto
+the MXU, and the same functions trace cleanly inside ``jit``/``grad``/
+``vmap``/``shard_map``.
+
+Batched variants (``gemm``, ``batch_dot``, ``squared_distances``) are the
+TPU-first additions: the reference calls gemv per row (e.g.
+``KnnModel.java:72-197``); on TPU the batch dimension belongs in the kernel.
+
+Functions accept jax or numpy arrays and return jax arrays. Precision policy:
+computations run in the input dtype; algorithms choose float32 (TPU-native)
+and tests may use float64 on CPU (x64 enabled in conftest).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def asum(x) -> Array:
+    """Sum of absolute values. Parity: BLAS.java asum."""
+    return jnp.sum(jnp.abs(x))
+
+
+def axpy(a, x, y) -> Array:
+    """a*x + y (functional: returns the result instead of mutating y).
+
+    Parity: BLAS.java axpy — the reference mutates ``y`` in place; under XLA
+    arrays are immutable and the fused result is returned.
+    """
+    return a * x + y
+
+
+def dot(x, y) -> Array:
+    """Vector dot product. Parity: BLAS.java dot."""
+    return jnp.dot(x, y)
+
+
+def norm2(x) -> Array:
+    """Euclidean norm. Parity: BLAS.java norm2."""
+    return jnp.sqrt(jnp.sum(x * x))
+
+
+def scal(a, x) -> Array:
+    """a*x (functional). Parity: BLAS.java scal."""
+    return a * x
+
+
+def gemv(alpha, matrix, x, beta=0.0, y=None, trans: bool = False) -> Array:
+    """alpha * op(A) @ x + beta * y. Parity: BLAS.java gemv."""
+    a = matrix.T if trans else matrix
+    out = alpha * (a @ x)
+    if y is not None:
+        out = out + beta * y
+    return out
+
+
+# -- batched TPU-first additions -------------------------------------------
+
+def gemm(a, b) -> Array:
+    """Plain matmul (MXU path); inputs [m,k] @ [k,n]."""
+    return a @ b
+
+
+def batch_dot(xs, y) -> Array:
+    """Row-wise dot of a batch [n, d] against a vector [d] -> [n]."""
+    return xs @ y
+
+
+def squared_distances(xs, ys) -> Array:
+    """Pairwise squared L2 distances: [n, d] x [m, d] -> [n, m].
+
+    Uses the (‖x‖² - 2x·y + ‖y‖²) expansion so the dominant cost is one
+    [n,d]@[d,m] matmul on the MXU instead of an O(n·m·d) elementwise
+    broadcast that would blow HBM.
+    """
+    xs = jnp.asarray(xs)
+    ys = jnp.asarray(ys)
+    x2 = jnp.sum(xs * xs, axis=-1, keepdims=True)
+    y2 = jnp.sum(ys * ys, axis=-1, keepdims=True).T
+    d2 = x2 - 2.0 * (xs @ ys.T) + y2
+    return jnp.maximum(d2, 0.0)

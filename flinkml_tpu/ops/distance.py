@@ -1,0 +1,104 @@
+"""Distance measures.
+
+Parity: ``ml/common/distance/DistanceMeasure.java:26-43`` — an SPI with a
+named factory (``DistanceMeasure.getInstance("euclidean")``) and a single
+``EuclideanDistanceMeasure`` implementation.
+
+TPU-first: the per-pair ``distance(a, b)`` exists for API parity, but the
+real interface is ``pairwise`` — a full [n, m] distance matrix in one MXU
+matmul — and ``nearest``/argmin over it, which is what KMeans/KNN use.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+import jax.numpy as jnp
+
+from flinkml_tpu.ops import blas
+
+
+class DistanceMeasure:
+    """SPI for distance measures; instances are stateless."""
+
+    NAME = "base"
+    _registry: Dict[str, "DistanceMeasure"] = {}
+
+    @classmethod
+    def register(cls, impl_cls: Type["DistanceMeasure"]) -> Type["DistanceMeasure"]:
+        cls._registry[impl_cls.NAME] = impl_cls()
+        return impl_cls
+
+    @staticmethod
+    def get_instance(name: str) -> "DistanceMeasure":
+        # Parity: DistanceMeasure.getInstance (DistanceMeasure.java:31-39).
+        impl = DistanceMeasure._registry.get(name)
+        if impl is None:
+            raise ValueError(
+                f"distanceMeasure must be one of {sorted(DistanceMeasure._registry)}, "
+                f"got {name!r}"
+            )
+        return impl
+
+    def distance(self, a, b):
+        raise NotImplementedError
+
+    def pairwise(self, xs, ys):
+        """[n, d] x [m, d] -> [n, m] distances."""
+        raise NotImplementedError
+
+    def nearest(self, xs, centroids):
+        """Index of nearest centroid per row: [n, d] x [k, d] -> [n] int32."""
+        return jnp.argmin(self.pairwise(xs, centroids), axis=-1)
+
+
+@DistanceMeasure.register
+class EuclideanDistanceMeasure(DistanceMeasure):
+    """Parity: ``EuclideanDistanceMeasure.java``."""
+
+    NAME = "euclidean"
+
+    def distance(self, a, b):
+        return blas.norm2(jnp.asarray(a) - jnp.asarray(b))
+
+    def pairwise(self, xs, ys):
+        return jnp.sqrt(blas.squared_distances(xs, ys))
+
+    def nearest(self, xs, centroids):
+        # argmin over squared distances avoids the sqrt entirely.
+        return jnp.argmin(blas.squared_distances(xs, centroids), axis=-1)
+
+
+@DistanceMeasure.register
+class CosineDistanceMeasure(DistanceMeasure):
+    """Cosine distance = 1 - cos(a, b); an addition beyond the reference's
+    single measure, registered through the same SPI."""
+
+    NAME = "cosine"
+
+    def distance(self, a, b):
+        a = jnp.asarray(a)
+        b = jnp.asarray(b)
+        return 1.0 - jnp.dot(a, b) / (blas.norm2(a) * blas.norm2(b))
+
+    def pairwise(self, xs, ys):
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        xn = xs / jnp.linalg.norm(xs, axis=-1, keepdims=True)
+        yn = ys / jnp.linalg.norm(ys, axis=-1, keepdims=True)
+        return 1.0 - xn @ yn.T
+
+
+@DistanceMeasure.register
+class ManhattanDistanceMeasure(DistanceMeasure):
+    """L1 distance; addition beyond the reference."""
+
+    NAME = "manhattan"
+
+    def distance(self, a, b):
+        return jnp.sum(jnp.abs(jnp.asarray(a) - jnp.asarray(b)))
+
+    def pairwise(self, xs, ys):
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        return jnp.sum(jnp.abs(xs[:, None, :] - ys[None, :, :]), axis=-1)

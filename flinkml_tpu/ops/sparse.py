@@ -1,0 +1,119 @@
+"""Batched sparse representation for TPU compute.
+
+The reference's sparse story is a per-record ``SparseVector`` fed through
+scalar BLAS (``BLAS.java`` dot on indices/values). On TPU, dynamic per-row
+nnz breaks XLA's static-shape requirement, so batches use a padded ELL-style
+layout: ``indices [n, max_nnz] int32`` and ``values [n, max_nnz]`` with
+padding entries carrying index 0 / value 0 (value 0 makes padded lanes
+no-ops in every product below — no masking needed).
+
+This is the Criteo-scale path (BASELINE.json config #5): forward = gather +
+row-sum; gradient = flat ``segment_sum`` scatter-add into the dense model,
+both of which XLA lowers to efficient HBM gathers/scatters without a Pallas
+kernel until profiling says otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.linalg import SparseVector
+
+
+class BatchedCSR:
+    """Padded batch of sparse rows with static shapes.
+
+    Attributes:
+        indices: int32 [n, max_nnz] column indices (0 where padded).
+        values: float [n, max_nnz] entries (0.0 where padded).
+        dim: dense width of each row.
+    """
+
+    def __init__(self, indices, values, dim: int):
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)
+        self.values = jnp.asarray(values)
+        if self.indices.shape != self.values.shape or self.indices.ndim != 2:
+            raise ValueError(
+                f"indices {self.indices.shape} and values {self.values.shape} "
+                "must be equal 2-D shapes"
+            )
+        self.dim = int(dim)
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def max_nnz(self) -> int:
+        return self.indices.shape[1]
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_sparse_vectors(
+        vectors: Iterable[SparseVector], max_nnz: int = None, dtype=np.float32
+    ) -> "BatchedCSR":
+        vectors = list(vectors)
+        if not vectors:
+            raise ValueError("empty batch")
+        dim = vectors[0].size()
+        nnzs = [v.indices.size for v in vectors]
+        width = max_nnz if max_nnz is not None else max(max(nnzs), 1)
+        n = len(vectors)
+        indices = np.zeros((n, width), dtype=np.int32)
+        values = np.zeros((n, width), dtype=dtype)
+        for i, v in enumerate(vectors):
+            if v.size() != dim:
+                raise ValueError(f"row {i} has dim {v.size()}, expected {dim}")
+            k = min(v.indices.size, width)
+            indices[i, :k] = v.indices[:k]
+            values[i, :k] = v.values[:k]
+        return BatchedCSR(indices, values, dim)
+
+    @staticmethod
+    def from_scipy(mat, dtype=np.float32) -> "BatchedCSR":
+        """From a scipy.sparse matrix (CSR), padding rows to the max nnz."""
+        mat = mat.tocsr()
+        n, dim = mat.shape
+        nnz_per_row = np.diff(mat.indptr)
+        width = max(int(nnz_per_row.max()), 1) if n else 1
+        indices = np.zeros((n, width), dtype=np.int32)
+        values = np.zeros((n, width), dtype=dtype)
+        for i in range(n):
+            lo, hi = mat.indptr[i], mat.indptr[i + 1]
+            k = hi - lo
+            indices[i, :k] = mat.indices[lo:hi]
+            values[i, :k] = mat.data[lo:hi]
+        return BatchedCSR(indices, values, dim)
+
+    # -- compute -----------------------------------------------------------
+    def to_dense(self) -> jax.Array:
+        """Densify to [n, dim] (for tests / small batches only)."""
+        n = self.num_rows
+        out = jnp.zeros((n, self.dim), dtype=self.values.dtype)
+        rows = jnp.repeat(jnp.arange(n), self.max_nnz)
+        return out.at[rows, self.indices.reshape(-1)].add(self.values.reshape(-1))
+
+    def matvec(self, w) -> jax.Array:
+        """Row-wise sparse dot against a dense vector: [n]."""
+        w = jnp.asarray(w)
+        return jnp.sum(self.values * w[self.indices], axis=1)
+
+    def rmatvec(self, coeffs) -> jax.Array:
+        """Transpose product: X^T @ coeffs -> dense [dim].
+
+        The sparse-gradient scatter-add (SURVEY.md §7 hard part (a)):
+        flattens to one ``segment_sum`` so XLA emits a single HBM scatter.
+        """
+        coeffs = jnp.asarray(coeffs)
+        contrib = (self.values * coeffs[:, None]).reshape(-1)
+        flat_idx = self.indices.reshape(-1)
+        return jax.ops.segment_sum(contrib, flat_idx, num_segments=self.dim)
+
+    def slice_rows(self, start: int, stop: int) -> "BatchedCSR":
+        return BatchedCSR(
+            self.indices[start:stop], self.values[start:stop], self.dim
+        )

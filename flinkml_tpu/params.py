@@ -197,40 +197,55 @@ class WithParams:
     def __init__(self) -> None:
         self._param_map: dict[Param, Any] = {}
 
+    # Per-class cache of discovered Param definitions; Params are static
+    # class attributes so one MRO scan per class suffices.
+    _params_by_class: dict = {}
+
     # -- core accessors ----------------------------------------------------
     @classmethod
     def params(cls) -> list:
         """All Param definitions on this class, in MRO discovery order."""
-        seen: dict[str, Param] = {}
-        for klass in reversed(cls.__mro__):
-            for attr in vars(klass).values():
-                if isinstance(attr, Param):
-                    seen[attr.name] = attr
-        return list(seen.values())
+        return list(cls._param_index().values())
+
+    @classmethod
+    def _param_index(cls) -> dict:
+        cached = WithParams._params_by_class.get(cls)
+        if cached is None:
+            cached = {}
+            for klass in reversed(cls.__mro__):
+                for attr in vars(klass).values():
+                    if isinstance(attr, Param):
+                        cached[attr.name] = attr
+            WithParams._params_by_class[cls] = cached
+        return cached
 
     @classmethod
     def get_param(cls, name: str) -> Optional[Param]:
-        for p in cls.params():
-            if p.name == name:
-                return p
-        return None
+        return cls._param_index().get(name)
 
     def set(self, param: Param, value: Any) -> "WithParams":
-        if self.get_param(param.name) is None:
+        # Re-key through this class's own Param of the same name, so values
+        # set via an equal-but-distinct Param (e.g. copy_params_from across
+        # stage types) land where this class's accessors find them.
+        own = self.get_param(param.name)
+        if own is None:
             raise ValueError(
                 f"Parameter {param.name} is not defined on {type(self).__name__}"
             )
-        param.validate(value)
-        self._ensure_map()[param] = value
+        own.validate(value)
+        self._ensure_map()[own] = value
         return self
 
     def get(self, param: Param) -> Any:
+        own = self.get_param(param.name)
+        if own is None:
+            raise ValueError(
+                f"Parameter {param.name} is not defined on {type(self).__name__}"
+            )
         m = self._ensure_map()
-        if param in m:
-            return m[param]
-        if self.get_param(param.name) is None:
-            raise ValueError(f"Parameter {param.name} is not defined on {type(self).__name__}")
-        return param.default_value
+        if own in m:
+            return m[own]
+        return own.default_value
 
     @property
     def param_map(self) -> dict:

@@ -16,7 +16,19 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+
+# The axon TPU plugin prepends itself to jax_platforms at import time,
+# overriding the JAX_PLATFORMS env var — force CPU via config as well.
+jax.config.update("jax_platforms", "cpu")
+
+# Golden-value tests compare against numpy float64; the env var form of this
+# flag is not honored by this jax build, so set it via config.
+jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: repeated pytest runs skip recompiles.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np
 import pytest

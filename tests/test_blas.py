@@ -1,0 +1,134 @@
+"""BLAS facade + distance + sparse kernel tests with numpy golden values —
+the TPU analog of BLASTest (``flink-ml-core/src/test/java/.../linalg/BLASTest.java``)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from flinkml_tpu.linalg import Vectors
+from flinkml_tpu.ops import blas
+from flinkml_tpu.ops.distance import DistanceMeasure
+from flinkml_tpu.ops.sparse import BatchedCSR
+
+
+@pytest.fixture
+def xs(rng):
+    return rng.normal(size=(7, 5))
+
+
+def test_asum_axpy_dot_norm2_scal(rng):
+    x = rng.normal(size=8)
+    y = rng.normal(size=8)
+    assert float(blas.asum(x)) == pytest.approx(np.abs(x).sum())
+    assert np.allclose(blas.axpy(2.5, x, y), 2.5 * x + y)
+    assert float(blas.dot(x, y)) == pytest.approx(np.dot(x, y))
+    assert float(blas.norm2(x)) == pytest.approx(np.linalg.norm(x))
+    assert np.allclose(blas.scal(3.0, x), 3.0 * x)
+
+
+def test_gemv(rng):
+    a = rng.normal(size=(4, 6))
+    x = rng.normal(size=6)
+    y = rng.normal(size=4)
+    assert np.allclose(blas.gemv(2.0, a, x), 2.0 * a @ x)
+    assert np.allclose(blas.gemv(2.0, a, x, 0.5, y), 2.0 * a @ x + 0.5 * y)
+    xt = rng.normal(size=4)
+    assert np.allclose(blas.gemv(1.0, a, xt, trans=True), a.T @ xt)
+
+
+def test_blas_ops_jit_compatible(rng):
+    """Every facade op must trace under jit (the whole point of the layer)."""
+    x = jnp.asarray(rng.normal(size=8))
+    y = jnp.asarray(rng.normal(size=8))
+    f = jax.jit(lambda x, y: blas.axpy(2.0, x, y) + blas.dot(x, y) * blas.norm2(x))
+    np.testing.assert_allclose(
+        np.asarray(f(x, y)),
+        2.0 * np.asarray(x) + np.asarray(y) + np.dot(x, y) * np.linalg.norm(x),
+        rtol=1e-6,
+    )
+
+
+def test_squared_distances(xs, rng):
+    ys = rng.normal(size=(3, 5))
+    d2 = np.asarray(blas.squared_distances(xs, ys))
+    expected = ((xs[:, None, :] - ys[None, :, :]) ** 2).sum(-1)
+    assert np.allclose(d2, expected, atol=1e-8)
+
+
+def test_euclidean_distance_measure(xs, rng):
+    m = DistanceMeasure.get_instance("euclidean")
+    ys = rng.normal(size=(3, 5))
+    assert float(m.distance(xs[0], ys[0])) == pytest.approx(
+        np.linalg.norm(xs[0] - ys[0])
+    )
+    pw = np.asarray(m.pairwise(xs, ys))
+    expected = np.linalg.norm(xs[:, None, :] - ys[None, :, :], axis=-1)
+    assert np.allclose(pw, expected, atol=1e-7)
+    nearest = np.asarray(m.nearest(xs, ys))
+    assert np.array_equal(nearest, expected.argmin(-1))
+
+
+def test_cosine_and_manhattan(rng):
+    a, b = rng.normal(size=5), rng.normal(size=5)
+    cos = DistanceMeasure.get_instance("cosine")
+    assert float(cos.distance(a, b)) == pytest.approx(
+        1 - np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b))
+    )
+    man = DistanceMeasure.get_instance("manhattan")
+    assert float(man.distance(a, b)) == pytest.approx(np.abs(a - b).sum())
+
+
+def test_unknown_measure():
+    with pytest.raises(ValueError):
+        DistanceMeasure.get_instance("chebyshev")
+
+
+# -- BatchedCSR ------------------------------------------------------------
+
+def test_batched_csr_from_sparse_vectors():
+    vecs = [
+        Vectors.sparse(6, [0, 4], [1.0, 2.0]),
+        Vectors.sparse(6, [2], [3.0]),
+        Vectors.sparse(6, [], []),
+    ]
+    b = BatchedCSR.from_sparse_vectors(vecs)
+    assert b.num_rows == 3 and b.dim == 6 and b.max_nnz == 2
+    dense = np.asarray(b.to_dense())
+    expected = np.stack([v.to_array() for v in vecs])
+    assert np.allclose(dense, expected)
+
+
+def test_batched_csr_matvec_rmatvec(rng):
+    mat = sp.random(20, 15, density=0.3, random_state=42, format="csr")
+    b = BatchedCSR.from_scipy(mat, dtype=np.float64)
+    w = rng.normal(size=15)
+    assert np.allclose(np.asarray(b.matvec(w)), mat @ w, atol=1e-10)
+    c = rng.normal(size=20)
+    assert np.allclose(np.asarray(b.rmatvec(c)), mat.T @ c, atol=1e-10)
+
+
+def test_batched_csr_padding_is_noop(rng):
+    # Padded lanes (index 0, value 0) must not contribute even when a real
+    # feature 0 exists.
+    vecs = [Vectors.sparse(4, [0], [5.0]), Vectors.sparse(4, [1, 2], [1.0, 1.0])]
+    b = BatchedCSR.from_sparse_vectors(vecs)
+    w = np.array([10.0, 1.0, 1.0, 1.0])
+    out = np.asarray(b.matvec(w))
+    assert np.allclose(out, [50.0, 2.0])
+    grad = np.asarray(b.rmatvec(np.array([1.0, 1.0])))
+    assert np.allclose(grad, [5.0, 1.0, 1.0, 0.0])
+
+
+def test_batched_csr_jit(rng):
+    mat = sp.random(8, 10, density=0.4, random_state=7, format="csr")
+    b = BatchedCSR.from_scipy(mat, dtype=np.float64)
+    w = jnp.asarray(rng.normal(size=10))
+
+    @jax.jit
+    def f(idx, vals, w):
+        return jnp.sum(BatchedCSR(idx, vals, 10).matvec(w))
+
+    assert float(f(b.indices, b.values, w)) == pytest.approx(float((mat @ np.asarray(w)).sum()))

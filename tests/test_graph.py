@@ -107,6 +107,18 @@ def test_graph_set_model_data():
     assert out["value"][0] == 43
 
 
+def test_transform_without_required_model_data_raises():
+    b = GraphBuilder()
+    src = b.create_table_id()
+    md = b.create_table_id()
+    model = SumModel()
+    out = b.add_algo_operator(model, src)
+    b.set_model_data_on_model(model, md)
+    gm = b.build_model([src], [out[0]], input_model_data=[md])
+    with pytest.raises(ValueError, match="set_model_data"):
+        gm.transform(make_table([1]))
+
+
 def test_build_model_rejects_estimator_nodes():
     b = GraphBuilder()
     src = b.create_table_id()

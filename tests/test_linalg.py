@@ -1,0 +1,98 @@
+"""Linalg tests — mirror the reference's BLASTest / SparseVectorTest
+(``flink-ml-core/src/test/java/.../linalg/``) plus the Python test_linalg.py,
+with golden values computed by numpy."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.linalg import (
+    DenseMatrix,
+    DenseVector,
+    SparseVector,
+    Vectors,
+    stack_vectors,
+)
+
+
+def test_dense_factory():
+    v = Vectors.dense(1.0, 2.0, 3.0)
+    assert v.size() == 3
+    assert v.get(1) == 2.0
+    assert np.array_equal(v.to_array(), [1, 2, 3])
+    v2 = Vectors.dense([4.0, 5.0])
+    assert v2.size() == 2
+
+
+def test_dense_ops():
+    a = Vectors.dense(1.0, 2.0)
+    b = Vectors.dense(3.0, 4.0)
+    assert a.dot(b) == 11.0
+    assert a.norm2() == pytest.approx(np.sqrt(5))
+    assert a == Vectors.dense(1.0, 2.0)
+    assert a != b
+
+
+def test_dense_rejects_2d():
+    with pytest.raises(ValueError):
+        DenseVector(np.ones((2, 2)))
+
+
+def test_sparse_basic():
+    v = Vectors.sparse(5, [0, 3], [1.0, 2.0])
+    assert v.size() == 5
+    assert v.get(0) == 1.0
+    assert v.get(1) == 0.0
+    assert v.get(3) == 2.0
+    assert np.array_equal(v.to_array(), [1, 0, 0, 2, 0])
+
+
+def test_sparse_sorts_indices():
+    v = Vectors.sparse(5, [3, 0], [2.0, 1.0])
+    assert list(v.indices) == [0, 3]
+    assert list(v.values) == [1.0, 2.0]
+
+
+def test_sparse_rejects_bad_indices():
+    with pytest.raises(ValueError):
+        Vectors.sparse(3, [0, 3], [1.0, 1.0])
+    with pytest.raises(ValueError):
+        Vectors.sparse(3, [-1], [1.0])
+    with pytest.raises(ValueError):
+        Vectors.sparse(3, [1, 1], [1.0, 2.0])
+
+
+def test_sparse_get_bounds():
+    v = Vectors.sparse(3, [1], [1.0])
+    with pytest.raises(IndexError):
+        v.get(3)
+
+
+def test_sparse_dot():
+    s = Vectors.sparse(4, [1, 2], [2.0, 3.0])
+    d = Vectors.dense(1.0, 1.0, 1.0, 1.0)
+    assert s.dot(d) == 5.0
+    s2 = Vectors.sparse(4, [2, 3], [1.0, 1.0])
+    assert s.dot(s2) == 3.0
+
+
+def test_to_dense():
+    s = Vectors.sparse(3, [1], [7.0])
+    d = s.to_dense()
+    assert isinstance(d, DenseVector)
+    assert np.array_equal(d.to_array(), [0, 7, 0])
+
+
+def test_dense_matrix():
+    m = DenseMatrix(2, 3)
+    assert m.num_rows == 2 and m.num_cols == 3
+    m2 = DenseMatrix(2, 2, np.array([[1.0, 2.0], [3.0, 4.0]]))
+    assert m2.get(0, 1) == 2.0
+    # Flat column-major payload like the reference ctor.
+    m3 = DenseMatrix(2, 2, np.array([1.0, 3.0, 2.0, 4.0]))
+    assert m3 == m2
+
+
+def test_stack_vectors():
+    batch = stack_vectors([Vectors.dense(1.0, 2.0), Vectors.sparse(2, [1], [5.0])])
+    assert batch.shape == (2, 2)
+    assert np.array_equal(batch, [[1, 2], [0, 5]])

@@ -50,6 +50,22 @@ def test_load_with_class_check(tmp_path):
     assert meta["className"].endswith("SumEstimator")
 
 
+def test_load_wrong_class_raises(tmp_path):
+    e = SumEstimator()
+    p = str(tmp_path / "e")
+    e.save(p)
+    with pytest.raises(ValueError):
+        SumModel.load(p)
+
+
+def test_copy_params_across_stage_types(tmp_path):
+    src = SumModel().set_delta(42)
+    dst = SumModel()
+    dst.copy_params_from(src)
+    assert dst.get_delta() == 42
+    assert dst.get_param_map_json()["delta"] == 42
+
+
 def test_model_arrays_round_trip(tmp_path):
     p = str(tmp_path / "m")
     arrays = {"coef": np.arange(5.0), "intercept": np.array([1.5])}
