@@ -1,0 +1,16 @@
+from flinkml_tpu.parallel.mesh import DeviceMesh, pad_to_multiple
+from flinkml_tpu.parallel.collectives import (
+    all_reduce_sum,
+    broadcast,
+    keyed_aggregate,
+    map_partition,
+)
+
+__all__ = [
+    "DeviceMesh",
+    "pad_to_multiple",
+    "all_reduce_sum",
+    "broadcast",
+    "keyed_aggregate",
+    "map_partition",
+]

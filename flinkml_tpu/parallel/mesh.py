@@ -1,0 +1,116 @@
+"""Device mesh and sharding helpers — the parallelism substrate.
+
+The reference's parallelism is Flink operator parallelism: P subtasks over
+partitioned streams, wired by Netty shuffles (SURVEY.md §2.5). Here the
+substrate is a named ``jax.sharding.Mesh``: data parallelism is a sharded
+leading batch axis, model replication is a replicated sharding, and every
+cross-device exchange is an XLA collective over ICI inserted by the compiler
+or written explicitly in ``flinkml_tpu.parallel.collectives``.
+
+The default mesh is 1-D over all local devices with axis ``"data"``; multi-
+axis meshes (e.g. ``{"data": 4, "model": 2}``) are supported so model/expert
+sharding can be layered on without changing this substrate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class DeviceMesh:
+    """A named device mesh plus sharding conveniences.
+
+    Replaces (SURVEY.md §2.5): Flink operator parallelism (data axis),
+    ``.broadcast()`` partitioners + per-TM ``BroadcastContext`` (replicated
+    sharding), and co-location constraints (meaningless in SPMD — every
+    device runs the same program).
+    """
+
+    DATA_AXIS = "data"
+
+    def __init__(
+        self,
+        axis_shapes: Optional[Dict[str, int]] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        if devices is None:
+            devices = jax.devices()
+        if axis_shapes is None:
+            axis_shapes = {self.DATA_AXIS: len(devices)}
+        names = tuple(axis_shapes.keys())
+        shape = tuple(axis_shapes.values())
+        n = int(np.prod(shape))
+        if n > len(devices):
+            raise ValueError(
+                f"mesh shape {dict(axis_shapes)} needs {n} devices, "
+                f"only {len(devices)} available"
+            )
+        device_array = np.asarray(devices[:n]).reshape(shape)
+        self.mesh = Mesh(device_array, names)
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return self.mesh.axis_names
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def axis_size(self, name: str = DATA_AXIS) -> int:
+        return self.mesh.shape[name]
+
+    # -- shardings ---------------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def data_sharding(self) -> NamedSharding:
+        """Leading axis split across the data axis; trailing axes replicated."""
+        return self.sharding(self.DATA_AXIS)
+
+    def replicated_sharding(self) -> NamedSharding:
+        return self.sharding()
+
+    # -- placement ---------------------------------------------------------
+    def shard_batch(self, array) -> jax.Array:
+        """Place a host batch onto the mesh, split along the leading axis.
+
+        The batch's leading dimension must be divisible by the data-axis size
+        (use :func:`pad_to_multiple` first when it is not) — mirroring the
+        reference's ``globalBatchSize / parallelism`` contract
+        (``LogisticRegression.java:334-342``).
+        """
+        n = self.axis_size(self.DATA_AXIS)
+        if array.shape[0] % n != 0:
+            raise ValueError(
+                f"batch dimension {array.shape[0]} not divisible by data-axis "
+                f"size {n}; pad with pad_to_multiple first"
+            )
+        return jax.device_put(array, self.data_sharding())
+
+    def replicate(self, tree):
+        """Replicate a pytree of arrays onto every device (broadcast-model)."""
+        sharding = self.replicated_sharding()
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), tree
+        )
+
+
+def pad_to_multiple(array: np.ndarray, multiple: int, axis: int = 0):
+    """Zero-pad ``array`` along ``axis`` to a multiple; returns (padded, n_valid).
+
+    Algorithms carry ``n_valid`` (or a weight column) so padded rows never
+    contribute to sums — the TPU version of the reference's exact per-task
+    record counts.
+    """
+    n = array.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return array, n
+    pad_width = [(0, 0)] * array.ndim
+    pad_width[axis] = (0, target - n)
+    return np.pad(array, pad_width), n
