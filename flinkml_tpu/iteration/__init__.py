@@ -1,0 +1,21 @@
+from flinkml_tpu.iteration.runtime import (
+    IterationConfig,
+    IterationListener,
+    Iterations,
+    TerminateOnMaxIter,
+    TerminateOnMaxIterOrTol,
+    iterate,
+)
+from flinkml_tpu.iteration.device_loop import device_iterate
+from flinkml_tpu.iteration.checkpoint import CheckpointManager
+
+__all__ = [
+    "IterationConfig",
+    "IterationListener",
+    "Iterations",
+    "TerminateOnMaxIter",
+    "TerminateOnMaxIterOrTol",
+    "iterate",
+    "device_iterate",
+    "CheckpointManager",
+]

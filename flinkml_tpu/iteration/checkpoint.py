@@ -1,0 +1,109 @@
+"""Loop-carry checkpointing: snapshot/restore of (state pytree, epoch).
+
+Parity mapping (SURVEY.md §5 checkpoint/resume): the reference's deepest
+subsystem — aligned barriers, feedback-record logging between alignment and
+the tail's BARRIER record (``Checkpoints.java:43-211``), coordinator-
+serialized snapshots, per-round live-instance tracking — exists because its
+loop state lives *inside a running dataflow*. Here the loop state is an
+explicit pytree on the host/device boundary, so a checkpoint is: pull the
+carry to host, write arrays + a JSON manifest atomically, done. Restore is
+exact (bit-identical arrays, epoch, rng keys included in the carry).
+
+Layout per checkpoint: ``<dir>/ckpt-<epoch>/arrays.npz`` + ``meta.json``;
+a checkpoint is visible only after an atomic rename, so a kill mid-write
+never corrupts the latest checkpoint (the fault-tolerance contract the
+reference gets from Flink's two-phase checkpoint commit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class CheckpointManager:
+    """Numbered checkpoints of an arbitrary pytree under one directory."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- save --------------------------------------------------------------
+    def save(self, state: Any, epoch: int, extra: Optional[dict] = None) -> str:
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        host_leaves = [np.asarray(leaf) for leaf in leaves]
+        final_dir = os.path.join(self.directory, f"ckpt-{epoch}")
+        tmp_dir = tempfile.mkdtemp(dir=self.directory, prefix=".tmp-ckpt-")
+        try:
+            np.savez(
+                os.path.join(tmp_dir, "arrays.npz"),
+                **{f"leaf_{i}": leaf for i, leaf in enumerate(host_leaves)},
+            )
+            meta = {
+                "epoch": int(epoch),
+                "num_leaves": len(host_leaves),
+                "treedef": str(treedef),
+                "extra": extra or {},
+            }
+            with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            if os.path.exists(final_dir):
+                shutil.rmtree(final_dir)
+            os.rename(tmp_dir, final_dir)  # atomic publish
+        except Exception:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            raise
+        self._prune()
+        return final_dir
+
+    # -- restore -----------------------------------------------------------
+    def all_epochs(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("ckpt-"):
+                try:
+                    out.append(int(name[len("ckpt-") :]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_epoch(self) -> Optional[int]:
+        epochs = self.all_epochs()
+        return epochs[-1] if epochs else None
+
+    def restore(self, epoch: int, like: Any) -> Tuple[Any, int]:
+        """Restore the checkpoint at ``epoch``; ``like`` provides the pytree
+        structure (e.g. the init state)."""
+        ckpt_dir = os.path.join(self.directory, f"ckpt-{epoch}")
+        with open(os.path.join(ckpt_dir, "meta.json")) as f:
+            meta = json.load(f)
+        with np.load(os.path.join(ckpt_dir, "arrays.npz")) as z:
+            host_leaves = [z[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+        treedef = jax.tree_util.tree_structure(like)
+        if treedef.num_leaves != len(host_leaves):
+            raise ValueError(
+                f"checkpoint has {len(host_leaves)} leaves but the provided "
+                f"structure has {treedef.num_leaves}"
+            )
+        state = jax.tree_util.tree_unflatten(treedef, host_leaves)
+        return state, int(meta["epoch"])
+
+    def restore_latest(self, like: Any) -> Optional[Tuple[Any, int]]:
+        epoch = self.latest_epoch()
+        if epoch is None:
+            return None
+        return self.restore(epoch, like)
+
+    def _prune(self) -> None:
+        epochs = self.all_epochs()
+        for epoch in epochs[: -self.max_to_keep]:
+            shutil.rmtree(
+                os.path.join(self.directory, f"ckpt-{epoch}"), ignore_errors=True
+            )

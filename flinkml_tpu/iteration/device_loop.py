@@ -1,0 +1,63 @@
+"""Fully on-device iteration via ``lax.while_loop``.
+
+For iteration bodies that are pure jax functions over device-resident data,
+the whole loop compiles into ONE XLA program: zero host round-trips per
+epoch, collectives fused into the loop body. This is the highest-performance
+mode — the host runtime (``flinkml_tpu.iteration.runtime``) exists for
+bodies that need per-epoch host work (data feeding, listeners, checkpoints).
+
+The reference has no analog: its loop must round-trip every epoch through
+feedback channels and coordinator RPC (SURVEY.md §3.2 runtime trace).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("step_fn", "max_iter", "has_tol"))
+def _device_iterate(
+    step_fn, init_state, max_iter: int, tol, has_tol: bool
+):
+    def cond(carry):
+        state, epoch, criteria, done = carry
+        return jnp.logical_and(epoch < max_iter, jnp.logical_not(done))
+
+    def body(carry):
+        state, epoch, _, _ = carry
+        new_state, criteria = step_fn(state, epoch)
+        criteria = jnp.asarray(criteria, dtype=jnp.float32)
+        done = (criteria <= tol) if has_tol else jnp.asarray(False)
+        return new_state, epoch + 1, criteria, done
+
+    init = (
+        init_state,
+        jnp.asarray(0, dtype=jnp.int32),
+        jnp.asarray(jnp.inf, dtype=jnp.float32),
+        jnp.asarray(False),
+    )
+    state, epochs, criteria, _ = jax.lax.while_loop(cond, body, init)
+    return state, epochs, criteria
+
+
+def device_iterate(
+    step_fn: Callable[[Any, jax.Array], Tuple[Any, jax.Array]],
+    init_state: Any,
+    max_iter: int,
+    tol: Optional[float] = None,
+):
+    """Run ``step_fn(state, epoch) -> (state, criteria)`` on device.
+
+    Terminates after ``max_iter`` epochs or when ``criteria <= tol`` (when
+    ``tol`` is given) — the on-device ``TerminateOnMaxIterOrTol``. Shapes
+    must be static across epochs (XLA requirement).
+
+    Returns ``(final_state, epochs_run, last_criteria)``.
+    """
+    has_tol = tol is not None
+    tol_val = jnp.asarray(0.0 if tol is None else tol, dtype=jnp.float32)
+    return _device_iterate(step_fn, init_state, int(max_iter), tol_val, has_tol)

@@ -1,0 +1,242 @@
+"""Iteration runtime tests — the analog of the reference's iteration ITCases
+(``BoundedAllRoundStreamIterationITCase``, ``UnboundedStreamIterationITCase``,
+``BoundedAllRoundCheckpointITCase`` fault injection; SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flinkml_tpu.iteration import (
+    CheckpointManager,
+    IterationConfig,
+    IterationListener,
+    Iterations,
+    TerminateOnMaxIter,
+    TerminateOnMaxIterOrTol,
+    device_iterate,
+    iterate,
+)
+
+
+def test_bounded_replay_sum():
+    # Analog of BoundedAllRoundStreamIterationITCase: 4 "sources" x 1000
+    # records, replayed 5 rounds; the state accumulates the global sum.
+    records = np.arange(4000, dtype=np.float64)
+
+    def step(state, data, epoch):
+        return state + data.sum(), None
+
+    result = Iterations.iterate_bounded_streams_until_termination(
+        step, 0.0, records, IterationConfig(TerminateOnMaxIter(5))
+    )
+    assert result.epochs == 5
+    assert result.state == pytest.approx(5 * records.sum())
+
+
+def test_terminate_on_tol():
+    # criteria halves each epoch; tol hits before max_iter.
+    def step(state, epoch):
+        new = state / 2.0
+        return new, new
+
+    result = iterate(step, 1.0, config=IterationConfig(TerminateOnMaxIterOrTol(100, 0.01)))
+    assert result.state <= 0.01
+    assert result.epochs == 7  # 1/2^7 ≈ 0.0078 <= 0.01
+    assert result.criteria_history[-1] <= 0.01
+
+
+def test_max_iter_validation():
+    with pytest.raises(ValueError):
+        TerminateOnMaxIter(0)
+    with pytest.raises(ValueError):
+        TerminateOnMaxIterOrTol(0, 0.1)
+
+
+def test_listeners_called_per_epoch():
+    events = []
+
+    class Recorder(IterationListener):
+        def on_epoch_watermark_incremented(self, epoch, state):
+            events.append(("epoch", epoch, state))
+
+        def on_iteration_terminated(self, state):
+            events.append(("terminated", state))
+
+    def step(state, epoch):
+        return state + 1, None
+
+    iterate(step, 0, config=IterationConfig(TerminateOnMaxIter(3)), listeners=[Recorder()])
+    assert events == [
+        ("epoch", 0, 1),
+        ("epoch", 1, 2),
+        ("epoch", 2, 3),
+        ("terminated", 3),
+    ]
+
+
+def test_unbounded_stream_consumes_once_each():
+    # Analog of UnboundedStreamIterationITCase: one batch per epoch,
+    # terminates when the stream ends.
+    batches = [np.full(10, i, dtype=np.float64) for i in range(4)]
+
+    def step(state, batch, epoch):
+        return state + batch.sum(), None
+
+    result = Iterations.iterate_unbounded_streams(
+        step, 0.0, batches, IterationConfig(TerminateOnMaxIter(100))
+    )
+    assert result.epochs == 4
+    assert result.state == pytest.approx(sum(b.sum() for b in batches))
+
+
+def test_callable_data_provider_stops_on_none():
+    def provider(epoch):
+        return np.ones(3) if epoch < 6 else None
+
+    def step(state, batch, epoch):
+        return state + batch.sum(), None
+
+    result = iterate(step, 0.0, provider, IterationConfig(TerminateOnMaxIter(100)))
+    assert result.epochs == 6
+    assert result.state == 18.0
+
+
+def test_outputs_collected():
+    def step(state, epoch):
+        return state + 1, None, state * 10
+
+    result = iterate(step, 0, config=IterationConfig(TerminateOnMaxIter(3)))
+    assert result.outputs == [0, 10, 20]
+
+
+def test_jitted_step():
+    @jax.jit
+    def step(state, data, epoch):
+        new = state + jnp.sum(data)
+        return new, jnp.abs(new)
+
+    result = iterate(
+        step,
+        jnp.asarray(0.0),
+        jnp.ones(8),
+        IterationConfig(TerminateOnMaxIter(4)),
+    )
+    assert float(result.state) == 32.0
+
+
+def test_device_iterate_max_iter():
+    def step(state, epoch):
+        return state + 1.0, jnp.asarray(1e9)
+
+    state, epochs, _ = device_iterate(step, jnp.asarray(0.0), max_iter=10)
+    assert float(state) == 10.0 and int(epochs) == 10
+
+
+def test_device_iterate_tol():
+    def step(state, epoch):
+        new = state / 2.0
+        return new, new
+
+    state, epochs, crit = device_iterate(step, jnp.asarray(1.0), max_iter=100, tol=0.01)
+    assert int(epochs) == 7
+    assert float(crit) <= 0.01
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume / fault injection
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_save_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    state = {"w": np.arange(5.0), "rng": jax.random.key_data(jax.random.key(0))}
+    mgr.save(state, epoch=3)
+    restored, epoch = mgr.restore_latest(like=state)
+    assert epoch == 3
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    np.testing.assert_array_equal(restored["rng"], state["rng"])
+
+
+def test_checkpoint_prune(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    for e in range(5):
+        mgr.save({"x": np.array([e])}, epoch=e)
+    assert mgr.all_epochs() == [3, 4]
+
+
+def test_checkpoint_structure_mismatch(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"a": np.ones(2), "b": np.ones(3)}, epoch=0)
+    with pytest.raises(ValueError):
+        mgr.restore(0, like={"a": np.ones(2)})
+
+
+def test_periodic_checkpoint_during_iterate(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=100)
+
+    def step(state, epoch):
+        return state + 1, None
+
+    iterate(
+        step,
+        0,
+        config=IterationConfig(
+            TerminateOnMaxIter(10), checkpoint_interval=3, checkpoint_manager=mgr
+        ),
+    )
+    # epochs 3, 6, 9 plus the terminal epoch 10.
+    assert mgr.all_epochs() == [3, 6, 9, 10]
+
+
+def test_failover_resume_exact(tmp_path):
+    """The BoundedAllRoundCheckpointITCase analog: fail mid-iteration on the
+    first attempt, resume from checkpoint, final result must be EXACTLY the
+    no-failure result."""
+    records = np.arange(100, dtype=np.float64)
+
+    def make_step(fail_at_epoch):
+        calls = {"n": 0}
+
+        def step(state, data, epoch):
+            if fail_at_epoch is not None and epoch == fail_at_epoch:
+                raise RuntimeError("injected failure")
+            return state + data.sum() * (epoch + 1), None
+
+        return step
+
+    config = lambda mgr: IterationConfig(
+        TerminateOnMaxIter(8), checkpoint_interval=2, checkpoint_manager=mgr
+    )
+
+    # Golden: no failure.
+    golden = iterate(
+        make_step(None), 0.0, records, config(CheckpointManager(str(tmp_path / "g")))
+    )
+
+    # Attempt 0: fails at epoch 5 (after the epoch-4 checkpoint).
+    mgr = CheckpointManager(str(tmp_path / "f"))
+    with pytest.raises(RuntimeError):
+        iterate(make_step(5), 0.0, records, config(mgr))
+    assert mgr.latest_epoch() == 4
+
+    # Attempt 1: resume; must converge to the exact same state.
+    result = iterate(make_step(None), 0.0, records, config(mgr), resume=True)
+    assert result.state == golden.state
+    assert mgr.latest_epoch() == 8
+
+
+def test_resume_without_manager_raises():
+    with pytest.raises(ValueError):
+        iterate(lambda s, e: (s, None), 0, resume=True)
+
+
+def test_resume_with_empty_dir_starts_fresh(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    result = iterate(
+        lambda s, e: (s + 1, None),
+        0,
+        config=IterationConfig(TerminateOnMaxIter(3), checkpoint_manager=mgr),
+        resume=True,
+    )
+    assert result.state == 3
