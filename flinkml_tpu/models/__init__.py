@@ -1,0 +1,9 @@
+from flinkml_tpu.models.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+
+__all__ = [
+    "LogisticRegression",
+    "LogisticRegressionModel",
+]

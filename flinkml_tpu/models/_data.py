@@ -1,0 +1,50 @@
+"""Column-extraction helpers shared by algorithms.
+
+The analog of the reference's row→POJO maps (e.g.
+``LogisticRegression.java:111-130`` mapping rows to
+``LabeledPointWithWeight``): tables are already columnar, so "extraction" is
+densifying a features column to ``[n, d]`` and reading label/weight columns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from flinkml_tpu.linalg import Vector, stack_vectors
+from flinkml_tpu.table import Table
+
+
+def features_matrix(table: Table, features_col: str) -> np.ndarray:
+    """Densify a features column to float [n, d].
+
+    Accepts 2-D numeric columns (native layout) or object columns of
+    ``Vector`` / array-likes (row-wise user data).
+    """
+    col = table.column(features_col)
+    if col.dtype == object:
+        return stack_vectors(col)
+    if col.ndim == 1:
+        return col.astype(np.float64).reshape(-1, 1)
+    return np.ascontiguousarray(col, dtype=np.float64)
+
+
+def labeled_data(
+    table: Table,
+    features_col: str,
+    label_col: str,
+    weight_col: Optional[str] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Extract (X [n,d], y [n], w [n]); weight defaults to 1.0 per row."""
+    x = features_matrix(table, features_col)
+    y = np.asarray(table.column(label_col), dtype=np.float64).reshape(-1)
+    if y.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"label column {label_col!r} has {y.shape[0]} rows, features have {x.shape[0]}"
+        )
+    if weight_col is not None:
+        w = np.asarray(table.column(weight_col), dtype=np.float64).reshape(-1)
+    else:
+        w = np.ones(x.shape[0], dtype=np.float64)
+    return x, y, w

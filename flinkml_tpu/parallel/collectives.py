@@ -23,7 +23,6 @@ used with device inputs (each wraps a ``jax.shard_map`` region).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
