@@ -76,6 +76,23 @@ class Model(Transformer):
             f"{type(self).__name__} does not support get_model_data"
         )
 
+    # -- shared persistence scaffold ---------------------------------------
+    def _save_with_arrays(self, path: str, arrays, extra=None) -> None:
+        """Standard model layout: metadata JSON + named arrays under data/."""
+        read_write.save_metadata(self, path, extra=extra)
+        read_write.save_model_arrays(path, arrays)
+
+    @classmethod
+    def _load_with_arrays(cls, path: str):
+        """Counterpart of ``_save_with_arrays``: class-checked metadata,
+        params restored; returns ``(model, arrays, metadata)``."""
+        meta = read_write.load_metadata(
+            path, expected_class_name=f"{cls.__module__}.{cls.__qualname__}"
+        )
+        model = cls()
+        model.load_param_map_json(meta["paramMap"])
+        return model, read_write.load_model_arrays(path), meta
+
 
 class Estimator(Stage):
     """Fits a Model from training tables. Parity: ``Estimator.java:31-38``."""

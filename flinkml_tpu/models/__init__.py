@@ -2,8 +2,20 @@ from flinkml_tpu.models.logistic_regression import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from flinkml_tpu.models.kmeans import KMeans, KMeansModel
+from flinkml_tpu.models.knn import Knn, KnnModel
+from flinkml_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
+from flinkml_tpu.models.one_hot_encoder import OneHotEncoder, OneHotEncoderModel
 
 __all__ = [
     "LogisticRegression",
     "LogisticRegressionModel",
+    "KMeans",
+    "KMeansModel",
+    "Knn",
+    "KnnModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
 ]

@@ -1,0 +1,225 @@
+"""KMeans — Lloyd's algorithm with random init.
+
+Capability parity with ``flink-ml-lib/.../clustering/kmeans/KMeans.java:79-335``
+(+ ``KMeansModel.java``, ``KMeansModelData.java``), rebuilt TPU-first:
+
+  - ``selectRandomCentroids`` (mapPartition + shuffle at parallelism 1,
+    ``KMeans.java:314-335``) → seeded host choice of k distinct rows.
+  - The per-epoch machinery — broadcast centroids into a 2-input
+    ``SelectNearestCentroidOperator`` caching points in ListState
+    (``:239-312``), per-round keyed reduce (``CountAppender``/
+    ``CentroidAccumulator``/``CentroidAverager`` + ``EndOfStreamWindows``,
+    ``:174-235``) — becomes one fused XLA program: pairwise-distance argmin
+    on the MXU, per-cluster sums via a one-hot matmul (k is small; a matmul
+    beats scatter on TPU), ``psum`` across the data axis, centroid update —
+    the whole Lloyd loop in a single ``lax.while_loop`` on device.
+  - Termination: ``TerminateOnMaxIter`` (``:150-151``); the reference has no
+    tol-based stop for KMeans.
+  - Empty clusters keep their previous centroid (the reference's keyed
+    reduce simply never emits for an empty cluster, leaving it unchanged).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import (
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasK,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+)
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.params import IntParam, ParamValidators, StringParam
+from flinkml_tpu.ops import blas
+from flinkml_tpu.ops.distance import DistanceMeasure
+from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
+from flinkml_tpu.table import Table
+
+
+class _KMeansParams(
+    HasDistanceMeasure, HasFeaturesCol, HasPredictionCol, HasK, HasMaxIter, HasSeed
+):
+    """Reference: KMeansParams. KMeans redefines ``k`` (clusters, default 2,
+    > 1 — ``KMeansModelParams`` declares gt(1)) over HasK's
+    nearest-neighbors variant.
+
+    ``initMode`` is an addition over the reference (random init only there,
+    ``KMeans.java:314-335``): "k-means++" gives sklearn-quality starts.
+    """
+
+    K = IntParam(
+        "k", "The number of clusters to create.", 2, ParamValidators.gt(1)
+    )
+
+    INIT_MODE = StringParam(
+        "initMode", "Centroid initialization: random or k-means++.", "random",
+        ParamValidators.in_array(["random", "k-means++"]),
+    )
+
+
+class KMeans(_KMeansParams, Estimator):
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "KMeansModel":
+        (table,) = inputs
+        x = features_matrix(table, self.get(_KMeansParams.FEATURES_COL))
+        k = self.get(_KMeansParams.K)
+        if x.shape[0] < k:
+            raise ValueError(f"k={k} exceeds number of points {x.shape[0]}")
+        measure = self.get(_KMeansParams.DISTANCE_MEASURE)
+        if measure != "euclidean":
+            raise ValueError(
+                "KMeans currently supports the euclidean distance measure "
+                f"(parity with the reference), got {measure!r}"
+            )
+        centroids = train_kmeans(
+            x,
+            k=k,
+            mesh=self.mesh or DeviceMesh(),
+            max_iter=self.get(_KMeansParams.MAX_ITER),
+            seed=self.get_seed(),
+            init_mode=self.get(_KMeansParams.INIT_MODE),
+        )
+        model = KMeansModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"centroids": centroids[None, :, :]}))
+        return model
+
+
+class KMeansModel(_KMeansParams, Model):
+    """Nearest-centroid prediction (broadcast-model pattern,
+    ``KMeansModel.java``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._centroids: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "KMeansModel":
+        (table,) = inputs
+        c = np.asarray(table.column("centroids"), dtype=np.float64)
+        self._centroids = c.reshape(c.shape[-2], c.shape[-1])
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"centroids": self._centroids[None, :, :]})]
+
+    @property
+    def centroids(self) -> np.ndarray:
+        self._require_model()
+        return self._centroids
+
+    def _require_model(self) -> None:
+        if self._centroids is None:
+            raise ValueError("Model data is not set; call set_model_data or fit first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require_model()
+        x = features_matrix(table, self.get(_KMeansParams.FEATURES_COL))
+        measure = DistanceMeasure.get_instance(
+            self.get(_KMeansParams.DISTANCE_MEASURE)
+        )
+        assign = np.asarray(
+            measure.nearest(jnp.asarray(x), jnp.asarray(self._centroids))
+        )
+        return (
+            table.with_column(self.get(_KMeansParams.PREDICTION_COL), assign),
+        )
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        self._save_with_arrays(path, {"centroids": self._centroids})
+
+    @classmethod
+    def load(cls, path: str) -> "KMeansModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._centroids = arrays["centroids"]
+        return model
+
+
+@functools.lru_cache(maxsize=64)
+def _kmeans_trainer(mesh, k: int, axis: str):
+    """Whole Lloyd loop as one XLA program, cached per (mesh, k)."""
+
+    def per_device(xl, wl, init_centroids, max_iter):
+        def body(_, centroids):
+            # Assignment: argmin over pairwise squared distances (MXU matmul).
+            d2 = blas.squared_distances(xl, centroids)
+            assign = jnp.argmin(d2, axis=-1)
+            # Per-cluster sums via one-hot matmul; padded rows have w=0.
+            onehot = jax.nn.one_hot(assign, k, dtype=xl.dtype) * wl[:, None]
+            sums = jax.lax.psum(onehot.T @ xl, axis)
+            counts = jax.lax.psum(jnp.sum(onehot, axis=0), axis)
+            # Empty clusters keep their previous centroid.
+            safe = jnp.maximum(counts, 1.0)[:, None]
+            new_centroids = jnp.where(
+                counts[:, None] > 0, sums / safe, centroids
+            )
+            return new_centroids
+
+        return jax.lax.fori_loop(0, max_iter, body, init_centroids)
+
+    return jax.jit(
+        jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(), P()),
+            out_specs=P(),
+        )
+    )
+
+
+def _kmeans_pp_init(x: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
+    """k-means++ seeding: each next centroid sampled ∝ distance² to the
+    nearest chosen one."""
+    centroids = [x[rng.integers(x.shape[0])]]
+    d2 = ((x - centroids[0]) ** 2).sum(-1)
+    for _ in range(1, k):
+        probs = d2 / d2.sum() if d2.sum() > 0 else np.full(len(x), 1.0 / len(x))
+        nxt = x[rng.choice(x.shape[0], p=probs)]
+        centroids.append(nxt)
+        d2 = np.minimum(d2, ((x - nxt) ** 2).sum(-1))
+    return np.stack(centroids)
+
+
+def train_kmeans(
+    x: np.ndarray,
+    k: int,
+    mesh: DeviceMesh,
+    max_iter: int,
+    seed: int,
+    init_mode: str = "random",
+) -> np.ndarray:
+    """Returns centroids [k, d]; the full loop runs on device."""
+    rng = np.random.default_rng(seed)
+    if init_mode == "k-means++":
+        init_centroids = _kmeans_pp_init(x, k, rng)
+    else:
+        init_idx = rng.choice(x.shape[0], size=k, replace=False)
+        init_centroids = np.ascontiguousarray(x[init_idx])
+
+    p_size = mesh.axis_size()
+    x_pad, n_valid = pad_to_multiple(x, p_size)
+    w = np.zeros(x_pad.shape[0], dtype=x.dtype)
+    w[:n_valid] = 1.0  # mask: padded rows never influence centroids
+    xd = mesh.shard_batch(x_pad)
+    wd = mesh.shard_batch(w)
+
+    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS)
+    centroids = trainer(
+        xd, wd, jnp.asarray(init_centroids), jnp.asarray(max_iter, jnp.int32)
+    )
+    return np.asarray(centroids)

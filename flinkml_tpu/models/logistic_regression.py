@@ -51,7 +51,6 @@ from flinkml_tpu.common_params import (
     HasTol,
     HasWeightCol,
 )
-from flinkml_tpu.io import read_write
 from flinkml_tpu.iteration import IterationConfig, TerminateOnMaxIterOrTol, iterate
 from flinkml_tpu.models._data import features_matrix, labeled_data
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
@@ -181,17 +180,11 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model):
     # -- persistence -------------------------------------------------------
     def save(self, path: str) -> None:
         self._require_model()
-        read_write.save_metadata(self, path)
-        read_write.save_model_arrays(path, {"coefficient": self._coefficient})
+        self._save_with_arrays(path, {"coefficient": self._coefficient})
 
     @classmethod
     def load(cls, path: str) -> "LogisticRegressionModel":
-        meta = read_write.load_metadata(
-            path, expected_class_name=f"{cls.__module__}.{cls.__qualname__}"
-        )
-        model = cls()
-        model.load_param_map_json(meta["paramMap"])
-        arrays = read_write.load_model_arrays(path)
+        model, arrays, _ = cls._load_with_arrays(path)
         model._coefficient = arrays["coefficient"]
         return model
 

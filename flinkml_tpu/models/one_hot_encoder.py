@@ -1,0 +1,162 @@
+"""OneHotEncoder — integer category columns → one-hot vectors.
+
+Capability parity with
+``flink-ml-lib/.../feature/onehotencoder/OneHotEncoder.java:51-147`` and
+``OneHotEncoderModel.java:56-190``:
+
+  - ``fit`` finds the max category index per input column (the reference's
+    keyed mapPartition; here a column max).
+  - Model data = (columnIndex, maxIndex) pairs; vector size =
+    ``maxIndex + (0 if dropLast else 1)``; encoding value v yields a vector
+    with 1.0 at v, and the LAST category (v == size) encodes as the empty
+    vector when dropLast (``OneHotEncoderModel.java:160-183``).
+  - ``handleInvalid`` supports "error" (reject v > max or non-integral —
+    the reference's only supported mode, ``OneHotEncoderModel.java:71``),
+    plus "keep" (clamp into an extra catch-all category) and "skip" is
+    rejected explicitly.
+
+TPU-first: output columns are dense ``[n, size]`` one-hot matrices (batched,
+MXU-ready) rather than per-row SparseVector objects; the information content
+is identical and downstream algorithms consume whole columns.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import HasHandleInvalid, HasInputCols, HasOutputCols
+from flinkml_tpu.params import BoolParam
+from flinkml_tpu.table import Table
+
+
+class _OneHotEncoderParams(HasInputCols, HasOutputCols, HasHandleInvalid):
+    DROP_LAST = BoolParam("dropLast", "Whether to drop the last category.", True)
+
+
+class OneHotEncoder(_OneHotEncoderParams, Estimator):
+    def __init__(self):
+        super().__init__()
+
+    def fit(self, *inputs: Table) -> "OneHotEncoderModel":
+        (table,) = inputs
+        input_cols = self.get(_OneHotEncoderParams.INPUT_COLS)
+        if not input_cols:
+            raise ValueError("inputCols must be set")
+        max_indices = []
+        for col in input_cols:
+            values = np.asarray(table.column(col), dtype=np.float64)
+            _check_indexed(values, col)
+            if (values < 0).any():
+                raise ValueError(f"Column {col!r} contains negative category values")
+            max_indices.append(int(values.max()))
+        model = OneHotEncoderModel()
+        model.copy_params_from(self)
+        model.set_model_data(
+            Table(
+                {
+                    "columnIndex": np.arange(len(input_cols)),
+                    "maxIndex": np.asarray(max_indices),
+                }
+            )
+        )
+        return model
+
+
+class OneHotEncoderModel(_OneHotEncoderParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._max_indices: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "OneHotEncoderModel":
+        (table,) = inputs
+        order = np.argsort(np.asarray(table.column("columnIndex")))
+        self._max_indices = np.asarray(table.column("maxIndex"))[order].astype(int)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [
+            Table(
+                {
+                    "columnIndex": np.arange(len(self._max_indices)),
+                    "maxIndex": self._max_indices.copy(),
+                }
+            )
+        ]
+
+    def _require_model(self) -> None:
+        if self._max_indices is None:
+            raise ValueError("Model data is not set; call set_model_data or fit first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require_model()
+        input_cols = self.get(_OneHotEncoderParams.INPUT_COLS)
+        output_cols = self.get(_OneHotEncoderParams.OUTPUT_COLS)
+        handle_invalid = self.get(_OneHotEncoderParams.HANDLE_INVALID)
+        if handle_invalid == HasHandleInvalid.SKIP_INVALID:
+            raise ValueError(
+                "handleInvalid='skip' is not supported (parity with the "
+                "reference, which supports 'error' only)"
+            )
+        if len(input_cols) != len(output_cols):
+            raise ValueError(
+                f"{len(input_cols)} input columns vs {len(output_cols)} output columns"
+            )
+        if len(input_cols) != len(self._max_indices):
+            raise ValueError(
+                f"model was fit on {len(self._max_indices)} columns, got {len(input_cols)}"
+            )
+        drop_last = self.get(_OneHotEncoderParams.DROP_LAST)
+        out = table
+        for col, out_col, max_idx in zip(input_cols, output_cols, self._max_indices):
+            values = np.asarray(table.column(col), dtype=np.float64)
+            _check_indexed(values, col)
+            idx = values.astype(int)
+            # Valid values are [0, base_size]; idx == base_size encodes as
+            # the all-zero vector (the reference's dropped-last rule,
+            # OneHotEncoderModel.java:176-183).
+            base_size = int(max_idx) + (0 if drop_last else 1)
+            invalid = (idx < 0) | (idx > base_size)
+            keep = handle_invalid == HasHandleInvalid.KEEP_INVALID
+            if keep:
+                # Invalids go to an extra catch-all slot appended AFTER
+                # base_size, keeping every valid encoding (including the
+                # all-zero dropped-last one) unchanged and distinguishable.
+                size = base_size + 1
+                hot = np.where(invalid, base_size, idx)
+                zero_row = ~invalid & (idx == base_size)
+            else:
+                if invalid.any():
+                    raise ValueError(
+                        f"Column {col!r} contains categories outside "
+                        f"[0, {base_size}]: {idx[invalid][:5]}"
+                    )
+                size = base_size
+                hot = idx
+                zero_row = idx == base_size
+            onehot = np.zeros((len(idx), size), dtype=np.float64)
+            rows = np.nonzero(~zero_row)[0]
+            onehot[rows, hot[rows]] = 1.0
+            out = out.with_column(out_col, onehot)
+        return (out,)
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        self._save_with_arrays(path, {"maxIndex": self._max_indices})
+
+    @classmethod
+    def load(cls, path: str) -> "OneHotEncoderModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._max_indices = arrays["maxIndex"].astype(int)
+        return model
+
+
+def _check_indexed(values: np.ndarray, col: str) -> None:
+    if not np.all(values == np.round(values)):
+        raise ValueError(
+            f"Value in column {col!r} cannot be parsed as indexed integer."
+        )

@@ -1,0 +1,111 @@
+"""KMeans tests — mirrors the reference's KMeansTest."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import KMeans, KMeansModel
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+def blob_table(rng, centers, n_per=50, scale=0.3):
+    pts = np.concatenate(
+        [rng.normal(loc=c, scale=scale, size=(n_per, len(c))) for c in centers]
+    )
+    return Table({"features": pts}), np.repeat(np.arange(len(centers)), n_per)
+
+
+def test_param_defaults():
+    km = KMeans()
+    assert km.get_k() == 2
+    assert km.get_max_iter() == 20
+    assert km.get_distance_measure() == "euclidean"
+    assert km.get_features_col() == "features"
+    assert km.get_prediction_col() == "prediction"
+
+
+def test_fit_two_blobs(rng):
+    table, truth = blob_table(rng, [(0.0, 0.0), (10.0, 10.0)])
+    model = KMeans().set_seed(1).fit(table)
+    (out,) = model.transform(table)
+    pred = out["prediction"]
+    # Clusters must perfectly separate the two blobs (up to label swap).
+    a, b = pred[truth == 0], pred[truth == 1]
+    assert len(np.unique(a)) == 1 and len(np.unique(b)) == 1
+    assert a[0] != b[0]
+    # Centroids near blob centers.
+    c = np.sort(model.centroids[:, 0])
+    np.testing.assert_allclose(c, [0.0, 10.0], atol=0.5)
+
+
+def test_against_sklearn_inertia(rng):
+    from sklearn.cluster import KMeans as SkKMeans
+
+    table, _ = blob_table(rng, [(0, 0), (5, 5), (0, 6)], n_per=60)
+    x = table["features"]
+    ours = (
+        KMeans().set_seed(3).set_k(3).set_max_iter(50)
+        .set_init_mode("k-means++").fit(table)
+    )
+    sk = SkKMeans(n_clusters=3, n_init=10, random_state=0).fit(x)
+
+    def inertia(centroids):
+        d2 = ((x[:, None, :] - centroids[None, :, :]) ** 2).sum(-1)
+        return d2.min(axis=1).sum()
+
+    assert inertia(ours.centroids) <= inertia(sk.cluster_centers_) * 1.10
+
+
+def test_multi_device(rng):
+    table, truth = blob_table(rng, [(0.0, 0.0), (8.0, 8.0)], n_per=101)
+    model = KMeans(mesh=DeviceMesh()).set_seed(5).set_max_iter(30).fit(table)
+    (out,) = model.transform(table)
+    pred = out["prediction"]
+    assert len(np.unique(pred[truth == 0])) == 1
+    assert len(np.unique(pred[truth == 1])) == 1
+
+
+def test_empty_cluster_keeps_centroid(rng):
+    # k=3 on data with 2 tight blobs: one centroid may end up empty; the
+    # fit must not produce NaNs.
+    table, _ = blob_table(rng, [(0.0, 0.0), (10.0, 10.0)], n_per=20, scale=0.01)
+    model = KMeans().set_seed(2).set_k(3).set_max_iter(30).fit(table)
+    assert np.isfinite(model.centroids).all()
+
+
+def test_k_exceeds_points():
+    table = Table({"features": np.zeros((3, 2))})
+    with pytest.raises(ValueError, match="k="):
+        KMeans().set_k(5).fit(table)
+
+
+def test_k_must_exceed_one():
+    # Parity: KMeansModelParams declares k with gt(1).
+    with pytest.raises(ValueError):
+        KMeans().set_k(1)
+
+
+def test_save_load(tmp_path, rng):
+    table, _ = blob_table(rng, [(0, 0), (9, 9)])
+    model = KMeans().set_seed(7).fit(table)
+    p = str(tmp_path / "km")
+    model.save(p)
+    loaded = KMeansModel.load(p)
+    np.testing.assert_array_equal(loaded.centroids, model.centroids)
+    (a,) = model.transform(table)
+    (b,) = loaded.transform(table)
+    np.testing.assert_array_equal(a["prediction"], b["prediction"])
+
+
+def test_model_data_round_trip(rng):
+    table, _ = blob_table(rng, [(0, 0), (9, 9)])
+    model = KMeans().set_seed(7).fit(table)
+    other = KMeansModel().set_model_data(*model.get_model_data())
+    np.testing.assert_array_equal(other.centroids, model.centroids)
+
+
+def test_deterministic(rng):
+    table, _ = blob_table(rng, [(0, 0), (9, 9)])
+    c1 = KMeans().set_seed(11).fit(table).centroids
+    c2 = KMeans().set_seed(11).fit(table).centroids
+    np.testing.assert_array_equal(c1, c2)

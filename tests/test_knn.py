@@ -1,0 +1,81 @@
+"""Knn tests — mirrors the reference's KnnTest."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import Knn, KnnModel
+from flinkml_tpu.table import Table
+
+
+@pytest.fixture
+def train_table(rng):
+    x0 = rng.normal(loc=(0, 0), scale=0.5, size=(40, 2))
+    x1 = rng.normal(loc=(6, 6), scale=0.5, size=(40, 2))
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(40), np.ones(40) * 3.0])  # labels 0.0 / 3.0
+    return Table({"features": x, "label": y})
+
+
+def test_param_defaults():
+    knn = Knn()
+    assert knn.get_k() == 5
+    assert knn.get_features_col() == "features"
+    assert knn.get_label_col() == "label"
+
+
+def test_fit_predict(train_table):
+    model = Knn().fit(train_table)
+    queries = Table({"features": np.array([[0.2, 0.1], [5.9, 6.2], [-0.5, 0.3]])})
+    (out,) = model.transform(queries)
+    np.testing.assert_array_equal(out["prediction"], [0.0, 3.0, 0.0])
+
+
+def test_against_sklearn(train_table, rng):
+    from sklearn.neighbors import KNeighborsClassifier
+
+    q = rng.normal(loc=(3, 3), scale=3.0, size=(50, 2))
+    model = Knn().set_k(7).fit(train_table)
+    (out,) = model.transform(Table({"features": q}))
+    sk = KNeighborsClassifier(n_neighbors=7).fit(
+        train_table["features"], train_table["label"]
+    )
+    agreement = np.mean(out["prediction"] == sk.predict(q))
+    assert agreement >= 0.95  # ties may break differently
+
+
+def test_k_larger_than_train_raises(train_table):
+    model = Knn().set_k(200).fit(train_table)
+    with pytest.raises(ValueError, match="k="):
+        model.transform(Table({"features": np.zeros((1, 2))}))
+
+
+def test_chunked_queries(train_table, rng):
+    model = Knn().fit(train_table)
+    model_chunked = Knn().fit(train_table)
+    KnnModel.CHUNK = 7  # force multiple chunks
+    try:
+        q = Table({"features": rng.normal(size=(23, 2))})
+        (a,) = model.transform(q)
+        (b,) = model_chunked.transform(q)
+        np.testing.assert_array_equal(a["prediction"], b["prediction"])
+    finally:
+        KnnModel.CHUNK = 4096
+
+
+def test_save_load(tmp_path, train_table):
+    model = Knn().set_k(3).fit(train_table)
+    p = str(tmp_path / "knn")
+    model.save(p)
+    loaded = KnnModel.load(p)
+    assert loaded.get_k() == 3
+    q = Table({"features": np.array([[0.0, 0.0], [6.0, 6.0]])})
+    np.testing.assert_array_equal(
+        model.transform(q)[0]["prediction"], loaded.transform(q)[0]["prediction"]
+    )
+
+
+def test_model_data_round_trip(train_table):
+    model = Knn().fit(train_table)
+    other = KnnModel().set_model_data(*model.get_model_data())
+    q = Table({"features": np.array([[6.1, 5.9]])})
+    assert other.transform(q)[0]["prediction"][0] == 3.0

@@ -1,0 +1,123 @@
+"""OneHotEncoder tests — mirrors the reference's OneHotEncoderTest."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import OneHotEncoder, OneHotEncoderModel
+from flinkml_tpu.table import Table
+
+
+@pytest.fixture
+def train_table():
+    return Table({"c1": np.array([0.0, 1.0, 2.0, 2.0]), "c2": np.array([0.0, 1.0, 0.0, 1.0])})
+
+
+def make_encoder():
+    return OneHotEncoder().set_input_cols(["c1", "c2"]).set_output_cols(["o1", "o2"])
+
+
+def test_drop_last_default(train_table):
+    model = make_encoder().fit(train_table)
+    (out,) = model.transform(train_table)
+    # c1 has max index 2 -> size 2 with dropLast; value 2 -> all zeros.
+    np.testing.assert_array_equal(
+        out["o1"], [[1, 0], [0, 1], [0, 0], [0, 0]]
+    )
+    # c2 max index 1 -> size 1; value 1 -> empty.
+    np.testing.assert_array_equal(out["o2"], [[1], [0], [1], [0]])
+
+
+def test_without_drop_last(train_table):
+    model = make_encoder().set_drop_last(False).fit(train_table)
+    (out,) = model.transform(train_table)
+    np.testing.assert_array_equal(
+        out["o1"], [[1, 0, 0], [0, 1, 0], [0, 0, 1], [0, 0, 1]]
+    )
+
+
+def test_error_on_out_of_range(train_table):
+    model = make_encoder().fit(train_table)
+    bad = Table({"c1": np.array([5.0]), "c2": np.array([0.0])})
+    with pytest.raises(ValueError, match="categories outside"):
+        model.transform(bad)
+
+
+def test_error_on_non_integer(train_table):
+    model = make_encoder().fit(train_table)
+    bad = Table({"c1": np.array([0.5]), "c2": np.array([0.0])})
+    with pytest.raises(ValueError, match="indexed integer"):
+        model.transform(bad)
+
+
+def test_keep_invalid(train_table):
+    model = make_encoder().set_handle_invalid("keep").fit(train_table)
+    bad = Table({"c1": np.array([0.0, 7.0, 2.0]), "c2": np.array([0.0, 0.0, 0.0])})
+    (out,) = model.transform(bad)
+    # keep: extra catch-all category at the end.
+    assert out["o1"].shape == (3, 3)
+    np.testing.assert_array_equal(out["o1"][1], [0, 0, 1])
+    # The VALID dropped-last category (2) keeps its all-zero encoding and
+    # stays distinguishable from invalid values.
+    np.testing.assert_array_equal(out["o1"][2], [0, 0, 0])
+
+
+def test_skip_invalid_rejected(train_table):
+    model = make_encoder().set_handle_invalid("skip").fit(train_table)
+    with pytest.raises(ValueError, match="skip"):
+        model.transform(train_table)
+
+
+def test_negative_category_rejected():
+    t = Table({"c1": np.array([-1.0, 0.0])})
+    with pytest.raises(ValueError, match="negative"):
+        OneHotEncoder().set_input_cols(["c1"]).set_output_cols(["o1"]).fit(t)
+
+
+def test_missing_input_cols():
+    with pytest.raises(ValueError, match="inputCols"):
+        OneHotEncoder().fit(Table({"c1": np.array([0.0])}))
+
+
+def test_save_load(tmp_path, train_table):
+    model = make_encoder().fit(train_table)
+    p = str(tmp_path / "ohe")
+    model.save(p)
+    loaded = OneHotEncoderModel.load(p)
+    assert loaded.get_input_cols() == ["c1", "c2"]
+    (a,) = model.transform(train_table)
+    (b,) = loaded.transform(train_table)
+    np.testing.assert_array_equal(a["o1"], b["o1"])
+
+
+def test_model_data_round_trip(train_table):
+    model = make_encoder().fit(train_table)
+    other = (
+        OneHotEncoderModel()
+        .set_input_cols(["c1", "c2"])
+        .set_output_cols(["o1", "o2"])
+        .set_model_data(*model.get_model_data())
+    )
+    (a,) = model.transform(train_table)
+    (b,) = other.transform(train_table)
+    np.testing.assert_array_equal(a["o2"], b["o2"])
+
+
+def test_in_pipeline_with_lr(train_table):
+    """OneHotEncoder -> LogisticRegression chained in a Pipeline (the
+    reference's canonical pipeline composition)."""
+    from flinkml_tpu.models import LogisticRegression
+    from flinkml_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(0)
+    c = rng.integers(0, 3, size=80).astype(np.float64)
+    y = (c == 2).astype(np.float64)
+    t = Table({"c1": c, "label": y})
+    pipeline = Pipeline(
+        [
+            OneHotEncoder().set_input_cols(["c1"]).set_output_cols(["features"]).set_drop_last(False),
+            LogisticRegression().set_seed(0).set_max_iter(200).set_learning_rate(1.0),
+        ]
+    )
+    pm = pipeline.fit(t)
+    (out,) = pm.transform(t)
+    assert np.mean(out["prediction"] == y) == 1.0
