@@ -45,7 +45,7 @@ def bench_tpu(x, y, w, global_batch_size, n_steps):
     local_bs = min(global_batch_size // mesh.axis_size(), xd.shape[0] // mesh.axis_size())
     trainer = _device_trainer(mesh.mesh, local_bs, DeviceMesh.DATA_AXIS)
     f32 = lambda v: jnp.asarray(v, xd.dtype)
-    args = (xd, yd, wd, f32(0.1), f32(0.0), f32(0.0))
+    args = (xd, yd, wd, f32(0.1), f32(0.0), f32(0.0), f32(0.0))
     # Warm-up compiles the whole-run program.
     np.asarray(trainer(*args, jnp.asarray(10, jnp.int32)))
     start = time.perf_counter()

@@ -6,6 +6,15 @@ from flinkml_tpu.models.kmeans import KMeans, KMeansModel
 from flinkml_tpu.models.knn import Knn, KnnModel
 from flinkml_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from flinkml_tpu.models.one_hot_encoder import OneHotEncoder, OneHotEncoderModel
+from flinkml_tpu.models.linear_svc import LinearSVC, LinearSVCModel
+from flinkml_tpu.models.linear_regression import (
+    LinearRegression,
+    LinearRegressionModel,
+)
+from flinkml_tpu.models.online_logistic_regression import (
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)
 
 __all__ = [
     "LogisticRegression",
@@ -18,4 +27,10 @@ __all__ = [
     "NaiveBayesModel",
     "OneHotEncoder",
     "OneHotEncoderModel",
+    "LinearSVC",
+    "LinearSVCModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+    "OnlineLogisticRegression",
+    "OnlineLogisticRegressionModel",
 ]

@@ -1,0 +1,46 @@
+"""Shared scaffold for models whose data is a single coefficient vector."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from flinkml_tpu.table import Table
+
+
+class CoefficientModelMixin:
+    """set/get model data, save/load, and the fitted-check for coefficient
+    models (LogisticRegression, LinearSVC, LinearRegression, online LR)."""
+
+    _coefficient: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table):
+        (table,) = inputs
+        self._coefficient = np.asarray(
+            table.column("coefficient"), dtype=np.float64
+        ).reshape(-1)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"coefficient": self._coefficient[None, :]})]
+
+    @property
+    def coefficient(self) -> np.ndarray:
+        self._require_model()
+        return self._coefficient
+
+    def _require_model(self) -> None:
+        if self._coefficient is None:
+            raise ValueError("Model data is not set; call set_model_data or fit first")
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        self._save_with_arrays(path, {"coefficient": self._coefficient})
+
+    @classmethod
+    def load(cls, path: str):
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._coefficient = arrays["coefficient"]
+        return model

@@ -1,0 +1,294 @@
+"""Generic distributed SGD for linear models (dense and sparse).
+
+One trainer serves LogisticRegression, LinearSVC, and LinearRegression: the
+models differ only in ``d loss/d margin``, so the loss enters as a static
+key selecting a margin-gradient function, and everything else — window
+slicing, MXU matvec, ``psum``, proximal update, ``lax.while_loop``
+termination — is shared. This is the TPU inversion of the reference's
+``CacheDataAndDoTrain`` machinery (``LogisticRegression.java:334-397``);
+see ``logistic_regression.py`` for the full mapping.
+
+Losses (margins use labels y ∈ {0,1} mapped to ys = 2y-1 where relevant):
+  - ``logistic``: loss = w·log(1+exp(-dot·ys)); matches
+    ``LogisticGradient.java:50-96``.
+  - ``hinge`` (LinearSVC): loss = w·max(0, 1 - dot·ys).
+  - ``squared`` (LinearRegression): loss = w·(dot - y)²/2.
+
+Regularization: L2 enters the gradient; L1 (elastic net) is applied as a
+proximal soft-threshold after the gradient step — the "proximal SGD step"
+of BASELINE.json config #3.
+
+The sparse path consumes padded ELL batches (``flinkml_tpu.ops.sparse``):
+forward = gather+row-sum, gradient = flat segment-sum scatter — the
+Criteo-scale path (config #5).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
+
+_LOSS_KEYS = ("logistic", "hinge", "squared")
+
+
+def _margin_grad(loss: str, dot, y, w):
+    """Returns (dloss/ddot weighted, per-example loss weighted)."""
+    if loss == "logistic":
+        ys = 2.0 * y - 1.0
+        margin = dot * ys
+        mult = w * (-ys * jax.nn.sigmoid(-margin))
+        per_ex = w * jax.nn.softplus(-margin)
+    elif loss == "hinge":
+        ys = 2.0 * y - 1.0
+        margin = dot * ys
+        active = (margin < 1.0).astype(dot.dtype)
+        mult = w * (-ys * active)
+        per_ex = w * jnp.maximum(0.0, 1.0 - margin)
+    elif loss == "squared":
+        resid = dot - y
+        mult = w * resid
+        per_ex = 0.5 * w * resid * resid
+    else:  # pragma: no cover - guarded by callers
+        raise ValueError(f"unknown loss {loss!r}")
+    return mult, per_ex
+
+
+def _soft_threshold(x, t):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+def _window(arr, epoch, local_bs):
+    """Contiguous rotating window with ceil coverage (tail included via
+    dynamic_slice clamping)."""
+    n_windows = max(-(-arr.shape[0] // local_bs), 1)
+    start = (jnp.asarray(epoch, jnp.int32) % n_windows) * local_bs
+    zero = jnp.zeros((), dtype=start.dtype)
+    if arr.ndim == 1:
+        return jax.lax.dynamic_slice(arr, (start,), (local_bs,))
+    return jax.lax.dynamic_slice(arr, (start, zero), (local_bs, arr.shape[1]))
+
+
+def make_dense_step(loss: str, local_bs: int, axis: str):
+    """Per-device epoch: window → margin grad on MXU → psum → prox update."""
+
+    def step(coef, epoch, xl, yl, wl, learning_rate, reg_l2, reg_l1):
+        xb = _window(xl, epoch, local_bs)
+        yb = _window(yl, epoch, local_bs)
+        wb = _window(wl, epoch, local_bs)
+        dot = xb @ coef
+        mult, per_ex = _margin_grad(loss, dot, yb, wb)
+        grad = jax.lax.psum(xb.T @ mult, axis)
+        loss_sum = jax.lax.psum(jnp.sum(per_ex), axis)
+        wsum = jax.lax.psum(jnp.sum(wb), axis)
+        grad = grad + 2.0 * reg_l2 * coef
+        loss_sum = loss_sum + reg_l2 * jnp.sum(coef * coef)
+        step_size = learning_rate / wsum
+        new_coef = _soft_threshold(coef - step_size * grad, step_size * reg_l1)
+        return new_coef, loss_sum / wsum
+
+    return step
+
+
+def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
+    """Sparse (padded-ELL) variant: gather forward, segment-sum gradient."""
+
+    def step(coef, epoch, idxl, vall, yl, wl, learning_rate, reg_l2, reg_l1):
+        ib = _window(idxl, epoch, local_bs)
+        vb = _window(vall, epoch, local_bs)
+        yb = _window(yl, epoch, local_bs)
+        wb = _window(wl, epoch, local_bs)
+        dot = jnp.sum(vb * coef[ib], axis=1)
+        mult, per_ex = _margin_grad(loss, dot, yb, wb)
+        contrib = (vb * mult[:, None]).reshape(-1)
+        grad_local = jax.ops.segment_sum(
+            contrib, ib.reshape(-1), num_segments=dim
+        )
+        grad = jax.lax.psum(grad_local, axis)
+        loss_sum = jax.lax.psum(jnp.sum(per_ex), axis)
+        wsum = jax.lax.psum(jnp.sum(wb), axis)
+        grad = grad + 2.0 * reg_l2 * coef
+        loss_sum = loss_sum + reg_l2 * jnp.sum(coef * coef)
+        step_size = learning_rate / wsum
+        new_coef = _soft_threshold(coef - step_size * grad, step_size * reg_l1)
+        return new_coef, loss_sum / wsum
+
+    return step
+
+
+@functools.lru_cache(maxsize=128)
+def _dense_trainer(mesh, loss: str, local_bs: int, axis: str):
+    local_step = make_dense_step(loss, local_bs, axis)
+
+    def per_device(xl, yl, wl, learning_rate, reg_l2, reg_l1, tol, max_iter):
+        def cond(carry):
+            _, epoch, cur = carry
+            return jnp.logical_and(epoch < max_iter, cur > tol)
+
+        def body(carry):
+            coef, epoch, _ = carry
+            new_coef, mean_loss = local_step(
+                coef, epoch, xl, yl, wl, learning_rate, reg_l2, reg_l1
+            )
+            return new_coef, epoch + 1, mean_loss
+
+        init = (
+            jnp.zeros(xl.shape[1], dtype=xl.dtype),
+            jnp.asarray(0, dtype=jnp.int32),
+            jnp.asarray(jnp.inf, dtype=xl.dtype),
+        )
+        coef, _, _ = jax.lax.while_loop(cond, body, init)
+        return coef
+
+    return jax.jit(
+        jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
+            out_specs=P(),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=128)
+def _sparse_trainer(mesh, loss: str, local_bs: int, axis: str, dim: int):
+    local_step = make_sparse_step(loss, local_bs, axis, dim)
+
+    def per_device(idxl, vall, yl, wl, learning_rate, reg_l2, reg_l1, tol, max_iter):
+        def cond(carry):
+            _, epoch, cur = carry
+            return jnp.logical_and(epoch < max_iter, cur > tol)
+
+        def body(carry):
+            coef, epoch, _ = carry
+            new_coef, mean_loss = local_step(
+                coef, epoch, idxl, vall, yl, wl, learning_rate, reg_l2, reg_l1
+            )
+            return new_coef, epoch + 1, mean_loss
+
+        init = (
+            jnp.zeros(dim, dtype=vall.dtype),
+            jnp.asarray(0, dtype=jnp.int32),
+            jnp.asarray(jnp.inf, dtype=vall.dtype),
+        )
+        coef, _, _ = jax.lax.while_loop(cond, body, init)
+        return coef
+
+    return jax.jit(
+        jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
+            out_specs=P(),
+        )
+    )
+
+
+def train_linear_model(
+    x: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray,
+    loss: str,
+    mesh: DeviceMesh,
+    max_iter: int,
+    learning_rate: float,
+    global_batch_size: int,
+    reg: float,
+    elastic_net: float,
+    tol: float,
+    seed: int,
+    dtype=None,
+) -> np.ndarray:
+    """Dense distributed training; returns the coefficient on host.
+
+    ``reg``/``elastic_net`` follow the sklearn/Spark convention:
+    l1 = reg * elastic_net, l2 = reg * (1 - elastic_net).
+    """
+    if loss not in _LOSS_KEYS:
+        raise ValueError(f"loss must be one of {_LOSS_KEYS}, got {loss!r}")
+    n = x.shape[0]
+    if n == 0:
+        raise ValueError("training table is empty")
+    p_size = mesh.axis_size()
+    if dtype is not None:
+        x, y, w = x.astype(dtype), y.astype(dtype), w.astype(dtype)
+    perm = np.random.default_rng(seed).permutation(n)
+    x, y, w = x[perm], y[perm], w[perm]
+    x_pad, _ = pad_to_multiple(x, p_size)
+    y_pad, _ = pad_to_multiple(y, p_size)
+    w_pad, _ = pad_to_multiple(w, p_size)
+    xd = mesh.shard_batch(x_pad)
+    yd = mesh.shard_batch(y_pad)
+    wd = mesh.shard_batch(w_pad)
+    n_local = xd.shape[0] // p_size
+    local_bs = min(max(1, math.ceil(global_batch_size / p_size)), n_local)
+    dt = xd.dtype
+    trainer = _dense_trainer(mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS)
+    coef = trainer(
+        xd, yd, wd,
+        jnp.asarray(learning_rate, dt),
+        jnp.asarray(reg * (1.0 - elastic_net), dt),
+        jnp.asarray(reg * elastic_net, dt),
+        jnp.asarray(tol, dt),
+        jnp.asarray(max_iter, jnp.int32),
+    )
+    return np.asarray(coef)
+
+
+def train_linear_model_sparse(
+    indices: np.ndarray,
+    values: np.ndarray,
+    dim: int,
+    y: np.ndarray,
+    w: np.ndarray,
+    loss: str,
+    mesh: DeviceMesh,
+    max_iter: int,
+    learning_rate: float,
+    global_batch_size: int,
+    reg: float,
+    elastic_net: float,
+    tol: float,
+    seed: int,
+) -> np.ndarray:
+    """Sparse (padded-ELL rows) distributed training — the Criteo-scale
+    path: per-step cost scales with nnz, the model stays a dense [dim]
+    array updated by segment-sum scatter-adds."""
+    if loss not in _LOSS_KEYS:
+        raise ValueError(f"loss must be one of {_LOSS_KEYS}, got {loss!r}")
+    n = indices.shape[0]
+    if n == 0:
+        raise ValueError("training table is empty")
+    p_size = mesh.axis_size()
+    perm = np.random.default_rng(seed).permutation(n)
+    indices, values, y, w = indices[perm], values[perm], y[perm], w[perm]
+    idx_pad, _ = pad_to_multiple(indices, p_size)
+    val_pad, _ = pad_to_multiple(values, p_size)
+    y_pad, _ = pad_to_multiple(y, p_size)
+    w_pad, _ = pad_to_multiple(w, p_size)
+    idxd = mesh.shard_batch(idx_pad)
+    vald = mesh.shard_batch(val_pad)
+    yd = mesh.shard_batch(y_pad)
+    wd = mesh.shard_batch(w_pad)
+    n_local = idxd.shape[0] // p_size
+    local_bs = min(max(1, math.ceil(global_batch_size / p_size)), n_local)
+    dt = vald.dtype
+    trainer = _sparse_trainer(
+        mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS, int(dim)
+    )
+    coef = trainer(
+        idxd, vald, yd, wd,
+        jnp.asarray(learning_rate, dt),
+        jnp.asarray(reg * (1.0 - elastic_net), dt),
+        jnp.asarray(reg * elastic_net, dt),
+        jnp.asarray(tol, dt),
+        jnp.asarray(max_iter, jnp.int32),
+    )
+    return np.asarray(coef)

@@ -1,0 +1,94 @@
+"""LinearRegression — least squares via proximal SGD.
+
+Capability target: BASELINE.json config #3. Same shared trainer as
+LogisticRegression/LinearSVC with the squared loss; supports L2 ("ridge"),
+L1 ("lasso") and elastic-net via the proximal step.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import (
+    HasElasticNet,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasReg,
+    HasSeed,
+    HasTol,
+    HasWeightCol,
+)
+from flinkml_tpu.models import _linear_sgd
+from flinkml_tpu.models._coefficient import CoefficientModelMixin
+from flinkml_tpu.models._data import features_matrix, labeled_data
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+class _LinearRegressionParams(
+    HasFeaturesCol,
+    HasLabelCol,
+    HasWeightCol,
+    HasMaxIter,
+    HasReg,
+    HasElasticNet,
+    HasLearningRate,
+    HasGlobalBatchSize,
+    HasTol,
+    HasSeed,
+    HasPredictionCol,
+):
+    pass
+
+
+class LinearRegression(_LinearRegressionParams, Estimator):
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "LinearRegressionModel":
+        (table,) = inputs
+        x, y, w = labeled_data(
+            table,
+            self.get(_LinearRegressionParams.FEATURES_COL),
+            self.get(_LinearRegressionParams.LABEL_COL),
+            self.get(_LinearRegressionParams.WEIGHT_COL),
+        )
+        coef = _linear_sgd.train_linear_model(
+            x, y, w, loss="squared",
+            mesh=self.mesh or DeviceMesh(),
+            max_iter=self.get(_LinearRegressionParams.MAX_ITER),
+            learning_rate=self.get(_LinearRegressionParams.LEARNING_RATE),
+            global_batch_size=self.get(_LinearRegressionParams.GLOBAL_BATCH_SIZE),
+            reg=self.get(_LinearRegressionParams.REG),
+            elastic_net=self.get(_LinearRegressionParams.ELASTIC_NET),
+            tol=self.get(_LinearRegressionParams.TOL),
+            seed=self.get_seed(),
+        )
+        model = LinearRegressionModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"coefficient": coef[None, :]}))
+        return model
+
+
+class LinearRegressionModel(CoefficientModelMixin, _LinearRegressionParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._coefficient: Optional[np.ndarray] = None
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require_model()
+        x = features_matrix(table, self.get(_LinearRegressionParams.FEATURES_COL))
+        pred = np.asarray(jnp.asarray(x) @ jnp.asarray(self._coefficient))
+        return (
+            table.with_column(self.get(_LinearRegressionParams.PREDICTION_COL), pred),
+        )

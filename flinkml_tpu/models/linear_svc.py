@@ -1,0 +1,113 @@
+"""LinearSVC — linear support vector classifier via proximal SGD.
+
+Capability target: BASELINE.json config #3 ("LinearSVC + LinearRegression
+with L1/L2 — proximal SGD step on TPU"). The reference snapshot does not
+ship LinearSVC (flink-ml 2.1's library is 5 algorithms, SURVEY.md §2.3);
+the API mirrors how the reference's later versions shape it (params:
+featuresCol/labelCol/weightCol/maxIter/reg/elasticNet/learningRate/
+globalBatchSize/tol/seed; predict: label = 1[dot >= threshold], raw = dot).
+
+Training shares ``flinkml_tpu.models._linear_sgd`` with LogisticRegression:
+hinge margin gradient, L2 in the gradient, L1 via proximal soft-threshold —
+the whole loop one XLA program on device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import (
+    HasElasticNet,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasReg,
+    HasSeed,
+    HasTol,
+    HasWeightCol,
+)
+from flinkml_tpu.models import _linear_sgd
+from flinkml_tpu.models._coefficient import CoefficientModelMixin
+from flinkml_tpu.models._data import features_matrix, labeled_data
+from flinkml_tpu.params import FloatParam
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+class _LinearSVCParams(
+    HasFeaturesCol,
+    HasLabelCol,
+    HasWeightCol,
+    HasMaxIter,
+    HasReg,
+    HasElasticNet,
+    HasLearningRate,
+    HasGlobalBatchSize,
+    HasTol,
+    HasSeed,
+    HasPredictionCol,
+    HasRawPredictionCol,
+):
+    THRESHOLD = FloatParam(
+        "threshold", "Decision threshold on the raw prediction.", 0.0
+    )
+
+
+class LinearSVC(_LinearSVCParams, Estimator):
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "LinearSVCModel":
+        (table,) = inputs
+        x, y, w = labeled_data(
+            table,
+            self.get(_LinearSVCParams.FEATURES_COL),
+            self.get(_LinearSVCParams.LABEL_COL),
+            self.get(_LinearSVCParams.WEIGHT_COL),
+        )
+        labels = np.unique(y)
+        if not np.all(np.isin(labels, (0.0, 1.0))):
+            raise ValueError(f"LinearSVC requires labels in {{0, 1}}, got {labels}")
+        coef = _linear_sgd.train_linear_model(
+            x, y, w, loss="hinge",
+            mesh=self.mesh or DeviceMesh(),
+            max_iter=self.get(_LinearSVCParams.MAX_ITER),
+            learning_rate=self.get(_LinearSVCParams.LEARNING_RATE),
+            global_batch_size=self.get(_LinearSVCParams.GLOBAL_BATCH_SIZE),
+            reg=self.get(_LinearSVCParams.REG),
+            elastic_net=self.get(_LinearSVCParams.ELASTIC_NET),
+            tol=self.get(_LinearSVCParams.TOL),
+            seed=self.get_seed(),
+        )
+        model = LinearSVCModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"coefficient": coef[None, :]}))
+        return model
+
+
+class LinearSVCModel(CoefficientModelMixin, _LinearSVCParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._coefficient: Optional[np.ndarray] = None
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require_model()
+        x = features_matrix(table, self.get(_LinearSVCParams.FEATURES_COL))
+        dot = np.asarray(jnp.asarray(x) @ jnp.asarray(self._coefficient))
+        threshold = self.get(_LinearSVCParams.THRESHOLD)
+        pred = (dot >= threshold).astype(np.float64)
+        out = table.with_column(
+            self.get(_LinearSVCParams.PREDICTION_COL), pred
+        ).with_column(self.get(_LinearSVCParams.RAW_PREDICTION_COL), dot)
+        return (out,)

@@ -52,7 +52,11 @@ from flinkml_tpu.common_params import (
     HasWeightCol,
 )
 from flinkml_tpu.iteration import IterationConfig, TerminateOnMaxIterOrTol, iterate
+from flinkml_tpu.linalg import SparseVector
+from flinkml_tpu.models import _linear_sgd
+from flinkml_tpu.models._coefficient import CoefficientModelMixin
 from flinkml_tpu.models._data import features_matrix, labeled_data
+from flinkml_tpu.ops.sparse import BatchedCSR
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
 
@@ -90,24 +94,8 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
                 "Currently we only support binomial logistic regression; "
                 "multinomial is not supported (parity with the reference)"
             )
-        x, y, w = labeled_data(
-            table,
-            self.get(_LogisticRegressionParams.FEATURES_COL),
-            self.get(_LogisticRegressionParams.LABEL_COL),
-            self.get(_LogisticRegressionParams.WEIGHT_COL),
-        )
-        if x.shape[0] == 0:
-            raise ValueError("training table is empty")
-        labels = np.unique(y)
-        if not np.all(np.isin(labels, (0.0, 1.0))):
-            raise ValueError(
-                f"binomial logistic regression requires labels in {{0, 1}}, got {labels}"
-            )
-
-        coef = train_logistic_regression(
-            x,
-            y,
-            w,
+        features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
+        hyper = dict(
             mesh=self.mesh or DeviceMesh(),
             max_iter=self.get(_LogisticRegressionParams.MAX_ITER),
             learning_rate=self.get(_LogisticRegressionParams.LEARNING_RATE),
@@ -117,13 +105,53 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
             seed=self.get_seed(),
         )
 
+        raw_col = table.column(features_col)
+        sparse_input = raw_col.dtype == object and isinstance(
+            raw_col[0], SparseVector
+        )
+        if sparse_input:
+            # Criteo-scale path (BASELINE.json config #5): padded-ELL batch,
+            # gather forward + segment-sum gradient; the dense [dim] model
+            # stays replicated.
+            # Host-side ELL packing: the trainer shards from host, so the
+            # full dataset never stages through a single device's HBM.
+            indices, values, dim = BatchedCSR.pack_sparse_vectors(
+                raw_col, dtype=np.float32
+            )
+            y = np.asarray(
+                table.column(self.get(_LogisticRegressionParams.LABEL_COL)),
+                dtype=np.float32,
+            )
+            weight_col = self.get(_LogisticRegressionParams.WEIGHT_COL)
+            w = (
+                np.asarray(table.column(weight_col), dtype=np.float32)
+                if weight_col is not None
+                else np.ones(len(y), dtype=np.float32)
+            )
+            _check_binomial_labels(y)
+            coef = _linear_sgd.train_linear_model_sparse(
+                indices, values, dim,
+                y, w, loss="logistic", elastic_net=0.0, **hyper,
+            )
+        else:
+            x, y, w = labeled_data(
+                table,
+                features_col,
+                self.get(_LogisticRegressionParams.LABEL_COL),
+                self.get(_LogisticRegressionParams.WEIGHT_COL),
+            )
+            if x.shape[0] == 0:
+                raise ValueError("training table is empty")
+            _check_binomial_labels(y)
+            coef = train_logistic_regression(x, y, w, **hyper)
+
         model = LogisticRegressionModel(mesh=self.mesh)
         model.copy_params_from(self)
         model.set_model_data(Table({"coefficient": coef[None, :]}))
         return model
 
 
-class LogisticRegressionModel(_LogisticRegressionParams, Model):
+class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, Model):
     """Broadcast-model batch inference (reference:
     ``LogisticRegressionModel.java:100-170`` — broadcast the coefficient,
     map each row; here: replicate the coefficient, one batched matmul)."""
@@ -133,32 +161,24 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model):
         self.mesh = mesh
         self._coefficient: Optional[np.ndarray] = None
 
-    # -- model data --------------------------------------------------------
-    def set_model_data(self, *inputs: Table) -> "LogisticRegressionModel":
-        (table,) = inputs
-        coef = np.asarray(table.column("coefficient"), dtype=np.float64)
-        self._coefficient = coef.reshape(-1)
-        return self
-
-    def get_model_data(self) -> List[Table]:
-        self._require_model()
-        return [Table({"coefficient": self._coefficient[None, :]})]
-
-    @property
-    def coefficient(self) -> np.ndarray:
-        self._require_model()
-        return self._coefficient
-
-    def _require_model(self) -> None:
-        if self._coefficient is None:
-            raise ValueError(
-                "Model data is not set; call set_model_data or fit first"
-            )
-
     # -- inference ---------------------------------------------------------
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require_model()
+        raw_col = table.column(self.get(_LogisticRegressionParams.FEATURES_COL))
+        if raw_col.dtype == object and isinstance(raw_col[0], SparseVector):
+            # Sparse inference: gather dot products, never densifying rows.
+            csr = BatchedCSR.from_sparse_vectors(raw_col)
+            dot = csr.matvec(jnp.asarray(self._coefficient, csr.values.dtype))
+            p = jax.nn.sigmoid(dot)
+            pred = np.asarray((dot >= 0).astype(csr.values.dtype))
+            raw = np.stack([1.0 - np.asarray(p), np.asarray(p)], axis=-1)
+            out = table.with_column(
+                self.get(_LogisticRegressionParams.PREDICTION_COL), pred
+            ).with_column(
+                self.get(_LogisticRegressionParams.RAW_PREDICTION_COL), raw
+            )
+            return (out,)
         x = features_matrix(table, self.get(_LogisticRegressionParams.FEATURES_COL))
         if self.mesh is not None and self.mesh.num_devices > 1:
             # Sharded batch inference: rows split over the data axis, the
@@ -177,16 +197,14 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model):
         )
         return (out,)
 
-    # -- persistence -------------------------------------------------------
-    def save(self, path: str) -> None:
-        self._require_model()
-        self._save_with_arrays(path, {"coefficient": self._coefficient})
 
-    @classmethod
-    def load(cls, path: str) -> "LogisticRegressionModel":
-        model, arrays, _ = cls._load_with_arrays(path)
-        model._coefficient = arrays["coefficient"]
-        return model
+
+def _check_binomial_labels(y: np.ndarray) -> None:
+    labels = np.unique(y)
+    if not np.all(np.isin(labels, (0.0, 1.0))):
+        raise ValueError(
+            f"binomial logistic regression requires labels in {{0, 1}}, got {labels}"
+        )
 
 
 @jax.jit
@@ -210,91 +228,15 @@ def _shard_training_data(x, y, w, mesh: DeviceMesh):
     return mesh.shard_batch(x_pad), mesh.shard_batch(y_pad), mesh.shard_batch(w_pad)
 
 
-def make_local_sgd_step(local_bs: int, axis: str):
-    """Per-device SGD epoch: slice window → batched grad on the MXU → psum
-    → update.
-
-    This is the inversion of ``LogisticRegression.java:334-397``; shapes are
-    static so it composes with ``lax.while_loop`` and ``shard_map``.
-    Hyperparameters (lr, reg) are traced scalars so one compilation serves
-    every configuration. Returns ``(new_coef, mean_loss)`` (replicated after
-    the psums).
-
-    Mini-batch selection divergence (intentional, HBM-friendly): the
-    reference samples WITH replacement per task
-    (``LogisticRegression.java:345-352`` — random row gathers). Random row
-    gathers waste HBM bandwidth on TPU, so each epoch takes a contiguous
-    rotating window of the (host-shuffled) local shard — sampling without
-    replacement with full-bandwidth streaming reads. Statistically this is
-    standard shuffled mini-batch SGD.
-    """
-
-    def local_step(coef, epoch, xl, yl, wl, learning_rate, reg):
-        # Ceil window count so the shard's tail rows are trained on too;
-        # dynamic_slice clamps the final start, overlapping the previous
-        # window rather than dropping rows.
-        n_windows = max(-(-xl.shape[0] // local_bs), 1)
-        start = (jnp.asarray(epoch, jnp.int32) % n_windows) * local_bs
-        zero = jnp.zeros((), dtype=start.dtype)
-        xb = jax.lax.dynamic_slice(xl, (start, zero), (local_bs, xl.shape[1]))
-        yb = jax.lax.dynamic_slice(yl, (start,), (local_bs,))
-        wb = jax.lax.dynamic_slice(wl, (start,), (local_bs,))
-        ys = 2.0 * yb - 1.0
-        dot = xb @ coef
-        margin = dot * ys
-        # d/d(dot) of log(1+exp(-margin)) = -ys * sigmoid(-margin)
-        mult = wb * (-ys * jax.nn.sigmoid(-margin))
-        grad = jax.lax.psum(xb.T @ mult, axis)
-        loss = jax.lax.psum(jnp.sum(wb * jax.nn.softplus(-margin)), axis)
-        wsum = jax.lax.psum(jnp.sum(wb), axis)
-        # L2 applied once globally (see module docstring on the divergence
-        # from LogisticGradient.java:79-82 which adds it per task).
-        grad = grad + 2.0 * reg * coef
-        loss = loss + reg * jnp.sum(coef * coef)
-        new_coef = coef - (learning_rate / wsum) * grad
-        return new_coef, loss / wsum
-
-    return local_step
-
-
-@functools.lru_cache(maxsize=64)
+# The shared linear-SGD kernels live in _linear_sgd. Mini-batch selection
+# divergence from the reference (intentional, HBM-friendly): the reference
+# samples WITH replacement per task (LogisticRegression.java:345-352 —
+# random row gathers); random gathers waste HBM bandwidth on TPU, so each
+# epoch takes a contiguous rotating window of the host-shuffled shard —
+# shuffled SGD with full-bandwidth streaming reads.
 def _device_trainer(mesh, local_bs: int, axis: str):
-    """Whole-training-run XLA program, cached per (mesh, batch) config.
-
-    Hyperparameters vary without recompiling: max_iter/lr/reg/tol are traced
-    scalars; only a new (mesh, local batch size) or new data shapes trigger
-    compilation.
-    """
-    local_step = make_local_sgd_step(local_bs, axis)
-
-    def per_device(xl, yl, wl, learning_rate, reg, tol, max_iter):
-        def cond(carry):
-            coef, epoch, loss = carry
-            return jnp.logical_and(epoch < max_iter, loss > tol)
-
-        def body(carry):
-            coef, epoch, _ = carry
-            new_coef, mean_loss = local_step(
-                coef, epoch, xl, yl, wl, learning_rate, reg
-            )
-            return new_coef, epoch + 1, mean_loss
-
-        init = (
-            jnp.zeros(xl.shape[1], dtype=xl.dtype),
-            jnp.asarray(0, dtype=jnp.int32),
-            jnp.asarray(jnp.inf, dtype=xl.dtype),
-        )
-        coef, _, _ = jax.lax.while_loop(cond, body, init)
-        return coef
-
-    return jax.jit(
-        jax.shard_map(
-            per_device,
-            mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P()),
-            out_specs=P(),
-        )
-    )
+    """Whole-training-run XLA program for logistic loss (cached)."""
+    return _linear_sgd._dense_trainer(mesh, "logistic", local_bs, axis)
 
 
 def train_logistic_regression(
@@ -334,6 +276,15 @@ def train_logistic_regression(
         raise ValueError(f"mode must be 'device' or 'host', got {mode!r}")
     if (checkpoint_manager is not None or resume) and mode != "host":
         raise ValueError("checkpointing/resume requires mode='host'")
+
+    if mode == "device":
+        return _linear_sgd.train_linear_model(
+            x, y, w, loss="logistic", mesh=mesh, max_iter=max_iter,
+            learning_rate=learning_rate, global_batch_size=global_batch_size,
+            reg=reg, elastic_net=0.0, tol=tol, seed=seed, dtype=dtype,
+        )
+
+    # host mode: per-epoch dispatch with listener/checkpoint support.
     n, dim = x.shape
     p_size = mesh.axis_size()
     if dtype is not None:
@@ -351,21 +302,11 @@ def train_logistic_regression(
     axis = DeviceMesh.DATA_AXIS
     dt = xd.dtype
 
-    if mode == "device":
-        trainer = _device_trainer(mesh.mesh, local_bs, axis)
-        fitted = trainer(
-            xd, yd, wd,
-            jnp.asarray(learning_rate, dt), jnp.asarray(reg, dt),
-            jnp.asarray(tol, dt), jnp.asarray(max_iter, jnp.int32),
-        )
-        return np.asarray(fitted)
-
-    # host mode: per-epoch dispatch with listener/checkpoint support.
-    local_step = make_local_sgd_step(local_bs, axis)
+    local_step = _linear_sgd.make_dense_step("logistic", local_bs, axis)
     sharded_step = jax.shard_map(
         local_step,
         mesh=mesh.mesh,
-        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P()),
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P(), P()),
         out_specs=(P(), P()),
     )
 
@@ -374,7 +315,8 @@ def train_logistic_regression(
         coef = state
         new_coef, mean_loss = sharded_step(
             coef, jnp.asarray(epoch, jnp.int32), xd, yd, wd,
-            jnp.asarray(learning_rate, dt), jnp.asarray(reg, dt)
+            jnp.asarray(learning_rate, dt), jnp.asarray(reg, dt),
+            jnp.asarray(0.0, dt),
         )
         return new_coef, mean_loss
 

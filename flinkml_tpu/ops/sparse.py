@@ -53,9 +53,12 @@ class BatchedCSR:
 
     # -- construction ------------------------------------------------------
     @staticmethod
-    def from_sparse_vectors(
+    def pack_sparse_vectors(
         vectors: Iterable[SparseVector], max_nnz: int = None, dtype=np.float32
-    ) -> "BatchedCSR":
+    ):
+        """Host-side ELL packing: returns numpy ``(indices, values, dim)``
+        WITHOUT device placement — callers that shard (training) use this to
+        avoid staging the full dataset in one device's HBM."""
         vectors = list(vectors)
         if not vectors:
             raise ValueError("empty batch")
@@ -71,6 +74,15 @@ class BatchedCSR:
             k = min(v.indices.size, width)
             indices[i, :k] = v.indices[:k]
             values[i, :k] = v.values[:k]
+        return indices, values, dim
+
+    @staticmethod
+    def from_sparse_vectors(
+        vectors: Iterable[SparseVector], max_nnz: int = None, dtype=np.float32
+    ) -> "BatchedCSR":
+        indices, values, dim = BatchedCSR.pack_sparse_vectors(
+            vectors, max_nnz, dtype
+        )
         return BatchedCSR(indices, values, dim)
 
     @staticmethod

@@ -1,0 +1,174 @@
+"""LinearSVC / LinearRegression / sparse LR / elastic-net tests
+(BASELINE.json config #3 and #5 coverage)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from flinkml_tpu.linalg import Vectors
+from flinkml_tpu.models import (
+    LinearRegression,
+    LinearRegressionModel,
+    LinearSVC,
+    LinearSVCModel,
+    LogisticRegression,
+)
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+@pytest.fixture
+def class_table(rng):
+    x = rng.normal(size=(300, 5))
+    true = rng.normal(size=5) * 2
+    y = (x @ true > 0).astype(np.float64)
+    return Table({"features": x, "label": y}), true
+
+
+def test_linear_svc_fit_predict(class_table):
+    table, _ = class_table
+    model = (
+        LinearSVC().set_seed(0).set_max_iter(300).set_learning_rate(0.5)
+        .set_global_batch_size(300).fit(table)
+    )
+    (out,) = model.transform(table)
+    acc = np.mean(out["prediction"] == table["label"])
+    assert acc > 0.97
+    # Raw prediction column = margin (dot product).
+    assert out["rawPrediction"].shape == (300,)
+
+
+def test_linear_svc_against_sklearn(class_table):
+    from sklearn.svm import LinearSVC as SkSVC
+
+    table, _ = class_table
+    x, y = table["features"], table["label"]
+    model = (
+        LinearSVC().set_seed(0).set_max_iter(500).set_learning_rate(0.5)
+        .set_global_batch_size(300).set_reg(0.001).fit(table)
+    )
+    sk = SkSVC(fit_intercept=False, max_iter=5000).fit(x, y)
+    cos = np.dot(model.coefficient, sk.coef_[0]) / (
+        np.linalg.norm(model.coefficient) * np.linalg.norm(sk.coef_[0])
+    )
+    assert cos > 0.98
+
+
+def test_linear_svc_save_load(tmp_path, class_table):
+    table, _ = class_table
+    model = LinearSVC().set_seed(0).set_max_iter(50).fit(table)
+    p = str(tmp_path / "svc")
+    model.save(p)
+    loaded = LinearSVCModel.load(p)
+    np.testing.assert_array_equal(loaded.coefficient, model.coefficient)
+
+
+def test_linear_regression_recovers_coefficients(rng):
+    x = rng.normal(size=(500, 4))
+    true = np.array([1.5, -2.0, 0.5, 3.0])
+    y = x @ true + 0.01 * rng.normal(size=500)
+    table = Table({"features": x, "label": y})
+    model = (
+        LinearRegression().set_seed(0).set_max_iter(2000)
+        .set_learning_rate(0.5).set_global_batch_size(500).fit(table)
+    )
+    np.testing.assert_allclose(model.coefficient, true, atol=0.05)
+    (out,) = model.transform(table)
+    assert np.corrcoef(out["prediction"], y)[0, 1] > 0.999
+
+
+def test_lasso_sparsifies(rng):
+    # 2 informative + 6 dead features; L1 must zero the dead ones.
+    x = rng.normal(size=(400, 8))
+    y = 2.0 * x[:, 0] - 1.0 * x[:, 1] + 0.01 * rng.normal(size=400)
+    table = Table({"features": x, "label": y})
+    model = (
+        LinearRegression().set_seed(0).set_max_iter(1500)
+        .set_learning_rate(0.5).set_global_batch_size(400)
+        .set_reg(0.5).set_elastic_net(1.0).fit(table)
+    )
+    coef = model.coefficient
+    assert abs(coef[0]) > 1.0 and abs(coef[1]) > 0.4
+    assert np.all(np.abs(coef[2:]) < 0.02)
+
+
+def test_weighted_linear_regression(rng):
+    x = rng.normal(size=(200, 2))
+    y = x @ np.array([1.0, 1.0])
+    w = np.ones(200)
+    table_w = Table({"features": x, "label": y, "w": w})
+    m1 = (
+        LinearRegression().set_seed(1).set_max_iter(500).set_learning_rate(0.5)
+        .set_global_batch_size(200).set_weight_col("w").fit(table_w)
+    )
+    np.testing.assert_allclose(m1.coefficient, [1.0, 1.0], atol=0.02)
+
+
+def test_sparse_logistic_regression(rng):
+    # Sparse features via SparseVector column (the Criteo-style path).
+    mat = sp.random(400, 50, density=0.1, random_state=0, format="csr")
+    true = rng.normal(size=50)
+    y = (mat @ true > 0).astype(np.float64)
+    vecs = [
+        Vectors.sparse(
+            50,
+            mat.indices[mat.indptr[i] : mat.indptr[i + 1]],
+            mat.data[mat.indptr[i] : mat.indptr[i + 1]],
+        )
+        for i in range(400)
+    ]
+    table = Table({"features": vecs, "label": y})
+    model = (
+        LogisticRegression().set_seed(0).set_max_iter(400)
+        .set_learning_rate(1.0).set_global_batch_size(400).fit(table)
+    )
+    (out,) = model.transform(table)
+    acc = np.mean(out["prediction"] == y)
+    assert acc > 0.93, acc
+
+
+def test_sparse_dense_agreement(rng):
+    # Same data sparse vs dense must converge to similar coefficients.
+    x = rng.normal(size=(200, 6)) * (rng.random((200, 6)) < 0.4)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    dense_table = Table({"features": x, "label": y})
+    vecs = [
+        Vectors.sparse(6, np.nonzero(row)[0], row[np.nonzero(row)[0]])
+        for row in x
+    ]
+    sparse_table = Table({"features": vecs, "label": y})
+    kw = dict()
+    dense_m = (
+        LogisticRegression().set_seed(3).set_max_iter(200)
+        .set_global_batch_size(200).fit(dense_table)
+    )
+    sparse_m = (
+        LogisticRegression().set_seed(3).set_max_iter(200)
+        .set_global_batch_size(200).fit(sparse_table)
+    )
+    cos = np.dot(dense_m.coefficient, sparse_m.coefficient) / (
+        np.linalg.norm(dense_m.coefficient) * np.linalg.norm(sparse_m.coefficient)
+    )
+    assert cos > 0.999
+    (a,) = sparse_m.transform(sparse_table)
+    (b,) = dense_m.transform(dense_table)
+    np.testing.assert_array_equal(a["prediction"], b["prediction"])
+
+
+def test_multi_device_sparse(rng):
+    mat = sp.random(333, 20, density=0.2, random_state=1, format="csr")
+    y = (np.asarray(mat.sum(axis=1)).ravel() > mat.sum() / 333).astype(np.float64)
+    vecs = [
+        Vectors.sparse(
+            20,
+            mat.indices[mat.indptr[i] : mat.indptr[i + 1]],
+            mat.data[mat.indptr[i] : mat.indptr[i + 1]],
+        )
+        for i in range(333)
+    ]
+    table = Table({"features": vecs, "label": y})
+    model = (
+        LogisticRegression(mesh=DeviceMesh()).set_seed(0).set_max_iter(100)
+        .set_global_batch_size(333).fit(table)
+    )
+    assert np.isfinite(model.coefficient).all()

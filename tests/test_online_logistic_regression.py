@@ -1,0 +1,103 @@
+"""OnlineLogisticRegression (FTRL) tests — unbounded-mode coverage
+(BASELINE.json config #4)."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import (
+    LogisticRegression,
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)
+from flinkml_tpu.table import Table
+
+
+def make_stream(rng, n_batches=20, batch=64, dim=5):
+    true = rng.normal(size=dim) * 2
+    batches, full_x, full_y = [], [], []
+    for _ in range(n_batches):
+        x = rng.normal(size=(batch, dim))
+        y = (x @ true > 0).astype(np.float64)
+        batches.append(Table({"features": x, "label": y}))
+        full_x.append(x)
+        full_y.append(y)
+    return batches, np.concatenate(full_x), np.concatenate(full_y), true
+
+
+def test_param_defaults():
+    olr = OnlineLogisticRegression()
+    assert olr.get_alpha() == 0.1
+    assert olr.get_beta() == 0.1
+    assert olr.get_batch_strategy() == "count"
+    assert olr.get_global_batch_size() == 32
+
+
+def test_fit_stream_learns(rng):
+    batches, x, y, _ = make_stream(rng)
+    model = OnlineLogisticRegression().set_alpha(0.5).fit_stream(batches)
+    assert model.model_version == 20
+    (out,) = model.transform(Table({"features": x, "label": y}))
+    assert np.mean(out["prediction"] == y) > 0.9
+    # Every output row carries the model version.
+    assert (out["modelVersion"] == 20).all()
+
+
+def test_fit_single_table_batches(rng):
+    batches, x, y, _ = make_stream(rng, n_batches=4, batch=32)
+    table = Table({"features": x, "label": y})
+    model = OnlineLogisticRegression().set_global_batch_size(32).fit(table)
+    assert model.model_version == 4
+
+
+def test_warm_start_from_offline_model(rng):
+    batches, x, y, _ = make_stream(rng, n_batches=3)
+    offline = (
+        LogisticRegression().set_seed(0).set_max_iter(100)
+        .set_global_batch_size(512).fit(Table({"features": x, "label": y}))
+    )
+    olr = OnlineLogisticRegression().set_initial_model_data(
+        *offline.get_model_data()
+    )
+    model = olr.fit_stream(batches[:1])
+    # Warm start means predictions stay good after one tiny batch.
+    (out,) = model.transform(Table({"features": x, "label": y}))
+    assert np.mean(out["prediction"] == y) > 0.95
+
+
+def test_l1_sparsifies(rng):
+    dim = 10
+    batches = []
+    for _ in range(30):
+        x = rng.normal(size=(64, dim))
+        y = (x[:, 0] > 0).astype(np.float64)  # only feature 0 matters
+        batches.append(Table({"features": x, "label": y}))
+    model = (
+        OnlineLogisticRegression().set_alpha(0.5)
+        .set_reg(0.1).set_elastic_net(1.0).fit_stream(batches)
+    )
+    coef = model.coefficient
+    assert abs(coef[0]) > 0.5
+    assert np.sum(np.abs(coef[1:]) < 1e-9) >= dim // 2  # FTRL exact zeros
+
+
+def test_empty_stream_raises():
+    with pytest.raises(ValueError, match="empty"):
+        OnlineLogisticRegression().fit_stream([])
+
+
+def test_save_load(tmp_path, rng):
+    batches, x, y, _ = make_stream(rng, n_batches=5)
+    model = OnlineLogisticRegression().set_alpha(0.5).fit_stream(batches)
+    p = str(tmp_path / "olr")
+    model.save(p)
+    loaded = OnlineLogisticRegressionModel.load(p)
+    assert loaded.model_version == 5
+    np.testing.assert_array_equal(loaded.coefficient, model.coefficient)
+
+
+def test_model_data_round_trip(rng):
+    batches, *_ = make_stream(rng, n_batches=2)
+    model = OnlineLogisticRegression().fit_stream(batches)
+    other = OnlineLogisticRegressionModel().set_model_data(*model.get_model_data())
+    assert other.model_version == 2
+    np.testing.assert_array_equal(other.coefficient, model.coefficient)
