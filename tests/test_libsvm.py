@@ -1,0 +1,142 @@
+"""libsvm ingest tests: native parser vs sklearn golden + python fallback."""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from flinkml_tpu.io.libsvm import (
+    _load_native,
+    read_libsvm,
+    read_libsvm_dense,
+)
+
+
+@pytest.fixture
+def svm_file(tmp_path, rng):
+    mat = sp.random(200, 40, density=0.15, random_state=0, format="csr")
+    mat.data = np.round(mat.data, 6)
+    y = rng.integers(0, 2, 200).astype(np.float64)
+    path = str(tmp_path / "data.svm")
+    with open(path, "w") as f:
+        for i in range(200):
+            toks = [str(y[i])]
+            for j in range(mat.indptr[i], mat.indptr[i + 1]):
+                toks.append(f"{mat.indices[j] + 1}:{float(mat.data[j])!r}")  # 1-based
+            f.write(" ".join(toks) + "\n")
+    return path, mat, y
+
+
+def test_native_parser_compiles():
+    assert _load_native() is not None, "g++ compile of native parser failed"
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_against_sklearn_golden(svm_file, use_native):
+    from sklearn.datasets import load_svmlight_file
+
+    path, mat, y = svm_file
+    labels, indptr, indices, values, nf = read_libsvm(path, use_native=use_native)
+    gx, gy = load_svmlight_file(path)
+    np.testing.assert_array_equal(labels, gy)
+    assert nf == gx.shape[1]
+    ours = sp.csr_matrix((values.astype(np.float64), indices, indptr), shape=(200, nf))
+    diff = abs(ours - gx).max()
+    assert diff < 1e-6, diff
+
+
+def test_native_matches_python_fallback(svm_file):
+    path, _, _ = svm_file
+    a = read_libsvm(path, use_native=True)
+    b = read_libsvm(path, use_native=False)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_dense_reader(svm_file):
+    path, mat, y = svm_file
+    x, labels = read_libsvm_dense(path)
+    np.testing.assert_array_equal(labels, y)
+    np.testing.assert_allclose(x, mat.toarray(), atol=1e-6)
+
+
+def test_zero_based_detection(tmp_path):
+    path = str(tmp_path / "zb.svm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n")
+    labels, indptr, indices, values, nf = read_libsvm(path)
+    # Index 0 present -> detected as 0-based; max index 3 -> 4 features.
+    assert nf == 4
+    np.testing.assert_array_equal(indices, [0, 3, 1])
+
+
+def test_comments_and_blank_lines(tmp_path):
+    path = str(tmp_path / "c.svm")
+    with open(path, "w") as f:
+        f.write("# header comment\n\n1 1:2.0\n\n0 2:3.0 # trailing\n")
+    labels, indptr, indices, values, nf = read_libsvm(path)
+    assert labels.tolist() == [1.0, 0.0]
+    np.testing.assert_array_equal(indices, [0, 1])
+
+
+def test_empty_file_raises(tmp_path):
+    path = str(tmp_path / "e.svm")
+    open(path, "w").close()
+    with pytest.raises(ValueError, match="empty"):
+        read_libsvm(path)
+
+
+def test_n_features_override_and_check(svm_file):
+    path, _, _ = svm_file
+    *_, nf = read_libsvm(path, n_features=100)
+    assert nf == 100
+    with pytest.raises(ValueError, match="n_features"):
+        read_libsvm(path, n_features=3)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_malformed_label_raises(tmp_path, use_native):
+    path = str(tmp_path / "bad.svm")
+    with open(path, "w") as f:
+        f.write("x 1:2.0\n1 1:3.0\n")
+    with pytest.raises(ValueError, match="label"):
+        read_libsvm(path, use_native=use_native)
+    # Partially-numeric label is also rejected.
+    with open(path, "w") as f:
+        f.write("1.5x 1:2.0\n")
+    os.remove(path + "x") if os.path.exists(path + "x") else None
+    with pytest.raises(ValueError, match="label"):
+        read_libsvm(path, use_native=use_native)
+
+
+@pytest.mark.parametrize(
+    "line,expected_nnz",
+    [
+        ("1 5:\n", 0),        # empty value
+        ("1 5: 6:2.0\n", 0),  # whitespace after colon ends the line
+        ("1 5:abc\n", 0),     # non-numeric value
+        ("1 5:2.0x\n", 0),    # trailing garbage on value
+        ("1 5:2.0#c\n", 0),   # comment glued to value
+        ("1 garbage 3:4.0\n", 0),  # malformed token ends line
+        ("1 2:1.0 5:\n", 1),  # valid pair before malformed one survives
+    ],
+)
+def test_malformed_pairs_native_fallback_agree(tmp_path, line, expected_nnz):
+    path = str(tmp_path / "m.svm")
+    with open(path, "w") as f:
+        f.write(line + "0 1:1.0\n")  # well-formed second line
+    a = read_libsvm(path, use_native=True)
+    b = read_libsvm(path, use_native=False)
+    for x, y in zip(a[:4], b[:4]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # nnz of first row:
+    assert a[1][1] - a[1][0] == expected_nnz
+
+
+def test_multithreaded_consistency(svm_file):
+    path, _, _ = svm_file
+    a = read_libsvm(path, n_threads=1)
+    b = read_libsvm(path, n_threads=8)
+    for x, y in zip(a[:4], b[:4]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
