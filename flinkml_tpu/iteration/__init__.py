@@ -8,6 +8,16 @@ from flinkml_tpu.iteration.runtime import (
 )
 from flinkml_tpu.iteration.device_loop import device_iterate
 from flinkml_tpu.iteration.checkpoint import CheckpointManager
+from flinkml_tpu.iteration.datacache import (
+    DataCache,
+    DataCacheReader,
+    DataCacheSnapshot,
+    DataCacheWriter,
+    PrefetchingDeviceFeed,
+    Segment,
+    cache_stream,
+    replay,
+)
 
 __all__ = [
     "IterationConfig",
@@ -18,4 +28,12 @@ __all__ = [
     "iterate",
     "device_iterate",
     "CheckpointManager",
+    "DataCache",
+    "DataCacheReader",
+    "DataCacheSnapshot",
+    "DataCacheWriter",
+    "PrefetchingDeviceFeed",
+    "Segment",
+    "cache_stream",
+    "replay",
 ]

@@ -1,0 +1,364 @@
+"""Segmented host data cache + epoch replay + prefetching device feed.
+
+Parity (SURVEY.md §2.2): the reference's ``iteration/datacache/nonkeyed``
+package — ``DataCacheWriter`` (append-only segments of serialized records,
+``DataCacheWriter.java:36-139``), ``DataCacheReader`` (iterator with
+position, ``DataCacheReader.java:35-135``), ``Segment{path,count,size}``
+(``Segment.java:27``), ``DataCacheSnapshot`` (persist/recover segment lists
+into checkpoint streams, ``DataCacheSnapshot.java:1-224``) — and the
+``ReplayOperator`` (``operator/ReplayOperator.java:62-250``) that caches a
+data stream in epoch 0 and re-emits it every subsequent epoch.
+
+TPU-native redesign: records are columnar *batches* (dict of numpy arrays),
+not serialized rows. A batch lives in host RAM until the writer's memory
+budget is exceeded, then spills to a segment file — a raw little-endian
+columnar format (JSON header + contiguous column bytes) that reads back via
+``np.fromfile`` with zero deserialization per record. Epoch replay is an
+iterator over batches; the ``PrefetchingDeviceFeed`` overlaps the next
+batch's host→HBM ``jax.device_put`` with the current step's compute, which
+is the whole point: the reference replays through the JVM record-at-a-time,
+we replay at memcpy/PCIe speed and the TPU never waits for input.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+_MAGIC = b"FMLTSEG1"
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """One spilled segment file. Parity: ``Segment.java:27`` {path,count,size}."""
+
+    path: str
+    num_rows: int
+    nbytes: int
+
+
+def _write_segment(path: str, batch: Batch) -> Segment:
+    """Raw columnar segment: MAGIC | u32 header_len | JSON header | column bytes.
+
+    Columns are written C-contiguous back to back; the header records
+    (dtype, shape, byte offset) per column. Atomic via temp-file rename so a
+    crash mid-spill never leaves a half segment in a manifest.
+    """
+    header: Dict[str, Any] = {"columns": {}}
+    offset = 0
+    cols: List[Tuple[str, np.ndarray]] = []
+    for name, arr in batch.items():
+        arr = np.ascontiguousarray(arr)
+        header["columns"][name] = {
+            "dtype": arr.dtype.str,
+            "shape": list(arr.shape),
+            "offset": offset,
+        }
+        offset += arr.nbytes
+        cols.append((name, arr))
+    num_rows = cols[0][1].shape[0] if cols else 0
+    header["num_rows"] = num_rows
+    hbytes = json.dumps(header).encode()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(len(hbytes).to_bytes(4, "little"))
+        f.write(hbytes)
+        for _, arr in cols:
+            # tofile writes straight from the (already contiguous) buffer —
+            # no tobytes() copy at the moment memory is tightest.
+            arr.tofile(f)
+    os.replace(tmp, path)
+    return Segment(path=path, num_rows=num_rows, nbytes=offset)
+
+
+def _read_segment(path: str) -> Batch:
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise IOError(f"{path}: not a datacache segment (magic={magic!r})")
+        hlen = int.from_bytes(f.read(4), "little")
+        header = json.loads(f.read(hlen))
+        data_start = f.tell()
+        batch: Batch = {}
+        for name, meta in header["columns"].items():
+            dtype = np.dtype(meta["dtype"])
+            shape = tuple(meta["shape"])
+            f.seek(data_start + meta["offset"])
+            count = int(np.prod(shape)) if shape else 1
+            batch[name] = np.fromfile(f, dtype=dtype, count=count).reshape(shape)
+    return batch
+
+
+class DataCacheWriter:
+    """Append columnar batches; spill to disk beyond a memory budget.
+
+    Parity: ``DataCacheWriter.java:36-139`` (append-only segments, finished
+    by ``finish()``). The reference always spills (its cache exists to
+    replay between epochs of a streaming job); here small datasets stay in
+    RAM and only the overflow hits disk.
+    """
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        memory_budget_bytes: int = 256 << 20,
+    ):
+        self.directory = directory
+        self.memory_budget_bytes = int(memory_budget_bytes)
+        # Ordered: each entry is an in-RAM Batch or a spilled Segment, in
+        # append order — a mid-stream spill must not reorder replay.
+        self._entries: List[Any] = []
+        self._mem_bytes = 0
+        self._num_spilled = 0
+        self._finished = False
+        self._num_rows = 0
+
+    def append(self, batch: Batch) -> None:
+        if self._finished:
+            raise RuntimeError("DataCacheWriter already finished")
+        batch = {k: np.asarray(v) for k, v in batch.items()}
+        nbytes = sum(a.nbytes for a in batch.values())
+        rows = next(iter(batch.values())).shape[0] if batch else 0
+        for name, a in batch.items():
+            if a.dtype == object:
+                # Fail at ingestion, not later mid-spill/mid-snapshot.
+                raise TypeError(
+                    f"column {name!r} has dtype=object; densify before caching"
+                )
+            if a.shape[0] != rows:
+                raise ValueError(
+                    f"column {name!r} has {a.shape[0]} rows, expected {rows}"
+                )
+        self._num_rows += rows
+        if (
+            self.directory is not None
+            and self._mem_bytes + nbytes > self.memory_budget_bytes
+        ):
+            self._spill(batch)
+        else:
+            self._entries.append(batch)
+            self._mem_bytes += nbytes
+
+    def _spill(self, batch: Batch) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, f"segment-{self._num_spilled:06d}.bin")
+        self._num_spilled += 1
+        self._entries.append(_write_segment(path, batch))
+
+    def finish(self) -> "DataCache":
+        """Seal the cache; no further appends. Returns the readable cache."""
+        self._finished = True
+        return DataCache(entries=list(self._entries), num_rows=self._num_rows)
+
+
+@dataclasses.dataclass
+class DataCache:
+    """A sealed, re-readable sequence of batches (RAM-resident + spilled),
+    in original append order."""
+
+    entries: List[Any]  # Batch | Segment, append-ordered
+    num_rows: int
+
+    @property
+    def num_batches(self) -> int:
+        return len(self.entries)
+
+    @property
+    def mem_batches(self) -> List[Batch]:
+        return [e for e in self.entries if not isinstance(e, Segment)]
+
+    @property
+    def segments(self) -> List[Segment]:
+        return [e for e in self.entries if isinstance(e, Segment)]
+
+    def reader(self, start_position: int = 0) -> "DataCacheReader":
+        return DataCacheReader(self, start_position)
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self.reader()
+
+
+class DataCacheReader:
+    """Iterate batches with a resumable position.
+
+    Parity: ``DataCacheReader.java:35-135`` (iterator + position for
+    checkpoint alignment). ``position`` counts whole batches consumed, so a
+    resumed reader re-reads from the next batch boundary.
+    """
+
+    def __init__(self, cache: DataCache, start_position: int = 0):
+        self._cache = cache
+        self.position = int(start_position)
+
+    def __iter__(self) -> "DataCacheReader":
+        return self
+
+    def __next__(self) -> Batch:
+        i = self.position
+        if i >= len(self._cache.entries):
+            raise StopIteration
+        self.position += 1
+        entry = self._cache.entries[i]
+        return _read_segment(entry.path) if isinstance(entry, Segment) else entry
+
+
+class DataCacheSnapshot:
+    """Persist/recover a cache for checkpoint-resume.
+
+    Parity: ``DataCacheSnapshot.java:1-224`` (segment lists into checkpoint
+    raw-state streams + local-FS copy). Persisting forces RAM-resident
+    batches into segment files under ``snapshot_dir`` and writes a JSON
+    manifest; recovery rebuilds a fully disk-backed cache.
+    """
+
+    MANIFEST = "datacache-manifest.json"
+
+    @staticmethod
+    def persist(cache: DataCache, snapshot_dir: str) -> None:
+        os.makedirs(snapshot_dir, exist_ok=True)
+        segments: List[Segment] = []
+        for i, entry in enumerate(cache.entries):
+            if isinstance(entry, Segment):
+                dst = os.path.join(snapshot_dir, f"snap-segment-{i:06d}.bin")
+                if os.path.abspath(dst) != os.path.abspath(entry.path):
+                    shutil.copyfile(entry.path, dst)
+                segments.append(Segment(dst, entry.num_rows, entry.nbytes))
+            else:
+                path = os.path.join(snapshot_dir, f"snap-segment-{i:06d}.bin")
+                segments.append(_write_segment(path, entry))
+        manifest = {
+            "num_rows": cache.num_rows,
+            "segments": [
+                {"file": os.path.basename(s.path), "num_rows": s.num_rows, "nbytes": s.nbytes}
+                for s in segments
+            ],
+        }
+        tmp = os.path.join(snapshot_dir, f".manifest.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(snapshot_dir, DataCacheSnapshot.MANIFEST))
+
+    @staticmethod
+    def recover(snapshot_dir: str) -> DataCache:
+        with open(os.path.join(snapshot_dir, DataCacheSnapshot.MANIFEST)) as f:
+            manifest = json.load(f)
+        segments = [
+            Segment(
+                path=os.path.join(snapshot_dir, s["file"]),
+                num_rows=s["num_rows"],
+                nbytes=s["nbytes"],
+            )
+            for s in manifest["segments"]
+        ]
+        return DataCache(entries=list(segments), num_rows=manifest["num_rows"])
+
+
+# ---------------------------------------------------------------------------
+# Epoch replay (ReplayOperator analog)
+# ---------------------------------------------------------------------------
+
+def cache_stream(
+    batches: Iterable[Batch],
+    directory: Optional[str] = None,
+    memory_budget_bytes: int = 256 << 20,
+) -> DataCache:
+    """Materialize a one-shot batch stream into a replayable cache.
+
+    This is epoch 0 of ``ReplayOperator.java:62-250`` (cache *and* forward);
+    iterate the returned cache for every subsequent epoch.
+    """
+    w = DataCacheWriter(directory, memory_budget_bytes)
+    for b in batches:
+        w.append(b)
+    return w.finish()
+
+
+def replay(cache: DataCache, num_epochs: Optional[int] = None) -> Iterator[Tuple[int, Batch]]:
+    """Yield ``(epoch, batch)`` re-reading the whole cache once per epoch.
+
+    Parity: ``ReplayOperator``'s re-emission of all cached records with the
+    new epoch on every global alignment; here the "alignment" is just the
+    outer loop advancing. ``num_epochs=None`` replays forever (the caller's
+    termination criterion breaks the loop).
+    """
+    if cache.num_batches == 0:
+        return  # an endless replay of nothing would spin forever
+    epoch = 0
+    while num_epochs is None or epoch < num_epochs:
+        for batch in cache.reader():
+            yield epoch, batch
+        epoch += 1
+
+
+# ---------------------------------------------------------------------------
+# Prefetching device feed
+# ---------------------------------------------------------------------------
+
+class PrefetchingDeviceFeed:
+    """Background host→device transfer pipeline over a batch iterator.
+
+    A worker thread pulls host batches, applies ``place`` (default
+    ``jax.device_put``, or a mesh-sharded placement like
+    ``mesh.shard_batch``) and parks up to ``depth`` device-resident batches
+    in a queue. With ``depth>=2`` the next batch's PCIe/DMA transfer runs
+    under the current step's compute — the TPU analog of the reference's
+    credit-based network buffering, minus the network.
+    """
+
+    _END = object()
+
+    def __init__(self, batches: Iterable[Any], place=None, depth: int = 2):
+        import jax
+
+        self._place = place if place is not None else jax.device_put
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._done = False
+
+        def worker():
+            try:
+                for b in batches:
+                    if self._stop.is_set():
+                        return
+                    self._q.put(self._place(b))
+            except BaseException as e:  # surfaced on next()
+                self._err = e
+            finally:
+                self._q.put(self._END)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> "PrefetchingDeviceFeed":
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._END:
+            self._done = True  # later next() must not block on an empty queue
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Drain so the worker's blocked put() wakes and exits.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass

@@ -1,0 +1,190 @@
+"""Data cache / replay tests — mirrors the reference's
+``DataCacheWriteReadTest`` / ``DataCacheSnapshotTest`` / ``ReplayOperatorTest``
+(SURVEY.md §4 tier 1)."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.iteration.datacache import (
+    DataCacheSnapshot,
+    DataCacheWriter,
+    PrefetchingDeviceFeed,
+    cache_stream,
+    replay,
+)
+
+
+def _batches(n_batches=4, rows=8, dim=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "features": rng.normal(size=(rows, dim)).astype(np.float32),
+            "label": rng.integers(0, 2, size=rows).astype(np.float32),
+        }
+        for _ in range(n_batches)
+    ]
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert set(x) == set(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k])
+
+
+def test_write_read_in_memory():
+    batches = _batches()
+    w = DataCacheWriter()
+    for b in batches:
+        w.append(b)
+    cache = w.finish()
+    assert cache.num_rows == 32
+    assert cache.num_batches == 4
+    _assert_batches_equal(batches, list(cache.reader()))
+    # Re-readable (epoch replay requires multiple passes).
+    _assert_batches_equal(batches, list(cache.reader()))
+
+
+def test_spill_to_disk_beyond_budget(tmp_path):
+    batches = _batches(n_batches=6)
+    one = sum(a.nbytes for a in batches[0].values())
+    w = DataCacheWriter(str(tmp_path), memory_budget_bytes=2 * one)
+    for b in batches:
+        w.append(b)
+    cache = w.finish()
+    assert len(cache.mem_batches) == 2
+    assert len(cache.segments) == 4
+    assert all(s.path.startswith(str(tmp_path)) for s in cache.segments)
+    _assert_batches_equal(batches, list(cache.reader()))
+
+
+def test_reader_position_resume(tmp_path):
+    batches = _batches(n_batches=5)
+    w = DataCacheWriter(str(tmp_path), memory_budget_bytes=0)
+    for b in batches:
+        w.append(b)
+    cache = w.finish()
+    r = cache.reader()
+    next(r)
+    next(r)
+    assert r.position == 2
+    resumed = cache.reader(start_position=r.position)
+    _assert_batches_equal(batches[2:], list(resumed))
+
+
+def test_append_after_finish_raises():
+    w = DataCacheWriter()
+    w.append(_batches(1)[0])
+    w.finish()
+    with pytest.raises(RuntimeError):
+        w.append(_batches(1)[0])
+
+
+def test_ragged_columns_rejected():
+    w = DataCacheWriter(directory=".")
+    with pytest.raises(ValueError):
+        w.append({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_object_dtype_rejected_on_spill(tmp_path):
+    w = DataCacheWriter(str(tmp_path), memory_budget_bytes=0)
+    obj = np.empty(2, dtype=object)
+    obj[0], obj[1] = [1], [2, 3]
+    with pytest.raises(TypeError):
+        w.append({"a": obj})
+
+
+def test_snapshot_persist_recover(tmp_path):
+    batches = _batches(n_batches=4)
+    one = sum(a.nbytes for a in batches[0].values())
+    w = DataCacheWriter(str(tmp_path / "spill"), memory_budget_bytes=2 * one)
+    for b in batches:
+        w.append(b)
+    cache = w.finish()
+    snap = tmp_path / "snap"
+    DataCacheSnapshot.persist(cache, str(snap))
+    recovered = DataCacheSnapshot.recover(str(snap))
+    assert recovered.num_rows == cache.num_rows
+    _assert_batches_equal(batches, list(recovered.reader()))
+
+
+def test_replay_epochs():
+    batches = _batches(n_batches=3)
+    cache = cache_stream(iter(batches))
+    seen = list(replay(cache, num_epochs=2))
+    assert [e for e, _ in seen] == [0, 0, 0, 1, 1, 1]
+    _assert_batches_equal(batches, [b for e, b in seen if e == 1])
+
+
+def test_prefetching_device_feed_matches():
+    import jax.numpy as jnp
+
+    batches = _batches(n_batches=5)
+    feed = PrefetchingDeviceFeed(iter(batches), depth=2)
+    out = list(feed)
+    assert len(out) == 5
+    for host, dev in zip(batches, out):
+        assert isinstance(dev["features"], jnp.ndarray)
+        np.testing.assert_array_equal(host["features"], np.asarray(dev["features"]))
+
+
+def test_spill_preserves_append_order(tmp_path):
+    """A mid-stream spill must not reorder replay (big batch between small)."""
+    small1 = {"a": np.full((2, 2), 1.0, dtype=np.float32)}
+    big = {"a": np.full((64, 64), 2.0, dtype=np.float32)}
+    small2 = {"a": np.full((2, 2), 3.0, dtype=np.float32)}
+    budget = small1["a"].nbytes + small2["a"].nbytes + 1  # big spills, smalls fit
+    w = DataCacheWriter(str(tmp_path), memory_budget_bytes=budget)
+    for b in (small1, big, small2):
+        w.append(b)
+    cache = w.finish()
+    assert len(cache.segments) == 1 and len(cache.mem_batches) == 2
+    vals = [b["a"].flat[0] for b in cache.reader()]
+    assert vals == [1.0, 2.0, 3.0]
+
+
+def test_snapshot_preserves_mixed_order(tmp_path):
+    small1 = {"a": np.full((2,), 1.0, dtype=np.float32)}
+    big = {"a": np.full((1024,), 2.0, dtype=np.float32)}
+    small2 = {"a": np.full((2,), 3.0, dtype=np.float32)}
+    w = DataCacheWriter(str(tmp_path / "spill"), memory_budget_bytes=64)
+    for b in (small1, big, small2):
+        w.append(b)
+    cache = w.finish()
+    DataCacheSnapshot.persist(cache, str(tmp_path / "snap"))
+    rec = DataCacheSnapshot.recover(str(tmp_path / "snap"))
+    assert [b["a"].flat[0] for b in rec.reader()] == [1.0, 2.0, 3.0]
+
+
+def test_object_dtype_rejected_in_memory_too():
+    w = DataCacheWriter()  # no directory: pure RAM path must still reject
+    obj = np.empty(2, dtype=object)
+    obj[0], obj[1] = [1], [2, 3]
+    with pytest.raises(TypeError):
+        w.append({"a": obj})
+
+
+def test_replay_empty_cache_terminates():
+    cache = cache_stream(iter([]))
+    assert list(replay(cache, num_epochs=None)) == []
+
+
+def test_feed_next_after_exhaustion_raises_stopiteration():
+    feed = PrefetchingDeviceFeed(iter(_batches(2)), depth=1)
+    list(feed)
+    with pytest.raises(StopIteration):
+        next(feed)  # must not deadlock on the drained queue
+    with pytest.raises(StopIteration):
+        next(feed)
+
+
+def test_prefetching_device_feed_propagates_errors():
+    def gen():
+        yield {"a": np.zeros(2)}
+        raise ValueError("boom")
+
+    feed = PrefetchingDeviceFeed(gen(), depth=1)
+    next(feed)
+    with pytest.raises(ValueError, match="boom"):
+        next(feed)
