@@ -1,4 +1,5 @@
 from flinkml_tpu.iteration.runtime import (
+    ForwardInputsOfLastRound,
     IterationConfig,
     IterationListener,
     Iterations,
@@ -26,6 +27,7 @@ __all__ = [
     "TerminateOnMaxIter",
     "TerminateOnMaxIterOrTol",
     "iterate",
+    "ForwardInputsOfLastRound",
     "device_iterate",
     "CheckpointManager",
     "DataCache",

@@ -111,10 +111,17 @@ class DataCacheWriter:
     def __init__(
         self,
         directory: Optional[str] = None,
-        memory_budget_bytes: int = 256 << 20,
+        memory_budget_bytes: Optional[int] = None,
     ):
+        if directory is None and memory_budget_bytes is not None:
+            raise ValueError(
+                "memory_budget_bytes requires a spill directory; without one "
+                "the cache is RAM-only and the budget cannot be honored"
+            )
         self.directory = directory
-        self.memory_budget_bytes = int(memory_budget_bytes)
+        self.memory_budget_bytes = int(
+            256 << 20 if memory_budget_bytes is None else memory_budget_bytes
+        )
         # Ordered: each entry is an in-RAM Batch or a spilled Segment, in
         # append order — a mid-stream spill must not reorder replay.
         self._entries: List[Any] = []
@@ -139,6 +146,11 @@ class DataCacheWriter:
                 raise ValueError(
                     f"column {name!r} has {a.shape[0]} rows, expected {rows}"
                 )
+        # RAM-resident batches are handed back by reference on every epoch;
+        # freeze them so in-place mutation by a consumer fails loudly instead
+        # of silently corrupting later epochs (spilled batches re-read fresh).
+        for a in batch.values():
+            a.flags.writeable = False
         self._num_rows += rows
         if (
             self.directory is not None
@@ -209,7 +221,11 @@ class DataCacheReader:
             raise StopIteration
         self.position += 1
         entry = self._cache.entries[i]
-        return _read_segment(entry.path) if isinstance(entry, Segment) else entry
+        if isinstance(entry, Segment):
+            return _read_segment(entry.path)
+        # Shallow copy: consumers may add/replace dict keys without altering
+        # the cached batch; the arrays themselves are frozen at append().
+        return dict(entry)
 
 
 class DataCacheSnapshot:
@@ -270,7 +286,7 @@ class DataCacheSnapshot:
 def cache_stream(
     batches: Iterable[Batch],
     directory: Optional[str] = None,
-    memory_budget_bytes: int = 256 << 20,
+    memory_budget_bytes: Optional[int] = None,
 ) -> DataCache:
     """Materialize a one-shot batch stream into a replayable cache.
 
@@ -329,16 +345,28 @@ class PrefetchingDeviceFeed:
         def worker():
             try:
                 for b in batches:
-                    if self._stop.is_set():
-                        return
-                    self._q.put(self._place(b))
+                    if not self._put(self._place(b)):
+                        return  # closed while blocked — drop and exit
             except BaseException as e:  # surfaced on next()
                 self._err = e
             finally:
-                self._q.put(self._END)
+                # Abort-aware blocking put: must not be dropped when the
+                # queue is momentarily full (a consumer would then block
+                # forever), and must not block after close() (_put aborts).
+                self._put(self._END)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Blocking put that aborts when the feed is closed; True if queued."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def __iter__(self) -> "PrefetchingDeviceFeed":
         return self
@@ -355,8 +383,19 @@ class PrefetchingDeviceFeed:
         return item
 
     def close(self) -> None:
+        """Stop the worker and release queued device batches. Idempotent."""
         self._stop.set()
-        # Drain so the worker's blocked put() wakes and exits.
+        self._done = True  # next() after close() must not block
+        # Drain until the worker exits: its timed put() observes _stop within
+        # one timeout tick, so no put can block forever (review finding: a
+        # single drain raced with an in-flight put and leaked the thread).
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
         try:
             while True:
                 self._q.get_nowait()

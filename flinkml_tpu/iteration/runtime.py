@@ -112,6 +112,35 @@ class IterationListener:
         ...
 
 
+class ForwardInputsOfLastRound(IterationListener):
+    """Capture only the final round's value and expose it after termination.
+
+    Parity: ``ml/common/iteration/ForwardInputsOfLastRound.java:34-60`` —
+    the reference buffers each epoch's records and discards them when the
+    next epoch's watermark arrives, emitting only the last round's buffer at
+    termination (KMeans uses it to emit final centroids,
+    ``KMeans.java:197-198``). Here each epoch's captured value simply
+    overwrites the previous one; ``value`` is valid once
+    ``on_iteration_terminated`` has fired (``terminated`` is True).
+
+    ``extract`` maps the loop state to the value to forward (default:
+    identity).
+    """
+
+    def __init__(self, extract: Optional[Callable[[Any], Any]] = None):
+        self._extract = extract if extract is not None else (lambda s: s)
+        self.value: Any = None
+        self.terminated = False
+
+    def on_iteration_terminated(self, state: Any) -> None:
+        # Extracting once here is observationally identical to the
+        # reference's buffer-per-epoch-discard-on-advance: intermediate
+        # rounds are never visible, so don't pay extract() (often a
+        # device→host transfer) for them.
+        self.value = self._extract(state)
+        self.terminated = True
+
+
 @dataclasses.dataclass
 class IterationConfig:
     """Runtime knobs. Parity: ``IterationConfig.java:22-66`` +

@@ -5,6 +5,11 @@ from flinkml_tpu.parallel.collectives import (
     keyed_aggregate,
     map_partition,
 )
+from flinkml_tpu.parallel.broadcast_utils import (
+    BroadcastContext,
+    get_broadcast_variable,
+    with_broadcast,
+)
 
 __all__ = [
     "DeviceMesh",
@@ -13,4 +18,7 @@ __all__ = [
     "broadcast",
     "keyed_aggregate",
     "map_partition",
+    "BroadcastContext",
+    "get_broadcast_variable",
+    "with_broadcast",
 ]

@@ -1,0 +1,95 @@
+"""Broadcast variables — the ``BroadcastUtils`` analog.
+
+Parity (SURVEY.md §2.1): ``BroadcastUtils.withBroadcastStream(inputs,
+bcStreams, fn)`` (``ml/common/broadcast/BroadcastUtils.java:67-155``) builds
+``fn``'s subgraph in a draft environment, co-locates a receiver operator
+with the consumer, and *blocks/caches the input to disk* until every
+broadcast variable has fully arrived, exposing them through a per-TM static
+registry (``BroadcastContext.java:40-84``) via
+``getBroadcastVariable(name)``.
+
+TPU-native redesign: a broadcast variable is a *replicated device value* —
+``jax.device_put`` with a fully-replicated sharding over the mesh. The
+receiver/caching/blocking machinery (≈1.9k LoC in the reference) does not
+exist because SPMD replication is a data placement performed before the
+consumer runs, not a runtime protocol. What survives is the API shape: a
+named registry scoped to one ``with_broadcast`` call, readable from inside
+the user function via :func:`get_broadcast_variable` — so algorithm code
+keeps the reference's idiom (e.g.
+``LogisticRegressionModel.PredictLabelFunction`` reads the model via
+``getBroadcastVariable``, ``LogisticRegressionModel.java:133-170``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+from flinkml_tpu.parallel.mesh import DeviceMesh
+
+_local = threading.local()
+
+
+class BroadcastContext:
+    """Per-thread registry of live broadcast variables.
+
+    Parity: the reference's static per-TM ``BroadcastContext`` map; here the
+    scope is one ``with_broadcast`` call on the calling thread (nested calls
+    shadow outer names, like nested broadcast scopes).
+    """
+
+    @staticmethod
+    def _stack() -> list:
+        if not hasattr(_local, "stack"):
+            _local.stack = []
+        return _local.stack
+
+    @staticmethod
+    def lookup(name: str) -> Any:
+        for frame in reversed(BroadcastContext._stack()):
+            if name in frame:
+                return frame[name]
+        raise KeyError(
+            f"no broadcast variable {name!r} in scope; available: "
+            f"{sorted(set().union(*BroadcastContext._stack()) if BroadcastContext._stack() else set())}"
+        )
+
+
+def get_broadcast_variable(name: str) -> Any:
+    """Read a broadcast variable from inside a ``with_broadcast`` function.
+
+    Parity: ``BroadcastStreamingRuntimeContext.getBroadcastVariable``.
+    """
+    return BroadcastContext.lookup(name)
+
+
+def with_broadcast(
+    fn: Callable,
+    inputs: Sequence[Any] = (),
+    broadcast_variables: Optional[Mapping[str, Any]] = None,
+    mesh: Optional[DeviceMesh] = None,
+):
+    """Run ``fn(*inputs)`` with named variables replicated to every device.
+
+    Parity: ``BroadcastUtils.withBroadcastStream`` — except nothing blocks:
+    each variable is placed replicated (over ``mesh`` if given, else the
+    default device) *before* ``fn`` runs, which is exactly the guarantee the
+    reference's cache-until-ready wrapper fights its runtime to provide.
+    """
+    broadcast_variables = dict(broadcast_variables or {})
+    placed = {
+        name: (mesh.replicate(v) if mesh is not None else _default_put(v))
+        for name, v in broadcast_variables.items()
+    }
+    stack = BroadcastContext._stack()
+    stack.append(placed)
+    try:
+        return fn(*inputs)
+    finally:
+        stack.pop()
+
+
+def _default_put(value: Any):
+    import jax
+
+    return jax.device_put(value)

@@ -37,3 +37,10 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(2024)
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    from flinkml_tpu.parallel import DeviceMesh
+
+    return DeviceMesh()

@@ -87,6 +87,34 @@ def test_ragged_columns_rejected():
         w.append({"a": np.zeros(3), "b": np.zeros(4)})
 
 
+def test_budget_without_directory_rejected():
+    with pytest.raises(ValueError, match="spill directory"):
+        DataCacheWriter(memory_budget_bytes=1024)
+
+
+def test_mem_batches_are_frozen_against_mutation():
+    batches = _batches(1)
+    cache = cache_stream(iter(batches))
+    out = next(cache.reader())
+    with pytest.raises(ValueError):
+        out["features"][0, 0] = 99.0  # in-place mutation must fail loudly
+    # Dict-level replacement is fine and must not alter the cache.
+    out["features"] = np.zeros_like(np.asarray(out["features"]))
+    np.testing.assert_array_equal(
+        next(cache.reader())["features"], batches[0]["features"]
+    )
+
+
+def test_feed_close_while_worker_blocked_exits():
+    feed = PrefetchingDeviceFeed(iter(_batches(8)), depth=1)
+    next(feed)  # worker now blocked on a full queue
+    feed.close()
+    feed._thread.join(timeout=5)
+    assert not feed._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(feed)
+
+
 def test_object_dtype_rejected_on_spill(tmp_path):
     w = DataCacheWriter(str(tmp_path), memory_budget_bytes=0)
     obj = np.empty(2, dtype=object)

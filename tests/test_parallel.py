@@ -18,11 +18,6 @@ from flinkml_tpu.parallel import (
 )
 
 
-@pytest.fixture(scope="module")
-def mesh():
-    return DeviceMesh()
-
-
 def test_eight_devices_available():
     assert len(jax.devices()) == 8
 
