@@ -146,18 +146,21 @@ class DataCacheWriter:
                 raise ValueError(
                     f"column {name!r} has {a.shape[0]} rows, expected {rows}"
                 )
-        # RAM-resident batches are handed back by reference on every epoch;
-        # freeze them so in-place mutation by a consumer fails loudly instead
-        # of silently corrupting later epochs (spilled batches re-read fresh).
-        for a in batch.values():
-            a.flags.writeable = False
         self._num_rows += rows
         if (
             self.directory is not None
             and self._mem_bytes + nbytes > self.memory_budget_bytes
         ):
+            # Spilled batches are copied to disk and re-read fresh each
+            # epoch; the caller's arrays stay untouched (and reusable).
             self._spill(batch)
         else:
+            # RAM-resident batches are handed back by reference on every
+            # epoch; freeze them so in-place mutation — by a consumer or by
+            # the producer reusing its buffer — fails loudly instead of
+            # silently corrupting later epochs.
+            for a in batch.values():
+                a.flags.writeable = False
             self._entries.append(batch)
             self._mem_bytes += nbytes
 

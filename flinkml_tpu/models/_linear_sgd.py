@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from flinkml_tpu.ops import pallas_kernels
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 
 _LOSS_KEYS = ("logistic", "hinge", "squared")
@@ -65,6 +66,15 @@ def _soft_threshold(x, t):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
 
 
+def align_local_bs(global_batch_size: int, p_size: int, n_local: int) -> int:
+    """Per-device batch: ceil(global/p) rounded up to the 8-row Pallas tile,
+    clamped to the shard. Shards are padded to multiples of 8 (zero-weight
+    rows), so the clamp preserves alignment and the fused kernel stays
+    reachable at any requested batch size."""
+    bs = max(1, math.ceil(global_batch_size / p_size))
+    return min(((bs + 7) // 8) * 8, n_local)
+
+
 def _window(arr, epoch, local_bs):
     """Contiguous rotating window with ceil coverage (tail included via
     dynamic_slice clamping)."""
@@ -76,18 +86,30 @@ def _window(arr, epoch, local_bs):
     return jax.lax.dynamic_slice(arr, (start, zero), (local_bs, arr.shape[1]))
 
 
-def make_dense_step(loss: str, local_bs: int, axis: str):
-    """Per-device epoch: window → margin grad on MXU → psum → prox update."""
+def make_dense_step(loss: str, local_bs: int, axis: str, use_pallas: bool = False):
+    """Per-device epoch: window → margin grad on MXU → psum → prox update.
+
+    With ``use_pallas`` (batch must be tile-aligned), the gradient uses the
+    fused Pallas kernel (``ops.pallas_kernels.fused_linear_grad``) — one HBM
+    pass over the batch instead of XLA's two (forward + back matmul)."""
 
     def step(coef, epoch, xl, yl, wl, learning_rate, reg_l2, reg_l1):
         xb = _window(xl, epoch, local_bs)
         yb = _window(yl, epoch, local_bs)
         wb = _window(wl, epoch, local_bs)
-        dot = xb @ coef
-        mult, per_ex = _margin_grad(loss, dot, yb, wb)
-        grad = jax.lax.psum(xb.T @ mult, axis)
-        loss_sum = jax.lax.psum(jnp.sum(per_ex), axis)
-        wsum = jax.lax.psum(jnp.sum(wb), axis)
+        if use_pallas:
+            grad_l, loss_l, wsum_l = pallas_kernels.fused_linear_grad(
+                xb, yb, wb, coef, loss=loss
+            )
+        else:
+            dot = xb @ coef
+            mult, per_ex = _margin_grad(loss, dot, yb, wb)
+            grad_l = xb.T @ mult
+            loss_l = jnp.sum(per_ex)
+            wsum_l = jnp.sum(wb)
+        grad = jax.lax.psum(grad_l, axis)
+        loss_sum = jax.lax.psum(loss_l, axis)
+        wsum = jax.lax.psum(wsum_l, axis)
         grad = grad + 2.0 * reg_l2 * coef
         loss_sum = loss_sum + reg_l2 * jnp.sum(coef * coef)
         step_size = learning_rate / wsum
@@ -124,8 +146,8 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
 
 
 @functools.lru_cache(maxsize=128)
-def _dense_trainer(mesh, loss: str, local_bs: int, axis: str):
-    local_step = make_dense_step(loss, local_bs, axis)
+def _dense_trainer(mesh, loss: str, local_bs: int, axis: str, use_pallas: bool):
+    local_step = make_dense_step(loss, local_bs, axis, use_pallas)
 
     def per_device(xl, yl, wl, learning_rate, reg_l2, reg_l1, tol, max_iter):
         def cond(carry):
@@ -153,6 +175,7 @@ def _dense_trainer(mesh, loss: str, local_bs: int, axis: str):
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=P(),
+            check_vma=False,  # pallas_call out_shapes carry no vma
         )
     )
 
@@ -221,16 +244,19 @@ def train_linear_model(
         x, y, w = x.astype(dtype), y.astype(dtype), w.astype(dtype)
     perm = np.random.default_rng(seed).permutation(n)
     x, y, w = x[perm], y[perm], w[perm]
-    x_pad, _ = pad_to_multiple(x, p_size)
-    y_pad, _ = pad_to_multiple(y, p_size)
-    w_pad, _ = pad_to_multiple(w, p_size)
+    x_pad, _ = pad_to_multiple(x, p_size * 8)
+    y_pad, _ = pad_to_multiple(y, p_size * 8)
+    w_pad, _ = pad_to_multiple(w, p_size * 8)
     xd = mesh.shard_batch(x_pad)
     yd = mesh.shard_batch(y_pad)
     wd = mesh.shard_batch(w_pad)
     n_local = xd.shape[0] // p_size
-    local_bs = min(max(1, math.ceil(global_batch_size / p_size)), n_local)
+    local_bs = align_local_bs(global_batch_size, p_size, n_local)
     dt = xd.dtype
-    trainer = _dense_trainer(mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS)
+    trainer = _dense_trainer(
+        mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS,
+        pallas_kernels.pallas_enabled(local_bs),
+    )
     coef = trainer(
         xd, yd, wd,
         jnp.asarray(learning_rate, dt),

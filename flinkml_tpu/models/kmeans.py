@@ -40,7 +40,7 @@ from flinkml_tpu.common_params import (
 )
 from flinkml_tpu.models._data import features_matrix
 from flinkml_tpu.params import IntParam, ParamValidators, StringParam
-from flinkml_tpu.ops import blas
+from flinkml_tpu.ops import blas, pallas_kernels
 from flinkml_tpu.ops.distance import DistanceMeasure
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
@@ -151,18 +151,27 @@ class KMeansModel(_KMeansParams, Model):
 
 
 @functools.lru_cache(maxsize=64)
-def _kmeans_trainer(mesh, k: int, axis: str):
+def _kmeans_trainer(mesh, k: int, axis: str, use_pallas: bool):
     """Whole Lloyd loop as one XLA program, cached per (mesh, k)."""
 
     def per_device(xl, wl, init_centroids, max_iter):
         def body(_, centroids):
-            # Assignment: argmin over pairwise squared distances (MXU matmul).
-            d2 = blas.squared_distances(xl, centroids)
-            assign = jnp.argmin(d2, axis=-1)
-            # Per-cluster sums via one-hot matmul; padded rows have w=0.
-            onehot = jax.nn.one_hot(assign, k, dtype=xl.dtype) * wl[:, None]
-            sums = jax.lax.psum(onehot.T @ xl, axis)
-            counts = jax.lax.psum(jnp.sum(onehot, axis=0), axis)
+            if use_pallas:
+                # Fused Pallas Lloyd pass: distances + argmin + one-hot
+                # accumulation in one read of the points.
+                sums_l, counts_l = pallas_kernels.fused_kmeans_step(
+                    xl, wl, centroids
+                )
+            else:
+                # Assignment: argmin over pairwise squared distances (MXU).
+                d2 = blas.squared_distances(xl, centroids)
+                assign = jnp.argmin(d2, axis=-1)
+                # Per-cluster sums via one-hot matmul; padded rows have w=0.
+                onehot = jax.nn.one_hot(assign, k, dtype=xl.dtype) * wl[:, None]
+                sums_l = onehot.T @ xl
+                counts_l = jnp.sum(onehot, axis=0)
+            sums = jax.lax.psum(sums_l, axis)
+            counts = jax.lax.psum(counts_l, axis)
             # Empty clusters keep their previous centroid.
             safe = jnp.maximum(counts, 1.0)[:, None]
             new_centroids = jnp.where(
@@ -178,6 +187,7 @@ def _kmeans_trainer(mesh, k: int, axis: str):
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P()),
             out_specs=P(),
+            check_vma=False,  # pallas_call out_shapes carry no vma
         )
     )
 
@@ -212,13 +222,18 @@ def train_kmeans(
         init_centroids = np.ascontiguousarray(x[init_idx])
 
     p_size = mesh.axis_size()
-    x_pad, n_valid = pad_to_multiple(x, p_size)
+    # Pad local shards to the Pallas row tile (8) so the fused Lloyd
+    # kernel applies; zero-weight rows are exact no-ops either way.
+    x_pad, n_valid = pad_to_multiple(x, p_size * 8)
     w = np.zeros(x_pad.shape[0], dtype=x.dtype)
     w[:n_valid] = 1.0  # mask: padded rows never influence centroids
     xd = mesh.shard_batch(x_pad)
     wd = mesh.shard_batch(w)
 
-    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS)
+    trainer = _kmeans_trainer(
+        mesh.mesh, k, DeviceMesh.DATA_AXIS,
+        pallas_kernels.pallas_enabled(x_pad.shape[0] // p_size),
+    )
     centroids = trainer(
         xd, wd, jnp.asarray(init_centroids), jnp.asarray(max_iter, jnp.int32)
     )

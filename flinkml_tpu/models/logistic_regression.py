@@ -56,6 +56,7 @@ from flinkml_tpu.linalg import SparseVector
 from flinkml_tpu.models import _linear_sgd
 from flinkml_tpu.models._coefficient import CoefficientModelMixin
 from flinkml_tpu.models._data import features_matrix, labeled_data
+from flinkml_tpu.ops import pallas_kernels
 from flinkml_tpu.ops.sparse import BatchedCSR
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
@@ -219,9 +220,9 @@ def _predict(x, coef):
 
 
 def _shard_training_data(x, y, w, mesh: DeviceMesh):
-    """Pad to the mesh and shard; padded rows carry weight 0 so they never
-    contribute to any weighted sum."""
-    p_size = mesh.axis_size()
+    """Pad to the mesh × the 8-row Pallas tile and shard; padded rows carry
+    weight 0 so they never contribute to any weighted sum."""
+    p_size = mesh.axis_size() * 8
     x_pad, _ = pad_to_multiple(x, p_size)
     y_pad, _ = pad_to_multiple(y, p_size)
     w_pad, _ = pad_to_multiple(w, p_size)
@@ -236,7 +237,10 @@ def _shard_training_data(x, y, w, mesh: DeviceMesh):
 # shuffled SGD with full-bandwidth streaming reads.
 def _device_trainer(mesh, local_bs: int, axis: str):
     """Whole-training-run XLA program for logistic loss (cached)."""
-    return _linear_sgd._dense_trainer(mesh, "logistic", local_bs, axis)
+    return _linear_sgd._dense_trainer(
+        mesh, "logistic", local_bs, axis,
+        pallas_kernels.pallas_enabled(local_bs),
+    )
 
 
 def train_logistic_regression(
@@ -297,17 +301,20 @@ def train_logistic_regression(
 
     # Reference: localBatchSize = globalBatchSize / numTasks (+1 for low
     # task ids on remainder, LogisticRegression.java:336-341). Here every
-    # device takes the ceiling, clamped to its shard.
-    local_bs = min(max(1, math.ceil(global_batch_size / p_size)), n_local)
+    # device takes the ceiling, tile-aligned and clamped to its shard.
+    local_bs = _linear_sgd.align_local_bs(global_batch_size, p_size, n_local)
     axis = DeviceMesh.DATA_AXIS
     dt = xd.dtype
 
-    local_step = _linear_sgd.make_dense_step("logistic", local_bs, axis)
+    local_step = _linear_sgd.make_dense_step(
+        "logistic", local_bs, axis, pallas_kernels.pallas_enabled(local_bs)
+    )
     sharded_step = jax.shard_map(
         local_step,
         mesh=mesh.mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P(), P()),
         out_specs=(P(), P()),
+        check_vma=False,  # pallas_call out_shapes carry no vma
     )
 
     @jax.jit

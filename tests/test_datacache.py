@@ -94,14 +94,27 @@ def test_budget_without_directory_rejected():
 
 def test_mem_batches_are_frozen_against_mutation():
     batches = _batches(1)
+    original = np.array(batches[0]["features"], copy=True)
     cache = cache_stream(iter(batches))
     out = next(cache.reader())
     with pytest.raises(ValueError):
         out["features"][0, 0] = 99.0  # in-place mutation must fail loudly
     # Dict-level replacement is fine and must not alter the cache.
     out["features"] = np.zeros_like(np.asarray(out["features"]))
+    np.testing.assert_array_equal(next(cache.reader())["features"], original)
+
+
+def test_spilled_batches_leave_caller_buffer_reusable(tmp_path):
+    from flinkml_tpu.iteration.datacache import DataCacheWriter
+
+    writer = DataCacheWriter(directory=str(tmp_path), memory_budget_bytes=0)
+    buf = np.arange(12, dtype=np.float64).reshape(3, 4)
+    writer.append({"features": buf})
+    buf[:] = -1.0  # spilled → producer may reuse its staging buffer
+    cache = writer.finish()
     np.testing.assert_array_equal(
-        next(cache.reader())["features"], batches[0]["features"]
+        next(cache.reader())["features"],
+        np.arange(12, dtype=np.float64).reshape(3, 4),
     )
 
 

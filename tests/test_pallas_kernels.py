@@ -1,0 +1,134 @@
+"""Golden-value tests for the Pallas hot-loop kernels.
+
+Run in interpreter mode on the CPU test mesh (same kernel code the TPU
+compiles); every kernel is compared against the straight-line jnp math it
+fuses, which itself is covered against sklearn/numpy elsewhere.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flinkml_tpu.models._linear_sgd import _margin_grad
+from flinkml_tpu.ops.pallas_kernels import (
+    _pick_tile,
+    fused_kmeans_step,
+    fused_linear_grad,
+)
+
+
+def _ref_linear_grad(x, y, w, coef, loss):
+    dot = x @ coef
+    mult, per_ex = _margin_grad(loss, dot, y, w)
+    return x.T @ mult, jnp.sum(per_ex), jnp.sum(w)
+
+
+@pytest.mark.parametrize("loss", ["logistic", "hinge", "squared"])
+@pytest.mark.parametrize("n,d", [(8, 4), (64, 123), (48, 16)])
+def test_fused_linear_grad_matches_unfused(loss, n, d):
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=n), dtype=jnp.float32)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, size=n), dtype=jnp.float32)
+    coef = jnp.asarray(rng.normal(size=d), dtype=jnp.float32)
+    grad, loss_sum, wsum = fused_linear_grad(
+        x, y, w, coef, loss=loss, interpret=True
+    )
+    g_ref, l_ref, w_ref = _ref_linear_grad(x, y, w, coef, loss)
+    np.testing.assert_allclose(grad, g_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(loss_sum, l_ref, rtol=1e-5)
+    np.testing.assert_allclose(wsum, w_ref, rtol=1e-6)
+
+
+def test_fused_linear_grad_zero_weight_rows_are_noops():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(16, 5)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=16), dtype=jnp.float32)
+    w = jnp.ones(16, dtype=jnp.float32).at[8:].set(0.0)
+    coef = jnp.asarray(rng.normal(size=5), dtype=jnp.float32)
+    grad, loss_sum, wsum = fused_linear_grad(
+        x, y, w, coef, loss="logistic", interpret=True
+    )
+    g_ref, l_ref, _ = _ref_linear_grad(x[:8], y[:8], w[:8], coef, "logistic")
+    np.testing.assert_allclose(grad, g_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(loss_sum, l_ref, rtol=1e-5)
+    assert float(wsum) == 8.0
+
+
+@pytest.mark.parametrize("n,d,k", [(32, 4, 3), (64, 7, 5), (8, 2, 2)])
+def test_fused_kmeans_step_matches_onehot(n, d, k):
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    w = jnp.asarray((rng.uniform(size=n) > 0.2), dtype=jnp.float32)
+    cents = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    sums, counts = fused_kmeans_step(x, w, cents, interpret=True)
+
+    d2 = ((x[:, None, :] - cents[None, :, :]) ** 2).sum(-1)
+    assign = jnp.argmin(d2, axis=1)
+    onehot = jnp.eye(k, dtype=x.dtype)[assign] * w[:, None]
+    np.testing.assert_allclose(sums, onehot.T @ x, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(counts, onehot.sum(0), rtol=1e-6)
+
+
+def test_fused_kmeans_step_tie_breaks_to_lowest_index():
+    # Two identical centroids: argmin must pick index 0, like jnp.argmin.
+    x = jnp.asarray([[1.0, 0.0]] * 8, dtype=jnp.float32)
+    w = jnp.ones(8, dtype=jnp.float32)
+    cents = jnp.asarray([[0.0, 0.0], [0.0, 0.0]], dtype=jnp.float32)
+    sums, counts = fused_kmeans_step(x, w, cents, interpret=True)
+    np.testing.assert_allclose(counts, [8.0, 0.0])
+    np.testing.assert_allclose(sums[0], [8.0, 0.0])
+
+
+def test_pick_tile_rejects_unpadded():
+    with pytest.raises(ValueError):
+        _pick_tile(13)
+    assert _pick_tile(512) == 512
+    assert _pick_tile(24) == 8
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: trainers with the Pallas path forced on (interpret on CPU)
+# ---------------------------------------------------------------------------
+
+def _lr_data(n=64, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    true = rng.normal(size=d)
+    y = (x @ true + 0.1 * rng.normal(size=n) > 0).astype(np.float64)
+    return x, y, np.ones(n)
+
+
+def test_train_linear_model_pallas_matches_xla(monkeypatch):
+    from flinkml_tpu.models._linear_sgd import train_linear_model
+    from flinkml_tpu.parallel import DeviceMesh
+
+    x, y, w = _lr_data()
+    kw = dict(
+        loss="logistic", mesh=DeviceMesh(), max_iter=30, learning_rate=0.5,
+        global_batch_size=64, reg=0.01, elastic_net=0.0, tol=0.0, seed=1,
+        dtype=np.float32,
+    )
+    monkeypatch.setenv("FLINKML_TPU_PALLAS", "never")
+    coef_xla = train_linear_model(x, y, w, **kw)
+    monkeypatch.setenv("FLINKML_TPU_PALLAS", "always")
+    coef_pl = train_linear_model(x, y, w, **kw)
+    np.testing.assert_allclose(coef_pl, coef_xla, rtol=2e-4, atol=2e-5)
+
+
+def test_train_kmeans_pallas_matches_xla(monkeypatch):
+    from flinkml_tpu.models.kmeans import train_kmeans
+    from flinkml_tpu.parallel import DeviceMesh
+
+    rng = np.random.default_rng(5)
+    x = np.concatenate(
+        [rng.normal(loc=c, scale=0.3, size=(40, 3)) for c in (-3.0, 0.0, 3.0)]
+    )
+    kw = dict(k=3, mesh=DeviceMesh(), max_iter=10, seed=2)
+    monkeypatch.setenv("FLINKML_TPU_PALLAS", "never")
+    c_xla = train_kmeans(x.astype(np.float32), **kw)
+    monkeypatch.setenv("FLINKML_TPU_PALLAS", "always")
+    c_pl = train_kmeans(x.astype(np.float32), **kw)
+    np.testing.assert_allclose(
+        np.sort(c_pl, axis=0), np.sort(c_xla, axis=0), rtol=1e-4, atol=1e-4
+    )
