@@ -40,26 +40,9 @@ from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 _LOSS_KEYS = ("logistic", "hinge", "squared")
 
 
-def _margin_grad(loss: str, dot, y, w):
-    """Returns (dloss/ddot weighted, per-example loss weighted)."""
-    if loss == "logistic":
-        ys = 2.0 * y - 1.0
-        margin = dot * ys
-        mult = w * (-ys * jax.nn.sigmoid(-margin))
-        per_ex = w * jax.nn.softplus(-margin)
-    elif loss == "hinge":
-        ys = 2.0 * y - 1.0
-        margin = dot * ys
-        active = (margin < 1.0).astype(dot.dtype)
-        mult = w * (-ys * active)
-        per_ex = w * jnp.maximum(0.0, 1.0 - margin)
-    elif loss == "squared":
-        resid = dot - y
-        mult = w * resid
-        per_ex = 0.5 * w * resid * resid
-    else:  # pragma: no cover - guarded by callers
-        raise ValueError(f"unknown loss {loss!r}")
-    return mult, per_ex
+# The margin-gradient math is shared verbatim with the fused Pallas kernel
+# (single source of truth — the fused and unfused paths must agree exactly).
+_margin_grad = pallas_kernels._margin_terms
 
 
 def _soft_threshold(x, t):
@@ -67,12 +50,14 @@ def _soft_threshold(x, t):
 
 
 def align_local_bs(global_batch_size: int, p_size: int, n_local: int) -> int:
-    """Per-device batch: ceil(global/p) rounded up to the 8-row Pallas tile,
-    clamped to the shard. Shards are padded to multiples of 8 (zero-weight
-    rows), so the clamp preserves alignment and the fused kernel stays
-    reachable at any requested batch size."""
+    """Per-device batch: ceil(global/p), rounded up to the 8-row tile when
+    the Pallas path is in play (so the fused kernel stays reachable at any
+    requested batch size), clamped to the shard. Without Pallas the
+    requested batch is honored exactly — no silent inflation."""
     bs = max(1, math.ceil(global_batch_size / p_size))
-    return min(((bs + 7) // 8) * 8, n_local)
+    if pallas_kernels.pallas_active():
+        bs = ((bs + 7) // 8) * 8
+    return min(bs, n_local)
 
 
 def _window(arr, epoch, local_bs):
@@ -175,7 +160,9 @@ def _dense_trainer(mesh, loss: str, local_bs: int, axis: str, use_pallas: bool):
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=P(),
-            check_vma=False,  # pallas_call out_shapes carry no vma
+            # pallas_call out_shapes carry no vma; keep the replication
+            # check whenever the plain-XLA path runs.
+            check_vma=not use_pallas,
         )
     )
 
@@ -244,9 +231,12 @@ def train_linear_model(
         x, y, w = x.astype(dtype), y.astype(dtype), w.astype(dtype)
     perm = np.random.default_rng(seed).permutation(n)
     x, y, w = x[perm], y[perm], w[perm]
-    x_pad, _ = pad_to_multiple(x, p_size * 8)
-    y_pad, _ = pad_to_multiple(y, p_size * 8)
-    w_pad, _ = pad_to_multiple(w, p_size * 8)
+    # Shards align to the 8-row tile only when the Pallas path is in play;
+    # otherwise pad exactly to the mesh (identical windows to the baseline).
+    row_tile = p_size * 8 if pallas_kernels.pallas_active() else p_size
+    x_pad, _ = pad_to_multiple(x, row_tile)
+    y_pad, _ = pad_to_multiple(y, row_tile)
+    w_pad, _ = pad_to_multiple(w, row_tile)
     xd = mesh.shard_batch(x_pad)
     yd = mesh.shard_batch(y_pad)
     wd = mesh.shard_batch(w_pad)

@@ -187,7 +187,9 @@ def _kmeans_trainer(mesh, k: int, axis: str, use_pallas: bool):
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P()),
             out_specs=P(),
-            check_vma=False,  # pallas_call out_shapes carry no vma
+            # pallas_call out_shapes carry no vma; keep the replication
+            # check whenever the plain-XLA path runs.
+            check_vma=not use_pallas,
         )
     )
 

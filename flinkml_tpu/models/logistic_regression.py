@@ -220,12 +220,14 @@ def _predict(x, coef):
 
 
 def _shard_training_data(x, y, w, mesh: DeviceMesh):
-    """Pad to the mesh × the 8-row Pallas tile and shard; padded rows carry
-    weight 0 so they never contribute to any weighted sum."""
-    p_size = mesh.axis_size() * 8
-    x_pad, _ = pad_to_multiple(x, p_size)
-    y_pad, _ = pad_to_multiple(y, p_size)
-    w_pad, _ = pad_to_multiple(w, p_size)
+    """Pad to the mesh (× the 8-row tile when the Pallas path is in play)
+    and shard; padded rows carry weight 0 so they never contribute to any
+    weighted sum."""
+    p_size = mesh.axis_size()
+    row_tile = p_size * 8 if pallas_kernels.pallas_active() else p_size
+    x_pad, _ = pad_to_multiple(x, row_tile)
+    y_pad, _ = pad_to_multiple(y, row_tile)
+    w_pad, _ = pad_to_multiple(w, row_tile)
     return mesh.shard_batch(x_pad), mesh.shard_batch(y_pad), mesh.shard_batch(w_pad)
 
 
@@ -306,15 +308,16 @@ def train_logistic_regression(
     axis = DeviceMesh.DATA_AXIS
     dt = xd.dtype
 
-    local_step = _linear_sgd.make_dense_step(
-        "logistic", local_bs, axis, pallas_kernels.pallas_enabled(local_bs)
-    )
+    use_pallas = pallas_kernels.pallas_enabled(local_bs)
+    local_step = _linear_sgd.make_dense_step("logistic", local_bs, axis, use_pallas)
     sharded_step = jax.shard_map(
         local_step,
         mesh=mesh.mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P(), P()),
         out_specs=(P(), P()),
-        check_vma=False,  # pallas_call out_shapes carry no vma
+        # pallas_call out_shapes carry no vma; keep the replication check
+        # whenever the plain-XLA path runs.
+        check_vma=not use_pallas,
     )
 
     @jax.jit

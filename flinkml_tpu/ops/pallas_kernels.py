@@ -37,22 +37,25 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def pallas_enabled(n_rows: int) -> bool:
-    """Whether the fused kernels should replace the plain-XLA hot loops.
+def pallas_active() -> bool:
+    """Whether the fused kernels are in play at all (env/backend gate).
 
     ``FLINKML_TPU_PALLAS``: ``auto`` (default — TPU backend only),
     ``always`` (any backend, interpret mode off-TPU; used by the test
     suite), or ``never`` (kill switch if a Mosaic regression ever bites).
-    Rows must be a multiple of the minimum tile regardless.
     """
-    if n_rows % 8 != 0:
-        return False
     mode = os.environ.get("FLINKML_TPU_PALLAS", "auto").lower()
     if mode == "never":
         return False
     if mode == "always":
         return True
     return jax.default_backend() == "tpu"
+
+
+def pallas_enabled(n_rows: int) -> bool:
+    """``pallas_active()`` plus the shape requirement: rows must be a
+    multiple of the minimum (f32 sublane) tile."""
+    return n_rows % 8 == 0 and pallas_active()
 
 # Row-tile heights to try, best first. All multiples of the f32 sublane
 # tile (8); the largest divisor of the batch is picked so the grid is
@@ -78,8 +81,12 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 def _margin_terms(loss: str, dot, y, w):
-    """(d loss/d margin, per-example loss), weighted. Must match
-    ``models._linear_sgd._margin_grad`` exactly — tests compare them."""
+    """(d loss/d margin, per-example loss), weighted.
+
+    The single source of the margin math — ``models._linear_sgd`` aliases
+    this as ``_margin_grad`` so the fused and unfused paths cannot drift.
+    Losses mirror the reference (``LogisticGradient.java:50-96`` for
+    logistic; hinge/squared extend the family)."""
     if loss == "logistic":
         ys = 2.0 * y - 1.0
         margin = dot * ys

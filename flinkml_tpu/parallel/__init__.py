@@ -10,6 +10,11 @@ from flinkml_tpu.parallel.broadcast_utils import (
     get_broadcast_variable,
     with_broadcast,
 )
+from flinkml_tpu.parallel.distributed import (
+    host_barrier,
+    init_distributed,
+    process_slice,
+)
 
 __all__ = [
     "DeviceMesh",
@@ -21,4 +26,7 @@ __all__ = [
     "BroadcastContext",
     "get_broadcast_variable",
     "with_broadcast",
+    "host_barrier",
+    "init_distributed",
+    "process_slice",
 ]

@@ -1,0 +1,111 @@
+"""Multi-host runtime: initialization, control-plane barrier, data slicing.
+
+SURVEY.md §5 "Distributed communication backend" maps the reference's three
+channels onto TPU pods:
+
+  1. data-plane (Flink's Netty credit-based shuffles between subtasks,
+     ``AllReduceImpl.java:79-93``) → XLA collectives over **ICI**, emitted
+     by the compiler from shardings (see ``parallel/collectives.py``);
+  2. feedback-plane (in-JVM ``FeedbackChannel`` between co-located
+     tail/head, ``TailOperator.java:81-88``) → the host loop carry —
+     no channel exists;
+  3. control-plane (``OperatorEventGateway`` RPC between head subtasks and
+     the JobManager-resident ``SharedProgressAligner``,
+     ``SharedProgressAligner.java:127-158``) → **this module**: the
+     ``jax.distributed`` coordination service over DCN for process startup,
+     plus a device-mediated global barrier for the few host-side sync
+     points (checkpoint commit, termination agreement).
+
+On a single host everything degrades to no-ops, so the same training
+script runs unchanged from a laptop CPU mesh to a multi-host pod slice.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Join the jax.distributed coordination service (DCN control plane).
+
+    Call once per process before any device computation, on every host of
+    the pod slice. Arguments default from the standard environment
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``, as set by most TPU launchers); with no coordinator
+    configured this is a single-process no-op.
+
+    Returns ``(process_index, process_count)``.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address and jax.process_count() == 1:
+        num_processes = num_processes or int(
+            os.environ.get("JAX_NUM_PROCESSES", "1")
+        )
+        process_id = process_id if process_id is not None else int(
+            os.environ.get("JAX_PROCESS_ID", "0")
+        )
+        if num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+    return jax.process_index(), jax.process_count()
+
+
+def host_barrier(mesh=None, tag: int = 0) -> int:
+    """Global barrier across all hosts/devices; returns ``tag``'s psum.
+
+    The SPMD data-plane is implicitly synchronized; this is for the rare
+    *host-side* rendezvous (the reference used coordinator RPC +
+    ``SharedProgressAligner``): e.g. "all hosts finished writing their
+    checkpoint shard" before committing a manifest. Implemented as a tiny
+    ``psum`` so it rides the same ICI/DCN fabric as the data plane and
+    needs no extra service.
+
+    ``mesh``: a :class:`flinkml_tpu.parallel.DeviceMesh` (defaults to a
+    fresh all-devices mesh).
+    """
+    from flinkml_tpu.parallel.mesh import DeviceMesh
+
+    dm = mesh if mesh is not None else DeviceMesh()
+    axis = dm.axis_names[0]
+
+    def _one(x):
+        return jax.lax.psum(x, axis)
+
+    summed = jax.jit(
+        jax.shard_map(
+            _one, mesh=dm.mesh, in_specs=P(axis), out_specs=P(None)
+        )
+    )(jnp.full((dm.axis_size(),), tag, dtype=jnp.int32))
+    # Host blocks until every participant contributed.
+    return int(np.asarray(summed)[0])
+
+
+def process_slice(n: int, process_index: Optional[int] = None,
+                  process_count: Optional[int] = None) -> slice:
+    """This host's contiguous row range of a global dataset of ``n`` rows.
+
+    Multi-host input pipeline convention: each host reads only its slice
+    (the reference's per-subtask stream partitions), then shards it over
+    its addressable devices; global batch = concat of host slices.
+    Remainder rows go to the low-index hosts, one each.
+    """
+    p = jax.process_index() if process_index is None else process_index
+    c = jax.process_count() if process_count is None else process_count
+    base, rem = divmod(n, c)
+    start = p * base + min(p, rem)
+    return slice(start, start + base + (1 if p < rem else 0))

@@ -1,0 +1,34 @@
+"""Operational utilities: metrics, tracing/profiling.
+
+SURVEY.md §5: the reference has no bespoke observability subsystem — it
+re-registers Flink ``InternalOperatorMetricGroup``s per wrapped operator
+(``AbstractWrapperOperator.java:103``) and per-round ``LatencyStats``
+(``AbstractPerRoundWrapperOperator.java:106,500-553``), and leans on Flink
+metric reporters. The TPU equivalents live here: a metrics registry with
+per-step timers (:mod:`flinkml_tpu.utils.metrics`) and ``jax.profiler``
+integration (:mod:`flinkml_tpu.utils.profiling`).
+"""
+
+from flinkml_tpu.utils.metrics import (
+    EpochMetricsListener,
+    Meter,
+    MetricGroup,
+    MetricsRegistry,
+    metrics,
+)
+from flinkml_tpu.utils.profiling import (
+    StepTimer,
+    annotate,
+    trace,
+)
+
+__all__ = [
+    "EpochMetricsListener",
+    "Meter",
+    "MetricGroup",
+    "MetricsRegistry",
+    "metrics",
+    "StepTimer",
+    "annotate",
+    "trace",
+]

@@ -1,0 +1,163 @@
+"""Metrics registry: counters, gauges, meters, per-epoch histories.
+
+TPU-native replacement for the reference's Flink metric plumbing: wrappers
+re-register an ``InternalOperatorMetricGroup`` per wrapped operator
+(``iteration/operator/AbstractWrapperOperator.java:103``) and per-round
+wrappers keep ``LatencyStats`` (``AbstractPerRoundWrapperOperator.java:
+106,500-553``). Here a process-wide :class:`MetricsRegistry` holds named
+:class:`MetricGroup`s (the operator-metric-group analog); training loops
+attach an :class:`EpochMetricsListener` to record epoch wall-times,
+criteria values, and throughput without touching the loop code.
+
+Everything is plain host-side Python — metrics never enter jitted code.
+Record values AFTER ``block_until_ready`` if you need device-accurate
+timing (see :class:`flinkml_tpu.utils.profiling.StepTimer`).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from flinkml_tpu.iteration.runtime import IterationListener
+
+
+class Meter:
+    """Windowed rate meter (events/sec), like Flink's MeterView."""
+
+    def __init__(self, window: int = 64):
+        self._events: collections.deque = collections.deque(maxlen=window)
+
+    def mark(self, n: float = 1.0, now: Optional[float] = None) -> None:
+        self._events.append((time.perf_counter() if now is None else now, n))
+
+    @property
+    def rate(self) -> float:
+        """Events/sec over the retained window (0.0 with <2 samples)."""
+        if len(self._events) < 2:
+            return 0.0
+        t0, _ = self._events[0]
+        t1, _ = self._events[-1]
+        if t1 <= t0:
+            return 0.0
+        total = sum(n for _, n in list(self._events)[1:])
+        return total / (t1 - t0)
+
+
+class MetricGroup:
+    """Named scope of counters/gauges/meters/histories (thread-safe)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = collections.defaultdict(float)
+        self._gauges: Dict[str, Any] = {}
+        self._meters: Dict[str, Meter] = {}
+        self._histories: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def counter(self, name: str, inc: float = 1.0) -> float:
+        with self._lock:
+            self._counters[name] += inc
+            return self._counters[name]
+
+    def gauge(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            if name not in self._meters:
+                self._meters[name] = Meter()
+            return self._meters[name]
+
+    def record(self, name: str, value: float) -> None:
+        """Append to a history series (epoch times, losses, ...)."""
+        with self._lock:
+            self._histories[name].append(float(value))
+
+    def history(self, name: str) -> List[float]:
+        with self._lock:
+            return list(self._histories[name])
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "meters": {k: m.rate for k, m in self._meters.items()},
+                "histories": {k: list(v) for k, v in self._histories.items()},
+            }
+
+
+class MetricsRegistry:
+    """Process-wide registry of metric groups.
+
+    The analog of Flink's per-TM metric registry; ``group("model.kmeans")``
+    plays the role of the re-registered operator metric group.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: Dict[str, MetricGroup] = {}
+
+    def group(self, name: str) -> MetricGroup:
+        with self._lock:
+            if name not in self._groups:
+                self._groups[name] = MetricGroup(name)
+            return self._groups[name]
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            groups = dict(self._groups)
+        return {name: g.snapshot() for name, g in groups.items()}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.snapshot(), default=str, sort_keys=True)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._groups.clear()
+
+
+#: Default process-wide registry (import-and-use, like Flink's).
+metrics = MetricsRegistry()
+
+
+class EpochMetricsListener(IterationListener):
+    """Records per-epoch wall time, criteria, and throughput into a group.
+
+    Attach to :func:`flinkml_tpu.iteration.iterate` via ``listeners=[...]``.
+    ``samples_per_epoch`` (if given) feeds a ``samples`` meter and a final
+    ``samples_per_sec`` gauge — the bench's headline metric.
+    """
+
+    def __init__(
+        self,
+        group: Optional[MetricGroup] = None,
+        samples_per_epoch: Optional[int] = None,
+    ):
+        self.group = group if group is not None else metrics.group("iteration")
+        self.samples_per_epoch = samples_per_epoch
+        self._last = time.perf_counter()
+        self._t0 = self._last
+        self._epochs = 0
+
+    def on_epoch_watermark_incremented(self, epoch: int, state: Any) -> None:
+        now = time.perf_counter()
+        self.group.record("epoch_seconds", now - self._last)
+        self.group.counter("epochs")
+        if self.samples_per_epoch:
+            self.group.meter("samples").mark(self.samples_per_epoch, now=now)
+        self._last = now
+        self._epochs += 1
+
+    def on_iteration_terminated(self, state: Any) -> None:
+        total = time.perf_counter() - self._t0
+        self.group.gauge("total_seconds", total)
+        if self.samples_per_epoch and total > 0:
+            self.group.gauge(
+                "samples_per_sec", self.samples_per_epoch * self._epochs / total
+            )

@@ -1,0 +1,53 @@
+"""Tests for the multi-host control-plane helpers.
+
+Single-process here; multi-host behavior is exercised through
+``process_slice``'s explicit-argument form and the barrier riding the
+8-device CPU mesh (participation of every device = participation of every
+host's devices on a real pod).
+"""
+
+import jax
+import pytest
+
+from flinkml_tpu.parallel import (
+    DeviceMesh,
+    host_barrier,
+    init_distributed,
+    process_slice,
+)
+
+
+def test_init_distributed_single_process_noop():
+    idx, count = init_distributed()
+    assert (idx, count) == (0, 1)
+
+
+def test_host_barrier_sums_over_all_devices():
+    mesh = DeviceMesh()
+    assert host_barrier(mesh, tag=1) == mesh.axis_size()
+    assert host_barrier(mesh, tag=3) == 3 * mesh.axis_size()
+
+
+def test_host_barrier_default_mesh():
+    assert host_barrier(tag=1) == len(jax.devices())
+
+
+@pytest.mark.parametrize(
+    "n,count,expected",
+    [
+        (10, 2, [(0, 5), (5, 10)]),
+        (10, 3, [(0, 4), (4, 7), (7, 10)]),  # remainder to low hosts
+        (2, 4, [(0, 1), (1, 2), (2, 2), (2, 2)]),
+    ],
+)
+def test_process_slice_partitions_exactly(n, count, expected):
+    slices = [process_slice(n, p, count) for p in range(count)]
+    assert [(s.start, s.stop) for s in slices] == expected
+    # Exact cover: concatenation of slices is 0..n.
+    rows = [i for s in slices for i in range(s.start, s.stop)]
+    assert rows == list(range(n))
+
+
+def test_process_slice_defaults_to_this_process():
+    s = process_slice(100)
+    assert s == slice(0, 100)  # single-process: everything

@@ -1,0 +1,88 @@
+"""Tests for the observability utilities (SURVEY.md §5 tracing/metrics)."""
+
+import time
+
+import numpy as np
+
+from flinkml_tpu.iteration import IterationConfig, TerminateOnMaxIter, iterate
+from flinkml_tpu.utils import (
+    EpochMetricsListener,
+    MetricsRegistry,
+    StepTimer,
+    annotate,
+    trace,
+)
+
+
+def test_counter_gauge_meter_history():
+    reg = MetricsRegistry()
+    g = reg.group("op")
+    assert g.counter("records", 3) == 3
+    assert g.counter("records", 2) == 5
+    g.gauge("epoch", 7)
+    g.record("loss", 0.5)
+    g.record("loss", 0.25)
+    m = g.meter("rows")
+    m.mark(100, now=0.0)
+    m.mark(100, now=1.0)
+    snap = reg.snapshot()["op"]
+    assert snap["counters"]["records"] == 5
+    assert snap["gauges"]["epoch"] == 7
+    assert snap["histories"]["loss"] == [0.5, 0.25]
+    assert abs(snap["meters"]["rows"] - 100.0) < 1e-9
+
+
+def test_registry_reuses_groups_and_dumps_json():
+    reg = MetricsRegistry()
+    assert reg.group("a") is reg.group("a")
+    reg.group("a").counter("c")
+    assert '"c": 1' in reg.dump_json().replace("1.0", "1")
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_epoch_metrics_listener_in_iterate():
+    reg = MetricsRegistry()
+    listener = EpochMetricsListener(
+        group=reg.group("train"), samples_per_epoch=128
+    )
+
+    def step(state, epoch):
+        return state + 1, None
+
+    result = iterate(
+        step, 0, config=IterationConfig(TerminateOnMaxIter(5)),
+        listeners=[listener],
+    )
+    snap = reg.snapshot()["train"]
+    assert result.epochs == 5
+    assert snap["counters"]["epochs"] == 5
+    assert len(snap["histories"]["epoch_seconds"]) == 5
+    assert snap["gauges"]["total_seconds"] > 0
+    assert snap["gauges"]["samples_per_sec"] > 0
+
+
+def test_step_timer_blocks_and_records():
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    timer = StepTimer(group=reg.group("t"))
+    for _ in range(3):
+        with timer:
+            out = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+            timer.observe(out)
+    assert len(timer.times) == 3
+    assert timer.mean > 0
+    assert len(reg.snapshot()["t"]["histories"]["step_seconds"]) == 3
+
+
+def test_trace_context_is_safe_without_profiler(tmp_path):
+    # Must not raise even if the backend can't start a trace.
+    with trace(str(tmp_path)):
+        x = np.arange(10).sum()
+    assert x == 45
+
+
+def test_annotate_context():
+    with annotate("my-region"):
+        pass
