@@ -5,6 +5,8 @@ from flinkml_tpu.io.read_write import (
     save_model_arrays,
     load_model_arrays,
 )
+from flinkml_tpu.io.csv import read_csv, read_csv_table
+from flinkml_tpu.io.libsvm import read_libsvm
 
 __all__ = [
     "load_metadata",
@@ -12,4 +14,7 @@ __all__ = [
     "save_metadata",
     "save_model_arrays",
     "load_model_arrays",
+    "read_csv",
+    "read_csv_table",
+    "read_libsvm",
 ]

@@ -1,0 +1,60 @@
+"""Shared compile-on-demand loader for the native (C++) ingest parsers.
+
+Each parser lives in ``native/<name>.cpp`` with a C ABI; the first import
+compiles it with the system ``g++`` into ``native/build/<name>.so``
+(atomic rename so concurrent processes never dlopen a half-written file)
+and caches the handle. Callers fall back to pure Python when no compiler
+is available — the native path is a throughput optimization, never a
+functional requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_lock = threading.Lock()
+_cache: Dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def compile_and_load(
+    name: str, declare: Callable[[ctypes.CDLL], None]
+) -> Optional[ctypes.CDLL]:
+    """Compile ``native/<name>.cpp`` (if stale) and load it.
+
+    ``declare`` sets restype/argtypes on the fresh handle. Returns None if
+    compilation or loading fails (callers use their Python fallback);
+    the failure is cached so we do not retry per call.
+    """
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+        so = os.path.join(_NATIVE_DIR, "build", f"{name}.so")
+        try:
+            if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+                os.makedirs(os.path.dirname(so), exist_ok=True)
+                tmp_so = f"{so}.tmp.{os.getpid()}"
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        "-o", tmp_so, src, "-lpthread",
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp_so, so)
+            lib = ctypes.CDLL(so)
+            declare(lib)
+            _cache[name] = lib
+        except (OSError, subprocess.CalledProcessError):
+            _cache[name] = None
+        return _cache[name]

@@ -11,69 +11,36 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 from typing import Optional, Tuple
 
 import numpy as np
 
-_NATIVE_SRC = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-    "libsvm_parser.cpp",
-)
-_NATIVE_SO = os.path.join(os.path.dirname(_NATIVE_SRC), "build", "libsvm_parser.so")
+from flinkml_tpu.io._native import compile_and_load
 
-_lib_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_lib_failed = False
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.libsvm_open.restype = ctypes.c_void_p
+    lib.libsvm_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.libsvm_fill.restype = ctypes.c_int32
+    lib.libsvm_fill.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ]
+    lib.libsvm_close.restype = None
+    lib.libsvm_close.argtypes = [ctypes.c_void_p]
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
     """Compile (once) and load the native parser; None if unavailable."""
-    global _lib, _lib_failed
-    with _lib_lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            if not os.path.exists(_NATIVE_SO) or os.path.getmtime(
-                _NATIVE_SO
-            ) < os.path.getmtime(_NATIVE_SRC):
-                os.makedirs(os.path.dirname(_NATIVE_SO), exist_ok=True)
-                # Compile to a temp path and rename atomically so a
-                # concurrent process never dlopens a half-written .so.
-                tmp_so = f"{_NATIVE_SO}.tmp.{os.getpid()}"
-                subprocess.run(
-                    [
-                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                        "-o", tmp_so, _NATIVE_SRC, "-lpthread",
-                    ],
-                    check=True,
-                    capture_output=True,
-                )
-                os.replace(tmp_so, _NATIVE_SO)
-            lib = ctypes.CDLL(_NATIVE_SO)
-            lib.libsvm_open.restype = ctypes.c_void_p
-            lib.libsvm_open.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
-                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_int64),
-            ]
-            lib.libsvm_fill.restype = ctypes.c_int32
-            lib.libsvm_fill.argtypes = [
-                ctypes.c_void_p,
-                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-                ctypes.c_int64,
-            ]
-            lib.libsvm_close.restype = None
-            lib.libsvm_close.argtypes = [ctypes.c_void_p]
-            _lib = lib
-        except (OSError, subprocess.CalledProcessError):
-            _lib_failed = True
-        return _lib
+    return compile_and_load("libsvm_parser", _declare)
 
 
 def read_libsvm(

@@ -28,11 +28,21 @@ import numpy as np
 
 
 class CheckpointManager:
-    """Numbered checkpoints of an arbitrary pytree under one directory."""
+    """Numbered checkpoints of an arbitrary pytree under one directory.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    Each checkpoint records the world size (device count) that wrote it;
+    restoring under a different world size raises unless
+    ``allow_rescale=True`` — the reference's recovery guard
+    (``HeadOperator.java:130-146`` ``parallelismState``: rescaling an
+    in-flight iteration is explicitly rejected, because sharded loop
+    carries and data shards are laid out for a specific parallelism).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 allow_rescale: bool = False):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        self.allow_rescale = allow_rescale
         os.makedirs(directory, exist_ok=True)
 
     # -- save --------------------------------------------------------------
@@ -50,6 +60,7 @@ class CheckpointManager:
                 "epoch": int(epoch),
                 "num_leaves": len(host_leaves),
                 "treedef": str(treedef),
+                "world_size": jax.device_count(),
                 "extra": extra or {},
             }
             with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
@@ -84,6 +95,19 @@ class CheckpointManager:
         ckpt_dir = os.path.join(self.directory, f"ckpt-{epoch}")
         with open(os.path.join(ckpt_dir, "meta.json")) as f:
             meta = json.load(f)
+        saved_world = meta.get("world_size")
+        if (
+            saved_world is not None
+            and saved_world != jax.device_count()
+            and not self.allow_rescale
+        ):
+            raise ValueError(
+                f"checkpoint was written with {saved_world} devices but "
+                f"{jax.device_count()} are present; rescaling an in-flight "
+                "iteration is rejected (reference parity: "
+                "HeadOperator.java:130-146). Pass allow_rescale=True only "
+                "if the loop carry is replicated/device-count-independent."
+            )
         with np.load(os.path.join(ckpt_dir, "arrays.npz")) as z:
             host_leaves = [z[f"leaf_{i}"] for i in range(meta["num_leaves"])]
         treedef = jax.tree_util.tree_structure(like)

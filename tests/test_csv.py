@@ -1,0 +1,126 @@
+"""Tests for the numeric-CSV ingest (native parser + Python fallback).
+
+Both paths run against the same fixtures; the native path is skipped
+automatically when no compiler is available (compile_and_load returns
+None and read_csv silently uses the fallback — asserted explicitly here).
+"""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.io.csv import _parse_python, read_csv, read_csv_table
+from flinkml_tpu.io._native import compile_and_load
+from flinkml_tpu.io.csv import _declare
+
+NATIVE = compile_and_load("csv_parser", _declare) is not None
+
+BASIC = b"a,b,c\n1,2,3\n4,5,6\n-1.5,2e3,0.25\n"
+NO_HEADER = b"1,2\n3,4\n\n5,6\n"
+MISSING = b"x,y\n1,\n,2\n"
+
+
+@pytest.mark.parametrize("use_native", [False] + ([True] if NATIVE else []))
+def test_header_and_values(use_native):
+    names, mat = read_csv(BASIC, use_native=use_native)
+    assert names == ["a", "b", "c"]
+    np.testing.assert_allclose(
+        mat, [[1, 2, 3], [4, 5, 6], [-1.5, 2000.0, 0.25]]
+    )
+    assert mat.flags.f_contiguous  # columns are contiguous views
+
+
+@pytest.mark.parametrize("use_native", [False] + ([True] if NATIVE else []))
+def test_no_header_auto_and_blank_lines(use_native):
+    names, mat = read_csv(NO_HEADER, use_native=use_native)
+    assert names is None
+    np.testing.assert_allclose(mat, [[1, 2], [3, 4], [5, 6]])
+
+
+@pytest.mark.parametrize("use_native", [False] + ([True] if NATIVE else []))
+def test_missing_fields_become_nan(use_native):
+    names, mat = read_csv(MISSING, use_native=use_native)
+    assert names == ["x", "y"]
+    assert np.isnan(mat[0, 1]) and np.isnan(mat[1, 0])
+    assert mat[0, 0] == 1.0 and mat[1, 1] == 2.0
+
+
+@pytest.mark.parametrize("use_native", [False] + ([True] if NATIVE else []))
+def test_ragged_rows_rejected(use_native):
+    with pytest.raises(ValueError, match="field count"):
+        read_csv(b"1,2\n3,4,5\n", use_native=use_native)
+
+
+@pytest.mark.parametrize("use_native", [False] + ([True] if NATIVE else []))
+def test_malformed_field_rejected(use_native):
+    with pytest.raises(ValueError, match="malformed|field count"):
+        read_csv(b"1,2\n3,oops\n", header=False, use_native=use_native)
+
+
+@pytest.mark.parametrize("use_native", [False] + ([True] if NATIVE else []))
+def test_crlf_and_spaces(use_native):
+    names, mat = read_csv(b"a,b\r\n 1 ,\t2\r\n", use_native=use_native)
+    assert names == ["a", "b"]
+    np.testing.assert_allclose(mat, [[1, 2]])
+
+
+def test_table_with_and_without_header(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_bytes(BASIC)
+    t = read_csv_table(str(p))
+    assert set(t.column_names) == {"a", "b", "c"}
+    np.testing.assert_allclose(t.column("b"), [2, 5, 2000.0])
+    t2 = read_csv_table(NO_HEADER)
+    assert set(t2.column_names) == {"c0", "c1"}
+
+
+def test_header_mismatch_rejected():
+    with pytest.raises(ValueError, match="header has"):
+        read_csv(b"a,b,c\n1,2\n", header=True)
+
+
+def test_empty_input():
+    names, mat = read_csv(b"", header=False)
+    assert mat.shape == (0, 0)
+    names, mat = read_csv(b"a,b\n")
+    assert names == ["a", "b"] and mat.shape == (0, 2)
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native compiler")
+def test_native_matches_python_on_random_data():
+    rng = np.random.default_rng(0)
+    ref = rng.normal(size=(500, 7))
+    body = "\n".join(
+        ",".join(f"{v:.17g}" for v in row) for row in ref
+    ).encode() + b"\n"
+    _, nat = read_csv(body, header=False, use_native=True)
+    _, py = read_csv(body, header=False, use_native=False)
+    np.testing.assert_array_equal(nat, py)
+    np.testing.assert_allclose(nat, ref)
+
+
+def test_python_fallback_direct():
+    mat = _parse_python(b"1,2\n3,4\n", ",")
+    np.testing.assert_allclose(mat, [[1, 2], [3, 4]])
+
+
+@pytest.mark.parametrize("use_native", [False] + ([True] if NATIVE else []))
+def test_grammar_parity_edge_values(use_native):
+    # Overflow saturates to inf, underflow to 0, like Python float().
+    _, mat = read_csv(b"1e400,-1e400,1e-400\n", header=False,
+                      use_native=use_native)
+    assert np.isinf(mat[0, 0]) and mat[0, 0] > 0
+    assert np.isinf(mat[0, 1]) and mat[0, 1] < 0
+    assert mat[0, 2] == 0.0
+    # Python-only '_' separators are rejected on BOTH paths.
+    with pytest.raises(ValueError, match="malformed"):
+        read_csv(b"1_0,2\n", header=False, use_native=use_native)
+
+
+def test_multibyte_delimiter_rejected():
+    with pytest.raises(ValueError, match="single-byte"):
+        read_csv(b"1;2\n", delimiter=" ")
+
+
+def test_duplicate_header_names_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        read_csv_table(b"a,a,b\n1,2,3\n")

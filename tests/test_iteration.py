@@ -240,3 +240,30 @@ def test_resume_with_empty_dir_starts_fresh(tmp_path):
         resume=True,
     )
     assert result.state == 3
+
+
+def test_rescale_guard_on_restore(tmp_path, monkeypatch):
+    """Reference parity: restoring under a different device count is
+    rejected (HeadOperator.java:130-146) unless explicitly allowed."""
+    import json
+    import os
+
+    from flinkml_tpu.iteration.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    state = {"coef": np.arange(4.0)}
+    mgr.save(state, epoch=3)
+    # Tamper the recorded world size to simulate a different pod shape.
+    meta_path = os.path.join(str(tmp_path), "ckpt-3", "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["world_size"] = meta["world_size"] + 1
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    with pytest.raises(ValueError, match="rescal"):
+        mgr.restore(3, like=state)
+    relaxed = CheckpointManager(str(tmp_path), allow_rescale=True)
+    restored, epoch = relaxed.restore(3, like=state)
+    assert epoch == 3
+    np.testing.assert_array_equal(restored["coef"], state["coef"])
