@@ -30,20 +30,30 @@ import numpy as np
 class CheckpointManager:
     """Numbered checkpoints of an arbitrary pytree under one directory.
 
-    Each checkpoint records the world size (device count) that wrote it;
-    restoring under a different world size raises unless
-    ``allow_rescale=True`` — the reference's recovery guard
-    (``HeadOperator.java:130-146`` ``parallelismState``: rescaling an
-    in-flight iteration is explicitly rejected, because sharded loop
-    carries and data shards are laid out for a specific parallelism).
+    Each checkpoint records the world size that wrote it; restoring under
+    a different world size raises unless ``allow_rescale=True`` — the
+    reference's recovery guard (``HeadOperator.java:130-146``
+    ``parallelismState``: rescaling an in-flight iteration is explicitly
+    rejected, because sharded loop carries and data shards are laid out
+    for a specific parallelism).
+
+    ``world_size`` should be the device count of the mesh the loop runs
+    on (trainers that own a mesh set it); it defaults to
+    ``jax.device_count()``, which over-counts when training on a subset
+    mesh — pass the mesh size explicitly in that case.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 allow_rescale: bool = False):
+                 allow_rescale: bool = False,
+                 world_size: Optional[int] = None):
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.allow_rescale = allow_rescale
+        self.world_size = world_size
         os.makedirs(directory, exist_ok=True)
+
+    def _world_size(self) -> int:
+        return self.world_size if self.world_size is not None else jax.device_count()
 
     # -- save --------------------------------------------------------------
     def save(self, state: Any, epoch: int, extra: Optional[dict] = None) -> str:
@@ -60,7 +70,7 @@ class CheckpointManager:
                 "epoch": int(epoch),
                 "num_leaves": len(host_leaves),
                 "treedef": str(treedef),
-                "world_size": jax.device_count(),
+                "world_size": self._world_size(),
                 "extra": extra or {},
             }
             with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
@@ -98,12 +108,12 @@ class CheckpointManager:
         saved_world = meta.get("world_size")
         if (
             saved_world is not None
-            and saved_world != jax.device_count()
+            and saved_world != self._world_size()
             and not self.allow_rescale
         ):
             raise ValueError(
                 f"checkpoint was written with {saved_world} devices but "
-                f"{jax.device_count()} are present; rescaling an in-flight "
+                f"{self._world_size()} are in the restoring mesh; rescaling an in-flight "
                 "iteration is rejected (reference parity: "
                 "HeadOperator.java:130-146). Pass allow_rescale=True only "
                 "if the loop carry is replicated/device-count-independent."

@@ -282,6 +282,10 @@ def train_logistic_regression(
         raise ValueError(f"mode must be 'device' or 'host', got {mode!r}")
     if (checkpoint_manager is not None or resume) and mode != "host":
         raise ValueError("checkpointing/resume requires mode='host'")
+    if checkpoint_manager is not None and checkpoint_manager.world_size is None:
+        # The rescale guard must compare against THIS trainer's mesh, not
+        # the process-global device count (they differ on subset meshes).
+        checkpoint_manager.world_size = mesh.mesh.size
 
     if mode == "device":
         return _linear_sgd.train_linear_model(

@@ -15,6 +15,7 @@ from flinkml_tpu.parallel.distributed import (
     init_distributed,
     process_slice,
 )
+from flinkml_tpu.parallel.ring import ring_attention, ulysses_attention
 
 __all__ = [
     "DeviceMesh",
@@ -29,4 +30,6 @@ __all__ = [
     "host_barrier",
     "init_distributed",
     "process_slice",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -21,6 +21,7 @@
 // (flinkml_tpu.io.csv compiles this on demand and caches the .so.)
 
 #include <charconv>
+#include <string>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -79,12 +80,9 @@ inline int64_t parse_line(const char* p, const char* eol, char delim,
       const char* numstart = (*fstart == '+') ? fstart + 1 : fstart;
       auto [endp, ec] = std::from_chars(numstart, fend, v);
       if (ec == std::errc::result_out_of_range && endp == fend) {
-        char tmp[64];
-        size_t flen = static_cast<size_t>(fend - numstart);
-        if (flen >= sizeof(tmp)) return -1;
-        memcpy(tmp, numstart, flen);
-        tmp[flen] = '\0';
-        v = strtod(tmp, nullptr);
+        // Heap copy: fields like "1" + 400 zeros are valid (-> inf).
+        std::string tmp(numstart, static_cast<size_t>(fend - numstart));
+        v = strtod(tmp.c_str(), nullptr);
       } else if (ec != std::errc() || endp != fend) {
         return -1;
       }

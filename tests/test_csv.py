@@ -124,3 +124,13 @@ def test_multibyte_delimiter_rejected():
 def test_duplicate_header_names_rejected():
     with pytest.raises(ValueError, match="duplicate"):
         read_csv_table(b"a,a,b\n1,2,3\n")
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native compiler")
+def test_long_overflow_field_native():
+    # 400-char overflow field must saturate to inf, not error (grammar
+    # parity with the fallback even past the stack-buffer length).
+    body = ("1" + "0" * 400 + ",2\n").encode()
+    _, nat = read_csv(body, header=False, use_native=True)
+    _, py = read_csv(body, header=False, use_native=False)
+    assert np.isinf(nat[0, 0]) and np.isinf(py[0, 0])

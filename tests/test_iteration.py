@@ -267,3 +267,20 @@ def test_rescale_guard_on_restore(tmp_path, monkeypatch):
     restored, epoch = relaxed.restore(3, like=state)
     assert epoch == 3
     np.testing.assert_array_equal(restored["coef"], state["coef"])
+
+
+def test_rescale_guard_uses_mesh_world_size(tmp_path):
+    """A manager pinned to its mesh size ignores the process device count."""
+    from flinkml_tpu.iteration.checkpoint import CheckpointManager
+
+    state = {"w": np.ones(2)}
+    writer = CheckpointManager(str(tmp_path), world_size=4)
+    writer.save(state, epoch=1)
+    # Same mesh size on restore -> fine, regardless of jax.device_count().
+    ok = CheckpointManager(str(tmp_path), world_size=4)
+    _, epoch = ok.restore(1, like=state)
+    assert epoch == 1
+    # Different mesh size -> rejected.
+    bad = CheckpointManager(str(tmp_path), world_size=2)
+    with pytest.raises(ValueError, match="rescal"):
+        bad.restore(1, like=state)
