@@ -38,9 +38,11 @@ class CheckpointManager:
     for a specific parallelism).
 
     ``world_size`` should be the device count of the mesh the loop runs
-    on (trainers that own a mesh set it); it defaults to
-    ``jax.device_count()``, which over-counts when training on a subset
-    mesh — pass the mesh size explicitly in that case.
+    on; it defaults to ``jax.device_count()``, which over-counts when
+    training on a subset mesh. Contract: trainers that own a mesh
+    (re-)pin ``manager.world_size`` to their mesh size at the start of
+    every run, so a manager reused across meshes always guards against
+    the mesh that actually wrote the checkpoint.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,

@@ -55,7 +55,7 @@ def align_local_bs(global_batch_size: int, p_size: int, n_local: int) -> int:
     requested batch size), clamped to the shard. Without Pallas the
     requested batch is honored exactly — no silent inflation."""
     bs = max(1, math.ceil(global_batch_size / p_size))
-    if pallas_kernels.pallas_active():
+    if pallas_kernels.pallas_active("linear"):
         bs = ((bs + 7) // 8) * 8
     return min(bs, n_local)
 

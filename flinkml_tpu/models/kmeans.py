@@ -234,7 +234,7 @@ def train_kmeans(
 
     trainer = _kmeans_trainer(
         mesh.mesh, k, DeviceMesh.DATA_AXIS,
-        pallas_kernels.pallas_enabled(x_pad.shape[0] // p_size),
+        pallas_kernels.pallas_enabled(x_pad.shape[0] // p_size, "kmeans"),
     )
     centroids = trainer(
         xd, wd, jnp.asarray(init_centroids), jnp.asarray(max_iter, jnp.int32)

@@ -282,9 +282,11 @@ def train_logistic_regression(
         raise ValueError(f"mode must be 'device' or 'host', got {mode!r}")
     if (checkpoint_manager is not None or resume) and mode != "host":
         raise ValueError("checkpointing/resume requires mode='host'")
-    if checkpoint_manager is not None and checkpoint_manager.world_size is None:
+    if checkpoint_manager is not None:
         # The rescale guard must compare against THIS trainer's mesh, not
         # the process-global device count (they differ on subset meshes).
+        # Re-pinned on every run so a manager reused across meshes never
+        # carries a stale size (CheckpointManager documents this contract).
         checkpoint_manager.world_size = mesh.mesh.size
 
     if mode == "device":

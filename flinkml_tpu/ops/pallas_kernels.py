@@ -37,25 +37,40 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def pallas_active() -> bool:
-    """Whether the fused kernels are in play at all (env/backend gate).
+# Measured per-kernel defaults for FLINKML_TPU_PALLAS=auto (BASELINE.md,
+# "Kernel-path measurement"):
+#   linear: OFF — on v5e, XLA's lowering of the dense-LR gradient runs at
+#     ~80% of HBM bandwidth (~660M samples/s at n=1M, d=123) while the
+#     fused Mosaic kernel plateaus at ~255M: the [tile,d]x[d,1] matvecs
+#     use 1/128 of the MXU and Mosaic cannot pipeline past them,
+#     regardless of precision, lane padding, or tile height.
+#   kmeans: OFF pending measurement (its [tile,d]x[d,k] contractions are
+#     real matmuls, so the balance may flip — re-measure before enabling;
+#     the round-1 device tunnel outage prevented a trustworthy number).
+_AUTO_DEFAULTS = {"linear": False, "kmeans": False}
 
-    ``FLINKML_TPU_PALLAS``: ``auto`` (default — TPU backend only),
-    ``always`` (any backend, interpret mode off-TPU; used by the test
-    suite), or ``never`` (kill switch if a Mosaic regression ever bites).
+
+def pallas_active(kernel: str = "linear") -> bool:
+    """Whether the fused kernel named ``kernel`` replaces its plain-XLA
+    hot loop.
+
+    ``FLINKML_TPU_PALLAS``: ``auto`` (default — per-kernel measured
+    defaults above), ``always`` (any backend; interpret mode off-TPU —
+    how the test suite exercises kernel code on the CPU mesh), or
+    ``never``.
     """
     mode = os.environ.get("FLINKML_TPU_PALLAS", "auto").lower()
-    if mode == "never":
-        return False
     if mode == "always":
         return True
-    return jax.default_backend() == "tpu"
+    if mode == "never":
+        return False
+    return _AUTO_DEFAULTS.get(kernel, False)
 
 
-def pallas_enabled(n_rows: int) -> bool:
-    """``pallas_active()`` plus the shape requirement: rows must be a
-    multiple of the minimum (f32 sublane) tile."""
-    return n_rows % 8 == 0 and pallas_active()
+def pallas_enabled(n_rows: int, kernel: str = "linear") -> bool:
+    """``pallas_active(kernel)`` plus the shape requirement: rows must be
+    a multiple of the minimum (f32 sublane) tile."""
+    return n_rows % 8 == 0 and pallas_active(kernel)
 
 # Row-tile heights to try, best first. All multiples of the f32 sublane
 # tile (8); the largest divisor of the batch is picked so the grid is

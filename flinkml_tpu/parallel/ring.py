@@ -156,12 +156,6 @@ def ulysses_attention(q, k, v, mesh: Optional[DeviceMesh] = None,
     Requires ``H % mesh_size == 0`` and ``L % mesh_size == 0``.
     """
     dm = mesh if mesh is not None else DeviceMesh()
-    p_size = dm.axis_size(dm.axis_names[0])
-    if q.shape[1] % p_size != 0:
-        raise ValueError(
-            f"ulysses needs heads ({q.shape[1]}) divisible by the mesh "
-            f"size ({p_size})"
-        )
     return _dispatch(q, k, v, dm, "ulysses", causal)
 
 
@@ -174,6 +168,11 @@ def _dispatch(q, k, v, dm: DeviceMesh, kind: str, causal: bool):
     if q.shape[2] % p_size != 0:
         raise ValueError(
             f"sequence length {q.shape[2]} must divide by mesh size {p_size}"
+        )
+    if kind == "ulysses" and q.shape[1] % p_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by the mesh "
+            f"size ({p_size})"
         )
     if p_size == 1:
         return _full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
