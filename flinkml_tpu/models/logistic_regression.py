@@ -261,6 +261,7 @@ def train_logistic_regression(
     checkpoint_manager=None,
     checkpoint_interval: int = 0,
     resume: bool = False,
+    listeners=(),
 ) -> np.ndarray:
     """The distributed SGD loop; returns the fitted coefficient on host.
 
@@ -280,8 +281,8 @@ def train_logistic_regression(
     """
     if mode not in ("device", "host"):
         raise ValueError(f"mode must be 'device' or 'host', got {mode!r}")
-    if (checkpoint_manager is not None or resume) and mode != "host":
-        raise ValueError("checkpointing/resume requires mode='host'")
+    if (checkpoint_manager is not None or resume or listeners) and mode != "host":
+        raise ValueError("checkpointing/resume/listeners require mode='host'")
     if checkpoint_manager is not None:
         # The rescale guard must compare against THIS trainer's mesh, not
         # the process-global device count (they differ on subset meshes).
@@ -342,5 +343,7 @@ def train_logistic_regression(
         checkpoint_manager=checkpoint_manager,
     )
     init = jnp.zeros(dim, dtype=xd.dtype)
-    result = iterate(epoch_step, init, config=config, resume=resume)
+    result = iterate(
+        epoch_step, init, config=config, listeners=listeners, resume=resume
+    )
     return np.asarray(result.state)

@@ -59,12 +59,17 @@ def pallas_active(kernel: str = "linear") -> bool:
     how the test suite exercises kernel code on the CPU mesh), or
     ``never``.
     """
+    if kernel not in _AUTO_DEFAULTS:
+        raise KeyError(
+            f"unknown kernel {kernel!r}; add a measured default to "
+            f"_AUTO_DEFAULTS (known: {sorted(_AUTO_DEFAULTS)})"
+        )
     mode = os.environ.get("FLINKML_TPU_PALLAS", "auto").lower()
     if mode == "always":
         return True
     if mode == "never":
         return False
-    return _AUTO_DEFAULTS.get(kernel, False)
+    return _AUTO_DEFAULTS[kernel]
 
 
 def pallas_enabled(n_rows: int, kernel: str = "linear") -> bool:

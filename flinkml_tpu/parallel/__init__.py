@@ -16,6 +16,12 @@ from flinkml_tpu.parallel.distributed import (
     process_slice,
 )
 from flinkml_tpu.parallel.ring import ring_attention, ulysses_attention
+from flinkml_tpu.parallel.tensor import (
+    expert_parallel_ffn,
+    pipeline_parallel_apply,
+    register_pipeline_stage,
+    tensor_parallel_mlp,
+)
 
 __all__ = [
     "DeviceMesh",
@@ -32,4 +38,8 @@ __all__ = [
     "process_slice",
     "ring_attention",
     "ulysses_attention",
+    "expert_parallel_ffn",
+    "pipeline_parallel_apply",
+    "register_pipeline_stage",
+    "tensor_parallel_mlp",
 ]

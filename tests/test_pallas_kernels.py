@@ -132,3 +132,10 @@ def test_train_kmeans_pallas_matches_xla(monkeypatch):
     np.testing.assert_allclose(
         np.sort(c_pl, axis=0), np.sort(c_xla, axis=0), rtol=1e-4, atol=1e-4
     )
+
+
+def test_pallas_active_rejects_unknown_kernel():
+    from flinkml_tpu.ops.pallas_kernels import pallas_active
+
+    with pytest.raises(KeyError, match="unknown kernel"):
+        pallas_active("kmean")  # typo'd name must fail loudly

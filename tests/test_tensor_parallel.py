@@ -1,0 +1,207 @@
+"""TP/PP primitives vs single-device references, on the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.parallel.tensor import (
+    pipeline_parallel_apply,
+    register_pipeline_stage,
+    tensor_parallel_mlp,
+)
+
+
+def test_tp_mlp_matches_dense():
+    rng = np.random.default_rng(0)
+    d_in, d_ff, d_out, n = 16, 64, 16, 32
+    x = rng.normal(size=(n, d_in)).astype(np.float32)
+    w1 = rng.normal(size=(d_in, d_ff)).astype(np.float32)
+    b1 = rng.normal(size=(d_ff,)).astype(np.float32)
+    w2 = rng.normal(size=(d_ff, d_out)).astype(np.float32)
+    b2 = rng.normal(size=(d_out,)).astype(np.float32)
+
+    out = tensor_parallel_mlp(
+        x, w1, b1, w2, b2, DeviceMesh({"model": 8}), axis="model"
+    )
+    ref = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_tp_mlp_on_2d_mesh_model_axis():
+    """TP must address its named axis on a multi-axis mesh."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    w1 = rng.normal(size=(4, 16)).astype(np.float32)
+    b1 = np.zeros(16, np.float32)
+    w2 = rng.normal(size=(16, 4)).astype(np.float32)
+    b2 = np.zeros(4, np.float32)
+    mesh = DeviceMesh({"data": 2, "model": 4})
+    out = tensor_parallel_mlp(x, w1, b1, w2, b2, mesh, axis="model")
+    ref = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_tp_mlp_validates_d_ff():
+    x = np.zeros((2, 4), np.float32)
+    w1 = np.zeros((4, 10), np.float32)  # 10 not divisible by 8
+    with pytest.raises(ValueError, match="divide"):
+        tensor_parallel_mlp(x, w1, np.zeros(10, np.float32),
+                            np.zeros((10, 4), np.float32),
+                            np.zeros(4, np.float32), DeviceMesh({"model": 8}))
+
+
+def test_tp_mlp_validates_axis_name():
+    x = np.zeros((2, 4), np.float32)
+    with pytest.raises(ValueError, match="no axis named"):
+        tensor_parallel_mlp(x, np.zeros((4, 8), np.float32),
+                            np.zeros(8, np.float32),
+                            np.zeros((8, 4), np.float32),
+                            np.zeros(4, np.float32),
+                            DeviceMesh({"data": 8}), axis="model")
+
+
+def test_pipeline_matches_sequential():
+    rng = np.random.default_rng(2)
+    n_stages, n_mb, b, d = 8, 6, 4, 8
+    params = rng.normal(size=(n_stages, d, d)).astype(np.float32) * 0.3
+    x = rng.normal(size=(n_mb, b, d)).astype(np.float32)
+
+    out = pipeline_parallel_apply(
+        x, params, stage="linear_tanh", mesh=DeviceMesh({"pipe": 8})
+    )
+    ref = x
+    for s in range(n_stages):
+        ref = np.tanh(ref @ params[s])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_validates_stage_count():
+    x = np.zeros((2, 2, 4), np.float32)
+    params = np.zeros((3, 4, 4), np.float32)  # 3 stages on an 8-wide axis
+    with pytest.raises(ValueError, match="stages"):
+        pipeline_parallel_apply(x, params, stage="linear_tanh",
+                                mesh=DeviceMesh({"pipe": 8}))
+
+
+def test_pipeline_unknown_stage():
+    x = np.zeros((2, 2, 4), np.float32)
+    params = np.zeros((8, 4, 4), np.float32)
+    with pytest.raises(ValueError, match="unknown"):
+        pipeline_parallel_apply(x, params, stage="nope",
+                                mesh=DeviceMesh({"pipe": 8}))
+
+
+def test_custom_registered_stage():
+    register_pipeline_stage("affine_relu", lambda a, p: jnp.maximum(a @ p, 0))
+    rng = np.random.default_rng(3)
+    params = rng.normal(size=(8, 4, 4)).astype(np.float32) * 0.4
+    x = rng.normal(size=(3, 2, 4)).astype(np.float32)
+    out = pipeline_parallel_apply(x, params, stage="affine_relu",
+                                  mesh=DeviceMesh({"pipe": 8}))
+    ref = x
+    for s in range(8):
+        ref = np.maximum(ref @ params[s], 0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_expert_parallel_matches_dense_moe():
+    from flinkml_tpu.parallel.tensor import expert_parallel_ffn
+
+    rng = np.random.default_rng(4)
+    n, d_in, d_ff, d_out, E = 16, 8, 32, 8, 8
+    x = rng.normal(size=(n, d_in)).astype(np.float32)
+    w1 = rng.normal(size=(E, d_in, d_ff)).astype(np.float32) * 0.3
+    w2 = rng.normal(size=(E, d_ff, d_out)).astype(np.float32) * 0.3
+    logits = rng.normal(size=(n, E)).astype(np.float32)
+    gates = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+
+    out = expert_parallel_ffn(x, gates, w1, w2, DeviceMesh({"expert": 8}))
+    ref = np.zeros((n, d_out), np.float32)
+    for e in range(E):
+        ref += gates[:, e:e + 1] * np.asarray(
+            jax.nn.gelu(x @ w1[e]) @ w2[e]
+        )
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_expert_parallel_top1_routing():
+    from flinkml_tpu.parallel.tensor import expert_parallel_ffn
+
+    rng = np.random.default_rng(5)
+    n, E = 8, 8
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w1 = rng.normal(size=(E, 4, 8)).astype(np.float32)
+    w2 = rng.normal(size=(E, 8, 4)).astype(np.float32)
+    assign = rng.integers(0, E, size=n)
+    gates = np.eye(E, dtype=np.float32)[assign]  # hard top-1
+    out = np.asarray(
+        expert_parallel_ffn(x, gates, w1, w2, DeviceMesh({"expert": 8}))
+    )
+    for i in range(n):
+        e = assign[i]
+        ref = np.asarray(jax.nn.gelu(x[i] @ w1[e]) @ w2[e])
+        np.testing.assert_allclose(out[i], ref, rtol=2e-4, atol=2e-5)
+
+
+def test_expert_parallel_validates_expert_count():
+    from flinkml_tpu.parallel.tensor import expert_parallel_ffn
+
+    with pytest.raises(ValueError, match="expert count"):
+        expert_parallel_ffn(
+            np.zeros((2, 4), np.float32), np.zeros((2, 3), np.float32),
+            np.zeros((3, 4, 8), np.float32), np.zeros((3, 8, 4), np.float32),
+            DeviceMesh({"expert": 8}),
+        )
+
+
+def test_pipeline_on_multi_axis_mesh():
+    rng = np.random.default_rng(6)
+    params = (rng.normal(size=(4, 5, 5)) * 0.3).astype(np.float32)
+    x = rng.normal(size=(3, 2, 5)).astype(np.float32)
+    out = pipeline_parallel_apply(
+        x, params, stage="linear_tanh",
+        mesh=DeviceMesh({"data": 2, "pipe": 4}), axis="pipe",
+    )
+    ref = x
+    for s in range(4):
+        ref = np.tanh(ref @ params[s])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_expert_parallel_on_multi_axis_mesh():
+    from flinkml_tpu.parallel.tensor import expert_parallel_ffn
+
+    rng = np.random.default_rng(7)
+    n, E = 6, 4
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w1 = (rng.normal(size=(E, 4, 8)) * 0.3).astype(np.float32)
+    w2 = (rng.normal(size=(E, 8, 4)) * 0.3).astype(np.float32)
+    gates = np.asarray(
+        jax.nn.softmax(jnp.asarray(rng.normal(size=(n, E)).astype(np.float32)), -1)
+    )
+    out = expert_parallel_ffn(
+        x, gates, w1, w2, DeviceMesh({"data": 2, "expert": 4}), axis="expert"
+    )
+    ref = sum(
+        gates[:, e:e + 1] * np.asarray(jax.nn.gelu(x @ w1[e]) @ w2[e])
+        for e in range(E)
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_stage_reregistration_takes_effect():
+    """Regression: re-registering a stage name must recompile, not reuse
+    the old function from the jit cache."""
+    register_pipeline_stage("mutable_stage", lambda a, p: a @ p)
+    params = np.stack([np.eye(4, dtype=np.float32)] * 8)
+    x = np.ones((2, 2, 4), np.float32)
+    out1 = np.asarray(pipeline_parallel_apply(
+        x, params, "mutable_stage", DeviceMesh({"pipe": 8})))
+    register_pipeline_stage("mutable_stage", lambda a, p: (a @ p) * 2.0)
+    out2 = np.asarray(pipeline_parallel_apply(
+        x, params, "mutable_stage", DeviceMesh({"pipe": 8})))
+    np.testing.assert_allclose(out2, out1 * 256.0)  # 2^8 over 8 stages
