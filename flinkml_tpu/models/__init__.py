@@ -15,6 +15,13 @@ from flinkml_tpu.models.online_logistic_regression import (
     OnlineLogisticRegression,
     OnlineLogisticRegressionModel,
 )
+from flinkml_tpu.models.scalers import (
+    MinMaxScaler,
+    MinMaxScalerModel,
+    StandardScaler,
+    StandardScalerModel,
+)
+from flinkml_tpu.models.vector_assembler import VectorAssembler
 
 __all__ = [
     "LogisticRegression",
@@ -33,4 +40,9 @@ __all__ = [
     "LinearRegressionModel",
     "OnlineLogisticRegression",
     "OnlineLogisticRegressionModel",
+    "StandardScaler",
+    "StandardScalerModel",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "VectorAssembler",
 ]

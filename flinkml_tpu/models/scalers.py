@@ -1,0 +1,258 @@
+"""Feature scaling stages: StandardScaler and MinMaxScaler.
+
+Beyond the reference snapshot (whose only feature stage is OneHotEncoder,
+SURVEY.md §2.3) but standard members of the wider Flink ML operator family;
+fit statistics are computed by sharded passes over the mesh (per-device
+partial sums/extrema + psum/pmin/pmax; variance via the two-pass centered
+form so float32 never cancels). Transform applies the tiny fitted
+statistics on the host in numpy — elementwise rescaling of an already
+host-resident table is bandwidth-trivial, so there is nothing to ship to
+the device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.params import BoolParam, FloatParam, StringParam, WithParams
+from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
+from flinkml_tpu.table import Table
+
+
+class _HasInputOutputCol(WithParams):
+    INPUT_COL = StringParam("inputCol", "Input column name.", "input")
+    OUTPUT_COL = StringParam("outputCol", "Output column name.", "output")
+
+
+@functools.lru_cache(maxsize=32)
+def _sum_fn(mesh, axis: str):
+    # The mean pass is shift-centered too: summing raw values of
+    # magnitude M loses ~M * eps_f32 per 2^k added terms; summing
+    # (x - shift) with shift ≈ typical value keeps the accumulator small.
+    def local(xl, wl, shift):
+        s = jax.lax.psum(jnp.sum((xl - shift) * wl[:, None], axis=0), axis)
+        n = jax.lax.psum(jnp.sum(wl), axis)
+        return s, n
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _centered_sumsq_fn(mesh, axis: str):
+    # Two-pass variance: summing (x - mean)^2 keeps float32 exact enough
+    # for any mean magnitude; the one-pass E[x^2] - E[x]^2 form cancels
+    # catastrophically when |mean| >> std.
+    def local(xl, wl, mean):
+        c = xl - mean
+        return jax.lax.psum(jnp.sum(c * c * wl[:, None], axis=0), axis)
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(axis), P()),
+            out_specs=P(),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _extrema_fn(mesh, axis: str):
+    def local(xl, wl):
+        big = jnp.asarray(np.finfo(np.float32).max, xl.dtype)
+        lo = jnp.where(wl[:, None] > 0, xl, big)
+        hi = jnp.where(wl[:, None] > 0, xl, -big)
+        return (
+            jax.lax.pmin(jnp.min(lo, axis=0), axis),
+            jax.lax.pmax(jnp.max(hi, axis=0), axis),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(axis)),
+            out_specs=(P(), P()),
+        )
+    )
+
+
+def _shard_with_mask(x: np.ndarray, mesh: DeviceMesh):
+    p = mesh.axis_size()
+    x_pad, n_valid = pad_to_multiple(x.astype(np.float32), p)
+    w = np.zeros(x_pad.shape[0], dtype=np.float32)
+    w[:n_valid] = 1.0
+    return mesh.shard_batch(x_pad), mesh.shard_batch(w)
+
+
+class StandardScaler(_HasInputOutputCol, Estimator):
+    """Standardize features to zero mean / unit variance (configurable)."""
+
+    WITH_MEAN = BoolParam("withMean", "Center features to mean zero.", True)
+    WITH_STD = BoolParam("withStd", "Scale features to unit std.", True)
+
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "StandardScalerModel":
+        (table,) = inputs
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        mesh = self.mesh or DeviceMesh()
+        xd, wd = _shard_with_mask(x, mesh)
+        shift = np.asarray(x[0], dtype=np.float32)
+        s, n = _sum_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
+            xd, wd, jnp.asarray(shift)
+        )
+        mean = shift.astype(np.float64) + np.asarray(s, np.float64) / float(n)
+        sq = _centered_sumsq_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
+            xd, wd, jnp.asarray(mean, xd.dtype)
+        )
+        var = np.maximum(np.asarray(sq, dtype=np.float64) / float(n), 0.0)
+        model = StandardScalerModel()
+        model.copy_params_from(self)
+        model.set_model_data(
+            Table({"mean": mean[None, :], "std": np.sqrt(var)[None, :]})
+        )
+        return model
+
+
+class StandardScalerModel(_HasInputOutputCol, Model):
+    WITH_MEAN = StandardScaler.WITH_MEAN
+    WITH_STD = StandardScaler.WITH_STD
+
+    def __init__(self):
+        super().__init__()
+        self._mean: Optional[np.ndarray] = None
+        self._std: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "StandardScalerModel":
+        (table,) = inputs
+        self._mean = np.asarray(table.column("mean"), dtype=np.float64)[0]
+        self._std = np.asarray(table.column("std"), dtype=np.float64)[0]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({"mean": self._mean[None, :], "std": self._std[None, :]})]
+
+    def _require(self) -> None:
+        if self._mean is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        out = x
+        if self.get(self.WITH_MEAN):
+            out = out - self._mean
+        if self.get(self.WITH_STD):
+            safe = np.where(self._std > 0, self._std, 1.0)
+            out = out / safe
+        return (table.with_column(self.get(self.OUTPUT_COL), out),)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(path, {"mean": self._mean, "std": self._std})
+
+    @classmethod
+    def load(cls, path: str) -> "StandardScalerModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._mean = arrays["mean"]
+        model._std = arrays["std"]
+        return model
+
+
+class MinMaxScaler(_HasInputOutputCol, Estimator):
+    """Rescale features into [min, max] (default [0, 1])."""
+
+    MIN = FloatParam("min", "Lower bound of the output range.", 0.0)
+    MAX = FloatParam("max", "Upper bound of the output range.", 1.0)
+
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "MinMaxScalerModel":
+        (table,) = inputs
+        if self.get(self.MIN) >= self.get(self.MAX):
+            raise ValueError(
+                f"min {self.get(self.MIN)} must be < max {self.get(self.MAX)}"
+            )
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        mesh = self.mesh or DeviceMesh()
+        xd, wd = _shard_with_mask(x, mesh)
+        lo, hi = _extrema_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(xd, wd)
+        model = MinMaxScalerModel()
+        model.copy_params_from(self)
+        model.set_model_data(
+            Table({
+                "dataMin": np.asarray(lo, np.float64)[None, :],
+                "dataMax": np.asarray(hi, np.float64)[None, :],
+            })
+        )
+        return model
+
+
+class MinMaxScalerModel(_HasInputOutputCol, Model):
+    MIN = MinMaxScaler.MIN
+    MAX = MinMaxScaler.MAX
+
+    def __init__(self):
+        super().__init__()
+        self._data_min: Optional[np.ndarray] = None
+        self._data_max: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "MinMaxScalerModel":
+        (table,) = inputs
+        self._data_min = np.asarray(table.column("dataMin"), np.float64)[0]
+        self._data_max = np.asarray(table.column("dataMax"), np.float64)[0]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({
+            "dataMin": self._data_min[None, :],
+            "dataMax": self._data_max[None, :],
+        })]
+
+    def _require(self) -> None:
+        if self._data_min is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        span = self._data_max - self._data_min
+        # Constant features map to the middle of the output range (the
+        # Flink ML / sklearn convention of avoiding division by zero).
+        safe = np.where(span > 0, span, 1.0)
+        unit = np.where(span > 0, (x - self._data_min) / safe, 0.5)
+        lo, hi = self.get(self.MIN), self.get(self.MAX)
+        return (
+            table.with_column(self.get(self.OUTPUT_COL), unit * (hi - lo) + lo),
+        )
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(
+            path, {"dataMin": self._data_min, "dataMax": self._data_max}
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "MinMaxScalerModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._data_min = arrays["dataMin"]
+        model._data_max = arrays["dataMax"]
+        return model

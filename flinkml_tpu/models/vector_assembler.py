@@ -1,0 +1,53 @@
+"""VectorAssembler — concatenate numeric/vector columns into one features
+column.
+
+Beyond the reference snapshot (SURVEY.md §2.3 has only OneHotEncoder) but a
+standard member of the wider Flink ML feature family. Stateless
+``AlgoOperator`` (no fit): scalars contribute one slot, 2-D columns their
+width. ``handleInvalid``: ``error`` rejects non-finite values, ``skip``
+drops offending rows, ``keep`` passes them through (NaN/inf preserved).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from flinkml_tpu.api import AlgoOperator
+from flinkml_tpu.common_params import HasHandleInvalid, HasInputCols
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.params import StringParam
+
+
+class VectorAssembler(HasInputCols, HasHandleInvalid, AlgoOperator):
+    OUTPUT_COL = StringParam("outputCol", "Output column name.", "features")
+
+    def transform(self, *inputs: Tuple) -> Tuple:
+        (table,) = inputs
+        cols = self.get(self.INPUT_COLS)
+        if not cols:
+            raise ValueError("inputCols must be set")
+        parts: List[np.ndarray] = [features_matrix(table, c) for c in cols]
+        n = parts[0].shape[0]
+        for c, p in zip(cols, parts):
+            if p.shape[0] != n:
+                raise ValueError(
+                    f"column {c!r} has {p.shape[0]} rows, expected {n}"
+                )
+        out = np.concatenate(parts, axis=1)
+        mode = self.get(self.HANDLE_INVALID)
+        bad = ~np.isfinite(out).all(axis=1)
+        if mode == "error":
+            if bad.any():
+                raise ValueError(
+                    f"non-finite value in row {int(np.argmax(bad))}; "
+                    "set handleInvalid to 'skip' or 'keep' to allow"
+                )
+        elif mode == "skip":
+            if bad.any():
+                keep = ~bad
+                table = table.take(np.flatnonzero(keep))
+                out = out[keep]
+        # mode == "keep": pass through unchanged.
+        return (table.with_column(self.get(self.OUTPUT_COL), out),)

@@ -74,8 +74,9 @@ def pallas_active(kernel: str = "linear") -> bool:
 
 def pallas_enabled(n_rows: int, kernel: str = "linear") -> bool:
     """``pallas_active(kernel)`` plus the shape requirement: rows must be
-    a multiple of the minimum (f32 sublane) tile."""
-    return n_rows % 8 == 0 and pallas_active(kernel)
+    a multiple of the minimum (f32 sublane) tile. The kernel-name check
+    runs first so typos fail loudly regardless of the batch shape."""
+    return pallas_active(kernel) and n_rows % 8 == 0
 
 # Row-tile heights to try, best first. All multiples of the f32 sublane
 # tile (8); the largest divisor of the batch is picked so the grid is

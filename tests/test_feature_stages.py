@@ -1,0 +1,152 @@
+"""StandardScaler / MinMaxScaler / VectorAssembler vs sklearn + semantics."""
+
+import numpy as np
+import pytest
+from sklearn.preprocessing import MinMaxScaler as SkMinMax
+from sklearn.preprocessing import StandardScaler as SkStandard
+
+from flinkml_tpu.models import (
+    MinMaxScaler,
+    MinMaxScalerModel,
+    StandardScaler,
+    StandardScalerModel,
+    VectorAssembler,
+)
+from flinkml_tpu.table import Table
+
+
+def _x(n=103, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(loc=3.0, scale=2.5, size=(n, d))
+    x[:, 2] = 7.0  # constant feature: degenerate std/span
+    return x
+
+
+def test_standard_scaler_matches_sklearn():
+    x = _x()
+    t = Table({"input": x})
+    model = StandardScaler().fit(t)
+    (out,) = model.transform(t)
+    ref = SkStandard().fit_transform(x)
+    np.testing.assert_allclose(out.column("output"), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_standard_scaler_flags():
+    x = _x(seed=1)
+    t = Table({"input": x})
+    m = StandardScaler().set(StandardScaler.WITH_MEAN, False).fit(t)
+    (out,) = m.transform(t)
+    ref = SkStandard(with_mean=False).fit_transform(x)
+    np.testing.assert_allclose(out.column("output"), ref, rtol=1e-5, atol=1e-5)
+    m2 = StandardScaler().set(StandardScaler.WITH_STD, False).fit(t)
+    (out2,) = m2.transform(t)
+    np.testing.assert_allclose(
+        out2.column("output"), x - x.mean(0), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_standard_scaler_save_load(tmp_path):
+    t = Table({"input": _x(seed=2)})
+    model = StandardScaler().fit(t)
+    model.save(str(tmp_path / "ss"))
+    loaded = StandardScalerModel.load(str(tmp_path / "ss"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0].column("output"),
+        model.transform(t)[0].column("output"),
+    )
+
+
+def test_min_max_scaler_matches_sklearn():
+    x = _x(seed=3)
+    t = Table({"input": x})
+    model = MinMaxScaler().fit(t)
+    (out,) = model.transform(t)
+    ref = SkMinMax().fit_transform(x)
+    got = np.asarray(out.column("output"), dtype=np.float64)
+    # Constant column: we map to mid-range 0.5; sklearn maps to min_.
+    np.testing.assert_allclose(
+        np.delete(got, 2, axis=1), np.delete(ref, 2, axis=1),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(got[:, 2], 0.5)
+
+
+def test_min_max_scaler_custom_range_and_roundtrip(tmp_path):
+    x = _x(seed=4)
+    t = Table({"input": x})
+    model = (MinMaxScaler().set(MinMaxScaler.MIN, -2.0)
+             .set(MinMaxScaler.MAX, 2.0).fit(t))
+    (out,) = model.transform(t)
+    got = np.asarray(out.column("output"), dtype=np.float64)
+    assert got[:, 0].min() == pytest.approx(-2.0)
+    assert got[:, 0].max() == pytest.approx(2.0)
+    model.save(str(tmp_path / "mm"))
+    loaded = MinMaxScalerModel.load(str(tmp_path / "mm"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0].column("output"), got
+    )
+
+
+def test_min_max_rejects_bad_range():
+    with pytest.raises(ValueError, match="min"):
+        (MinMaxScaler().set(MinMaxScaler.MIN, 2.0)
+         .set(MinMaxScaler.MAX, 1.0).fit(Table({"input": _x()})))
+
+
+def test_vector_assembler_concatenates():
+    t = Table({
+        "a": np.asarray([1.0, 2.0, 3.0]),
+        "b": np.asarray([[10.0, 20.0], [30.0, 40.0], [50.0, 60.0]]),
+    })
+    va = VectorAssembler().set_input_cols(["a", "b"])
+    (out,) = va.transform(t)
+    np.testing.assert_allclose(
+        out.column("features"),
+        [[1, 10, 20], [2, 30, 40], [3, 50, 60]],
+    )
+
+
+def test_vector_assembler_handle_invalid():
+    t = Table({
+        "a": np.asarray([1.0, np.nan, 3.0]),
+        "b": np.asarray([4.0, 5.0, 6.0]),
+    })
+    va = VectorAssembler().set_input_cols(["a", "b"])
+    with pytest.raises(ValueError, match="non-finite"):
+        va.transform(t)
+    va.set_handle_invalid("skip")
+    (out,) = va.transform(t)
+    np.testing.assert_allclose(out.column("features"), [[1, 4], [3, 6]])
+    np.testing.assert_allclose(out.column("b"), [4, 6])  # rows dropped everywhere
+    va.set_handle_invalid("keep")
+    (out2,) = va.transform(t)
+    assert np.isnan(out2.column("features")[1, 0])
+
+
+def test_scalers_in_pipeline():
+    from flinkml_tpu.pipeline import Pipeline
+
+    x = _x(seed=5)
+    t = Table({"input": x})
+    pipe = Pipeline([
+        StandardScaler(),
+        MinMaxScaler().set(MinMaxScaler.INPUT_COL, "output")
+                      .set(MinMaxScaler.OUTPUT_COL, "scaled"),
+    ])
+    model = pipe.fit(t)
+    (out,) = model.transform(t)
+    got = np.asarray(out.column("scaled"), np.float64)
+    # f32 device extrema vs f64 transform: allow rounding slop at the edges.
+    assert np.nanmin(got) >= -1e-6 and np.nanmax(got) <= 1.0 + 1e-6
+
+
+def test_standard_scaler_large_mean_no_cancellation():
+    """Regression: one-pass E[x^2]-E[x]^2 in f32 catastrophically cancels
+    for |mean| >> std; the two-pass centered form must not."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(loc=1e5, scale=1.0, size=(256, 3))
+    model = StandardScaler().fit(Table({"input": x}))
+    (out,) = model.transform(Table({"input": x}))
+    got = np.asarray(out.column("output"), np.float64)
+    np.testing.assert_allclose(got.std(axis=0), 1.0, rtol=1e-3)
+    np.testing.assert_allclose(got.mean(axis=0), 0.0, atol=1e-3)
