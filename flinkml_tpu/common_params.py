@@ -118,6 +118,14 @@ class HasDistanceMeasure(WithParams):
     )
 
 
+class HasInputCol(WithParams):
+    INPUT_COL = StringParam("inputCol", "Input column name.", "input")
+
+
+class HasOutputCol(WithParams):
+    OUTPUT_COL = StringParam("outputCol", "Output column name.", "output")
+
+
 class HasInputCols(WithParams):
     INPUT_COLS = StringArrayParam(
         "inputCols", "Input column names.", None, ParamValidators.non_empty_array()

@@ -22,6 +22,7 @@ from flinkml_tpu.models.scalers import (
     StandardScalerModel,
 )
 from flinkml_tpu.models.vector_assembler import VectorAssembler
+from flinkml_tpu.models.evaluation import BinaryClassificationEvaluator
 
 __all__ = [
     "LogisticRegression",
@@ -45,4 +46,5 @@ __all__ = [
     "MinMaxScaler",
     "MinMaxScalerModel",
     "VectorAssembler",
+    "BinaryClassificationEvaluator",
 ]

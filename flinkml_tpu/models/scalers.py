@@ -21,15 +21,17 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import HasInputCol, HasOutputCol
 from flinkml_tpu.models._data import features_matrix
-from flinkml_tpu.params import BoolParam, FloatParam, StringParam, WithParams
+from flinkml_tpu.params import BoolParam, FloatParam
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
 
 
-class _HasInputOutputCol(WithParams):
-    INPUT_COL = StringParam("inputCol", "Input column name.", "input")
-    OUTPUT_COL = StringParam("outputCol", "Output column name.", "output")
+class _HasInputOutputCol(HasInputCol, HasOutputCol):
+    """Shared single-column in/out mixin (common_params is the canonical
+    home of the Has* params; this alias keeps the scaler class lists
+    short)."""
 
 
 @functools.lru_cache(maxsize=32)

@@ -1,0 +1,121 @@
+"""BinaryClassificationEvaluator vs sklearn golden values."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+from flinkml_tpu.models.evaluation import (
+    BinaryClassificationEvaluator,
+    binary_metrics,
+)
+from flinkml_tpu.table import Table
+
+
+def _data(n=500, seed=0, ties=False):
+    rng = np.random.default_rng(seed)
+    y = (rng.uniform(size=n) > 0.4).astype(np.float64)
+    scores = np.clip(y * 0.3 + rng.normal(0.35, 0.25, size=n), 0, 1)
+    if ties:
+        scores = np.round(scores, 1)  # heavy ties
+    return scores, y
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_auc_roc_matches_sklearn(ties):
+    s, y = _data(ties=ties)
+    m = binary_metrics(s, y)
+    assert m["areaUnderROC"] == pytest.approx(roc_auc_score(y, s), abs=1e-12)
+
+
+def test_weighted_auc_matches_sklearn():
+    s, y = _data(seed=1)
+    w = np.random.default_rng(2).uniform(0.1, 3.0, size=s.shape)
+    m = binary_metrics(s, y, w)
+    assert m["areaUnderROC"] == pytest.approx(
+        roc_auc_score(y, s, sample_weight=w), abs=1e-12
+    )
+
+
+def test_auc_pr_close_to_sklearn_ap():
+    # Trapezoidal PR-AUC vs sklearn's step-interpolated AP: close, not equal.
+    s, y = _data(seed=3)
+    m = binary_metrics(s, y)
+    assert m["areaUnderPR"] == pytest.approx(
+        average_precision_score(y, s), abs=0.02
+    )
+
+
+def test_ks_and_accuracy():
+    # Perfect separation: KS = 1, accuracy = 1 at the 0.5 threshold.
+    y = np.asarray([0, 0, 1, 1], dtype=float)
+    s = np.asarray([0.1, 0.2, 0.8, 0.9])
+    m = binary_metrics(s, y)
+    assert m["ks"] == pytest.approx(1.0)
+    assert m["accuracy"] == pytest.approx(1.0)
+    assert m["areaUnderROC"] == pytest.approx(1.0)
+
+
+def test_evaluator_operator_table_io():
+    s, y = _data(seed=4)
+    t = Table({"label": y, "rawPrediction": np.stack([1 - s, s], axis=1)})
+    ev = BinaryClassificationEvaluator().set(
+        BinaryClassificationEvaluator.METRICS_NAMES,
+        ["areaUnderROC", "ks", "accuracy"],
+    )
+    (out,) = ev.transform(t)
+    assert set(out.column_names) == {"areaUnderROC", "ks", "accuracy"}
+    assert out.column("areaUnderROC")[0] == pytest.approx(roc_auc_score(y, s))
+
+
+def test_evaluator_rejects_unknown_metric():
+    ev = BinaryClassificationEvaluator().set(
+        BinaryClassificationEvaluator.METRICS_NAMES, ["areaUnderLorenz"]
+    )
+    with pytest.raises(ValueError, match="unsupported"):
+        ev.transform(Table({"label": np.zeros(2), "rawPrediction": np.zeros(2)}))
+
+
+def test_single_class_rejected():
+    with pytest.raises(ValueError, match="both classes"):
+        binary_metrics(np.asarray([0.1, 0.9]), np.asarray([1.0, 1.0]))
+
+
+def test_end_to_end_with_logistic_regression():
+    from flinkml_tpu.models import LogisticRegression
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(600, 10)).astype(np.float32)
+    y = (x @ rng.normal(size=10) + 0.3 * rng.normal(size=600) > 0).astype(
+        np.float32
+    )
+    train = Table({"features": x, "label": y})
+    model = (LogisticRegression().set_max_iter(80).set_learning_rate(0.5)
+             .set_global_batch_size(600).set_seed(0).fit(train))
+    (scored,) = model.transform(train)
+    (metrics,) = BinaryClassificationEvaluator().transform(scored)
+    assert metrics.column("areaUnderROC")[0] > 0.95
+
+
+def test_accuracy_uses_prediction_column_for_margins():
+    """LinearSVC-style margins: thresholding raw scores at 0.5 is wrong;
+    the prediction column must drive accuracy."""
+    y = np.asarray([0.0, 0.0, 1.0, 1.0])
+    margins = np.asarray([-0.3, -0.1, 0.1, 0.3])  # perfect at threshold 0
+    t = Table({
+        "label": y, "rawPrediction": margins,
+        "prediction": (margins > 0).astype(np.float64),
+    })
+    ev = BinaryClassificationEvaluator().set(
+        BinaryClassificationEvaluator.METRICS_NAMES, ["accuracy"]
+    )
+    (out,) = ev.transform(t)
+    assert out.column("accuracy")[0] == pytest.approx(1.0)
+    # Without a prediction column the 0.5 threshold is (documentedly) off.
+    t2 = Table({"label": y, "rawPrediction": margins})
+    (out2,) = ev.transform(t2)
+    assert out2.column("accuracy")[0] == pytest.approx(0.5)
+
+
+def test_nan_scores_rejected():
+    with pytest.raises(ValueError, match="NaN"):
+        binary_metrics(np.asarray([0.1, np.nan]), np.asarray([0.0, 1.0]))
