@@ -20,6 +20,7 @@ from flinkml_tpu.parallel.tensor import (
     expert_parallel_ffn,
     pipeline_parallel_apply,
     register_pipeline_stage,
+    routed_expert_ffn,
     tensor_parallel_mlp,
 )
 
@@ -41,5 +42,6 @@ __all__ = [
     "expert_parallel_ffn",
     "pipeline_parallel_apply",
     "register_pipeline_stage",
+    "routed_expert_ffn",
     "tensor_parallel_mlp",
 ]

@@ -24,6 +24,7 @@ so they compose with the data axis (e.g. ``{"data": 2, "model": 4}``).
 from __future__ import annotations
 
 import functools
+import math
 from typing import Callable, Optional
 
 import jax
@@ -212,6 +213,93 @@ def expert_parallel_ffn(x, gates, w1, w2, mesh: Optional[DeviceMesh] = None,
         )
     fn = _expert_fn(dm.mesh, axis, activation)
     return fn(jnp.asarray(x), jnp.asarray(gates), jnp.asarray(w1),
+              jnp.asarray(w2))
+
+
+@functools.lru_cache(maxsize=64)
+def _routed_expert_fn(mesh, axis: str, capacity: int, activation_name: str):
+    activation = getattr(jax.nn, activation_name)
+
+    def local(xl, logits_l, w1, w2):
+        """Switch-style top-1 routed MoE. xl [n_loc, d] token-sharded;
+        logits_l [n_loc, E]; w1/w2 [1, ...] — this device's expert."""
+        n_loc, d = xl.shape
+        e_count = logits_l.shape[1]
+        probs = jax.nn.softmax(logits_l, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                 # [n]
+        gate = jnp.max(probs, axis=-1)                      # [n]
+        onehot = jax.nn.one_hot(expert, e_count, dtype=xl.dtype)  # [n, E]
+        # 0-based rank of each token within its expert's send buffer;
+        # tokens beyond capacity are dropped (their combine weight is 0).
+        # Rank bookkeeping runs in int32 regardless of the data dtype —
+        # a bf16 cumsum cannot count past 256 and would silently collide
+        # buffer slots.
+        onehot_i = jax.nn.one_hot(expert, e_count, dtype=jnp.int32)
+        ranks = jnp.cumsum(onehot_i, axis=0) * onehot_i      # [n, E]: rank+1
+        pos_tok = jnp.sum(ranks, axis=1) - 1                 # [n]
+        keep_tok = pos_tok < capacity
+        # one_hot(-1) is all-zeros, which zeroes dropped tokens out of the
+        # dispatch AND the combine.
+        poshot = jax.nn.one_hot(
+            jnp.where(keep_tok, pos_tok, -1), capacity, dtype=xl.dtype
+        )                                                    # [n, C]
+        mask = onehot[:, :, None] * poshot[:, None, :]       # [n, E, C]
+        dispatch = jnp.einsum("nec,nd->ecd", mask, xl)       # [E, C, d]
+        # Exchange: device p receives every peer's buffer for expert p.
+        recv = jax.lax.all_to_all(
+            dispatch, axis, split_axis=0, concat_axis=0, tiled=True
+        )                                                    # [P, C, d]
+        h = activation(recv.reshape(-1, d) @ w1[0])
+        y = (h @ w2[0]).reshape(recv.shape[0], capacity, -1)
+        back = jax.lax.all_to_all(
+            y, axis, split_axis=0, concat_axis=0, tiled=True
+        )                                                    # [E, C, d_out]
+        combined = jnp.einsum("nec,ecd->nd", mask, back)
+        return combined * gate[:, None]
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
+        )
+    )
+
+
+def routed_expert_ffn(x, router_logits, w1, w2,
+                      mesh: Optional[DeviceMesh] = None,
+                      axis: str = "expert", capacity_factor: float = 1.25,
+                      activation: str = "gelu"):
+    """Top-1 routed expert-parallel MoE (Switch-style): tokens are
+    dispatched to their expert's device over one ``all_to_all``, processed
+    there, and returned by a second ``all_to_all`` — communication scales
+    with tokens actually routed, not tokens × experts.
+
+    Shapes: ``x [n, d_in]`` (token-sharded over ``axis``),
+    ``router_logits [n, E]``, ``w1 [E, d_in, d_ff]``, ``w2 [E, d_ff,
+    d_out]``; ``E`` must equal the axis size and ``n`` divide by it.
+    Per-device-per-expert capacity = ``ceil(n_local / E *
+    capacity_factor)``; over-capacity tokens are dropped (zero output),
+    the standard Switch behavior.
+    """
+    dm = mesh if mesh is not None else DeviceMesh({"expert": len(jax.devices())})
+    p_size = _axis_check(dm, axis)
+    n, e_count = router_logits.shape[0], router_logits.shape[1]
+    if e_count != p_size or w1.shape[0] != e_count or w2.shape[0] != e_count:
+        raise ValueError(
+            f"expert count mismatch: logits {e_count}, w1 {w1.shape[0]}, "
+            f"w2 {w2.shape[0]}, axis size {p_size}"
+        )
+    if n % p_size != 0 or x.shape[0] != n:
+        raise ValueError(
+            f"token count {n} must match x rows {x.shape[0]} and divide by "
+            f"the mesh size {p_size}"
+        )
+    n_local = n // p_size
+    capacity = max(1, math.ceil(n_local * capacity_factor / p_size))
+    fn = _routed_expert_fn(dm.mesh, axis, capacity, activation)
+    return fn(jnp.asarray(x), jnp.asarray(router_logits), jnp.asarray(w1),
               jnp.asarray(w2))
 
 
