@@ -80,21 +80,68 @@ def bench_reference_style_cpu(x, y, w, global_batch_size, budget_s=10.0):
     return processed / (time.perf_counter() - start)
 
 
-def main():
+def _run_device_bench() -> float:
+    """Device-side measurement, run in a child process so a hung device
+    tunnel (jax init can block forever if the TPU proxy is down) cannot
+    take the whole bench with it."""
     n, dim = 1_000_000, 123  # a9a-like width (BASELINE.json config #1)
     global_batch_size = 262_144
     x, y, w = make_data(n, dim)
+    return bench_tpu(x, y, w, global_batch_size, n_steps=400)
 
-    tpu_sps = bench_tpu(x, y, w, global_batch_size, n_steps=400)
-    cpu_sps = bench_reference_style_cpu(x[:200_000], y[:200_000], w[:200_000], 16_384)
+
+def main():
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("_FLINKML_BENCH_INNER") == "1":
+        print(f"{_run_device_bench():.1f}")
+        return
+
+    timeout_s = float(os.environ.get("FLINKML_BENCH_TIMEOUT", "1500"))
+    device_sps = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**os.environ, "_FLINKML_BENCH_INNER": "1"},
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if proc.returncode == 0:
+            device_sps = float(proc.stdout.strip().splitlines()[-1])
+        else:
+            sys.stderr.write(
+                f"device bench failed (rc={proc.returncode}):\n{proc.stderr}\n"
+            )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            f"device bench timed out after {timeout_s}s (device tunnel hung?)\n"
+        )
+    except (ValueError, IndexError):
+        sys.stderr.write(
+            f"device bench produced unparseable output:\n{proc.stdout!r}\n"
+        )
+
+    n_cpu = 200_000
+    x, y, w = make_data(n_cpu, 123)
+    cpu_sps = bench_reference_style_cpu(x, y, w, 16_384)
+
+    if device_sps is None:
+        # Device unreachable: still emit one JSON line so the driver
+        # records something, but under a DIFFERENT metric name so a CPU
+        # fallback can never be mistaken for a per-chip measurement.
+        metric = "logreg_train_samples_per_sec_cpu_fallback"
+        device_sps = cpu_sps
+    else:
+        metric = "logreg_train_samples_per_sec_per_chip"
 
     print(
         json.dumps(
             {
-                "metric": "logreg_train_samples_per_sec_per_chip",
-                "value": round(tpu_sps, 1),
+                "metric": metric,
+                "value": round(device_sps, 1),
                 "unit": "samples/sec",
-                "vs_baseline": round(tpu_sps / cpu_sps, 2),
+                "vs_baseline": round(device_sps / cpu_sps, 2),
             }
         )
     )
