@@ -1,0 +1,190 @@
+"""OnlineKMeans — decayed mini-batch k-means over an unbounded stream.
+
+Member of the wider Flink ML family (apache/flink-ml's ``OnlineKMeans``;
+the reference snapshot has only the bounded KMeans, SURVEY.md §2.3) and
+the second user of the unbounded-iteration mode (with
+``OnlineLogisticRegression``): one centroid update per arriving batch,
+with the standard decay rule shared by Spark's streaming k-means and
+flink-ml::
+
+    n'       = decay * n + count_batch
+    centroid = (decay * n * centroid + sum_batch) / n'      (n' > 0)
+
+``decayFactor`` = 1 gives the running exact mini-batch mean; 0 forgets
+history entirely each batch. Initial centroids come from a fitted
+``KMeansModel`` via ``set_initial_model_data`` (how flink-ml requires it)
+or, if unset, from ``k`` random rows of the first batch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import (
+    HasDecayFactor,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasPredictionCol,
+    HasSeed,
+)
+from flinkml_tpu.iteration import (
+    IterationConfig,
+    Iterations,
+    TerminateOnMaxIter,
+)
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.models.kmeans import _KMeansParams
+from flinkml_tpu.ops import blas
+from flinkml_tpu.ops.distance import DistanceMeasure
+from flinkml_tpu.params import IntParam, ParamValidators
+from flinkml_tpu.table import Table
+
+
+class _OnlineKMeansParams(
+    HasFeaturesCol, HasPredictionCol, HasGlobalBatchSize, HasDecayFactor,
+    HasSeed,
+):
+    K = IntParam(
+        "k", "The number of clusters to create.", 2, ParamValidators.gt(1)
+    )
+
+
+@jax.jit
+def _batch_stats(x, centroids):
+    """One assignment pass: per-centroid batch sums and counts."""
+    d2 = blas.squared_distances(x, centroids)
+    assign = jnp.argmin(d2, axis=-1)
+    onehot = jax.nn.one_hot(assign, centroids.shape[0], dtype=x.dtype)
+    return onehot.T @ x, jnp.sum(onehot, axis=0)
+
+
+class OnlineKMeans(_OnlineKMeansParams, Estimator):
+    def __init__(self):
+        super().__init__()
+        self._initial_centroids: Optional[np.ndarray] = None
+
+    def set_initial_model_data(self, *inputs: Table) -> "OnlineKMeans":
+        """Warm-start from a (bounded) KMeansModel's model-data table."""
+        (table,) = inputs
+        c = np.asarray(table.column("centroids"), dtype=np.float64)
+        self._initial_centroids = c.reshape(c.shape[-2], c.shape[-1])
+        return self
+
+    def fit(self, *inputs: Table) -> "OnlineKMeansModel":
+        """Consume the table as a stream of globalBatchSize mini-batches."""
+        (table,) = inputs
+        batch_size = self.get(self.GLOBAL_BATCH_SIZE)
+        return self.fit_stream(table.batches(batch_size))
+
+    def fit_stream(self, batches: Iterable[Table]) -> "OnlineKMeansModel":
+        k = self.get(self.K)
+        decay = self.get(self.DECAY_FACTOR)
+        features_col = self.get(self.FEATURES_COL)
+        rng = np.random.default_rng(self.get_seed())
+
+        state = {
+            "centroids": self._initial_centroids,
+            "weights": None,
+            "version": 0,
+        }
+
+        def step(carry, batch_table, epoch):
+            x = features_matrix(batch_table, features_col).astype(np.float64)
+            if carry["centroids"] is None:
+                if x.shape[0] < k:
+                    raise ValueError(
+                        f"first batch has {x.shape[0]} rows < k={k}; "
+                        "increase globalBatchSize or provide initial model data"
+                    )
+                idx = rng.choice(x.shape[0], size=k, replace=False)
+                carry["centroids"] = jnp.asarray(x[idx])
+                carry["weights"] = jnp.zeros(k, dtype=jnp.float64)
+            elif carry["weights"] is None:
+                carry["centroids"] = jnp.asarray(carry["centroids"])
+                carry["weights"] = jnp.zeros(k, dtype=jnp.float64)
+
+            sums, counts = _batch_stats(jnp.asarray(x), carry["centroids"])
+            old_w = carry["weights"] * decay
+            new_w = old_w + counts
+            safe = jnp.maximum(new_w, 1e-12)[:, None]
+            updated = (old_w[:, None] * carry["centroids"] + sums) / safe
+            carry["centroids"] = jnp.where(
+                new_w[:, None] > 0, updated, carry["centroids"]
+            )
+            carry["weights"] = new_w
+            carry["version"] += 1
+            return carry, None
+
+        result = Iterations.iterate_unbounded_streams(
+            step, state, batches, IterationConfig(TerminateOnMaxIter(2**31 - 1))
+        )
+        final = result.state
+        if final["centroids"] is None:
+            raise ValueError("training stream is empty")
+        model = OnlineKMeansModel()
+        model.copy_params_from(self)
+        model._centroids = np.asarray(final["centroids"])
+        model._model_version = final["version"]
+        return model
+
+
+class OnlineKMeansModel(_OnlineKMeansParams, Model):
+    """Nearest-centroid prediction; tracks the model-data version like the
+    online LR model (one version per consumed batch)."""
+
+    def __init__(self):
+        super().__init__()
+        self._centroids: Optional[np.ndarray] = None
+        self._model_version = 0
+
+    @property
+    def centroids(self) -> np.ndarray:
+        self._require()
+        return self._centroids
+
+    @property
+    def model_version(self) -> int:
+        return self._model_version
+
+    def set_model_data(self, *inputs: Table) -> "OnlineKMeansModel":
+        (table,) = inputs
+        c = np.asarray(table.column("centroids"), dtype=np.float64)
+        self._centroids = c.reshape(c.shape[-2], c.shape[-1])
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({"centroids": self._centroids[None, :, :]})]
+
+    def _require(self) -> None:
+        if self._centroids is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        x = features_matrix(table, self.get(self.FEATURES_COL))
+        measure = DistanceMeasure.get_instance("euclidean")
+        assign = np.asarray(
+            measure.nearest(jnp.asarray(x), jnp.asarray(self._centroids))
+        )
+        return (table.with_column(self.get(self.PREDICTION_COL), assign),)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(
+            path, {"centroids": self._centroids},
+            extra={"modelVersion": self._model_version},
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "OnlineKMeansModel":
+        model, arrays, extra = cls._load_with_arrays(path)
+        model._centroids = arrays["centroids"]
+        model._model_version = int(extra.get("modelVersion", 0))
+        return model
