@@ -181,11 +181,12 @@ def test_host_mode_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(resumed, golden, rtol=1e-12)
 
 
-def test_checkpoint_requires_host_mode():
-    from flinkml_tpu.iteration import CheckpointManager
+def test_device_mode_resume_requires_manager():
+    """Device mode checkpoints via chunked dispatches (round 2); resume
+    still demands a manager to restore from."""
     from flinkml_tpu.models.logistic_regression import train_logistic_regression
 
-    with pytest.raises(ValueError, match="host"):
+    with pytest.raises(ValueError, match="checkpoint_manager"):
         train_logistic_regression(
             np.ones((4, 2)), np.zeros(4), np.ones(4), mesh=DeviceMesh(),
             max_iter=1, learning_rate=0.1, global_batch_size=4, reg=0.0,

