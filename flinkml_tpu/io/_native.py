@@ -1,7 +1,9 @@
 """Shared compile-on-demand loader for the native (C++) ingest parsers.
 
-Each parser lives in ``native/<name>.cpp`` with a C ABI; the first import
-compiles it with the system ``g++`` into ``native/build/<name>.so``
+Each parser lives in ``flinkml_tpu/native/<name>.cpp`` with a C ABI (the
+sources ship inside the wheel via package-data); the first import compiles
+it with the system ``g++`` into a ``build/`` dir next to the sources — or,
+when the installed package is read-only, into a per-user cache dir —
 (atomic rename so concurrent processes never dlopen a half-written file)
 and caches the handle. Callers fall back to pure Python when no compiler
 is available — the native path is a throughput optimization, never a
@@ -13,13 +15,30 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import tempfile
 import threading
 from typing import Callable, Dict, Optional
 
 _NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "native",
 )
+
+
+def _build_dir() -> str:
+    preferred = os.path.join(_NATIVE_DIR, "build")
+    try:
+        os.makedirs(preferred, exist_ok=True)
+        if os.access(preferred, os.W_OK):
+            return preferred
+    except OSError:
+        pass
+    fallback = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", tempfile.gettempdir()),
+        "flinkml_tpu_native",
+    )
+    os.makedirs(fallback, exist_ok=True)
+    return fallback
 
 _lock = threading.Lock()
 _cache: Dict[str, Optional[ctypes.CDLL]] = {}
@@ -28,7 +47,7 @@ _cache: Dict[str, Optional[ctypes.CDLL]] = {}
 def compile_and_load(
     name: str, declare: Callable[[ctypes.CDLL], None]
 ) -> Optional[ctypes.CDLL]:
-    """Compile ``native/<name>.cpp`` (if stale) and load it.
+    """Compile ``flinkml_tpu/native/<name>.cpp`` (if stale) and load it.
 
     ``declare`` sets restype/argtypes on the fresh handle. Returns None if
     compilation or loading fails (callers use their Python fallback);
@@ -38,7 +57,7 @@ def compile_and_load(
         if name in _cache:
             return _cache[name]
         src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
-        so = os.path.join(_NATIVE_DIR, "build", f"{name}.so")
+        so = os.path.join(_build_dir(), f"{name}.so")
         try:
             if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
                 os.makedirs(os.path.dirname(so), exist_ok=True)

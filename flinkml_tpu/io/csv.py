@@ -1,7 +1,7 @@
 """Numeric-CSV ingest: native multithreaded parser with numpy fallback.
 
 Companion to :mod:`flinkml_tpu.io.libsvm` (same pattern: compile
-``native/csv_parser.cpp`` on demand, fall back to pure Python without a
+``flinkml_tpu/native/csv_parser.cpp`` on demand, fall back to pure Python without a
 compiler). The reference reads CSV through Flink's table connectors,
 record-at-a-time on the JVM; here the parser splits the buffer at line
 boundaries across threads and fills a column-major float64 buffer so each

@@ -1,6 +1,6 @@
 """libsvm-format ingest: native multithreaded parser with pure-Python fallback.
 
-The native path (``native/libsvm_parser.cpp``) is compiled on first use with
+The native path (``flinkml_tpu/native/libsvm_parser.cpp``) is compiled on first use with
 the system ``g++`` and cached next to the source; when no compiler is
 available the numpy fallback parses correctly (just slower). Either way the
 result is CSR arrays ready for ``BatchedCSR``/densification — vectorized

@@ -67,8 +67,10 @@ def binary_metrics(scores, labels, weights=None, predictions=None) -> dict:
     )
     recall = tpr
 
-    auc_roc = float(np.trapezoid(tpr, fpr))
-    auc_pr = float(np.trapezoid(precision, recall))
+    # np.trapezoid is numpy>=2; numpy 1.x spells it np.trapz.
+    _trapezoid = getattr(np, "trapezoid", None) or np.trapz
+    auc_roc = float(_trapezoid(tpr, fpr))
+    auc_pr = float(_trapezoid(precision, recall))
     ks = float(np.max(np.abs(tpr - fpr)))
     if predictions is not None:
         pred = np.asarray(predictions, dtype=np.float64).reshape(-1)

@@ -26,7 +26,6 @@ import os
 from typing import Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -49,19 +48,27 @@ def init_distributed(
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
-    if coordinator_address and jax.process_count() == 1:
-        num_processes = num_processes or int(
-            os.environ.get("JAX_NUM_PROCESSES", "1")
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "1")
+    )
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "0")
+    )
+    # The guard must not touch any backend-initializing API
+    # (jax.process_count() et al. would create the XLA backend, after which
+    # jax.distributed.initialize() unconditionally raises) — so the decision
+    # is made from the arguments/environment plus jax.distributed's own
+    # state, which is safe to query before backend init.
+    if (
+        coordinator_address
+        and num_processes > 1
+        and not jax.distributed.is_initialized()
+    ):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
         )
-        process_id = process_id if process_id is not None else int(
-            os.environ.get("JAX_PROCESS_ID", "0")
-        )
-        if num_processes > 1:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
     return jax.process_index(), jax.process_count()
 
 
@@ -86,13 +93,22 @@ def host_barrier(mesh=None, tag: int = 0) -> int:
     def _one(x):
         return jax.lax.psum(x, axis)
 
+    # Build the input per-device via callback so each process only touches
+    # its addressable devices — a host-local global array would need a
+    # device_put onto non-addressable devices on a multi-host pod.
+    global_shape = (dm.axis_size(),)
+    sharding = jax.sharding.NamedSharding(dm.mesh, P(axis))
+    full = np.full(global_shape, tag, dtype=np.int32)
+    arr = jax.make_array_from_callback(global_shape, sharding,
+                                       lambda idx: full[idx])
     summed = jax.jit(
         jax.shard_map(
             _one, mesh=dm.mesh, in_specs=P(axis), out_specs=P(None)
         )
-    )(jnp.full((dm.axis_size(),), tag, dtype=jnp.int32))
-    # Host blocks until every participant contributed.
-    return int(np.asarray(summed)[0])
+    )(arr)
+    # Host blocks until every participant contributed (output is fully
+    # replicated, so every host can read shard 0 locally).
+    return int(np.asarray(summed.addressable_shards[0].data)[0])
 
 
 def process_slice(n: int, process_index: Optional[int] = None,
