@@ -132,14 +132,15 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
             raw_col[0], SparseVector
         )
         if sparse_input:
-            # Criteo-scale path (BASELINE.json config #5): padded-ELL batch,
-            # gather forward + segment-sum gradient; the dense [dim] model
-            # stays replicated.
-            # Host-side ELL packing: the trainer shards from host, so the
-            # full dataset never stages through a single device's HBM.
-            indices, values, dim = BatchedCSR.pack_sparse_vectors(
-                raw_col, dtype=np.float32
-            )
+            # Criteo-scale path (BASELINE.json config #5): nnz-bucketed ELL
+            # blocks (ops.sparse.pack_ell_buckets — padded cells ≈ total
+            # nnz even under skew), gather forward + one fused segment-sum
+            # gradient scatter; the dense [dim] model stays replicated.
+            # Host-side packing: the trainer shards from host, so the full
+            # dataset never stages through a single device's HBM.
+            from flinkml_tpu.ops.sparse import csr_from_sparse_vectors
+
+            indptr, indices, values, dim = csr_from_sparse_vectors(raw_col)
             y = np.asarray(
                 table.column(self.get(_LogisticRegressionParams.LABEL_COL)),
                 dtype=np.float32,
@@ -151,8 +152,8 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
                 else np.ones(len(y), dtype=np.float32)
             )
             _check_binomial_labels(y)
-            coef = _linear_sgd.train_linear_model_sparse(
-                indices, values, dim,
+            coef = _linear_sgd.train_linear_model_sparse_csr(
+                indptr, indices, values, dim,
                 y, w, loss="logistic", elastic_net=0.0, **hyper,
             )
         else:

@@ -66,13 +66,10 @@ def test_two_process_control_plane(tmp_path):
     count, a cross-process all-reduce, and barrier-ordered checkpoint
     manifest commit. See tests/_dist_worker.py for the worker body.
     """
+    import shutil
     import socket
     import subprocess
     import sys
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
 
     worker = os.path.join(os.path.dirname(__file__), "_dist_worker.py")
     env = dict(os.environ)
@@ -83,28 +80,48 @@ def test_two_process_control_plane(tmp_path):
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
 
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(port), str(p), "2", str(tmp_path)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
+    def attempt(workdir):
+        # Probe a free ephemeral port. The bind-then-close window is racy
+        # (another process can claim it before the coordinator binds), so
+        # the whole launch retries on a fresh port below.
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(port), str(p), "2", workdir],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+            )
+            for p in range(2)
+        ]
+        outputs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=180)
+                outputs.append(out)
+        except subprocess.TimeoutExpired:
+            outputs = ["<timeout>"] * 2
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        ok = all(
+            p.returncode == 0 and f"WORKER_OK {rank}" in out
+            for rank, (p, out) in enumerate(zip(procs, outputs))
         )
-        for p in range(2)
-    ]
-    outputs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outputs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for rank, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-        assert f"WORKER_OK {rank}" in out, out
+        return ok, outputs
+
+    for retry in range(3):
+        workdir = tmp_path / f"run{retry}"
+        workdir.mkdir()
+        ok, outputs = attempt(str(workdir))
+        if ok:
+            break
+        shutil.rmtree(workdir, ignore_errors=True)
+    assert ok, "all attempts failed; last outputs:\n" + "\n----\n".join(outputs)
     # The committed artifacts exist on the shared filesystem.
-    assert (tmp_path / "manifest.json").exists()
-    assert (tmp_path / "ckpt").is_dir()
+    assert (workdir / "manifest.json").exists()
+    assert (workdir / "ckpt").is_dir()
