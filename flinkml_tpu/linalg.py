@@ -108,6 +108,19 @@ class SparseVector(Vector):
         self.indices = indices
         self.values = values
 
+    @classmethod
+    def _from_sorted(cls, size: int, indices: np.ndarray,
+                     values: np.ndarray) -> "SparseVector":
+        """Internal trusted construction: skips validation and sorting.
+        Callers guarantee sorted, unique, in-range int64 indices and
+        float64 values — used by bulk producers (e.g. the sparse
+        OneHotEncoder) where per-row validation dominates."""
+        self = object.__new__(cls)
+        self._size = int(size)
+        self.indices = indices
+        self.values = values
+        return self
+
     def size(self) -> int:
         return self._size
 

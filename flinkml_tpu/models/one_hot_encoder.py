@@ -15,9 +15,15 @@ Capability parity with
     plus "keep" (clamp into an extra catch-all category) and "skip" is
     rejected explicitly.
 
-TPU-first: output columns are dense ``[n, size]`` one-hot matrices (batched,
-MXU-ready) rather than per-row SparseVector objects; the information content
-is identical and downstream algorithms consume whole columns.
+Output layout is selected by ``outputFormat``:
+
+  - ``"dense"`` (default): ``[n, size]`` one-hot matrices — batched,
+    MXU-ready, the TPU-first layout for moderate cardinality.
+  - ``"sparse"``: one ``SparseVector(size, [v], [1.0])`` per row, exactly
+    the reference's encoding (``OneHotEncoderModel.java:160-183``) — the
+    only viable layout at high cardinality (dense is O(n·cardinality)),
+    and directly consumable by the sparse LogisticRegression path
+    (nnz-bucketed ELL training).
 """
 
 from __future__ import annotations
@@ -28,12 +34,27 @@ import numpy as np
 
 from flinkml_tpu.api import Estimator, Model
 from flinkml_tpu.common_params import HasHandleInvalid, HasInputCols, HasOutputCols
-from flinkml_tpu.params import BoolParam
+from flinkml_tpu.linalg import SparseVector
+from flinkml_tpu.params import BoolParam, ParamValidators, StringParam
 from flinkml_tpu.table import Table
+
+
+# Shared, frozen 1.0 buffer for the sparse rows (each SparseVector holds a
+# read-only view; freezing removes any cross-row mutation hazard).
+_ONE = np.ones(1)
+_ONE.setflags(write=False)
 
 
 class _OneHotEncoderParams(HasInputCols, HasOutputCols, HasHandleInvalid):
     DROP_LAST = BoolParam("dropLast", "Whether to drop the last category.", True)
+    OUTPUT_FORMAT = StringParam(
+        "outputFormat",
+        "Encoding layout: 'dense' ([n, size] matrices) or 'sparse' "
+        "(per-row SparseVector, the reference's encoding — required at "
+        "high cardinality).",
+        "dense",
+        ParamValidators.in_array(["dense", "sparse"]),
+    )
 
 
 class OneHotEncoder(_OneHotEncoderParams, Estimator):
@@ -111,6 +132,9 @@ class OneHotEncoderModel(_OneHotEncoderParams, Model):
                 f"model was fit on {len(self._max_indices)} columns, got {len(input_cols)}"
             )
         drop_last = self.get(_OneHotEncoderParams.DROP_LAST)
+        sparse_format = (
+            self.get(_OneHotEncoderParams.OUTPUT_FORMAT) == "sparse"
+        )
         out = table
         for col, out_col, max_idx in zip(input_cols, output_cols, self._max_indices):
             values = np.asarray(table.column(col), dtype=np.float64)
@@ -138,9 +162,30 @@ class OneHotEncoderModel(_OneHotEncoderParams, Model):
                 size = base_size
                 hot = idx
                 zero_row = idx == base_size
-            onehot = np.zeros((len(idx), size), dtype=np.float64)
-            rows = np.nonzero(~zero_row)[0]
-            onehot[rows, hot[rows]] = 1.0
+            if sparse_format:
+                # Reference encoding (OneHotEncoderModel.java:160-183):
+                # SparseVector(size, [v], [1.0]); the dropped-last value
+                # encodes as the empty vector. O(n) memory regardless of
+                # cardinality. Trusted construction (single known-valid
+                # index per row) — full validation would dominate at
+                # Criteo-scale row counts.
+                empty_i = np.zeros(0, dtype=np.int64)
+                empty_v = np.zeros(0)
+                hot64 = hot.astype(np.int64)
+                hot64.setflags(write=False)
+                onehot = np.empty(len(idx), dtype=object)
+                for i in range(len(idx)):
+                    onehot[i] = (
+                        SparseVector._from_sorted(size, empty_i, empty_v)
+                        if zero_row[i]
+                        else SparseVector._from_sorted(
+                            size, hot64[i : i + 1], _ONE
+                        )
+                    )
+            else:
+                onehot = np.zeros((len(idx), size), dtype=np.float64)
+                rows = np.nonzero(~zero_row)[0]
+                onehot[rows, hot[rows]] = 1.0
             out = out.with_column(out_col, onehot)
         return (out,)
 
