@@ -157,6 +157,22 @@ class IterationConfig:
     # Snapshot the loop carry every N epochs (0 = disabled).
     checkpoint_interval: int = 0
     checkpoint_manager: Optional[Any] = None
+    # How a resumed run re-aligns an *iterable* data stream:
+    #   "replay":   the iterable restarts from the beginning on each run
+    #               (list, file reader, DataCache) — skip the batches the
+    #               pre-failure run already consumed so epoch k always
+    #               sees batch k.
+    #   "continue": the iterable is a live one-shot stream already
+    #               positioned at "now" (online learning) — consume from
+    #               the front; skipping would silently DROP real data.
+    stream_resume: str = "replay"
+
+    def __post_init__(self):
+        if self.stream_resume not in ("replay", "continue"):
+            raise ValueError(
+                "stream_resume must be 'replay' or 'continue', "
+                f"got {self.stream_resume!r}"
+            )
 
 
 @dataclasses.dataclass
@@ -234,12 +250,16 @@ def iterate(
     data_iter: Optional[Iterator] = None
     if data is not None and not callable(data) and _is_stream(data):
         data_iter = iter(data)
-        # Fast-forward a resumed unbounded stream past consumed epochs.
-        for _ in range(start_epoch):
-            try:
-                next(data_iter)
-            except StopIteration:
-                break
+        if config.stream_resume == "replay":
+            # The iterable restarts from the beginning: fast-forward past
+            # the epochs the pre-failure run consumed. For a live one-shot
+            # stream this would drop real data — set
+            # stream_resume='continue' there.
+            for _ in range(start_epoch):
+                try:
+                    next(data_iter)
+                except StopIteration:
+                    break
 
     criteria_history: List[Optional[float]] = []
     outputs: List[Any] = []

@@ -140,11 +140,12 @@ class OneHotEncoderModel(_OneHotEncoderParams, Model):
             values = np.asarray(table.column(col), dtype=np.float64)
             _check_indexed(values, col)
             idx = values.astype(int)
-            # Valid values are [0, base_size]; idx == base_size encodes as
-            # the all-zero vector (the reference's dropped-last rule,
-            # OneHotEncoderModel.java:176-183).
-            base_size = int(max_idx) + (0 if drop_last else 1)
-            invalid = (idx < 0) | (idx > base_size)
+            # Valid categories are [0, maxIndex] regardless of dropLast;
+            # with dropLast the LAST category (idx == maxIndex) encodes
+            # as the all-zero vector (OneHotEncoderModel.java:176-183).
+            max_valid = int(max_idx)
+            base_size = max_valid + (0 if drop_last else 1)
+            invalid = (idx < 0) | (idx > max_valid)
             keep = handle_invalid == HasHandleInvalid.KEEP_INVALID
             if keep:
                 # Invalids go to an extra catch-all slot appended AFTER
@@ -152,16 +153,16 @@ class OneHotEncoderModel(_OneHotEncoderParams, Model):
                 # all-zero dropped-last one) unchanged and distinguishable.
                 size = base_size + 1
                 hot = np.where(invalid, base_size, idx)
-                zero_row = ~invalid & (idx == base_size)
+                zero_row = ~invalid & drop_last & (idx == max_valid)
             else:
                 if invalid.any():
                     raise ValueError(
                         f"Column {col!r} contains categories outside "
-                        f"[0, {base_size}]: {idx[invalid][:5]}"
+                        f"[0, {max_valid}]: {idx[invalid][:5]}"
                     )
                 size = base_size
                 hot = idx
-                zero_row = idx == base_size
+                zero_row = drop_last & (idx == max_valid)
             if sparse_format:
                 # Reference encoding (OneHotEncoderModel.java:160-183):
                 # SparseVector(size, [v], [1.0]); the dropped-last value

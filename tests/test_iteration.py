@@ -226,6 +226,40 @@ def test_failover_resume_exact(tmp_path):
     assert mgr.latest_epoch() == 8
 
 
+def test_stream_resume_replay_vs_continue(tmp_path):
+    """Resumed iterable streams: 'replay' (default) skips the consumed
+    epochs of a restartable source; 'continue' consumes a live one-shot
+    stream from the front instead of silently dropping its batches."""
+    step = lambda s, data, epoch: (s + float(data), None)
+
+    def run(mode, stream):
+        mgr = CheckpointManager(str(tmp_path / mode))
+        # Pretend epochs 0-1 already consumed batches 10, 20 (sum 30).
+        mgr.save(30.0, epoch=2)
+        return iterate(
+            step, 0.0, stream,
+            IterationConfig(TerminateOnMaxIter(4), checkpoint_manager=mgr,
+                            stream_resume=mode),
+            resume=True,
+        ).state
+
+    # Replayable source restarts from the beginning: epochs 2..3 must see
+    # batches 2..3 (30, 40), not re-consume 10, 20.
+    assert run("replay", [10.0, 20.0, 30.0, 40.0]) == 100.0
+    # A live one-shot stream is already positioned at "now": consume from
+    # the front — 'replay' would have skipped (dropped) 30 and 40 and
+    # ended at 30.0.
+    assert run("continue", iter([30.0, 40.0])) == 100.0
+
+
+def test_stream_resume_invalid_mode():
+    with pytest.raises(ValueError, match="stream_resume"):
+        iterate(
+            lambda s, d, e: (s, None), 0, [1.0],
+            IterationConfig(TerminateOnMaxIter(1), stream_resume="bogus"),
+        )
+
+
 def test_resume_without_manager_raises():
     with pytest.raises(ValueError):
         iterate(lambda s, e: (s, None), 0, resume=True)

@@ -42,6 +42,22 @@ def test_error_on_out_of_range(train_table):
         model.transform(bad)
 
 
+def test_error_on_unseen_category_without_drop_last(train_table):
+    """Without dropLast there is no all-zero encoding: maxIndex+1 is an
+    unseen category and must error (not silently encode as zeros)."""
+    model = make_encoder().set_drop_last(False).fit(train_table)
+    bad = Table({"c1": np.array([3.0]), "c2": np.array([0.0])})
+    with pytest.raises(ValueError, match="categories outside"):
+        model.transform(bad)
+    # And under 'keep' it goes to the catch-all slot, not the zero vector.
+    keep_model = (
+        make_encoder().set_drop_last(False).set_handle_invalid("keep")
+        .fit(train_table)
+    )
+    (out,) = keep_model.transform(bad)
+    np.testing.assert_array_equal(out["o1"][0], [0, 0, 0, 1])
+
+
 def test_error_on_non_integer(train_table):
     model = make_encoder().fit(train_table)
     bad = Table({"c1": np.array([0.5]), "c2": np.array([0.0])})
