@@ -38,15 +38,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 # Measured per-kernel defaults for FLINKML_TPU_PALLAS=auto (BASELINE.md,
-# "Kernel-path measurement"):
-#   linear: OFF — on v5e, XLA's lowering of the dense-LR gradient runs at
-#     ~80% of HBM bandwidth (~660M samples/s at n=1M, d=123) while the
-#     fused Mosaic kernel plateaus at ~255M: the [tile,d]x[d,1] matvecs
-#     use 1/128 of the MXU and Mosaic cannot pipeline past them,
-#     regardless of precision, lane padding, or tile height.
-#   kmeans: OFF pending measurement (its [tile,d]x[d,k] contractions are
-#     real matmuls, so the balance may flip — re-measure before enabling;
-#     the round-1 device tunnel outage prevented a trustworthy number).
+# "Kernel-path verdict (round 2)": both RETIRED from auto on evidence):
+#   linear: OFF — on v5e XLA's two-pass lowering beats the fused kernel
+#     at every measured shape (f32 d=123: 0.70x; bf16 d=123: 0.82x): the
+#     [tile,d]x[d,1] matvec uses 1/128 of the MXU and Mosaic cannot
+#     pipeline past it, regardless of precision or tile height.
+#   kmeans: OFF — measured 0.39-0.72x vs XLA's argmin+one-hot-matmul
+#     lowering across (d,k) in {64x16, 128x64, 256x256}.
+# Both kernels stay correct + tested and reachable via
+# FLINKML_TPU_PALLAS=always for future TPU/Mosaic generations.
 _AUTO_DEFAULTS = {"linear": False, "kmeans": False}
 
 
@@ -128,7 +128,7 @@ def _margin_terms(loss: str, dot, y, w):
     return mult, per_ex
 
 
-def _linear_grad_kernel(loss: str, x_ref, y_ref, w_ref, coef_ref,
+def _linear_grad_kernel(loss: str, acc_dt, x_ref, y_ref, w_ref, coef_ref,
                         grad_ref, stats_ref):
     @pl.when(pl.program_id(0) == 0)
     def _():
@@ -136,23 +136,30 @@ def _linear_grad_kernel(loss: str, x_ref, y_ref, w_ref, coef_ref,
         stats_ref[0, 0] = jnp.zeros((), stats_ref.dtype)  # SMEM: scalar stores
         stats_ref[0, 1] = jnp.zeros((), stats_ref.dtype)
 
-    x = x_ref[:]                       # [T, d]
     # Mosaic wants strictly 2-D matmuls: margins/labels ride as [T, 1]
     # column vectors, contractions are expressed via dot_general so no
-    # transpose relayout is ever emitted.
+    # transpose relayout is ever emitted. Sub-f32 inputs are bf16 in HBM
+    # (halved traffic — the point of the fused pass) but compute in f32
+    # (``acc_dt``) after the VMEM load: the d→1 matvec lowers to VPU
+    # broadcast-reduce, Mosaic cannot lower transcendentals
+    # (logistic/softplus) or mixed-dtype contractions on bf16 vectors,
+    # and bf16 accumulation would lose the sums anyway.
+    x = x_ref[:].astype(acc_dt)        # [T, d]
     dot = jax.lax.dot_general(         # x [T,d] . coef [1,d] -> [T,1]
-        x, coef_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=x.dtype,
+        x, coef_ref[:].astype(acc_dt), (((1,), (1,)), ((), ())),
+        preferred_element_type=acc_dt,
         precision=jax.lax.Precision.HIGHEST,
     )
-    mult, per_ex = _margin_terms(loss, dot, y_ref[:], w_ref[:])
+    mult, per_ex = _margin_terms(
+        loss, dot, y_ref[:].astype(acc_dt), w_ref[:].astype(acc_dt)
+    )
     grad_ref[:] += jax.lax.dot_general(  # mult [T,1] . x [T,d] -> [1,d]
         mult, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=x.dtype,
+        preferred_element_type=acc_dt,
         precision=jax.lax.Precision.HIGHEST,
     )
     stats_ref[0, 0] += jnp.sum(per_ex)
-    stats_ref[0, 1] += jnp.sum(w_ref[:])
+    stats_ref[0, 1] += jnp.sum(w_ref[:].astype(acc_dt))
 
 
 @functools.partial(jax.jit, static_argnames=("loss", "interpret"))
@@ -163,8 +170,12 @@ def fused_linear_grad(x, y, w, coef, *, loss: str, interpret: bool = None):
         x: [n, d] features, n a multiple of 8 (pad rows carry w = 0).
         y: [n] labels, w: [n] example weights, coef: [d] model.
     Returns:
-        (grad [d], loss_sum scalar, weight_sum scalar) — identical math to
-        the unfused ``x.T @ mult`` path, with ``x`` read from HBM once.
+        (grad [d], loss_sum scalar, weight_sum scalar) — for f32/f64
+        inputs, identical math to the unfused ``x.T @ mult`` path, with
+        ``x`` read from HBM once. Sub-f32 inputs (bf16) compute margins
+        and accumulate in f32 and round the results back, so they differ
+        from the all-bf16 unfused path by quantization (the fused result
+        is the more accurate one).
     """
     if interpret is None:
         interpret = _interpret()
@@ -172,7 +183,10 @@ def fused_linear_grad(x, y, w, coef, *, loss: str, interpret: bool = None):
     tile = _pick_tile(n)
     grid = n // tile
     dt = x.dtype
-    kernel = functools.partial(_linear_grad_kernel, loss)
+    # Sub-f32 inputs accumulate (and run VPU math) in f32; wider dtypes
+    # (f32, and f64 in interpreter tests) accumulate natively.
+    acc_dt = jnp.float32 if jnp.dtype(dt).itemsize < 4 else dt
+    kernel = functools.partial(_linear_grad_kernel, loss, acc_dt)
     grad, stats = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -187,16 +201,18 @@ def fused_linear_grad(x, y, w, coef, *, loss: str, interpret: bool = None):
             pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, d), dt),
-            jax.ShapeDtypeStruct((1, 2), dt),
+            jax.ShapeDtypeStruct((1, d), acc_dt),
+            jax.ShapeDtypeStruct((1, 2), acc_dt),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=4 * n * d, bytes_accessed=(n * d + 3 * n + 2 * d) * 4,
+            flops=4 * n * d,
+            bytes_accessed=(n * d + 3 * n) * jnp.dtype(dt).itemsize
+            + 2 * d * jnp.dtype(acc_dt).itemsize,
             transcendentals=2 * n if loss == "logistic" else 0,
         ),
         interpret=interpret,
     )(x, y[:, None], w[:, None], coef[None, :])
-    return grad[0], stats[0, 0], stats[0, 1]
+    return grad[0].astype(dt), stats[0, 0].astype(dt), stats[0, 1].astype(dt)
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,40 @@ def test_fused_linear_grad_matches_unfused(loss, n, d):
     np.testing.assert_allclose(wsum, w_ref, rtol=1e-6)
 
 
+@pytest.mark.parametrize("loss", ["logistic", "hinge", "squared"])
+def test_fused_linear_grad_bf16_inputs(loss):
+    """bf16 storage, f32 compute/accumulation (acc_dt): outputs come back
+    bf16 and match an f32 reference within bf16 quantization — the path
+    Mosaic cannot lower with all-bf16 math (transcendentals)."""
+    rng = np.random.default_rng(3)
+    n, d = 64, 32
+    x32 = rng.normal(size=(n, d)).astype(np.float32)
+    y32 = rng.integers(0, 2, size=n).astype(np.float32)
+    w32 = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    c32 = rng.normal(size=d).astype(np.float32)
+    xb, yb, wb, cb = (jnp.asarray(a, jnp.bfloat16) for a in (x32, y32, w32, c32))
+    grad, loss_sum, wsum = fused_linear_grad(
+        xb, yb, wb, cb, loss=loss, interpret=True
+    )
+    assert grad.dtype == jnp.bfloat16
+    assert loss_sum.dtype == jnp.bfloat16 and wsum.dtype == jnp.bfloat16
+    # f32 reference over the bf16-rounded inputs; bf16 has ~3 decimal
+    # digits, so compare at ~1% of the result scale.
+    g_ref, l_ref, w_ref = _ref_linear_grad(
+        jnp.asarray(xb, jnp.float32), jnp.asarray(yb, jnp.float32),
+        jnp.asarray(wb, jnp.float32), jnp.asarray(cb, jnp.float32), loss,
+    )
+    scale = float(jnp.max(jnp.abs(g_ref))) + 1e-6
+    np.testing.assert_allclose(
+        np.asarray(grad, np.float32), np.asarray(g_ref),
+        atol=0.02 * scale, rtol=0.02,
+    )
+    np.testing.assert_allclose(
+        float(loss_sum), float(l_ref), rtol=0.02
+    )
+    np.testing.assert_allclose(float(wsum), float(w_ref), rtol=0.01)
+
+
 def test_fused_linear_grad_zero_weight_rows_are_noops():
     rng = np.random.default_rng(3)
     x = jnp.asarray(rng.normal(size=(16, 5)), dtype=jnp.float32)
