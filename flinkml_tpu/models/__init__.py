@@ -11,6 +11,7 @@ from flinkml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
 )
+from flinkml_tpu.models.online_kmeans import OnlineKMeans, OnlineKMeansModel
 from flinkml_tpu.models.online_logistic_regression import (
     OnlineLogisticRegression,
     OnlineLogisticRegressionModel,
@@ -39,6 +40,8 @@ __all__ = [
     "LinearSVCModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "OnlineKMeans",
+    "OnlineKMeansModel",
     "OnlineLogisticRegression",
     "OnlineLogisticRegressionModel",
     "StandardScaler",

@@ -103,10 +103,10 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
                     )
                 idx = rng.choice(x.shape[0], size=k, replace=False)
                 carry["centroids"] = jnp.asarray(x[idx])
-                carry["weights"] = jnp.zeros(k, dtype=jnp.float64)
+                carry["weights"] = jnp.zeros(k, dtype=jnp.result_type(float))
             elif carry["weights"] is None:
                 carry["centroids"] = jnp.asarray(carry["centroids"])
-                carry["weights"] = jnp.zeros(k, dtype=jnp.float64)
+                carry["weights"] = jnp.zeros(k, dtype=jnp.result_type(float))
 
             sums, counts = _batch_stats(jnp.asarray(x), carry["centroids"])
             old_w = carry["weights"] * decay
