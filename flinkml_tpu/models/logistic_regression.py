@@ -57,7 +57,6 @@ from flinkml_tpu.models import _linear_sgd
 from flinkml_tpu.models._coefficient import CoefficientModelMixin
 from flinkml_tpu.models._data import features_matrix, labeled_data
 from flinkml_tpu.ops import pallas_kernels
-from flinkml_tpu.ops.sparse import BatchedCSR
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
 
@@ -231,12 +230,17 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
         self._require_model()
         raw_col = table.column(self.get(_LogisticRegressionParams.FEATURES_COL))
         if raw_col.dtype == object and isinstance(raw_col[0], SparseVector):
-            # Sparse inference: gather dot products, never densifying rows.
-            csr = BatchedCSR.from_sparse_vectors(raw_col)
-            dot = csr.matvec(jnp.asarray(self._coefficient, csr.values.dtype))
-            p = jax.nn.sigmoid(dot)
-            pred = np.asarray((dot >= 0).astype(csr.values.dtype))
-            raw = np.stack([1.0 - np.asarray(p), np.asarray(p)], axis=-1)
+            # Sparse inference: nnz-bucketed gather dots — O(nnz) memory
+            # even under skewed nnz (same layout the trainer uses), never
+            # densifying rows.
+            from flinkml_tpu.ops.sparse import sparse_margins
+
+            # Margins arrive on host; the elementwise tail stays on host
+            # (no device round-trip for a sigmoid on [n] values).
+            dot = sparse_margins(raw_col, self._coefficient)
+            p = 1.0 / (1.0 + np.exp(-dot.astype(np.float64)))
+            pred = (dot >= 0).astype(dot.dtype)
+            raw = np.stack([1.0 - p, p], axis=-1)
             out = table.with_column(
                 self.get(_LogisticRegressionParams.PREDICTION_COL), pred
             ).with_column(

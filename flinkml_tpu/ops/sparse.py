@@ -131,6 +131,32 @@ class BatchedCSR:
         )
 
 
+def sparse_margins(vectors: Sequence[SparseVector], coef,
+                   max_buckets: int = 4) -> np.ndarray:
+    """Row-wise dots ``X @ coef`` for SparseVector rows, skew-proof.
+
+    Inference-side counterpart of the bucketed trainer: packs rows into
+    nnz buckets (padded cells ≈ total nnz, vs n·max_nnz for a uniform
+    :class:`BatchedCSR`), computes each bucket's gather-dot on device,
+    and reassembles results in the caller's row order. O(nnz) memory at
+    any skew and any dim.
+    """
+    indptr, indices, values, dim = csr_from_sparse_vectors(
+        vectors, dtype=np.float32
+    )
+    buckets, row_ids = pack_ell_buckets(
+        indptr, indices, values, dim, max_buckets=max_buckets,
+        dtype=np.float32,
+    )
+    coef = jnp.asarray(coef, jnp.float32)
+    out = np.empty(indptr.size - 1, dtype=np.float32)
+    for bucket, rows in zip(buckets, row_ids):
+        vb = jnp.asarray(bucket["values"])
+        ib = jnp.asarray(bucket["indices"])
+        out[rows] = np.asarray(jnp.sum(vb * coef[ib], axis=1))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # nnz-bucketed ELL packing (skew-proof Criteo-scale layout)
 # ---------------------------------------------------------------------------

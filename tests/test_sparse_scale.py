@@ -213,6 +213,27 @@ def test_sparse_csr_checkpoint_resume_exact(rng, mesh, tmp_path):
     np.testing.assert_allclose(resumed, golden, atol=0)
 
 
+def test_sparse_margins_bucketed_inference(rng):
+    """Inference-side bucketed dots: exact vs dense, O(nnz) under skew."""
+    from flinkml_tpu.linalg import Vectors
+    from flinkml_tpu.ops.sparse import sparse_margins
+
+    dim = 5000
+    vecs, dense = [], []
+    for i in range(300):
+        k = 200 if i % 25 == 0 else 3
+        idx = np.sort(rng.choice(dim, size=k, replace=False))
+        val = rng.normal(size=k)
+        vecs.append(Vectors.sparse(dim, idx, val))
+        row = np.zeros(dim)
+        row[idx] = val
+        dense.append(row)
+    coef = rng.normal(size=dim)
+    got = sparse_margins(vecs, coef)
+    want = np.stack(dense) @ coef
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
 def test_estimator_sparse_vectors_use_bucketed_path(rng):
     """End-to-end through the public API with SparseVector rows of very
     different nnz — exercises csr_from_sparse_vectors + bucketing."""
