@@ -101,9 +101,21 @@ def bench_tpu(x, y, w, global_batch_size, n_steps):
     np.asarray(trainer(*carry0, *args, jnp.asarray(10, jnp.int32))[0])
     _log("measuring ...")
     start = time.perf_counter()
-    np.asarray(trainer(*carry0, *args, jnp.asarray(n_steps, jnp.int32))[0])
+    coef_out, steps_out, _ = trainer(
+        *carry0, *args, jnp.asarray(n_steps, jnp.int32)
+    )
+    np.asarray(coef_out)
     elapsed = time.perf_counter() - start
-    return local_bs * p * n_steps / elapsed
+    # The while_loop can exit early (tol hit, or a NaN loss — NaN > tol is
+    # False); throughput must count the steps that actually ran, and a
+    # short-circuited run must never masquerade as a fast one.
+    steps_ran = int(steps_out)
+    if steps_ran != n_steps:
+        raise RuntimeError(
+            f"trainer stopped after {steps_ran}/{n_steps} steps "
+            "(diverged or converged); measurement invalid"
+        )
+    return local_bs * p * steps_ran / elapsed
 
 
 def bench_tpu_sparse(indptr, indices, values, dim, y, w,
@@ -137,10 +149,18 @@ def bench_tpu_sparse(indptr, indices, values, dim, y, w,
                        jnp.asarray(10, jnp.int32))[0])
     _log("sparse: measuring ...")
     start = time.perf_counter()
-    np.asarray(trainer(*carry0, *data_args, *hy,
-                       jnp.asarray(n_steps, jnp.int32))[0])
+    coef_out, steps_out, _ = trainer(
+        *carry0, *data_args, *hy, jnp.asarray(n_steps, jnp.int32)
+    )
+    np.asarray(coef_out)
     elapsed = time.perf_counter() - start
-    return sum(local_bss) * p * n_steps / elapsed
+    steps_ran = int(steps_out)
+    if steps_ran != n_steps:
+        raise RuntimeError(
+            f"sparse trainer stopped after {steps_ran}/{n_steps} steps; "
+            "measurement invalid"
+        )
+    return sum(local_bss) * p * steps_ran / elapsed
 
 
 def bench_reference_style_cpu(x, y, w, global_batch_size, budget_s=10.0):
@@ -182,13 +202,30 @@ def _inner_probe() -> float:
     return bench_tpu(x, y, w, global_batch_size=8_192, n_steps=20)
 
 
-def _inner_dense() -> float:
-    """Stage 2: the real measurement — a9a-like width (BASELINE.json
-    config #1), dataset resident in HBM, whole loop in one dispatch."""
+def _dense_stage(dtype=None) -> float:
+    """The dense measurement — a9a-like width (BASELINE.json config #1),
+    dataset resident in HBM, whole loop in one dispatch. One definition
+    for every dtype so f32 and bf16 always measure the same workload."""
     _setup_jax_cache()
     n, dim = 1_000_000, 123
     x, y, w = make_data(n, dim)
+    if dtype is not None:
+        x, y, w = x.astype(dtype), y.astype(dtype), w.astype(dtype)
     return bench_tpu(x, y, w, global_batch_size=262_144, n_steps=400)
+
+
+def _inner_dense() -> float:
+    return _dense_stage()
+
+
+def _inner_dense_bf16() -> float:
+    """Same workload, bf16-resident: the loop is HBM-bandwidth-bound
+    (BASELINE.md roofline), so halving bytes/sample roughly doubles the
+    throughput ceiling (~1.66G samples/s at 819 GB/s, 2·123·2 B/sample).
+    Reductions still accumulate in f32 (_linear_sgd._acc_dt)."""
+    import jax.numpy as jnp
+
+    return _dense_stage(jnp.bfloat16)
 
 
 def _inner_sparse() -> float:
@@ -215,7 +252,10 @@ def _inner_sparse() -> float:
 
 
 _INNER_STAGES = {
-    "probe": _inner_probe, "dense": _inner_dense, "sparse": _inner_sparse,
+    "probe": _inner_probe,
+    "dense": _inner_dense,
+    "dense_bf16": _inner_dense_bf16,
+    "sparse": _inner_sparse,
 }
 
 
@@ -274,9 +314,11 @@ def main():
 
     device_sps = None
     sparse_sps = None
+    bf16_sps = None
     if _run_stage("probe", probe_timeout, deadline) is not None:
         device_sps = _run_stage("dense", total_budget, deadline)
         sparse_sps = _run_stage("sparse", total_budget, deadline)
+        bf16_sps = _run_stage("dense_bf16", total_budget, deadline)
     else:
         _log("probe failed; skipping device measurement")
 
@@ -300,12 +342,16 @@ def main():
         "unit": "samples/sec",
         "vs_baseline": round(device_sps / cpu_sps, 2),
     }
+    extras = {}
     if sparse_sps is not None:
-        # Secondary measurement (Criteo-profile sparse LR, dim=1e6,
-        # nnz=39); kept inside the single JSON line as an extra field.
-        record["extras"] = {
-            "sparse_logreg_samples_per_sec_per_chip": round(sparse_sps, 1)
-        }
+        # Criteo-profile sparse LR (dim=1e6, nnz=39/row).
+        extras["sparse_logreg_samples_per_sec_per_chip"] = round(sparse_sps, 1)
+    if bf16_sps is not None:
+        # Same dense workload, bf16-resident (bandwidth-bound: ~2x ceiling).
+        extras["dense_bf16_logreg_samples_per_sec_per_chip"] = round(bf16_sps, 1)
+    if extras:
+        # Secondary measurements kept inside the single JSON line.
+        record["extras"] = extras
     print(json.dumps(record))
 
 

@@ -49,6 +49,13 @@ def _soft_threshold(x, t):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
 
 
+def _acc_dt(dt):
+    """Reduction dtype: sub-f32 data accumulates in f32. A stepwise bf16
+    sum saturates absurdly early (32768 unit weights sum to 256), which
+    would corrupt ``step_size = lr / wsum`` and the loss criterion."""
+    return jnp.float32 if jnp.dtype(dt).itemsize < 4 else jnp.dtype(dt)
+
+
 def align_local_bs(global_batch_size: int, p_size: int, n_local: int) -> int:
     """Per-device batch: ceil(global/p), rounded up to the 8-row tile when
     the Pallas path is in play (so the fused kernel stays reachable at any
@@ -82,24 +89,29 @@ def make_dense_step(loss: str, local_bs: int, axis: str, use_pallas: bool = Fals
         xb = _window(xl, epoch, local_bs)
         yb = _window(yl, epoch, local_bs)
         wb = _window(wl, epoch, local_bs)
+        acc = _acc_dt(xb.dtype)
         if use_pallas:
             grad_l, loss_l, wsum_l = pallas_kernels.fused_linear_grad(
                 xb, yb, wb, coef, loss=loss
             )
+            loss_l, wsum_l = loss_l.astype(acc), wsum_l.astype(acc)
         else:
             dot = xb @ coef
             mult, per_ex = _margin_grad(loss, dot, yb, wb)
             grad_l = xb.T @ mult
-            loss_l = jnp.sum(per_ex)
-            wsum_l = jnp.sum(wb)
+            loss_l = jnp.sum(per_ex.astype(acc))
+            wsum_l = jnp.sum(wb.astype(acc))
         grad = jax.lax.psum(grad_l, axis)
         loss_sum = jax.lax.psum(loss_l, axis)
         wsum = jax.lax.psum(wsum_l, axis)
         grad = grad + 2.0 * reg_l2 * coef
-        loss_sum = loss_sum + reg_l2 * jnp.sum(coef * coef)
-        step_size = learning_rate / wsum
-        new_coef = _soft_threshold(coef - step_size * grad, step_size * reg_l1)
-        return new_coef, loss_sum / wsum
+        loss_sum = loss_sum + reg_l2 * jnp.sum(jnp.square(coef.astype(acc)))
+        step_size = learning_rate.astype(acc) / wsum
+        new_coef = _soft_threshold(
+            coef - step_size.astype(coef.dtype) * grad,
+            step_size.astype(coef.dtype) * reg_l1,
+        )
+        return new_coef, (loss_sum / wsum).astype(coef.dtype)
 
     return step
 
@@ -112,6 +124,7 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
         vb = _window(vall, epoch, local_bs)
         yb = _window(yl, epoch, local_bs)
         wb = _window(wl, epoch, local_bs)
+        acc = _acc_dt(vb.dtype)
         dot = jnp.sum(vb * coef[ib], axis=1)
         mult, per_ex = _margin_grad(loss, dot, yb, wb)
         contrib = (vb * mult[:, None]).reshape(-1)
@@ -119,13 +132,16 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
             contrib, ib.reshape(-1), num_segments=dim
         )
         grad = jax.lax.psum(grad_local, axis)
-        loss_sum = jax.lax.psum(jnp.sum(per_ex), axis)
-        wsum = jax.lax.psum(jnp.sum(wb), axis)
+        loss_sum = jax.lax.psum(jnp.sum(per_ex.astype(acc)), axis)
+        wsum = jax.lax.psum(jnp.sum(wb.astype(acc)), axis)
         grad = grad + 2.0 * reg_l2 * coef
-        loss_sum = loss_sum + reg_l2 * jnp.sum(coef * coef)
-        step_size = learning_rate / wsum
-        new_coef = _soft_threshold(coef - step_size * grad, step_size * reg_l1)
-        return new_coef, loss_sum / wsum
+        loss_sum = loss_sum + reg_l2 * jnp.sum(jnp.square(coef.astype(acc)))
+        step_size = learning_rate.astype(acc) / wsum
+        new_coef = _soft_threshold(
+            coef - step_size.astype(coef.dtype) * grad,
+            step_size.astype(coef.dtype) * reg_l1,
+        )
+        return new_coef, (loss_sum / wsum).astype(coef.dtype)
 
     return step
 
@@ -143,9 +159,10 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
     """
 
     def step(coef, epoch, blocks, learning_rate, reg_l2, reg_l1):
+        acc = _acc_dt(coef.dtype)
         contribs, flat_idx = [], []
-        loss_l = jnp.zeros((), coef.dtype)
-        wsum_l = jnp.zeros((), coef.dtype)
+        loss_l = jnp.zeros((), acc)
+        wsum_l = jnp.zeros((), acc)
         for b, local_bs in enumerate(local_bss):
             idxl, vall, yl, wl = blocks[4 * b : 4 * b + 4]
             ib = _window(idxl, epoch, local_bs)
@@ -156,8 +173,8 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
             mult, per_ex = _margin_grad(loss, dot, yb, wb)
             contribs.append((vb * mult[:, None]).reshape(-1))
             flat_idx.append(ib.reshape(-1))
-            loss_l = loss_l + jnp.sum(per_ex)
-            wsum_l = wsum_l + jnp.sum(wb)
+            loss_l = loss_l + jnp.sum(per_ex.astype(acc))
+            wsum_l = wsum_l + jnp.sum(wb.astype(acc))
         grad_local = jax.ops.segment_sum(
             jnp.concatenate(contribs), jnp.concatenate(flat_idx),
             num_segments=dim,
@@ -166,10 +183,13 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
         loss_sum = jax.lax.psum(loss_l, axis)
         wsum = jax.lax.psum(wsum_l, axis)
         grad = grad + 2.0 * reg_l2 * coef
-        loss_sum = loss_sum + reg_l2 * jnp.sum(coef * coef)
-        step_size = learning_rate / wsum
-        new_coef = _soft_threshold(coef - step_size * grad, step_size * reg_l1)
-        return new_coef, loss_sum / wsum
+        loss_sum = loss_sum + reg_l2 * jnp.sum(jnp.square(coef.astype(acc)))
+        step_size = learning_rate.astype(acc) / wsum
+        new_coef = _soft_threshold(
+            coef - step_size.astype(coef.dtype) * grad,
+            step_size.astype(coef.dtype) * reg_l1,
+        )
+        return new_coef, (loss_sum / wsum).astype(coef.dtype)
 
     return step
 
@@ -614,13 +634,19 @@ def _stream_stepper(mesh, loss: str, axis: str):
     weighted epoch-mean loss across variable-size batches."""
 
     def per_device(coef, xb, yb, wb, learning_rate, reg_l2, reg_l1):
+        acc = _acc_dt(xb.dtype)
         dot = xb @ coef
         mult, per_ex = _margin_grad(loss, dot, yb, wb)
         grad = jax.lax.psum(xb.T @ mult, axis) + 2.0 * reg_l2 * coef
-        loss_sum = jax.lax.psum(jnp.sum(per_ex), axis) + reg_l2 * jnp.sum(coef * coef)
-        wsum = jax.lax.psum(jnp.sum(wb), axis)
-        step_size = learning_rate / wsum
-        new_coef = _soft_threshold(coef - step_size * grad, step_size * reg_l1)
+        loss_sum = jax.lax.psum(jnp.sum(per_ex.astype(acc)), axis) + (
+            reg_l2 * jnp.sum(jnp.square(coef.astype(acc)))
+        )
+        wsum = jax.lax.psum(jnp.sum(wb.astype(acc)), axis)
+        step_size = learning_rate.astype(acc) / wsum
+        new_coef = _soft_threshold(
+            coef - step_size.astype(coef.dtype) * grad,
+            step_size.astype(coef.dtype) * reg_l1,
+        )
         return new_coef, loss_sum, wsum
 
     return jax.jit(

@@ -128,6 +128,39 @@ def test_full_batch_gd_matches_sklearn_l2_optimum(rng):
     np.testing.assert_allclose(model.coefficient, sk.coef_[0], atol=1e-4)
 
 
+def test_bf16_training_accumulates_in_f32(rng):
+    """bf16-resident training must reduce loss/weight sums in f32: a
+    stepwise bf16 sum of 4096 unit weights saturates at 256, which would
+    scale step_size 16x too large and diverge. Regression for the
+    _acc_dt fix."""
+    import jax.numpy as jnp
+
+    from flinkml_tpu.models._linear_sgd import train_linear_model
+    from flinkml_tpu.parallel import DeviceMesh
+
+    n, d = 4096, 8
+    x, y = _noisy_logistic_data(rng, n, d)
+    hyper = dict(
+        loss="logistic", mesh=DeviceMesh(), max_iter=150,
+        learning_rate=1.0, global_batch_size=n,
+        reg=0.0, elastic_net=0.0, tol=0.0, seed=0,
+    )
+    coef16 = train_linear_model(
+        x, y, np.ones(n), dtype=jnp.bfloat16, **hyper
+    ).astype(np.float64)
+    coef32 = train_linear_model(
+        x, y, np.ones(n), dtype=np.float32, **hyper
+    ).astype(np.float64)
+    assert np.isfinite(coef16).all()
+    acc16 = np.mean((x @ coef16 > 0) == (y > 0.5))
+    acc32 = np.mean((x @ coef32 > 0) == (y > 0.5))
+    # A saturated wsum scales step_size 16x and diverges; with the f32
+    # accumulators the bf16 run tracks the f32 one.
+    assert acc16 > acc32 - 0.05, (acc16, acc32)
+    cos = coef16 @ coef32 / (np.linalg.norm(coef16) * np.linalg.norm(coef32))
+    assert cos > 0.98, cos
+
+
 def test_full_batch_is_deterministic_across_seeds():
     """With the batch window covering the dataset the sampling seed is
     irrelevant — the trajectory is plain GD."""
