@@ -12,7 +12,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from flinkml_tpu.linalg import Vector, stack_vectors
+from flinkml_tpu.linalg import SparseVector, Vector, stack_vectors
 from flinkml_tpu.table import Table
 
 
@@ -48,3 +48,56 @@ def labeled_data(
     else:
         w = np.ones(x.shape[0], dtype=np.float64)
     return x, y, w
+
+
+def sparse_features(table: Table, features_col: str):
+    """The features column if EVERY row is a SparseVector, else None —
+    the dispatch every linear model uses to pick the O(nnz) sparse path
+    over densification. A mixed Sparse/Dense vector column returns None
+    and takes the densifying path (which handles any Vector)."""
+    col = table.column(features_col)
+    if (
+        col.dtype == object
+        and col.size
+        and isinstance(col[0], SparseVector)
+        and all(isinstance(v, SparseVector) for v in col)
+    ):
+        return col
+    return None
+
+
+def check_binary_labels(y: np.ndarray, model_name: str) -> None:
+    """Validate labels ∈ {0, 1} (shared by the binomial classifiers)."""
+    labels = np.unique(y)
+    if not np.all(np.isin(labels, (0.0, 1.0))):
+        raise ValueError(
+            f"{model_name} requires labels in {{0, 1}}, got {labels}"
+        )
+
+
+def labeled_sparse_data(
+    table: Table,
+    features_col: str,
+    label_col: str,
+    weight_col: Optional[str] = None,
+    dtype=np.float32,
+):
+    """Sparse analog of :func:`labeled_data`: host CSR arrays + labels.
+
+    Returns ``(indptr, indices, values, dim, y, w)``.
+    """
+    from flinkml_tpu.ops.sparse import csr_from_sparse_vectors
+
+    col = table.column(features_col)
+    indptr, indices, values, dim = csr_from_sparse_vectors(col, dtype=dtype)
+    y = np.asarray(table.column(label_col), dtype=dtype).reshape(-1)
+    if y.shape[0] != indptr.size - 1:
+        raise ValueError(
+            f"label column {label_col!r} has {y.shape[0]} rows, features "
+            f"have {indptr.size - 1}"
+        )
+    if weight_col is not None:
+        w = np.asarray(table.column(weight_col), dtype=dtype).reshape(-1)
+    else:
+        w = np.ones(y.shape[0], dtype=dtype)
+    return indptr, indices, values, dim, y, w

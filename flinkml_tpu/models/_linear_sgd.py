@@ -622,6 +622,42 @@ def train_linear_model_sparse_csr(
     )
 
 
+def train_linear_model_from_table(
+    table,
+    features_col: str,
+    label_col: str,
+    weight_col: Optional[str],
+    label_check=None,
+    **hyper,
+) -> np.ndarray:
+    """One fit dispatch for every linear estimator: SparseVector columns
+    take the nnz-bucketed CSR trainer, everything else densifies into the
+    dense trainer. ``label_check(y)`` (optional) validates labels on
+    either branch. ``hyper`` passes straight to the trainers (loss, mesh,
+    max_iter, ...)."""
+    from flinkml_tpu.models._data import (
+        labeled_data,
+        labeled_sparse_data,
+        sparse_features,
+    )
+
+    if sparse_features(table, features_col) is not None:
+        indptr, indices, values, dim, y, w = labeled_sparse_data(
+            table, features_col, label_col, weight_col
+        )
+        if label_check is not None:
+            label_check(y)
+        return train_linear_model_sparse_csr(
+            indptr, indices, values, dim, y, w, **hyper
+        )
+    x, y, w = labeled_data(table, features_col, label_col, weight_col)
+    if x.shape[0] == 0:
+        raise ValueError("training table is empty")
+    if label_check is not None:
+        label_check(y)
+    return train_linear_model(x, y, w, **hyper)
+
+
 # ---------------------------------------------------------------------------
 # Streamed / out-of-core training (the load-bearing ReplayOperator path)
 # ---------------------------------------------------------------------------

@@ -28,7 +28,7 @@ from flinkml_tpu.common_params import (
 )
 from flinkml_tpu.models import _linear_sgd
 from flinkml_tpu.models._coefficient import CoefficientModelMixin
-from flinkml_tpu.models._data import features_matrix, labeled_data
+from flinkml_tpu.models._data import features_matrix, sparse_features
 from flinkml_tpu.parallel import DeviceMesh
 from flinkml_tpu.table import Table
 
@@ -56,14 +56,9 @@ class LinearRegression(_LinearRegressionParams, Estimator):
 
     def fit(self, *inputs: Table) -> "LinearRegressionModel":
         (table,) = inputs
-        x, y, w = labeled_data(
-            table,
-            self.get(_LinearRegressionParams.FEATURES_COL),
-            self.get(_LinearRegressionParams.LABEL_COL),
-            self.get(_LinearRegressionParams.WEIGHT_COL),
-        )
-        coef = _linear_sgd.train_linear_model(
-            x, y, w, loss="squared",
+        features_col = self.get(_LinearRegressionParams.FEATURES_COL)
+        hyper = dict(
+            loss="squared",
             mesh=self.mesh or DeviceMesh(),
             max_iter=self.get(_LinearRegressionParams.MAX_ITER),
             learning_rate=self.get(_LinearRegressionParams.LEARNING_RATE),
@@ -72,6 +67,12 @@ class LinearRegression(_LinearRegressionParams, Estimator):
             elastic_net=self.get(_LinearRegressionParams.ELASTIC_NET),
             tol=self.get(_LinearRegressionParams.TOL),
             seed=self.get_seed(),
+        )
+        coef = _linear_sgd.train_linear_model_from_table(
+            table, features_col,
+            self.get(_LinearRegressionParams.LABEL_COL),
+            self.get(_LinearRegressionParams.WEIGHT_COL),
+            **hyper,
         )
         model = LinearRegressionModel()
         model.copy_params_from(self)
@@ -87,8 +88,17 @@ class LinearRegressionModel(CoefficientModelMixin, _LinearRegressionParams, Mode
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require_model()
-        x = features_matrix(table, self.get(_LinearRegressionParams.FEATURES_COL))
-        pred = np.asarray(jnp.asarray(x) @ jnp.asarray(self._coefficient))
+        features_col = self.get(_LinearRegressionParams.FEATURES_COL)
+        sparse_col = sparse_features(table, features_col)
+        if sparse_col is not None:
+            from flinkml_tpu.ops.sparse import sparse_margins
+
+            pred = sparse_margins(sparse_col, self._coefficient).astype(
+                np.float64
+            )
+        else:
+            x = features_matrix(table, features_col)
+            pred = np.asarray(jnp.asarray(x) @ jnp.asarray(self._coefficient))
         return (
             table.with_column(self.get(_LinearRegressionParams.PREDICTION_COL), pred),
         )

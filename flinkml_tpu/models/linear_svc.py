@@ -37,7 +37,11 @@ from flinkml_tpu.common_params import (
 )
 from flinkml_tpu.models import _linear_sgd
 from flinkml_tpu.models._coefficient import CoefficientModelMixin
-from flinkml_tpu.models._data import features_matrix, labeled_data
+from flinkml_tpu.models._data import (
+    check_binary_labels,
+    features_matrix,
+    sparse_features,
+)
 from flinkml_tpu.params import FloatParam
 from flinkml_tpu.parallel import DeviceMesh
 from flinkml_tpu.table import Table
@@ -69,17 +73,9 @@ class LinearSVC(_LinearSVCParams, Estimator):
 
     def fit(self, *inputs: Table) -> "LinearSVCModel":
         (table,) = inputs
-        x, y, w = labeled_data(
-            table,
-            self.get(_LinearSVCParams.FEATURES_COL),
-            self.get(_LinearSVCParams.LABEL_COL),
-            self.get(_LinearSVCParams.WEIGHT_COL),
-        )
-        labels = np.unique(y)
-        if not np.all(np.isin(labels, (0.0, 1.0))):
-            raise ValueError(f"LinearSVC requires labels in {{0, 1}}, got {labels}")
-        coef = _linear_sgd.train_linear_model(
-            x, y, w, loss="hinge",
+        features_col = self.get(_LinearSVCParams.FEATURES_COL)
+        hyper = dict(
+            loss="hinge",
             mesh=self.mesh or DeviceMesh(),
             max_iter=self.get(_LinearSVCParams.MAX_ITER),
             learning_rate=self.get(_LinearSVCParams.LEARNING_RATE),
@@ -88,6 +84,13 @@ class LinearSVC(_LinearSVCParams, Estimator):
             elastic_net=self.get(_LinearSVCParams.ELASTIC_NET),
             tol=self.get(_LinearSVCParams.TOL),
             seed=self.get_seed(),
+        )
+        coef = _linear_sgd.train_linear_model_from_table(
+            table, features_col,
+            self.get(_LinearSVCParams.LABEL_COL),
+            self.get(_LinearSVCParams.WEIGHT_COL),
+            label_check=lambda y: check_binary_labels(y, "LinearSVC"),
+            **hyper,
         )
         model = LinearSVCModel()
         model.copy_params_from(self)
@@ -103,8 +106,17 @@ class LinearSVCModel(CoefficientModelMixin, _LinearSVCParams, Model):
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require_model()
-        x = features_matrix(table, self.get(_LinearSVCParams.FEATURES_COL))
-        dot = np.asarray(jnp.asarray(x) @ jnp.asarray(self._coefficient))
+        features_col = self.get(_LinearSVCParams.FEATURES_COL)
+        sparse_col = sparse_features(table, features_col)
+        if sparse_col is not None:
+            from flinkml_tpu.ops.sparse import sparse_margins
+
+            dot = sparse_margins(sparse_col, self._coefficient).astype(
+                np.float64
+            )
+        else:
+            x = features_matrix(table, features_col)
+            dot = np.asarray(jnp.asarray(x) @ jnp.asarray(self._coefficient))
         threshold = self.get(_LinearSVCParams.THRESHOLD)
         pred = (dot >= threshold).astype(np.float64)
         out = table.with_column(

@@ -52,10 +52,15 @@ from flinkml_tpu.common_params import (
     HasWeightCol,
 )
 from flinkml_tpu.iteration import IterationConfig, TerminateOnMaxIterOrTol, iterate
-from flinkml_tpu.linalg import SparseVector
 from flinkml_tpu.models import _linear_sgd
 from flinkml_tpu.models._coefficient import CoefficientModelMixin
-from flinkml_tpu.models._data import features_matrix, labeled_data
+from flinkml_tpu.models._data import (
+    check_binary_labels,
+    features_matrix,
+    labeled_data,
+    labeled_sparse_data,
+    sparse_features,
+)
 from flinkml_tpu.ops import pallas_kernels
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
@@ -126,29 +131,17 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
             seed=self.get_seed(),
         )
 
-        raw_col = table.column(features_col)
-        sparse_input = raw_col.dtype == object and isinstance(
-            raw_col[0], SparseVector
-        )
-        if sparse_input:
+        if sparse_features(table, features_col) is not None:
             # Criteo-scale path (BASELINE.json config #5): nnz-bucketed ELL
             # blocks (ops.sparse.pack_ell_buckets — padded cells ≈ total
             # nnz even under skew), gather forward + one fused segment-sum
             # gradient scatter; the dense [dim] model stays replicated.
             # Host-side packing: the trainer shards from host, so the full
             # dataset never stages through a single device's HBM.
-            from flinkml_tpu.ops.sparse import csr_from_sparse_vectors
-
-            indptr, indices, values, dim = csr_from_sparse_vectors(raw_col)
-            y = np.asarray(
-                table.column(self.get(_LogisticRegressionParams.LABEL_COL)),
-                dtype=np.float32,
-            )
-            weight_col = self.get(_LogisticRegressionParams.WEIGHT_COL)
-            w = (
-                np.asarray(table.column(weight_col), dtype=np.float32)
-                if weight_col is not None
-                else np.ones(len(y), dtype=np.float32)
+            indptr, indices, values, dim, y, w = labeled_sparse_data(
+                table, features_col,
+                self.get(_LogisticRegressionParams.LABEL_COL),
+                self.get(_LogisticRegressionParams.WEIGHT_COL),
             )
             _check_binomial_labels(y)
             coef = _linear_sgd.train_linear_model_sparse_csr(
@@ -228,8 +221,9 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require_model()
-        raw_col = table.column(self.get(_LogisticRegressionParams.FEATURES_COL))
-        if raw_col.dtype == object and isinstance(raw_col[0], SparseVector):
+        features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
+        sparse_col = sparse_features(table, features_col)
+        if sparse_col is not None:
             # Sparse inference: nnz-bucketed gather dots — O(nnz) memory
             # even under skewed nnz (same layout the trainer uses), never
             # densifying rows.
@@ -237,7 +231,7 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
 
             # Margins arrive on host; the elementwise tail stays on host
             # (no device round-trip for a sigmoid on [n] values).
-            dot = sparse_margins(raw_col, self._coefficient)
+            dot = sparse_margins(sparse_col, self._coefficient)
             p = 1.0 / (1.0 + np.exp(-dot.astype(np.float64)))
             pred = (dot >= 0).astype(dot.dtype)
             raw = np.stack([1.0 - p, p], axis=-1)
@@ -268,11 +262,7 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
 
 
 def _check_binomial_labels(y: np.ndarray) -> None:
-    labels = np.unique(y)
-    if not np.all(np.isin(labels, (0.0, 1.0))):
-        raise ValueError(
-            f"binomial logistic regression requires labels in {{0, 1}}, got {labels}"
-        )
+    check_binary_labels(y, "binomial logistic regression")
 
 
 @jax.jit

@@ -144,6 +144,15 @@ def sparse_margins(vectors: Sequence[SparseVector], coef,
     indptr, indices, values, dim = csr_from_sparse_vectors(
         vectors, dtype=np.float32
     )
+    # Same guarantee the dense path gets from `x @ coef` shape checking:
+    # a dim mismatch must raise, not silently gather-clamp out-of-range
+    # indices onto the last coefficient.
+    n_coef = np.shape(coef)[0]
+    if dim != n_coef:
+        raise ValueError(
+            f"features have dim {dim} but the model coefficient has "
+            f"dim {n_coef}"
+        )
     buckets, row_ids = pack_ell_buckets(
         indptr, indices, values, dim, max_buckets=max_buckets,
         dtype=np.float32,

@@ -127,6 +127,95 @@ def test_sparse_logistic_regression(rng):
     assert acc > 0.93, acc
 
 
+def _sparse_and_dense_tables(rng, n=200, d=6, label_fn=None):
+    x = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.4)
+    y = (
+        label_fn(x) if label_fn is not None
+        else (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    )
+    vecs = [
+        Vectors.sparse(d, np.nonzero(row)[0], row[np.nonzero(row)[0]])
+        for row in x
+    ]
+    return (
+        Table({"features": x, "label": y}),
+        Table({"features": np.array(vecs, dtype=object), "label": y}),
+        y,
+    )
+
+
+def test_sparse_linear_svc_matches_dense(rng):
+    """LinearSVC accepts SparseVector columns (bucketed path) and agrees
+    with its dense fit on the same data."""
+    dense_t, sparse_t, y = _sparse_and_dense_tables(rng)
+    kw = lambda: (LinearSVC().set_seed(3).set_max_iter(200)
+                  .set_global_batch_size(200).set_learning_rate(0.5))
+    dense_m = kw().fit(dense_t)
+    sparse_m = kw().fit(sparse_t)
+    cos = np.dot(dense_m.coefficient, sparse_m.coefficient) / (
+        np.linalg.norm(dense_m.coefficient)
+        * np.linalg.norm(sparse_m.coefficient)
+    )
+    assert cos > 0.999, cos
+    (a,) = sparse_m.transform(sparse_t)   # sparse inference path
+    (b,) = dense_m.transform(dense_t)
+    assert np.mean(a["prediction"] == b["prediction"]) > 0.98
+
+
+def test_sparse_linear_regression_matches_dense(rng):
+    dense_t, sparse_t, y = _sparse_and_dense_tables(
+        rng, label_fn=lambda x: x[:, 0] * 2.0 - x[:, 2]
+    )
+    kw = lambda: (LinearRegression().set_seed(3).set_max_iter(400)
+                  .set_global_batch_size(200).set_learning_rate(0.5)
+                  .set_tol(0.0))
+    dense_m = kw().fit(dense_t)
+    sparse_m = kw().fit(sparse_t)
+    np.testing.assert_allclose(
+        sparse_m.coefficient, dense_m.coefficient, atol=5e-3
+    )
+    (a,) = sparse_m.transform(sparse_t)
+    (b,) = dense_m.transform(dense_t)
+    np.testing.assert_allclose(a["prediction"], b["prediction"], atol=2e-2)
+
+
+def test_sparse_inference_dim_mismatch_raises(rng):
+    """A dim mismatch must raise like the dense matmul would — JAX's
+    gather would otherwise silently clamp out-of-range indices."""
+    _, sparse_t, y = _sparse_and_dense_tables(rng)
+    model = (
+        LinearSVC().set_seed(0).set_max_iter(20)
+        .set_global_batch_size(200).fit(sparse_t)
+    )
+    wrong = Table({
+        "features": np.array(
+            [Vectors.sparse(12, [0, 7], [1.0, 2.0])], dtype=object
+        ),
+    })
+    with pytest.raises(ValueError, match="dim"):
+        model.transform(wrong)
+
+
+def test_mixed_vector_column_densifies(rng):
+    """A column mixing Sparse and Dense vectors takes the densifying
+    path (any-Vector support), not the CSR path."""
+    from flinkml_tpu.linalg import DenseVector
+
+    x = rng.normal(size=(64, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    vecs = [
+        Vectors.sparse(4, np.arange(4), row) if i % 2 else DenseVector(row)
+        for i, row in enumerate(x)
+    ]
+    t = Table({"features": np.array(vecs, dtype=object), "label": y})
+    model = (
+        LinearSVC().set_seed(0).set_max_iter(100)
+        .set_global_batch_size(64).set_learning_rate(0.5).fit(t)
+    )
+    (out,) = model.transform(t)
+    assert np.mean(out["prediction"] == y) > 0.9
+
+
 def test_sparse_dense_agreement(rng):
     # Same data sparse vs dense must converge to similar coefficients.
     x = rng.normal(size=(200, 6)) * (rng.random((200, 6)) < 0.4)
