@@ -228,6 +228,31 @@ def _inner_dense_bf16() -> float:
     return _dense_stage(jnp.bfloat16)
 
 
+def _inner_kmeans() -> float:
+    """Stage 4: KMeans Lloyd throughput — the whole loop (assignment on
+    the MXU + one-hot aggregation + psum + update) in one dispatch."""
+    _setup_jax_cache()
+    import jax.numpy as jnp
+    from flinkml_tpu.models.kmeans import _kmeans_trainer, prepare_kmeans_data
+    from flinkml_tpu.parallel import DeviceMesh
+
+    n, dim, k, iters = 1_000_000, 64, 64, 100
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    mesh = DeviceMesh()
+    # Same pad/mask/shard + kernel gate as the product fit path.
+    xd, wd, _, use_pallas = prepare_kmeans_data(x, mesh)
+    cent0 = jnp.asarray(x[rng.choice(n, size=k, replace=False)])
+    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS, use_pallas)
+    _log("kmeans: compiling + warm-up dispatch ...")
+    np.asarray(trainer(xd, wd, cent0, jnp.asarray(3, jnp.int32)))
+    _log("kmeans: measuring ...")
+    start = time.perf_counter()
+    np.asarray(trainer(xd, wd, cent0, jnp.asarray(iters, jnp.int32)))
+    elapsed = time.perf_counter() - start
+    return n * iters / elapsed
+
+
 def _inner_sparse() -> float:
     """Stage 3: Criteo-profile sparse LR (BASELINE.json config #5):
     dim = 1e6, 39 nnz per row, nnz-bucketed ELL resident in HBM."""
@@ -256,6 +281,7 @@ _INNER_STAGES = {
     "dense": _inner_dense,
     "dense_bf16": _inner_dense_bf16,
     "sparse": _inner_sparse,
+    "kmeans": _inner_kmeans,
 }
 
 
@@ -315,10 +341,12 @@ def main():
     device_sps = None
     sparse_sps = None
     bf16_sps = None
+    kmeans_pps = None
     if _run_stage("probe", probe_timeout, deadline) is not None:
         device_sps = _run_stage("dense", total_budget, deadline)
         sparse_sps = _run_stage("sparse", total_budget, deadline)
         bf16_sps = _run_stage("dense_bf16", total_budget, deadline)
+        kmeans_pps = _run_stage("kmeans", total_budget, deadline)
     else:
         _log("probe failed; skipping device measurement")
 
@@ -349,6 +377,9 @@ def main():
     if bf16_sps is not None:
         # Same dense workload, bf16-resident (bandwidth-bound: ~2x ceiling).
         extras["dense_bf16_logreg_samples_per_sec_per_chip"] = round(bf16_sps, 1)
+    if kmeans_pps is not None:
+        # KMeans Lloyd (n=1M, d=64, k=64), whole loop on device.
+        extras["kmeans_points_per_sec_per_chip"] = round(kmeans_pps, 1)
     if extras:
         # Secondary measurements kept inside the single JSON line.
         record["extras"] = extras

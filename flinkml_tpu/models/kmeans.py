@@ -223,20 +223,28 @@ def train_kmeans(
         init_idx = rng.choice(x.shape[0], size=k, replace=False)
         init_centroids = np.ascontiguousarray(x[init_idx])
 
+    xd, wd, _, use_pallas = prepare_kmeans_data(x, mesh)
+    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS, use_pallas)
+    centroids = trainer(
+        xd, wd, jnp.asarray(init_centroids), jnp.asarray(max_iter, jnp.int32)
+    )
+    return np.asarray(centroids)
+
+
+def prepare_kmeans_data(x: np.ndarray, mesh: DeviceMesh):
+    """Pad/mask/shard points for the Lloyd trainer; returns
+    ``(xd, wd, n_valid, use_pallas)``. The single source of the padding
+    and kernel-gating policy — the bench measures exactly what
+    :func:`train_kmeans` runs."""
     p_size = mesh.axis_size()
     # Pad local shards to the Pallas row tile (8) so the fused Lloyd
     # kernel applies; zero-weight rows are exact no-ops either way.
     x_pad, n_valid = pad_to_multiple(x, p_size * 8)
     w = np.zeros(x_pad.shape[0], dtype=x.dtype)
     w[:n_valid] = 1.0  # mask: padded rows never influence centroids
-    xd = mesh.shard_batch(x_pad)
-    wd = mesh.shard_batch(w)
-
-    trainer = _kmeans_trainer(
-        mesh.mesh, k, DeviceMesh.DATA_AXIS,
+    return (
+        mesh.shard_batch(x_pad),
+        mesh.shard_batch(w),
+        n_valid,
         pallas_kernels.pallas_enabled(x_pad.shape[0] // p_size, "kmeans"),
     )
-    centroids = trainer(
-        xd, wd, jnp.asarray(init_centroids), jnp.asarray(max_iter, jnp.int32)
-    )
-    return np.asarray(centroids)
