@@ -16,7 +16,7 @@ exists to serve Flink's deployment model, not the ML semantics.
 from __future__ import annotations
 
 import abc
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 from flinkml_tpu.io import read_write
 from flinkml_tpu.params import WithParams

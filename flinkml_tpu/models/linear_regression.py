@@ -7,7 +7,7 @@ L1 ("lasso") and elastic-net via the proximal step.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np

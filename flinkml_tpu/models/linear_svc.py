@@ -14,7 +14,7 @@ the whole loop one XLA program on device.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

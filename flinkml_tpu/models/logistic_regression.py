@@ -27,7 +27,7 @@ rebuilt TPU-first:
 
 from __future__ import annotations
 
-import functools
+
 import math
 from typing import List, Optional, Tuple
 

@@ -38,7 +38,6 @@ from flinkml_tpu.iteration import (
     TerminateOnMaxIter,
 )
 from flinkml_tpu.models._data import features_matrix
-from flinkml_tpu.models.kmeans import _KMeansParams
 from flinkml_tpu.ops import blas
 from flinkml_tpu.ops.distance import DistanceMeasure
 from flinkml_tpu.params import IntParam, ParamValidators
