@@ -6,7 +6,11 @@ from flinkml_tpu.io.read_write import (
     load_model_arrays,
 )
 from flinkml_tpu.io.csv import read_csv, read_csv_table
-from flinkml_tpu.io.libsvm import read_libsvm
+from flinkml_tpu.io.libsvm import (
+    read_libsvm,
+    read_libsvm_dense,
+    read_libsvm_table,
+)
 
 __all__ = [
     "load_metadata",
@@ -17,4 +21,6 @@ __all__ = [
     "read_csv",
     "read_csv_table",
     "read_libsvm",
+    "read_libsvm_dense",
+    "read_libsvm_table",
 ]

@@ -164,3 +164,52 @@ def read_libsvm_dense(path: str, n_features: Optional[int] = None, **kw):
     rows = np.repeat(np.arange(n), np.diff(indptr))
     x[rows, indices] = values
     return x, labels
+
+
+def read_libsvm_table(
+    path: str,
+    n_features: Optional[int] = None,
+    features_col: str = "features",
+    label_col: str = "label",
+    **kw,
+):
+    """Parse into a :class:`~flinkml_tpu.table.Table` with a SparseVector
+    features column — the bridge from libsvm ingest straight into the
+    O(nnz) sparse estimators (LogisticRegression / LinearSVC /
+    LinearRegression fit + transform), never densifying.
+
+    Rows are sorted by feature index on the way in (libsvm does not
+    guarantee ordering); a duplicate index within a row raises, keeping
+    SparseVector's sorted-unique invariant intact.
+    """
+    from flinkml_tpu.linalg import SparseVector
+    from flinkml_tpu.table import Table
+
+    labels, indptr, indices, values, dim = read_libsvm(
+        path, n_features=n_features, **kw
+    )
+    n = labels.shape[0]
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    order = np.lexsort((indices, rows))
+    if indices.size > 1:
+        srows, sidx = rows[order], indices[order]
+        dup = (np.diff(sidx) == 0) & (np.diff(srows) == 0)
+        if dup.any():
+            # Indices here are base-adjusted (0-based); say so and point
+            # at the 1-based data line so the message matches the file.
+            raise ValueError(
+                f"duplicate feature index {int(sidx[1:][dup][0])} "
+                f"(0-based) on data line {int(srows[1:][dup][0]) + 1} "
+                f"of {path}"
+            )
+    idx64 = indices[order].astype(np.int64)
+    val64 = values[order].astype(np.float64)
+    idx64.setflags(write=False)
+    val64.setflags(write=False)
+    vecs = np.empty(n, dtype=object)
+    for i in range(n):
+        sl = slice(indptr[i], indptr[i + 1])
+        # Trusted construction over frozen sorted views: per-row
+        # validation would dominate at dataset scale.
+        vecs[i] = SparseVector._from_sorted(dim, idx64[sl], val64[sl])
+    return Table({features_col: vecs, label_col: labels})

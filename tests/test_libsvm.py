@@ -140,3 +140,51 @@ def test_multithreaded_consistency(svm_file):
     b = read_libsvm(path, n_threads=8)
     for x, y in zip(a[:4], b[:4]):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_read_libsvm_table_sparse_pipeline(svm_file):
+    """Table reader: SparseVector column matching the file exactly, and
+    consumable by the sparse LogisticRegression end to end."""
+    from flinkml_tpu.io import read_libsvm_table
+    from flinkml_tpu.linalg import SparseVector
+    from flinkml_tpu.models import LogisticRegression
+
+    path, mat, y = svm_file
+    table = read_libsvm_table(path)
+    col = table["features"]
+    assert col.dtype == object and isinstance(col[0], SparseVector)
+    dense = np.stack([v.to_array() for v in col])
+    np.testing.assert_allclose(dense, mat.toarray(), rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(table["label"], y)
+    # Rows hold sorted unique indices (the SparseVector invariant).
+    for v in col[:20]:
+        assert (np.diff(v.indices) > 0).all()
+
+    model = (
+        LogisticRegression().set_seed(0).set_max_iter(100)
+        .set_global_batch_size(200).set_learning_rate(1.0).fit(table)
+    )
+    (out,) = model.transform(table)
+    assert out["prediction"].shape == y.shape
+
+
+def test_read_libsvm_table_duplicate_index_raises(tmp_path):
+    from flinkml_tpu.io import read_libsvm_table
+
+    path = str(tmp_path / "dup.svm")
+    with open(path, "w") as f:
+        f.write("1 1:2.0 1:3.0 2:1.0\n")
+    with pytest.raises(ValueError, match="duplicate feature index"):
+        read_libsvm_table(path)
+
+
+def test_read_libsvm_table_unsorted_indices(tmp_path):
+    from flinkml_tpu.io import read_libsvm_table
+
+    path = str(tmp_path / "unsorted.svm")
+    with open(path, "w") as f:
+        f.write("1 5:5.0 2:2.0 9:9.0\n0 3:3.0 1:1.0\n")
+    t = read_libsvm_table(path, n_features=10)
+    v0 = t["features"][0]
+    np.testing.assert_array_equal(v0.indices, [1, 4, 8])  # 1-based input
+    np.testing.assert_array_equal(v0.values, [2.0, 5.0, 9.0])
