@@ -82,7 +82,7 @@ def test_degenerate_margins_match_sklearn():
         Table({"features": x, "label": y})
     )
     sk = SkLR(
-        penalty=None, fit_intercept=False, tol=1e-12, max_iter=50_000
+        C=np.inf, fit_intercept=False, tol=1e-12, max_iter=50_000
     ).fit(x, y)
     np.testing.assert_allclose(
         x @ model.coefficient, x @ sk.coef_[0], atol=1e-3
@@ -107,7 +107,7 @@ def test_full_batch_gd_matches_sklearn_optimum(rng):
         n, max_iter=20_000, learning_rate=2.0
     ).fit(Table({"features": x, "label": y}))
     sk = SkLR(
-        penalty=None, fit_intercept=False, tol=1e-12, max_iter=50_000
+        C=np.inf, fit_intercept=False, tol=1e-12, max_iter=50_000
     ).fit(x, y)
     np.testing.assert_allclose(model.coefficient, sk.coef_[0], atol=1e-4)
 

@@ -97,7 +97,7 @@ def test_against_sklearn(rng):
     (out,) = model.transform(table)
     ours = np.mean(out["prediction"] == y)
 
-    sk = SkLR(penalty=None, fit_intercept=False, max_iter=1000).fit(x, y)
+    sk = SkLR(C=np.inf, fit_intercept=False, max_iter=1000).fit(x, y)
     theirs = sk.score(x, y)
     assert ours >= theirs - 0.02, (ours, theirs)
     # Coefficient direction agreement.
