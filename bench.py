@@ -230,13 +230,14 @@ def _inner_dense_bf16() -> float:
 
 def _inner_kmeans() -> float:
     """Stage 4: KMeans Lloyd throughput — the whole loop (assignment on
-    the MXU + one-hot aggregation + psum + update) in one dispatch."""
+    the MXU + one-hot aggregation + psum + update) in one dispatch.
+    MNIST-784 profile (BASELINE.json config #2): d=784, k=10."""
     _setup_jax_cache()
     import jax.numpy as jnp
     from flinkml_tpu.models.kmeans import _kmeans_trainer, prepare_kmeans_data
     from flinkml_tpu.parallel import DeviceMesh
 
-    n, dim, k, iters = 1_000_000, 64, 64, 100
+    n, dim, k, iters = 262_144, 784, 10, 100
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, dim)).astype(np.float32)
     mesh = DeviceMesh()
@@ -378,7 +379,8 @@ def main():
         # Same dense workload, bf16-resident (bandwidth-bound: ~2x ceiling).
         extras["dense_bf16_logreg_samples_per_sec_per_chip"] = round(bf16_sps, 1)
     if kmeans_pps is not None:
-        # KMeans Lloyd (n=1M, d=64, k=64), whole loop on device.
+        # KMeans Lloyd, MNIST-784 profile (n=262k, d=784, k=10),
+        # whole loop on device.
         extras["kmeans_points_per_sec_per_chip"] = round(kmeans_pps, 1)
     if extras:
         # Secondary measurements kept inside the single JSON line.
