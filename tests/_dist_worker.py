@@ -67,20 +67,45 @@ assert covered == list(range(10)), covered
 
 # --- data plane: a psum across processes through the collectives helper.
 import numpy as np  # noqa: E402
-from flinkml_tpu.parallel.collectives import all_reduce_sum  # noqa: E402
-
-local = np.full(
-    (jax.local_device_count(), 4), float(pid + 1), dtype=np.float32
+from flinkml_tpu.parallel.collectives import (  # noqa: E402
+    all_reduce_sum,
+    keyed_aggregate,
+    map_partition,
 )
+
+n_local_dev = jax.local_device_count()
+local = np.full((n_local_dev, 4), float(pid + 1), dtype=np.float32)
 global_batch = jax.make_array_from_process_local_data(
     dm.data_sharding(), local
 )
 summed = all_reduce_sum(dm, global_batch)
-expected = sum(
-    (p + 1) * jax.local_device_count() for p in range(nproc)
-)
+expected = sum((p + 1) * n_local_dev for p in range(nproc))
 got = np.asarray(summed.addressable_shards[0].data)
 assert np.allclose(got, expected), (got, expected)
+
+# --- keyed aggregation across processes (segment_sum + psum): rows on
+# every device contribute to shared key buckets.
+rows_per_dev = 4
+vals_local = np.ones((n_local_dev * rows_per_dev, 2), dtype=np.float32)
+keys_local = np.tile(
+    np.arange(rows_per_dev, dtype=np.int32), n_local_dev
+)
+vals_g = jax.make_array_from_process_local_data(dm.data_sharding(), vals_local)
+keys_g = jax.make_array_from_process_local_data(dm.data_sharding(), keys_local)
+agg = keyed_aggregate(dm, vals_g, keys_g, num_segments=rows_per_dev)
+agg_host = np.asarray(agg.addressable_shards[0].data)
+total_devices = nproc * n_local_dev
+assert np.allclose(agg_host, np.full((rows_per_dev, 2), total_devices)), agg_host
+
+# --- mapPartition across processes: per-shard function, sharded output.
+part = map_partition(
+    dm, lambda shard: shard - shard.sum(), vals_g
+)
+# Every shard has rows_per_dev ones per column -> shard.sum() = 2*rows_per_dev.
+local_out = np.concatenate(
+    [np.asarray(s.data) for s in part.addressable_shards]
+)
+assert np.allclose(local_out, 1.0 - 2.0 * rows_per_dev), local_out[:2]
 
 # --- checkpoint commit ordering: shard files → barrier → manifest commit
 # by host 0 → barrier → visible everywhere (the two-phase commit the

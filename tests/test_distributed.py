@@ -66,6 +66,20 @@ def test_two_process_control_plane(tmp_path):
     count, a cross-process all-reduce, and barrier-ordered checkpoint
     manifest commit. See tests/_dist_worker.py for the worker body.
     """
+    # One local device per process: the mesh must span processes, not be
+    # satisfiable host-locally.
+    _launch_two_process_workers(tmp_path, local_devices=1)
+
+
+def test_two_process_multi_device_data_plane(tmp_path):
+    """2 processes × 2 local CPU devices = a 4-device global mesh with
+    mixed addressable/non-addressable shards per process — the layout a
+    real multi-host pod has. Exercises all_reduce_sum, keyed_aggregate,
+    and map_partition across the process boundary."""
+    _launch_two_process_workers(tmp_path, local_devices=2)
+
+
+def _launch_two_process_workers(tmp_path, local_devices):
     import shutil
     import socket
     import subprocess
@@ -74,9 +88,12 @@ def test_two_process_control_plane(tmp_path):
     worker = os.path.join(os.path.dirname(__file__), "_dist_worker.py")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    # One local device per process: the mesh must span processes, not be
-    # satisfiable host-locally.
-    env.pop("XLA_FLAGS", None)
+    if local_devices > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={local_devices}"
+        )
+    else:
+        env.pop("XLA_FLAGS", None)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
 
