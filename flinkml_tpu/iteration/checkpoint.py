@@ -21,6 +21,7 @@ import json
 import os
 import shutil
 import tempfile
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -47,11 +48,15 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  allow_rescale: bool = False,
-                 world_size: Optional[int] = None):
+                 world_size: Optional[int] = None,
+                 async_write: bool = False):
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.allow_rescale = allow_rescale
         self.world_size = world_size
+        self.async_write = async_write
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._pending: Optional[Future] = None
         os.makedirs(directory, exist_ok=True)
 
     def _world_size(self) -> int:
@@ -59,22 +64,70 @@ class CheckpointManager:
 
     # -- save --------------------------------------------------------------
     def save(self, state: Any, epoch: int, extra: Optional[dict] = None) -> str:
+        """Snapshot ``state`` at ``epoch``.
+
+        With ``async_write=True`` the device→host transfer happens here
+        (so the snapshot is consistent) but serialization + the atomic
+        publish run on a background thread, overlapping checkpoint IO
+        with the next training chunk (the orbax-style async pattern; the
+        reference overlaps the same way via Flink's async snapshots). At
+        most one write is in flight — a new save first drains the
+        previous one, re-raising any failure.
+        """
         leaves, treedef = jax.tree_util.tree_flatten(state)
-        host_leaves = [np.asarray(leaf) for leaf in leaves]
+        if self.async_write:
+            # np.asarray is a zero-copy VIEW for numpy inputs; the caller
+            # may mutate those buffers while the background write runs,
+            # so async snapshots must own their memory.
+            host_leaves = [np.array(leaf) for leaf in leaves]
+        else:
+            host_leaves = [np.asarray(leaf) for leaf in leaves]
+        meta = {
+            "epoch": int(epoch),
+            "num_leaves": len(host_leaves),
+            "treedef": str(treedef),
+            "world_size": self._world_size(),
+            "extra": extra or {},
+        }
         final_dir = os.path.join(self.directory, f"ckpt-{epoch}")
+        if not self.async_write:
+            self._write(host_leaves, meta, final_dir)
+            return final_dir
+        self.wait()  # serialize in-flight writes; surface prior failures
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-write"
+            )
+        self._pending = self._executor.submit(
+            self._write, host_leaves, meta, final_dir
+        )
+        return final_dir
+
+    def wait(self) -> None:
+        """Block until the in-flight async write (if any) has committed;
+        re-raises its exception. No-op for synchronous managers."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def close(self) -> None:
+        """Drain the in-flight write and release the writer thread.
+        Idempotent; the manager stays usable (a later save re-creates
+        the executor)."""
+        try:
+            self.wait()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
+
+    def _write(self, host_leaves, meta, final_dir) -> None:
         tmp_dir = tempfile.mkdtemp(dir=self.directory, prefix=".tmp-ckpt-")
         try:
             np.savez(
                 os.path.join(tmp_dir, "arrays.npz"),
                 **{f"leaf_{i}": leaf for i, leaf in enumerate(host_leaves)},
             )
-            meta = {
-                "epoch": int(epoch),
-                "num_leaves": len(host_leaves),
-                "treedef": str(treedef),
-                "world_size": self._world_size(),
-                "extra": extra or {},
-            }
             with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
                 json.dump(meta, f)
             if os.path.exists(final_dir):
@@ -84,10 +137,16 @@ class CheckpointManager:
             shutil.rmtree(tmp_dir, ignore_errors=True)
             raise
         self._prune()
-        return final_dir
 
     # -- restore -----------------------------------------------------------
     def all_epochs(self) -> List[int]:
+        self.wait()  # readers always see the committed state
+        return self._list_epochs()
+
+    def _list_epochs(self) -> List[int]:
+        """Directory listing without draining the writer — safe to call
+        from inside the background write itself (``_prune``); ``wait()``
+        here would self-join the in-flight future and deadlock."""
         out = []
         for name in os.listdir(self.directory):
             if name.startswith("ckpt-"):
@@ -104,6 +163,7 @@ class CheckpointManager:
     def restore(self, epoch: int, like: Any) -> Tuple[Any, int]:
         """Restore the checkpoint at ``epoch``; ``like`` provides the pytree
         structure (e.g. the init state)."""
+        self.wait()
         ckpt_dir = os.path.join(self.directory, f"ckpt-{epoch}")
         with open(os.path.join(ckpt_dir, "meta.json")) as f:
             meta = json.load(f)
@@ -138,7 +198,7 @@ class CheckpointManager:
         return self.restore(epoch, like)
 
     def _prune(self) -> None:
-        epochs = self.all_epochs()
+        epochs = self._list_epochs()
         for epoch in epochs[: -self.max_to_keep]:
             shutil.rmtree(
                 os.path.join(self.directory, f"ckpt-{epoch}"), ignore_errors=True

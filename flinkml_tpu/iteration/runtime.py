@@ -298,6 +298,12 @@ def iterate(
         ):
             config.checkpoint_manager.save(state, epoch)
 
+    if config.checkpoint_manager is not None and hasattr(
+        config.checkpoint_manager, "wait"
+    ):
+        # Drain any in-flight async write so a failed final snapshot
+        # surfaces here rather than vanishing at interpreter exit.
+        config.checkpoint_manager.wait()
     for listener in listeners:
         listener.on_iteration_terminated(state)
 

@@ -392,6 +392,10 @@ def _run_chunked(
         for listener in listeners:
             listener.on_epoch_watermark_incremented(epoch - 1, coef_host)
     result = np.asarray(coef)
+    if checkpoint_manager is not None:
+        # Drain any in-flight async write so a failed final snapshot
+        # surfaces here, not silently at interpreter exit.
+        checkpoint_manager.wait()
     for listener in listeners:
         listener.on_iteration_terminated(result)
     return result
@@ -907,6 +911,8 @@ def train_linear_model_stream(
         after_epoch(criterion.should_terminate(epoch - 1, cur_loss))
 
     result = np.asarray(coef)
+    if checkpoint_manager is not None:
+        checkpoint_manager.wait()  # surface a failed final async write
     for listener in listeners:
         listener.on_iteration_terminated(result)
     return result

@@ -158,6 +158,86 @@ def test_checkpoint_save_restore(tmp_path):
     np.testing.assert_array_equal(restored["rng"], state["rng"])
 
 
+def test_async_checkpoint_matches_sync(tmp_path):
+    """async_write=True commits identically to the synchronous manager;
+    readers drain the in-flight write."""
+    state = {"w": np.arange(6.0), "e": np.float64(1.5)}
+    sync = CheckpointManager(str(tmp_path / "s"))
+    anc = CheckpointManager(str(tmp_path / "a"), async_write=True)
+    for epoch in (1, 2, 3):
+        sync.save(state, epoch)
+        anc.save(state, epoch)
+    assert anc.all_epochs() == sync.all_epochs() == [1, 2, 3]
+    ra, ea = anc.restore_latest(like=state)
+    rs, es = sync.restore_latest(like=state)
+    assert ea == es == 3
+    np.testing.assert_array_equal(ra["w"], rs["w"])
+
+
+def test_async_checkpoint_failover_exact(tmp_path):
+    """The chunked-failover contract holds with async writes: crash,
+    resume, bit-exact result."""
+    from flinkml_tpu.models.logistic_regression import train_logistic_regression
+    from flinkml_tpu.parallel import DeviceMesh
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3))
+    y = (x[:, 0] > 0).astype(np.float64)
+    w = np.ones(64)
+    kw = dict(mesh=DeviceMesh(), max_iter=30, learning_rate=0.5,
+              global_batch_size=64, reg=0.0, tol=0.0, seed=5)
+    golden = train_logistic_regression(x, y, w, **kw)
+    mgr = CheckpointManager(str(tmp_path), async_write=True)
+    train_logistic_regression(
+        x, y, w, **{**kw, "max_iter": 12},
+        checkpoint_manager=mgr, checkpoint_interval=6,
+    )
+    assert mgr.latest_epoch() == 12
+    resumed = train_logistic_regression(
+        x, y, w, **kw, checkpoint_manager=mgr, checkpoint_interval=6,
+        resume=True,
+    )
+    np.testing.assert_allclose(resumed, golden, atol=0)
+
+
+def test_async_checkpoint_snapshots_before_mutation(tmp_path):
+    """The async snapshot must own its memory: mutating the saved arrays
+    after save() returns cannot leak into the checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), async_write=True)
+    state = {"w": np.arange(5.0)}
+    mgr.save(state, epoch=1)
+    state["w"] += 100.0  # caller mutates immediately (in-place training)
+    restored, _ = mgr.restore(1, like=state)
+    np.testing.assert_array_equal(restored["w"], np.arange(5.0))
+    mgr.close()
+
+
+def test_async_checkpoint_close_idempotent(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_write=True)
+    mgr.save({"w": np.ones(2)}, epoch=1)
+    mgr.close()
+    mgr.close()
+    # Still usable after close: a later save re-creates the writer.
+    mgr.save({"w": np.ones(2)}, epoch=2)
+    assert mgr.all_epochs() == [1, 2]
+    mgr.close()
+
+
+def test_async_checkpoint_write_error_surfaces(tmp_path):
+    import shutil
+
+    target = tmp_path / "ckpts"
+    mgr = CheckpointManager(str(target), async_write=True)
+    mgr.save({"w": np.ones(2)}, epoch=1)
+    mgr.wait()
+    # Remove the directory out from under the manager so the background
+    # write fails; the error must surface on the next wait()/save().
+    shutil.rmtree(target)
+    mgr.save({"w": np.ones(2)}, epoch=2)  # submitted; fails in background
+    with pytest.raises(OSError):
+        mgr.wait()
+
+
 def test_checkpoint_prune(tmp_path):
     mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
     for e in range(5):
