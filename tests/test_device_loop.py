@@ -1,0 +1,79 @@
+"""device_iterate: the whole-loop-on-device mode (lax.while_loop).
+
+The highest-performance iteration mode (zero host round-trips per epoch);
+its termination semantics must match the host runtime's
+TerminateOnMaxIterOrTol exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.iteration.device_loop import device_iterate
+from flinkml_tpu.iteration.runtime import (
+    IterationConfig,
+    TerminateOnMaxIterOrTol,
+    iterate,
+)
+
+
+def test_runs_exactly_max_iter_without_tol():
+    state, epochs, criteria = device_iterate(
+        lambda s, e: (s + 1.0, s), jnp.asarray(0.0), max_iter=7
+    )
+    assert int(epochs) == 7
+    assert float(state) == 7.0
+
+
+def test_tol_stops_early():
+    # criteria = 10 - epoch; tol 5.5 -> stops when 10 - e <= 5.5 (e = 5),
+    # i.e. after epoch index 5 has run -> 6 epochs.
+    state, epochs, criteria = device_iterate(
+        lambda s, e: (s, 10.0 - e.astype(jnp.float32)),
+        jnp.asarray(0.0), max_iter=100, tol=5.5,
+    )
+    assert int(epochs) == 6
+    assert float(criteria) <= 5.5
+
+
+def test_matches_host_runtime_trajectory():
+    """Same step, same termination: device loop == host iterate."""
+
+    def step(s, e):
+        s = s * 0.5 + 1.0
+        return s, jnp.abs(s - 2.0)
+
+    d_state, d_epochs, _ = device_iterate(
+        step, jnp.asarray(0.0), max_iter=50, tol=1e-3
+    )
+    h = iterate(
+        lambda s, e: step(s, jnp.asarray(e)),
+        jnp.asarray(0.0),
+        config=IterationConfig(TerminateOnMaxIterOrTol(50, 1e-3)),
+    )
+    assert int(d_epochs) == h.epochs
+    np.testing.assert_allclose(float(d_state), float(h.state), rtol=1e-6)
+
+
+def test_pytree_state_and_single_compile():
+    traces = {"n": 0}
+
+    def step(s, e):
+        traces["n"] += 1
+        return {"a": s["a"] + s["b"], "b": s["b"]}, jnp.asarray(1.0)
+
+    init = {"a": jnp.zeros(3), "b": jnp.ones(3)}
+    state, epochs, _ = device_iterate(step, init, max_iter=10)
+    np.testing.assert_array_equal(np.asarray(state["a"]), np.full(3, 10.0))
+    # Traced once (whole loop is one XLA program), not once per epoch.
+    assert traces["n"] == 1
+
+
+def test_nan_criteria_terminates():
+    """NaN <= tol is False — the loop must still stop at max_iter, not
+    spin forever."""
+    state, epochs, criteria = device_iterate(
+        lambda s, e: (s, jnp.asarray(float("nan"))),
+        jnp.asarray(0.0), max_iter=5, tol=1e-6,
+    )
+    assert int(epochs) == 5
