@@ -626,6 +626,128 @@ def train_linear_model_sparse_csr(
     )
 
 
+def make_softmax_step(num_classes: int, local_bs: int, axis: str):
+    """Multinomial (softmax) step: logits on the MXU, cross-entropy on
+    the VPU, gradient ``(p - onehot)ᵀ·x`` back on the MXU. The model is a
+    ``[k, d]`` matrix; same update rule as the binomial trainer
+    (``coef -= lr/weightSum · grad``)."""
+
+    def step(coef, epoch, xl, yl, wl, learning_rate, reg_l2, reg_l1):
+        xb = _window(xl, epoch, local_bs)
+        yb = _window(yl, epoch, local_bs)
+        wb = _window(wl, epoch, local_bs)
+        acc = _acc_dt(xb.dtype)
+        logits = xb @ coef.T                             # [bs, k]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(
+            yb.astype(jnp.int32), num_classes, dtype=xb.dtype
+        )
+        per_ex = -jnp.sum(onehot * logp, axis=-1) * wb
+        mult = (jnp.exp(logp) - onehot) * wb[:, None]    # [bs, k]
+        grad_l = mult.T @ xb                             # [k, d]
+        grad = jax.lax.psum(grad_l, axis)
+        loss_sum = jax.lax.psum(jnp.sum(per_ex.astype(acc)), axis)
+        wsum = jax.lax.psum(jnp.sum(wb.astype(acc)), axis)
+        grad = grad + 2.0 * reg_l2 * coef
+        loss_sum = loss_sum + reg_l2 * jnp.sum(jnp.square(coef.astype(acc)))
+        step_size = learning_rate.astype(acc) / wsum
+        new_coef = _soft_threshold(
+            coef - step_size.astype(coef.dtype) * grad,
+            step_size.astype(coef.dtype) * reg_l1,
+        )
+        return new_coef, (loss_sum / wsum).astype(coef.dtype)
+
+    return step
+
+
+@functools.lru_cache(maxsize=128)
+def _softmax_trainer(mesh, num_classes: int, local_bs: int, axis: str):
+    """Carry-style whole-loop softmax trainer — same contract as
+    :func:`_dense_trainer` (chunked checkpointing included)."""
+    local_step = make_softmax_step(num_classes, local_bs, axis)
+
+    def per_device(coef, epoch, cur_loss, xl, yl, wl,
+                   learning_rate, reg_l2, reg_l1, tol, epoch_end):
+        def cond(carry):
+            _, ep, cur = carry
+            return jnp.logical_and(ep < epoch_end, cur > tol)
+
+        def body(carry):
+            c, ep, _ = carry
+            new_coef, mean_loss = local_step(
+                c, ep, xl, yl, wl, learning_rate, reg_l2, reg_l1
+            )
+            return new_coef, ep + 1, mean_loss
+
+        return jax.lax.while_loop(cond, body, (coef, epoch, cur_loss))
+
+    return jax.jit(
+        jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(axis), P(axis), P(axis),
+                      P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P()),
+        )
+    )
+
+
+def train_softmax_model(
+    x: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray,
+    num_classes: int,
+    mesh: DeviceMesh,
+    max_iter: int,
+    learning_rate: float,
+    global_batch_size: int,
+    reg: float,
+    elastic_net: float,
+    tol: float,
+    seed: int,
+    dtype=None,
+    checkpoint_manager=None,
+    checkpoint_interval: int = 0,
+    resume: bool = False,
+    listeners=(),
+) -> np.ndarray:
+    """Multinomial logistic regression: returns coefficient ``[k, d]``.
+
+    Same distributed machinery as :func:`train_linear_model` (windowed
+    batches, psum, proximal elastic-net, chunked checkpointing); the loss
+    is weighted softmax cross-entropy over integer labels ``0..k-1``.
+    """
+    n = x.shape[0]
+    if n == 0:
+        raise ValueError("training table is empty")
+    p_size = mesh.axis_size()
+    if dtype is not None:
+        x = x.astype(dtype)
+    w = np.asarray(w, dtype=x.dtype)
+    y = np.asarray(y, dtype=x.dtype)
+    perm = np.random.default_rng(seed).permutation(n)
+    x, y, w = x[perm], y[perm], w[perm]
+    x_pad, _ = pad_to_multiple(x, p_size)
+    y_pad, _ = pad_to_multiple(y, p_size)
+    w_pad, _ = pad_to_multiple(w, p_size)
+    xd = mesh.shard_batch(x_pad)
+    yd = mesh.shard_batch(y_pad)
+    wd = mesh.shard_batch(w_pad)
+    n_local = xd.shape[0] // p_size
+    local_bs = min(max(1, math.ceil(global_batch_size / p_size)), n_local)
+    trainer = _softmax_trainer(
+        mesh.mesh, int(num_classes), local_bs, DeviceMesh.DATA_AXIS
+    )
+    return _run_chunked(
+        trainer, (xd, yd, wd), (int(num_classes), x.shape[1]), xd.dtype,
+        learning_rate, reg * (1.0 - elastic_net), reg * elastic_net,
+        tol, max_iter, mesh,
+        checkpoint_manager=checkpoint_manager,
+        checkpoint_interval=checkpoint_interval,
+        resume=resume, listeners=listeners,
+    )
+
+
 def train_linear_model_from_table(
     table,
     features_col: str,

@@ -113,11 +113,6 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
     def fit(self, *inputs) -> "LogisticRegressionModel":
         (table,) = inputs
         multi_class = self.get(_LogisticRegressionParams.MULTI_CLASS)
-        if multi_class == "multinomial":
-            raise ValueError(
-                "Currently we only support binomial logistic regression; "
-                "multinomial is not supported (parity with the reference)"
-            )
         features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
         if not isinstance(table, Table):
             return self._fit_stream(table)
@@ -143,6 +138,12 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
                 self.get(_LogisticRegressionParams.LABEL_COL),
                 self.get(_LogisticRegressionParams.WEIGHT_COL),
             )
+            if _resolve_multi_class(multi_class, y) == "multinomial":
+                raise ValueError(
+                    "multinomial logistic regression supports dense "
+                    "features only; one-hot/sparse inputs train one "
+                    "binomial model per concept"
+                )
             _check_binomial_labels(y)
             coef = _linear_sgd.train_linear_model_sparse_csr(
                 indptr, indices, values, dim,
@@ -157,18 +158,34 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
             )
             if x.shape[0] == 0:
                 raise ValueError("training table is empty")
-            _check_binomial_labels(y)
-            coef = train_logistic_regression(x, y, w, **hyper)
+            if _resolve_multi_class(multi_class, y) == "multinomial":
+                # Softmax cross-entropy over integer classes 0..k-1:
+                # coefficient is [k, d] (beyond the reference snapshot,
+                # which rejects multinomial outright).
+                num_classes = _check_multinomial_labels(y)
+                coef = _linear_sgd.train_softmax_model(
+                    x, y, w, num_classes=num_classes, elastic_net=0.0,
+                    **hyper,
+                )
+            else:
+                _check_binomial_labels(y)
+                coef = train_logistic_regression(x, y, w, **hyper)
 
         model = LogisticRegressionModel(mesh=self.mesh)
         model.copy_params_from(self)
-        model.set_model_data(Table({"coefficient": coef[None, :]}))
+        model.set_model_data(Table({"coefficient": coef[None, ...]}))
         return model
 
     def _fit_stream(self, source) -> "LogisticRegressionModel":
         """Out-of-core fit from an iterable of batch Tables or a DataCache
         (see class docstring; ReplayOperator.java:62-250 parity)."""
         from flinkml_tpu.iteration.datacache import DataCache
+
+        if self.get(_LogisticRegressionParams.MULTI_CLASS) == "multinomial":
+            raise ValueError(
+                "multinomial logistic regression does not support "
+                "streamed fits; materialize the data as a Table"
+            )
 
         features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
         label_col = self.get(_LogisticRegressionParams.LABEL_COL)
@@ -186,7 +203,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
         )
         if isinstance(source, DataCache):
             def validate(batch):
-                _check_binomial_labels(np.asarray(batch[label_col]))
+                _check_stream_labels(np.asarray(batch[label_col]))
 
             coef = _linear_sgd.train_linear_model_stream(
                 source, columns=(features_col, label_col, weight_col),
@@ -196,7 +213,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
             def batches():
                 for t in source:
                     x, y, w = labeled_data(t, features_col, label_col, weight_col)
-                    _check_binomial_labels(y)
+                    _check_stream_labels(y)
                     yield {"x": x, "y": y, "w": w}
 
             coef = _linear_sgd.train_linear_model_stream(batches(), **kwargs)
@@ -217,10 +234,22 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
         self.mesh = mesh
         self._coefficient: Optional[np.ndarray] = None
 
+    def set_model_data(self, *inputs: Table) -> "LogisticRegressionModel":
+        (table,) = inputs
+        c = np.asarray(table.column("coefficient"), dtype=np.float64)
+        # [1, d] (binomial vector) or [1, k, d] (multinomial matrix).
+        self._coefficient = c[0] if c.ndim >= 2 else c.reshape(-1)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"coefficient": self._coefficient[None, ...]})]
+
     # -- inference ---------------------------------------------------------
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require_model()
+        multinomial = self._coefficient.ndim == 2
         features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
         sparse_col = sparse_features(table, features_col)
         if sparse_col is not None:
@@ -230,11 +259,14 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
             from flinkml_tpu.ops.sparse import sparse_margins
 
             # Margins arrive on host; the elementwise tail stays on host
-            # (no device round-trip for a sigmoid on [n] values).
+            # (no device round-trip for a sigmoid/softmax on [n] values).
             dot = sparse_margins(sparse_col, self._coefficient)
-            p = 1.0 / (1.0 + np.exp(-dot.astype(np.float64)))
-            pred = (dot >= 0).astype(dot.dtype)
-            raw = np.stack([1.0 - p, p], axis=-1)
+            if multinomial:
+                pred, raw = _softmax_from_logits(dot.astype(np.float64))
+            else:
+                p = 1.0 / (1.0 + np.exp(-dot.astype(np.float64)))
+                pred = (dot >= 0).astype(dot.dtype)
+                raw = np.stack([1.0 - p, p], axis=-1)
             out = table.with_column(
                 self.get(_LogisticRegressionParams.PREDICTION_COL), pred
             ).with_column(
@@ -242,16 +274,17 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
             )
             return (out,)
         x = features_matrix(table, self.get(_LogisticRegressionParams.FEATURES_COL))
+        predict = _predict_multinomial if multinomial else _predict
         if self.mesh is not None and self.mesh.num_devices > 1:
             # Sharded batch inference: rows split over the data axis, the
             # coefficient replicated (the broadcast-model pattern).
             x_pad, n_valid = pad_to_multiple(x, self.mesh.axis_size())
             xd = self.mesh.shard_batch(x_pad)
             coef = self.mesh.replicate(jnp.asarray(self._coefficient, xd.dtype))
-            pred, raw = _predict(xd, coef)
+            pred, raw = predict(xd, coef)
             pred, raw = np.asarray(pred)[:n_valid], np.asarray(raw)[:n_valid]
         else:
-            pred, raw = _predict(jnp.asarray(x), jnp.asarray(self._coefficient))
+            pred, raw = predict(jnp.asarray(x), jnp.asarray(self._coefficient))
         out = table.with_column(
             self.get(_LogisticRegressionParams.PREDICTION_COL), np.asarray(pred)
         ).with_column(
@@ -265,6 +298,44 @@ def _check_binomial_labels(y: np.ndarray) -> None:
     check_binary_labels(y, "binomial logistic regression")
 
 
+def _check_stream_labels(y: np.ndarray) -> None:
+    """Streamed fits are binomial-only; >2-class data gets the actual
+    limitation in the message, not a confusing binomial-labels error."""
+    try:
+        _check_binomial_labels(y)
+    except ValueError as e:
+        raise ValueError(
+            f"{e}; multinomial (>2 classes) is not supported for "
+            "streamed fits — materialize the data as a Table"
+        ) from None
+
+
+def _resolve_multi_class(multi_class: str, y: np.ndarray) -> str:
+    """'auto' follows the label cardinality (≤2 → binomial), like the
+    wider flink-ml family; explicit settings are honored as-is."""
+    if multi_class != "auto":
+        return multi_class
+    return "multinomial" if np.unique(y).size > 2 else "binomial"
+
+
+def _check_multinomial_labels(y: np.ndarray) -> int:
+    """Labels must be exactly the integers 0..k-1 (every class present);
+    returns k. Guards against phantom classes and against a single
+    outlier label silently allocating a huge [maxLabel+1, d] matrix."""
+    uniq = np.unique(y)
+    if (
+        not np.all(uniq == np.round(uniq))
+        or uniq.min() < 0
+        or uniq.size != int(uniq.max()) + 1
+    ):
+        raise ValueError(
+            "multinomial logistic regression requires integer labels "
+            f"covering 0..k-1 exactly, got {uniq[:6]}"
+            f"{'...' if uniq.size > 6 else ''}"
+        )
+    return int(uniq.max()) + 1
+
+
 @jax.jit
 def _predict(x, coef):
     """prediction = 1[dot >= 0]; raw = [1-p, p]
@@ -273,6 +344,23 @@ def _predict(x, coef):
     p = jax.nn.sigmoid(dot)
     pred = (dot >= 0).astype(x.dtype)
     raw = jnp.stack([1.0 - p, p], axis=-1)
+    return pred, raw
+
+
+@jax.jit
+def _predict_multinomial(x, coef):
+    """prediction = argmax class; raw = softmax probabilities [n, k]."""
+    logits = x @ coef.T
+    raw = jax.nn.softmax(logits, axis=-1)
+    pred = jnp.argmax(logits, axis=-1).astype(x.dtype)
+    return pred, raw
+
+
+def _softmax_from_logits(logits: np.ndarray):
+    z = logits - logits.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    raw = e / e.sum(axis=-1, keepdims=True)
+    pred = np.argmax(logits, axis=-1).astype(np.float64)
     return pred, raw
 
 

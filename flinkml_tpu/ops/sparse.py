@@ -135,8 +135,10 @@ def sparse_margins(vectors: Sequence[SparseVector], coef,
                    max_buckets: int = 4) -> np.ndarray:
     """Row-wise dots ``X @ coef`` for SparseVector rows, skew-proof.
 
-    Inference-side counterpart of the bucketed trainer: packs rows into
-    nnz buckets (padded cells ≈ total nnz, vs n·max_nnz for a uniform
+    ``coef`` may be a vector ``[d]`` (returns ``[n]``) or a class matrix
+    ``[k, d]`` (returns ``[n, k]`` — multinomial scoring). Inference-side
+    counterpart of the bucketed trainer: packs rows into nnz buckets
+    (padded cells ≈ total nnz, vs n·max_nnz for a uniform
     :class:`BatchedCSR`), computes each bucket's gather-dot on device,
     and reassembles results in the caller's row order. O(nnz) memory at
     any skew and any dim.
@@ -147,7 +149,8 @@ def sparse_margins(vectors: Sequence[SparseVector], coef,
     # Same guarantee the dense path gets from `x @ coef` shape checking:
     # a dim mismatch must raise, not silently gather-clamp out-of-range
     # indices onto the last coefficient.
-    n_coef = np.shape(coef)[0]
+    coef = np.asarray(coef)
+    n_coef = coef.shape[-1]
     if dim != n_coef:
         raise ValueError(
             f"features have dim {dim} but the model coefficient has "
@@ -157,12 +160,24 @@ def sparse_margins(vectors: Sequence[SparseVector], coef,
         indptr, indices, values, dim, max_buckets=max_buckets,
         dtype=np.float32,
     )
-    coef = jnp.asarray(coef, jnp.float32)
-    out = np.empty(indptr.size - 1, dtype=np.float32)
+    n = indptr.size - 1
+    if coef.ndim == 2:
+        coef_t = jnp.asarray(coef.T, jnp.float32)       # [d, k]
+        out = np.empty((n, coef.shape[0]), dtype=np.float32)
+        for bucket, rows in zip(buckets, row_ids):
+            vb = jnp.asarray(bucket["values"])           # [r, s]
+            ib = jnp.asarray(bucket["indices"])          # [r, s]
+            # Gather [r, s, k], contract the slot axis.
+            out[rows] = np.asarray(
+                jnp.einsum("rs,rsk->rk", vb, coef_t[ib])
+            )
+        return out
+    coef_j = jnp.asarray(coef, jnp.float32)
+    out = np.empty(n, dtype=np.float32)
     for bucket, rows in zip(buckets, row_ids):
         vb = jnp.asarray(bucket["values"])
         ib = jnp.asarray(bucket["indices"])
-        out[rows] = np.asarray(jnp.sum(vb * coef[ib], axis=1))
+        out[rows] = np.asarray(jnp.sum(vb * coef_j[ib], axis=1))
     return out
 
 

@@ -217,13 +217,116 @@ def test_get_set_model_data():
 
 def test_validation_errors():
     table = reference_train_table()
-    with pytest.raises(ValueError, match="multinomial"):
-        LogisticRegression().set_multi_class("multinomial").fit(table)
     bad = Table({"features": np.ones((3, 2)), "label": np.array([0.0, 1.0, 2.0])})
     with pytest.raises(ValueError, match="labels"):
-        LogisticRegression().fit(bad)
+        # Forced binomial on 3 classes must reject.
+        LogisticRegression().set_multi_class("binomial").fit(bad)
+    frac = Table({"features": np.ones((3, 2)), "label": np.array([0.0, 1.5, 2.0])})
+    with pytest.raises(ValueError, match="integer labels"):
+        LogisticRegression().set_multi_class("multinomial").fit(frac)
     with pytest.raises(ValueError):
         LogisticRegressionModel().transform(table)  # no model data
+
+
+def test_multinomial_softmax_matches_sklearn(rng):
+    """multiClass='auto' on >2 classes trains a softmax [k, d] model;
+    probabilities match sklearn's multinomial optimum."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    n, d, k = 450, 6, 3
+    x = rng.normal(size=(n, d))
+    beta = rng.normal(size=(k, d))
+    # Heavy class noise keeps the optimum finite and well-conditioned so
+    # full-batch GD and sklearn's lbfgs land on the same point.
+    y = np.argmax(x @ beta.T + rng.normal(scale=2.0, size=(n, k)), axis=1)
+    t = Table({"features": x, "label": y.astype(np.float64)})
+    model = (
+        LogisticRegression().set_seed(0).set_max_iter(8000)
+        .set_global_batch_size(n).set_learning_rate(2.0).set_tol(0.0)
+        .fit(t)
+    )
+    assert model.coefficient.shape == (k, d)
+    (out,) = model.transform(t)
+    assert out["rawPrediction"].shape == (n, k)
+    np.testing.assert_allclose(out["rawPrediction"].sum(axis=1), 1.0, atol=1e-6)
+
+    sk = SkLR(C=np.inf, fit_intercept=False, max_iter=5000, tol=1e-10).fit(x, y)
+    sk_proba = sk.predict_proba(x)
+    np.testing.assert_allclose(
+        np.asarray(out["rawPrediction"]), sk_proba, atol=5e-3
+    )
+    acc = np.mean(out["prediction"] == y)
+    assert acc >= sk.score(x, y) - 0.02
+
+
+def test_multinomial_save_load_and_model_data(rng, tmp_path):
+    x = rng.normal(size=(90, 4))
+    y = rng.integers(0, 3, 90).astype(np.float64)
+    t = Table({"features": x, "label": y})
+    model = (
+        LogisticRegression().set_seed(1).set_max_iter(50)
+        .set_global_batch_size(90).fit(t)
+    )
+    p = str(tmp_path / "softmax")
+    model.save(p)
+    loaded = LogisticRegressionModel.load(p)
+    np.testing.assert_array_equal(loaded.coefficient, model.coefficient)
+    other = LogisticRegressionModel().set_model_data(*model.get_model_data())
+    np.testing.assert_array_equal(other.coefficient, model.coefficient)
+    (a,) = model.transform(t)
+    (b,) = loaded.transform(t)
+    np.testing.assert_array_equal(a["prediction"], b["prediction"])
+
+
+def test_multinomial_two_classes_agrees_with_binomial(rng):
+    """Forced multinomial on 2 classes: probabilities agree with the
+    binomial model (softmax with k=2 ≡ sigmoid on the margin diff)."""
+    x = rng.normal(size=(200, 5))
+    # Noisy labels -> finite optimum; at the optimum softmax(k=2) and
+    # the binomial sigmoid agree exactly.
+    y = (x[:, 0] + 1.5 * rng.normal(size=200) > 0).astype(np.float64)
+    t = Table({"features": x, "label": y})
+    kw = lambda: (LogisticRegression().set_seed(0).set_max_iter(6000)
+                  .set_tol(0.0).set_global_batch_size(200)
+                  .set_learning_rate(1.0))
+    softmax_m = kw().set_multi_class("multinomial").fit(t)
+    binom_m = kw().set_multi_class("binomial").fit(t)
+    (a,) = softmax_m.transform(t)
+    (b,) = binom_m.transform(t)
+    np.testing.assert_array_equal(a["prediction"], b["prediction"])
+    np.testing.assert_allclose(
+        a["rawPrediction"][:, 1], b["rawPrediction"][:, 1], atol=5e-3
+    )
+
+
+def test_multinomial_stream_fit_rejected():
+    src = iter([Table({"features": np.ones((4, 2)),
+                       "label": np.zeros(4)})])
+    with pytest.raises(ValueError, match="streamed"):
+        LogisticRegression().set_multi_class("multinomial").fit(src)
+
+
+def test_auto_stream_with_multiclass_labels_says_streamed(rng):
+    """'auto' + >2-class streamed data: the error names the streamed-fit
+    limitation, not just binomial labels."""
+    x = rng.normal(size=(8, 2))
+    src = iter([Table({"features": x,
+                       "label": np.array([0.0, 1, 2, 0, 1, 2, 0, 1])})])
+    with pytest.raises(ValueError, match="streamed"):
+        LogisticRegression().fit(src)
+
+
+def test_multinomial_labels_must_cover_classes(rng):
+    x = rng.normal(size=(6, 2))
+    # Missing class 0 (labels 1..3): phantom-class guard.
+    t = Table({"features": x, "label": np.array([1.0, 2, 3, 1, 2, 3])})
+    with pytest.raises(ValueError, match="covering 0..k-1"):
+        LogisticRegression().fit(t)
+    # One absurd outlier label: must not allocate a [500001, d] model.
+    t2 = Table({"features": x,
+                "label": np.array([0.0, 1, 2, 0, 1, 500000.0])})
+    with pytest.raises(ValueError, match="covering 0..k-1"):
+        LogisticRegression().fit(t2)
 
 
 def test_in_pipeline(tmp_path):
