@@ -85,8 +85,11 @@ class KnnModel(_KnnParams, Model):
         self._require_model()
         k = self.get(_KnnParams.K)
         n_train = self._features.shape[0]
-        if k > n_train:
-            raise ValueError(f"k={k} exceeds number of train points {n_train}")
+        if n_train == 0:
+            raise ValueError("Knn model has no training points")
+        # Reference parity: KnnModel's top-k priority queue simply holds
+        # all n points when k > n — vote among everything, don't raise.
+        k = min(k, n_train)
         x = features_matrix(table, self.get(_KnnParams.FEATURES_COL))
 
         # Map labels to dense class ids for the one-hot vote.

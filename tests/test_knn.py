@@ -43,10 +43,16 @@ def test_against_sklearn(train_table, rng):
     assert agreement >= 0.95  # ties may break differently
 
 
-def test_k_larger_than_train_raises(train_table):
-    model = Knn().set_k(200).fit(train_table)
-    with pytest.raises(ValueError, match="k="):
-        model.transform(Table({"features": np.zeros((1, 2))}))
+def test_k_larger_than_train_votes_among_all(rng):
+    """Reference parity: KnnModel's top-k queue holds all n points when
+    k > n — it votes among everything rather than raising. An actual
+    majority class (5 vs 8) gives the assertion power: a broken clamp
+    (e.g. k=0 voting) would predict class 0 instead."""
+    x = rng.normal(size=(13, 2))
+    y = np.array([0.0] * 5 + [1.0] * 8)
+    model = Knn().set_k(200).fit(Table({"features": x, "label": y}))
+    (out,) = model.transform(Table({"features": np.zeros((3, 2))}))
+    np.testing.assert_array_equal(out["prediction"], [1.0, 1.0, 1.0])
 
 
 def test_chunked_queries(train_table, rng):
