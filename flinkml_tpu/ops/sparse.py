@@ -131,6 +131,11 @@ class BatchedCSR:
         )
 
 
+# Elements per scoring dispatch (~64 MB of f32 working set); module-level
+# so tests can shrink it to force the multi-chunk path.
+_SCORING_CHUNK_ELEMS = 16 << 20
+
+
 def sparse_margins(vectors: Sequence[SparseVector], coef,
                    max_buckets: int = 4) -> np.ndarray:
     """Row-wise dots ``X @ coef`` for SparseVector rows, skew-proof.
@@ -161,23 +166,29 @@ def sparse_margins(vectors: Sequence[SparseVector], coef,
         dtype=np.float32,
     )
     n = indptr.size - 1
-    if coef.ndim == 2:
-        coef_t = jnp.asarray(coef.T, jnp.float32)       # [d, k]
-        out = np.empty((n, coef.shape[0]), dtype=np.float32)
-        for bucket, rows in zip(buckets, row_ids):
-            vb = jnp.asarray(bucket["values"])           # [r, s]
-            ib = jnp.asarray(bucket["indices"])          # [r, s]
-            # Gather [r, s, k], contract the slot axis.
-            out[rows] = np.asarray(
-                jnp.einsum("rs,rsk->rk", vb, coef_t[ib])
-            )
-        return out
-    coef_j = jnp.asarray(coef, jnp.float32)
-    out = np.empty(n, dtype=np.float32)
+    multinomial = coef.ndim == 2
+    k = coef.shape[0] if multinomial else 1
+    coef_dev = jnp.asarray(coef.T if multinomial else coef, jnp.float32)
+    out = np.empty((n, k) if multinomial else n, dtype=np.float32)
     for bucket, rows in zip(buckets, row_ids):
-        vb = jnp.asarray(bucket["values"])
-        ib = jnp.asarray(bucket["indices"])
-        out[rows] = np.asarray(jnp.sum(vb * coef_j[ib], axis=1))
+        width = bucket["indices"].shape[1]
+        # The per-dispatch working set ([chunk, slots] values + indices +
+        # the gathered coefficients) is bounded so scoring a million-row
+        # batch cannot blow host/HBM memory, on either branch.
+        chunk = max(1, _SCORING_CHUNK_ELEMS // max(1, width * k))
+        for lo in range(0, rows.size, chunk):
+            sl = slice(lo, lo + chunk)
+            vb = jnp.asarray(bucket["values"][sl])       # [c, s]
+            ib = jnp.asarray(bucket["indices"][sl])      # [c, s]
+            if multinomial:
+                # Gather [c, s, k], contract the slot axis.
+                out[rows[sl]] = np.asarray(
+                    jnp.einsum("rs,rsk->rk", vb, coef_dev[ib])
+                )
+            else:
+                out[rows[sl]] = np.asarray(
+                    jnp.sum(vb * coef_dev[ib], axis=1)
+                )
     return out
 
 

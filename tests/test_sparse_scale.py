@@ -240,6 +240,33 @@ def test_sparse_margins_bucketed_inference(rng):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_sparse_margins_multichunk_both_shapes(rng, monkeypatch):
+    """Force the chunk loop to run many times (budget of 64 elements) and
+    check both coefficient shapes stay exact — the path production hits
+    at million-row scoring batches."""
+    from flinkml_tpu.linalg import Vectors
+    from flinkml_tpu.ops import sparse as sparse_mod
+
+    monkeypatch.setattr(sparse_mod, "_SCORING_CHUNK_ELEMS", 64)
+    dim, n, k = 300, 120, 3
+    vecs, dense = [], []
+    for i in range(n):
+        nnz = 20 if i % 7 == 0 else 4   # two buckets
+        idx = np.sort(rng.choice(dim, size=nnz, replace=False))
+        val = rng.normal(size=nnz)
+        vecs.append(Vectors.sparse(dim, idx, val))
+        row = np.zeros(dim)
+        row[idx] = val
+        dense.append(row)
+    X = np.stack(dense)
+    coef1 = rng.normal(size=dim)
+    coef2 = rng.normal(size=(k, dim))
+    got1 = sparse_mod.sparse_margins(vecs, coef1)
+    got2 = sparse_mod.sparse_margins(vecs, coef2)
+    np.testing.assert_allclose(got1, X @ coef1, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(got2, X @ coef2.T, rtol=2e-4, atol=2e-4)
+
+
 def test_estimator_sparse_vectors_use_bucketed_path(rng):
     """End-to-end through the public API with SparseVector rows of very
     different nnz — exercises csr_from_sparse_vectors + bucketing."""
