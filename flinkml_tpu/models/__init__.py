@@ -22,6 +22,11 @@ from flinkml_tpu.models.scalers import (
     StandardScaler,
     StandardScalerModel,
 )
+from flinkml_tpu.models.string_indexer import (
+    IndexToStringModel,
+    StringIndexer,
+    StringIndexerModel,
+)
 from flinkml_tpu.models.vector_assembler import VectorAssembler
 from flinkml_tpu.models.evaluation import BinaryClassificationEvaluator
 
@@ -48,6 +53,9 @@ __all__ = [
     "StandardScalerModel",
     "MinMaxScaler",
     "MinMaxScalerModel",
+    "StringIndexer",
+    "StringIndexerModel",
+    "IndexToStringModel",
     "VectorAssembler",
     "BinaryClassificationEvaluator",
 ]

@@ -1,0 +1,171 @@
+"""StringIndexer / IndexToString: ordering, handleInvalid, persistence."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import (
+    IndexToStringModel,
+    StringIndexer,
+    StringIndexerModel,
+)
+from flinkml_tpu.table import Table
+
+
+def _table():
+    return Table({
+        "color": np.asarray(["b", "a", "b", "c", "b", "a"]),
+        "size": np.asarray([2.0, 1.0, 2.0, 2.0, 3.0, 1.0]),
+    })
+
+
+def _indexer(order="arbitrary", handle="error"):
+    return (
+        StringIndexer()
+        .set_input_cols(["color", "size"])
+        .set_output_cols(["colorIdx", "sizeIdx"])
+        .set_string_order_type(order)
+        .set_handle_invalid(handle)
+    )
+
+
+def test_frequency_desc_ordering():
+    model = _indexer("frequencyDesc").fit(_table())
+    (out,) = model.transform(_table())
+    # color counts: b=3, a=2, c=1 -> b:0, a:1, c:2
+    np.testing.assert_array_equal(
+        out.column("colorIdx"), [0, 1, 0, 2, 0, 1]
+    )
+    # size counts: 2.0=3, 1.0=2, 3.0=1 -> 2.0:0, 1.0:1, 3.0:2
+    np.testing.assert_array_equal(out.column("sizeIdx"), [0, 1, 0, 0, 2, 1])
+
+
+def test_frequency_asc_and_tie_break():
+    t = Table({"c": np.asarray(["y", "x", "y", "x", "z"])})
+    model = (
+        StringIndexer()
+        .set_input_cols(["c"]).set_output_cols(["i"])
+        .set_string_order_type("frequencyAsc")
+        .fit(t)
+    )
+    (out,) = model.transform(t)
+    # counts: x=2, y=2, z=1 -> z:0, then tie x before y (value ascending)
+    np.testing.assert_array_equal(out.column("i"), [2, 1, 2, 1, 0])
+
+
+def test_alphabet_orders():
+    t = _table()
+    asc = _indexer("alphabetAsc").fit(t).transform(t)[0]
+    np.testing.assert_array_equal(asc.column("colorIdx"), [1, 0, 1, 2, 1, 0])
+    desc = _indexer("alphabetDesc").fit(t).transform(t)[0]
+    np.testing.assert_array_equal(desc.column("colorIdx"), [1, 2, 1, 0, 1, 2])
+    # Numeric columns order by value, not by string representation.
+    t2 = Table({"v": np.asarray([10.0, 2.0, 10.0])})
+    m = (
+        StringIndexer().set_input_cols(["v"]).set_output_cols(["i"])
+        .set_string_order_type("alphabetAsc").fit(t2)
+    )
+    np.testing.assert_array_equal(m.transform(t2)[0].column("i"), [1, 0, 1])
+
+
+def test_handle_invalid_error():
+    model = _indexer().fit(_table())
+    bad = Table({
+        "color": np.asarray(["a", "UNSEEN"]),
+        "size": np.asarray([1.0, 2.0]),
+    })
+    with pytest.raises(ValueError, match="UNSEEN"):
+        model.transform(bad)
+
+
+def test_handle_invalid_skip_drops_whole_row():
+    model = _indexer(handle="skip").fit(_table())
+    bad = Table({
+        "color": np.asarray(["a", "UNSEEN", "c"]),
+        "size": np.asarray([1.0, 2.0, 99.0]),
+    })
+    (out,) = model.transform(bad)
+    # row 1 (unseen color) and row 2 (unseen size) both dropped
+    assert out.num_rows == 1
+    np.testing.assert_array_equal(out.column("color"), ["a"])
+
+
+def test_handle_invalid_keep_maps_to_catch_all():
+    model = _indexer(handle="keep", order="alphabetAsc").fit(_table())
+    bad = Table({
+        "color": np.asarray(["a", "UNSEEN"]),
+        "size": np.asarray([99.0, 2.0]),
+    })
+    (out,) = model.transform(bad)
+    np.testing.assert_array_equal(out.column("colorIdx"), [0.0, 3.0])
+    np.testing.assert_array_equal(out.column("sizeIdx"), [3.0, 1.0])
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = _indexer("frequencyDesc").fit(_table())
+    model.save(str(tmp_path / "si"))
+    loaded = StringIndexerModel.load(str(tmp_path / "si"))
+    t = _table()
+    np.testing.assert_array_equal(
+        loaded.transform(t)[0].column("colorIdx"),
+        model.transform(t)[0].column("colorIdx"),
+    )
+    assert loaded.get(StringIndexerModel.STRING_ORDER_TYPE) == "frequencyDesc"
+
+
+def test_model_data_roundtrip():
+    model = _indexer("frequencyDesc").fit(_table())
+    clone = StringIndexerModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    t = _table()
+    np.testing.assert_array_equal(
+        clone.transform(t)[0].column("sizeIdx"),
+        model.transform(t)[0].column("sizeIdx"),
+    )
+
+
+def test_index_to_string_inverts(tmp_path):
+    indexer = _indexer("frequencyDesc").fit(_table())
+    (indexed,) = indexer.transform(_table())
+    inv = IndexToStringModel.from_indexer(indexer)
+    inv.set_input_cols(["colorIdx", "sizeIdx"]).set_output_cols(["color2", "size2"])
+    (out,) = inv.transform(indexed)
+    np.testing.assert_array_equal(out.column("color2"), _table().column("color"))
+    np.testing.assert_array_equal(
+        out.column("size2").astype(float), _table().column("size")
+    )
+    # persistence of the inverse model
+    inv.save(str(tmp_path / "i2s"))
+    loaded = IndexToStringModel.load(str(tmp_path / "i2s"))
+    np.testing.assert_array_equal(
+        loaded.transform(indexed)[0].column("color2"), out.column("color2")
+    )
+
+
+def test_index_to_string_rejects_bad_indices():
+    indexer = _indexer().fit(_table())
+    inv = IndexToStringModel.from_indexer(indexer)
+    inv.set_input_cols(["i", "j"]).set_output_cols(["o1", "o2"])
+    bad = Table({"i": np.asarray([5.0]), "j": np.asarray([0.0])})
+    with pytest.raises(ValueError, match="outside"):
+        inv.transform(bad)
+    frac = Table({"i": np.asarray([0.5]), "j": np.asarray([0.0])})
+    with pytest.raises(ValueError, match="non-integral"):
+        inv.transform(frac)
+
+
+def test_chains_into_one_hot():
+    from flinkml_tpu.models import OneHotEncoder
+
+    t = _table()
+    indexer = _indexer("frequencyDesc").fit(t)
+    (indexed,) = indexer.transform(t)
+    enc = (
+        OneHotEncoder()
+        .set_input_cols(["colorIdx"]).set_output_cols(["colorVec"])
+        .fit(indexed)
+    )
+    (out,) = enc.transform(indexed)
+    vec = out.column("colorVec")
+    assert vec.shape == (6, 2)  # 3 categories, dropLast
+    np.testing.assert_array_equal(vec[0], [1.0, 0.0])  # "b" -> idx 0
