@@ -17,11 +17,24 @@ from flinkml_tpu.models.online_logistic_regression import (
     OnlineLogisticRegressionModel,
 )
 from flinkml_tpu.models.scalers import (
+    MaxAbsScaler,
+    MaxAbsScalerModel,
     MinMaxScaler,
     MinMaxScalerModel,
+    RobustScaler,
+    RobustScalerModel,
     StandardScaler,
     StandardScalerModel,
 )
+from flinkml_tpu.models.feature_transforms import (
+    Binarizer,
+    Bucketizer,
+    ElementwiseProduct,
+    Normalizer,
+    PolynomialExpansion,
+    VectorSlicer,
+)
+from flinkml_tpu.models.imputer import Imputer, ImputerModel
 from flinkml_tpu.models.string_indexer import (
     IndexToStringModel,
     StringIndexer,
@@ -53,6 +66,18 @@ __all__ = [
     "StandardScalerModel",
     "MinMaxScaler",
     "MinMaxScalerModel",
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
+    "RobustScaler",
+    "RobustScalerModel",
+    "Normalizer",
+    "ElementwiseProduct",
+    "VectorSlicer",
+    "PolynomialExpansion",
+    "Binarizer",
+    "Bucketizer",
+    "Imputer",
+    "ImputerModel",
     "StringIndexer",
     "StringIndexerModel",
     "IndexToStringModel",

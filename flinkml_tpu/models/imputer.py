@@ -1,0 +1,132 @@
+"""Imputer — replace missing values in scalar columns with a fitted
+surrogate (mean / median / most frequent).
+
+Beyond the reference snapshot but a standard member of the wider Flink ML
+operator family. Missing = ``missingValue`` (default NaN; NaN always
+counts as missing). Surrogates are per-column host statistics: the
+columns are host-resident and the statistic is one vectorized pass, so
+there is no device work to ship. ``mostFrequent`` ties break by smallest
+value (deterministic).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import HasInputCols, HasOutputCols
+from flinkml_tpu.params import FloatParam, ParamValidators, StringParam
+from flinkml_tpu.table import Table
+
+MEAN = "mean"
+MEDIAN = "median"
+MOST_FREQUENT = "mostFrequent"
+
+
+class _ImputerParams(HasInputCols, HasOutputCols):
+    STRATEGY = StringParam(
+        "strategy", "Imputation strategy.", MEAN,
+        ParamValidators.in_array([MEAN, MEDIAN, MOST_FREQUENT]),
+    )
+    MISSING_VALUE = FloatParam(
+        "missingValue",
+        "The placeholder that marks a value as missing (NaN always does).",
+        float("nan"),
+    )
+
+
+def _missing_mask(values: np.ndarray, missing_value: float) -> np.ndarray:
+    mask = np.isnan(values)
+    if not np.isnan(missing_value):
+        mask |= values == missing_value
+    return mask
+
+
+class Imputer(_ImputerParams, Estimator):
+    def fit(self, *inputs: Table) -> "ImputerModel":
+        (table,) = inputs
+        input_cols = self.get(self.INPUT_COLS)
+        if not input_cols:
+            raise ValueError("inputCols must be set")
+        strategy = self.get(self.STRATEGY)
+        missing_value = self.get(self.MISSING_VALUE)
+        surrogates = []
+        for col in input_cols:
+            values = np.asarray(table.column(col), dtype=np.float64)
+            if values.ndim != 1:
+                raise ValueError(
+                    f"Column {col!r} must be scalar, has shape {values.shape}"
+                )
+            present = values[~_missing_mask(values, missing_value)]
+            if present.size == 0:
+                raise ValueError(
+                    f"Column {col!r} has no non-missing values to fit from"
+                )
+            if strategy == MEAN:
+                surrogates.append(float(present.mean()))
+            elif strategy == MEDIAN:
+                surrogates.append(float(np.median(present)))
+            else:  # mostFrequent; np.unique is ascending -> smallest wins ties
+                uniq, counts = np.unique(present, return_counts=True)
+                surrogates.append(float(uniq[np.argmax(counts)]))
+        model = ImputerModel()
+        model.copy_params_from(self)
+        model.set_model_data(
+            Table({"surrogate": np.asarray(surrogates)[None, :]})
+        )
+        return model
+
+
+class ImputerModel(_ImputerParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._surrogates: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "ImputerModel":
+        (table,) = inputs
+        self._surrogates = np.asarray(table.column("surrogate"), np.float64)[0]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({"surrogate": self._surrogates[None, :]})]
+
+    def _require(self) -> None:
+        if self._surrogates is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        input_cols = self.get(self.INPUT_COLS)
+        output_cols = self.get(self.OUTPUT_COLS)
+        if len(input_cols) != len(output_cols):
+            raise ValueError(
+                f"{len(input_cols)} input columns vs {len(output_cols)} output columns"
+            )
+        if len(input_cols) != len(self._surrogates):
+            raise ValueError(
+                f"model was fit on {len(self._surrogates)} columns, "
+                f"got {len(input_cols)}"
+            )
+        missing_value = self.get(self.MISSING_VALUE)
+        out = table
+        for col, out_col, surrogate in zip(
+            input_cols, output_cols, self._surrogates
+        ):
+            values = np.asarray(table.column(col), dtype=np.float64)
+            mask = _missing_mask(values, missing_value)
+            out = out.with_column(out_col, np.where(mask, surrogate, values))
+        return (out,)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(path, {"surrogate": self._surrogates})
+
+    @classmethod
+    def load(cls, path: str) -> "ImputerModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._surrogates = arrays["surrogate"]
+        return model

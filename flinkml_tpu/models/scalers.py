@@ -1,4 +1,5 @@
-"""Feature scaling stages: StandardScaler and MinMaxScaler.
+"""Feature scaling stages: StandardScaler, MinMaxScaler, MaxAbsScaler,
+RobustScaler.
 
 Beyond the reference snapshot (whose only feature stage is OneHotEncoder,
 SURVEY.md §2.3) but standard members of the wider Flink ML operator family;
@@ -23,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 from flinkml_tpu.api import Estimator, Model
 from flinkml_tpu.common_params import HasInputCol, HasOutputCol
 from flinkml_tpu.models._data import features_matrix
-from flinkml_tpu.params import BoolParam, FloatParam
+from flinkml_tpu.params import BoolParam, FloatParam, ParamValidators
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
 
@@ -257,4 +258,161 @@ class MinMaxScalerModel(_HasInputOutputCol, Model):
         model, arrays, _ = cls._load_with_arrays(path)
         model._data_min = arrays["dataMin"]
         model._data_max = arrays["dataMax"]
+        return model
+
+
+class MaxAbsScaler(_HasInputOutputCol, Estimator):
+    """Scale each feature into [-1, 1] by its max absolute value.
+
+    The fit statistic reuses the sharded extrema pass (per-device
+    min/max + pmin/pmax over the mesh): max|x| = max(|min|, |max|).
+    """
+
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "MaxAbsScalerModel":
+        (table,) = inputs
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        mesh = self.mesh or DeviceMesh()
+        xd, wd = _shard_with_mask(x, mesh)
+        lo, hi = _extrema_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(xd, wd)
+        max_abs = np.maximum(
+            np.abs(np.asarray(lo, np.float64)), np.abs(np.asarray(hi, np.float64))
+        )
+        model = MaxAbsScalerModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"maxAbs": max_abs[None, :]}))
+        return model
+
+
+class MaxAbsScalerModel(_HasInputOutputCol, Model):
+    def __init__(self):
+        super().__init__()
+        self._max_abs: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "MaxAbsScalerModel":
+        (table,) = inputs
+        self._max_abs = np.asarray(table.column("maxAbs"), np.float64)[0]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({"maxAbs": self._max_abs[None, :]})]
+
+    def _require(self) -> None:
+        if self._max_abs is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        safe = np.where(self._max_abs > 0, self._max_abs, 1.0)
+        return (table.with_column(self.get(self.OUTPUT_COL), x / safe),)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(path, {"maxAbs": self._max_abs})
+
+    @classmethod
+    def load(cls, path: str) -> "MaxAbsScalerModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._max_abs = arrays["maxAbs"]
+        return model
+
+
+class RobustScaler(_HasInputOutputCol, Estimator):
+    """Scale by quantile range (robust to outliers): optionally center by
+    the median, scale by ``quantile(upper) - quantile(lower)``.
+
+    Quantiles are exact, computed on the host: per-feature quantiles of
+    an in-RAM column are one vectorized ``np.quantile`` pass — a
+    distributed sketch would add error without saving a device
+    round-trip (the data starts host-resident).
+    """
+
+    LOWER = FloatParam(
+        "lower", "Lower quantile of the scaling range.", 0.25,
+        ParamValidators.in_range(0.0, 1.0),
+    )
+    UPPER = FloatParam(
+        "upper", "Upper quantile of the scaling range.", 0.75,
+        ParamValidators.in_range(0.0, 1.0),
+    )
+    WITH_CENTERING = BoolParam(
+        "withCentering", "Whether to subtract the median.", False
+    )
+    WITH_SCALING = BoolParam(
+        "withScaling", "Whether to divide by the quantile range.", True
+    )
+
+    def fit(self, *inputs: Table) -> "RobustScalerModel":
+        (table,) = inputs
+        lower, upper = self.get(self.LOWER), self.get(self.UPPER)
+        if lower >= upper:
+            raise ValueError(f"lower {lower} must be < upper {upper}")
+        x = features_matrix(table, self.get(self.INPUT_COL)).astype(np.float64)
+        median = np.quantile(x, 0.5, axis=0)
+        q_lo = np.quantile(x, lower, axis=0)
+        q_hi = np.quantile(x, upper, axis=0)
+        model = RobustScalerModel()
+        model.copy_params_from(self)
+        model.set_model_data(
+            Table({"median": median[None, :], "range": (q_hi - q_lo)[None, :]})
+        )
+        return model
+
+
+class RobustScalerModel(_HasInputOutputCol, Model):
+    LOWER = RobustScaler.LOWER
+    UPPER = RobustScaler.UPPER
+    WITH_CENTERING = RobustScaler.WITH_CENTERING
+    WITH_SCALING = RobustScaler.WITH_SCALING
+
+    def __init__(self):
+        super().__init__()
+        self._median: Optional[np.ndarray] = None
+        self._range: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "RobustScalerModel":
+        (table,) = inputs
+        self._median = np.asarray(table.column("median"), np.float64)[0]
+        self._range = np.asarray(table.column("range"), np.float64)[0]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({
+            "median": self._median[None, :], "range": self._range[None, :],
+        })]
+
+    def _require(self) -> None:
+        if self._median is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        out = x
+        if self.get(self.WITH_CENTERING):
+            out = out - self._median
+        if self.get(self.WITH_SCALING):
+            safe = np.where(self._range > 0, self._range, 1.0)
+            out = out / safe
+        return (table.with_column(self.get(self.OUTPUT_COL), out),)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(
+            path, {"median": self._median, "range": self._range}
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "RobustScalerModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._median = arrays["median"]
+        model._range = arrays["range"]
         return model

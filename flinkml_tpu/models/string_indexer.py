@@ -70,6 +70,13 @@ def _column_values(table: Table, col: str) -> np.ndarray:
 
 
 def _ordered_vocab(values: np.ndarray, order_type: str) -> np.ndarray:
+    if values.dtype.kind == "f":
+        # NaN can never be matched by the equality lookup, so it must not
+        # enter the vocabulary — NaN rows are handled by handleInvalid at
+        # transform time instead.
+        values = values[~np.isnan(values)]
+        if values.size == 0:
+            raise ValueError("column has no non-NaN values to index")
     uniq, counts = np.unique(values, return_counts=True)
     if order_type in (ARBITRARY, ALPHABET_ASC):
         return uniq  # np.unique is ascending — deterministic "arbitrary"
@@ -82,16 +89,26 @@ def _ordered_vocab(values: np.ndarray, order_type: str) -> np.ndarray:
     return uniq[np.argsort(counts, kind="stable")]
 
 
-def _lookup(values: np.ndarray, vocab: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Vectorized vocab lookup: returns (indices, found_mask); indices are
-    valid only where found."""
-    if vocab.dtype.kind in "US" or values.dtype.kind in "US":
-        vocab = np.asarray(vocab, dtype=str)
-        values = np.asarray(values, dtype=str)
+def _sorted_lookup_table(vocab: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Precompute (sorted_vocab, order) once per fitted column."""
     order = np.argsort(vocab, kind="stable")
-    sorted_vocab = vocab[order]
+    return vocab[order], order
+
+
+def _lookup(
+    values: np.ndarray, sorted_vocab: np.ndarray, order: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized vocab lookup: returns (indices, found_mask); indices are
+    valid only where found. NaN values never match (vocabularies are
+    NaN-free by construction)."""
+    if len(sorted_vocab) == 0:
+        z = np.zeros(len(values), dtype=np.int64)
+        return z, np.zeros(len(values), dtype=bool)
+    if sorted_vocab.dtype.kind in "US" or values.dtype.kind in "US":
+        sorted_vocab = np.asarray(sorted_vocab, dtype=str)
+        values = np.asarray(values, dtype=str)
     pos = np.searchsorted(sorted_vocab, values)
-    pos_clipped = np.minimum(pos, len(vocab) - 1)
+    pos_clipped = np.minimum(pos, len(sorted_vocab) - 1)
     found = sorted_vocab[pos_clipped] == values
     return order[pos_clipped], found
 
@@ -125,9 +142,13 @@ class _VocabModelBase(_StringIndexerParams, Model):
     def __init__(self):
         super().__init__()
         self._vocabs: Optional[List[np.ndarray]] = None
+        self._lookup_tables: List[Tuple[np.ndarray, np.ndarray]] = []
 
     def _set_vocabs(self, vocabs: List[np.ndarray]) -> None:
         self._vocabs = [np.asarray(v) for v in vocabs]
+        # (sorted_vocab, order) per column, fixed at fit time so transform
+        # never re-sorts a (possibly high-cardinality) vocabulary.
+        self._lookup_tables = [_sorted_lookup_table(v) for v in self._vocabs]
 
     def set_model_data(self, *inputs: Table):
         (table,) = inputs
@@ -186,9 +207,11 @@ class StringIndexerModel(_VocabModelBase):
         self._check_columns(input_cols, output_cols)
         out = table
         keep_mask = np.ones(table.num_rows, dtype=bool)
-        for col, out_col, vocab in zip(input_cols, output_cols, self._vocabs):
+        for col, out_col, vocab, (sorted_vocab, order) in zip(
+            input_cols, output_cols, self._vocabs, self._lookup_tables
+        ):
             values = _column_values(table, col)
-            idx, found = _lookup(values, vocab)
+            idx, found = _lookup(values, sorted_vocab, order)
             if handle_invalid == HasHandleInvalid.ERROR_INVALID:
                 if not found.all():
                     bad = np.asarray(values)[~found][:5]

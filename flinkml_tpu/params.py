@@ -171,6 +171,20 @@ class FloatArrayParam(Param[list]):
 DoubleArrayParam = FloatArrayParam
 
 
+class FloatArrayArrayParam(Param[list]):
+    """List-of-float-lists (the reference's DoubleArrayArrayParam), e.g.
+    per-column bucket split arrays."""
+
+    def json_encode(self, value: list) -> Any:
+        return [list(row) for row in value] if value is not None else None
+
+    def json_decode(self, json_value: Any) -> list:
+        return [[float(v) for v in row] for row in json_value]
+
+
+DoubleArrayArrayParam = FloatArrayArrayParam
+
+
 class StringArrayParam(Param[list]):
     def json_encode(self, value: list) -> Any:
         return list(value) if value is not None else None

@@ -1,0 +1,282 @@
+"""Normalizer / ElementwiseProduct / VectorSlicer / PolynomialExpansion /
+Binarizer / Bucketizer / MaxAbsScaler / RobustScaler / Imputer vs sklearn
++ semantics."""
+
+import numpy as np
+import pytest
+from sklearn.preprocessing import (
+    Binarizer as SkBinarizer,
+    KBinsDiscretizer,
+    MaxAbsScaler as SkMaxAbs,
+    Normalizer as SkNormalizer,
+    PolynomialFeatures,
+    RobustScaler as SkRobust,
+)
+
+from flinkml_tpu.models import (
+    Binarizer,
+    Bucketizer,
+    ElementwiseProduct,
+    Imputer,
+    ImputerModel,
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    Normalizer,
+    PolynomialExpansion,
+    RobustScaler,
+    RobustScalerModel,
+    VectorSlicer,
+)
+from flinkml_tpu.table import Table
+
+
+def _x(n=57, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(loc=1.0, scale=3.0, size=(n, d))
+
+
+# -- Normalizer --------------------------------------------------------------
+
+@pytest.mark.parametrize("p", [1.0, 2.0, 3.0, float("inf")])
+def test_normalizer_matches_sklearn(p):
+    x = _x()
+    t = Table({"input": x})
+    norm = {1.0: "l1", 2.0: "l2", float("inf"): "max"}.get(p)
+    (out,) = Normalizer().set(Normalizer.P, p).transform(t)
+    if norm is not None:
+        ref = SkNormalizer(norm=norm).fit_transform(x)
+    else:
+        ref = x / np.linalg.norm(x, ord=3, axis=1, keepdims=True)
+    np.testing.assert_allclose(out.column("output"), ref, rtol=1e-12)
+
+
+def test_normalizer_zero_row_stays_zero():
+    t = Table({"input": np.zeros((3, 2))})
+    (out,) = Normalizer().transform(t)
+    np.testing.assert_array_equal(out.column("output"), np.zeros((3, 2)))
+
+
+# -- ElementwiseProduct / VectorSlicer ---------------------------------------
+
+def test_elementwise_product():
+    x = _x(d=3)
+    t = Table({"input": x})
+    (out,) = (
+        ElementwiseProduct().set_scaling_vec([2.0, 0.0, -1.0]).transform(t)
+    )
+    np.testing.assert_allclose(
+        out.column("output"), x * np.array([2.0, 0.0, -1.0])
+    )
+    with pytest.raises(ValueError, match="dim"):
+        ElementwiseProduct().set_scaling_vec([1.0]).transform(t)
+
+
+def test_vector_slicer():
+    x = _x(d=5)
+    t = Table({"input": x})
+    (out,) = VectorSlicer().set_indices([3, 0, 3]).transform(t)
+    np.testing.assert_array_equal(out.column("output"), x[:, [3, 0, 3]])
+    with pytest.raises(ValueError, match="within"):
+        VectorSlicer().set_indices([5]).transform(t)
+
+
+# -- PolynomialExpansion -----------------------------------------------------
+
+def test_polynomial_expansion_matches_sklearn_as_set():
+    x = _x(n=11, d=3, seed=1)
+    t = Table({"input": x})
+    (out,) = PolynomialExpansion().set_degree(3).transform(t)
+    got = out.column("output")
+    ref = PolynomialFeatures(degree=3, include_bias=False).fit_transform(x)
+    assert got.shape == ref.shape
+    # Same monomial set (ordering differs from sklearn's) — compare as
+    # sorted column multisets row by row.
+    np.testing.assert_allclose(np.sort(got, axis=1), np.sort(ref, axis=1),
+                               rtol=1e-9)
+
+
+def test_polynomial_expansion_degree1_is_identity():
+    x = _x(n=5, d=2)
+    t = Table({"input": x})
+    (out,) = PolynomialExpansion().set_degree(1).transform(t)
+    np.testing.assert_array_equal(out.column("output"), x)
+
+
+# -- Binarizer ---------------------------------------------------------------
+
+def test_binarizer_scalar_and_vector():
+    x = _x(n=20, d=3, seed=2)
+    s = x[:, 0]
+    t = Table({"vec": x, "scalar": s})
+    (out,) = (
+        Binarizer()
+        .set_input_cols(["vec", "scalar"]).set_output_cols(["bv", "bs"])
+        .set_thresholds([0.5, 0.0])
+        .transform(t)
+    )
+    np.testing.assert_array_equal(
+        out.column("bv"), SkBinarizer(threshold=0.5).fit_transform(x)
+    )
+    np.testing.assert_array_equal(out.column("bs"), (s > 0).astype(float))
+
+
+# -- Bucketizer --------------------------------------------------------------
+
+def test_bucketizer_bins_match_kbins_edges():
+    rng = np.random.default_rng(3)
+    v = rng.uniform(0, 10, size=200)
+    kb = KBinsDiscretizer(n_bins=4, encode="ordinal", strategy="quantile")
+    ref = kb.fit_transform(v[:, None])[:, 0]
+    edges = kb.bin_edges_[0].copy()
+    edges[0], edges[-1] = -np.inf, np.inf
+    t = Table({"v": v})
+    (out,) = (
+        Bucketizer()
+        .set_input_cols(["v"]).set_output_cols(["b"])
+        .set_splits_array([list(edges)])
+        .transform(t)
+    )
+    np.testing.assert_array_equal(out.column("b"), ref)
+
+
+def test_bucketizer_edges_and_last_bucket_inclusive():
+    t = Table({"v": np.asarray([0.0, 1.0, 5.0, 10.0])})
+    (out,) = (
+        Bucketizer().set_input_cols(["v"]).set_output_cols(["b"])
+        .set_splits_array([[0.0, 1.0, 10.0]])
+        .transform(t)
+    )
+    # 0.0 -> bucket 0; 1.0 -> bucket 1 (left-inclusive); 10.0 -> last bucket
+    np.testing.assert_array_equal(out.column("b"), [0.0, 1.0, 1.0, 1.0])
+
+
+def test_bucketizer_handle_invalid():
+    t = Table({"v": np.asarray([0.5, -1.0, np.nan]),
+               "id": np.asarray([1.0, 2.0, 3.0])})
+    bkt = (
+        Bucketizer().set_input_cols(["v"]).set_output_cols(["b"])
+        .set_splits_array([[0.0, 1.0]])
+    )
+    with pytest.raises(ValueError, match="outside"):
+        bkt.transform(t)
+    (skipped,) = bkt.set_handle_invalid("skip").transform(t)
+    np.testing.assert_array_equal(skipped.column("id"), [1.0])
+    (kept,) = bkt.set_handle_invalid("keep").transform(t)
+    np.testing.assert_array_equal(kept.column("b"), [0.0, 1.0, 1.0])
+
+
+def test_bucketizer_rejects_bad_splits():
+    t = Table({"v": np.asarray([0.5])})
+    with pytest.raises(ValueError, match="strictly"):
+        (
+            Bucketizer().set_input_cols(["v"]).set_output_cols(["b"])
+            .set_splits_array([[1.0, 1.0]])
+            .transform(t)
+        )
+
+
+# -- MaxAbsScaler ------------------------------------------------------------
+
+def test_max_abs_scaler_matches_sklearn(tmp_path):
+    x = _x(seed=4)
+    x[:, 1] = 0.0  # all-zero feature: degenerate max-abs
+    t = Table({"input": x})
+    model = MaxAbsScaler().fit(t)
+    (out,) = model.transform(t)
+    ref = SkMaxAbs().fit_transform(x)
+    np.testing.assert_allclose(out.column("output"), ref, rtol=1e-5, atol=1e-6)
+    model.save(str(tmp_path / "mas"))
+    loaded = MaxAbsScalerModel.load(str(tmp_path / "mas"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0].column("output"), out.column("output")
+    )
+
+
+# -- RobustScaler ------------------------------------------------------------
+
+def test_robust_scaler_matches_sklearn(tmp_path):
+    x = _x(n=201, seed=5)
+    x[0] = 1e6  # outlier robustness is the point
+    t = Table({"input": x})
+    model = (
+        RobustScaler().set_with_centering(True).fit(t)
+    )
+    (out,) = model.transform(t)
+    ref = SkRobust(with_centering=True).fit_transform(x)
+    np.testing.assert_allclose(out.column("output"), ref, rtol=1e-7, atol=1e-9)
+    model.save(str(tmp_path / "rs"))
+    loaded = RobustScalerModel.load(str(tmp_path / "rs"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0].column("output"), out.column("output")
+    )
+
+
+def test_robust_scaler_flags_and_validation():
+    x = _x(n=50, seed=6)
+    t = Table({"input": x})
+    m = RobustScaler().set_with_scaling(False).set_with_centering(True).fit(t)
+    (out,) = m.transform(t)
+    np.testing.assert_allclose(
+        out.column("output"), x - np.median(x, axis=0), rtol=1e-12
+    )
+    with pytest.raises(ValueError, match="lower"):
+        RobustScaler().set_lower(0.8).set_upper(0.2).fit(t)
+
+
+# -- Imputer -----------------------------------------------------------------
+
+def test_imputer_strategies(tmp_path):
+    v1 = np.asarray([1.0, np.nan, 3.0, np.nan, 8.0])
+    v2 = np.asarray([2.0, 2.0, -1.0, 7.0, np.nan])
+    t = Table({"a": v1, "b": v2})
+
+    def impute(strategy):
+        return (
+            Imputer()
+            .set_input_cols(["a", "b"]).set_output_cols(["oa", "ob"])
+            .set_strategy(strategy)
+            .fit(t).transform(t)[0]
+        )
+
+    mean = impute("mean")
+    np.testing.assert_allclose(mean.column("oa")[1], (1 + 3 + 8) / 3)
+    np.testing.assert_allclose(mean.column("ob")[4], (2 + 2 - 1 + 7) / 4)
+    med = impute("median")
+    np.testing.assert_allclose(med.column("oa")[1], 3.0)
+    freq = impute("mostFrequent")
+    np.testing.assert_allclose(freq.column("ob")[4], 2.0)
+
+    model = (
+        Imputer().set_input_cols(["a", "b"]).set_output_cols(["oa", "ob"])
+        .fit(t)
+    )
+    model.save(str(tmp_path / "imp"))
+    loaded = ImputerModel.load(str(tmp_path / "imp"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0].column("oa"), model.transform(t)[0].column("oa")
+    )
+
+
+def test_imputer_custom_missing_value():
+    t = Table({"a": np.asarray([1.0, -999.0, 3.0])})
+    (out,) = (
+        Imputer().set_input_cols(["a"]).set_output_cols(["o"])
+        .set_missing_value(-999.0)
+        .fit(t).transform(t)[0],
+    )
+    np.testing.assert_allclose(out.column("o"), [1.0, 2.0, 3.0])
+
+
+def test_imputer_all_missing_errors():
+    t = Table({"a": np.asarray([np.nan, np.nan])})
+    with pytest.raises(ValueError, match="no non-missing"):
+        Imputer().set_input_cols(["a"]).set_output_cols(["o"]).fit(t)
+
+
+def test_most_frequent_tie_breaks_smallest():
+    t = Table({"a": np.asarray([5.0, 5.0, 2.0, 2.0, np.nan])})
+    (out,) = (
+        Imputer().set_input_cols(["a"]).set_output_cols(["o"])
+        .set_strategy("mostFrequent").fit(t).transform(t)
+    )
+    assert out.column("o")[4] == 2.0

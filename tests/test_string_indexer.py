@@ -169,3 +169,24 @@ def test_chains_into_one_hot():
     vec = out.column("colorVec")
     assert vec.shape == (6, 2)  # 3 categories, dropLast
     np.testing.assert_array_equal(vec[0], [1.0, 0.0])  # "b" -> idx 0
+
+
+def test_nan_excluded_from_vocab_and_handled_as_invalid():
+    t = Table({"v": np.asarray([1.0, np.nan, 1.0, 2.0, np.nan])})
+    model = (
+        StringIndexer().set_input_cols(["v"]).set_output_cols(["i"])
+        .set_string_order_type("frequencyDesc").fit(t)
+    )
+    # vocab is NaN-free: {1.0: 0, 2.0: 1}
+    with pytest.raises(ValueError, match="not seen"):
+        model.transform(t)
+    (kept,) = model.set_handle_invalid("keep").transform(t)
+    np.testing.assert_array_equal(kept.column("i"), [0.0, 2.0, 0.0, 1.0, 2.0])
+    (skipped,) = model.set_handle_invalid("skip").transform(t)
+    np.testing.assert_array_equal(skipped.column("i"), [0.0, 0.0, 1.0])
+
+
+def test_all_nan_column_rejected_at_fit():
+    t = Table({"v": np.asarray([np.nan, np.nan])})
+    with pytest.raises(ValueError, match="non-NaN"):
+        StringIndexer().set_input_cols(["v"]).set_output_cols(["i"]).fit(t)
