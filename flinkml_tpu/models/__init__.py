@@ -35,6 +35,16 @@ from flinkml_tpu.models.feature_transforms import (
     VectorSlicer,
 )
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
+from flinkml_tpu.models.pca import PCA, PCAModel
+from flinkml_tpu.models.text import (
+    CountVectorizer,
+    CountVectorizerModel,
+    HashingTF,
+    IDF,
+    IDFModel,
+    RegexTokenizer,
+    Tokenizer,
+)
 from flinkml_tpu.models.string_indexer import (
     IndexToStringModel,
     StringIndexer,
@@ -78,6 +88,15 @@ __all__ = [
     "Bucketizer",
     "Imputer",
     "ImputerModel",
+    "PCA",
+    "PCAModel",
+    "Tokenizer",
+    "RegexTokenizer",
+    "HashingTF",
+    "CountVectorizer",
+    "CountVectorizerModel",
+    "IDF",
+    "IDFModel",
     "StringIndexer",
     "StringIndexerModel",
     "IndexToStringModel",

@@ -1,0 +1,182 @@
+"""PCA — principal component analysis on the device mesh.
+
+Beyond the reference snapshot but a standard member of the wider operator
+family. TPU-native fit: the [d, d] covariance is accumulated as one
+sharded gram-matrix pass — each device computes its local
+``centered_xᵀ @ centered_x`` on the MXU and a single ``psum`` combines
+them over ICI (this is the allReduce-of-partials pattern the reference
+would express as mapPartition + AllReduce). The tiny [d, d] eigensolve
+then runs on the host in float64 (d ≪ n; an O(d³) host eigh is noise
+next to the O(n·d²) device pass, and f64 keeps close eigenvalues stable).
+
+Sign convention: each component is flipped so its max-|entry| is
+positive, making fitted models deterministic across runs and meshes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import HasInputCol, HasOutputCol
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.models.scalers import _shard_with_mask
+from flinkml_tpu.params import IntParam, ParamValidators
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+@functools.lru_cache(maxsize=32)
+def _mean_and_gram_fn(mesh, axis: str):
+    """One fused pass: masked count, per-feature sum, and centered gram.
+
+    Centering uses a caller-supplied shift (first row) so the f32 gram
+    accumulates small magnitudes; the exact mean correction happens on
+    the host in f64 (same shift-centering discipline as the scalers).
+    """
+
+    def local(xl, wl, shift):
+        c = (xl - shift) * wl[:, None]
+        n = jax.lax.psum(jnp.sum(wl), axis)
+        s = jax.lax.psum(jnp.sum(c, axis=0), axis)
+        # Gram of masked centered rows on the MXU; one psum over ICI.
+        g = jax.lax.psum((xl - shift).T @ c, axis)
+        return n, s, g
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(axis), P()),
+            out_specs=(P(), P(), P()),
+        )
+    )
+
+
+class _PCAParams(HasInputCol, HasOutputCol):
+    K = IntParam(
+        "k", "Number of principal components.", 2, ParamValidators.gt(0)
+    )
+
+
+class PCA(_PCAParams, Estimator):
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "PCAModel":
+        (table,) = inputs
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        n, d = x.shape
+        k = self.get(self.K)
+        if k > min(n, d):
+            raise ValueError(f"k={k} must be <= min(n_rows, dim) = {min(n, d)}")
+        mesh = self.mesh or DeviceMesh()
+        xd, wd = _shard_with_mask(x, mesh)
+        shift = np.asarray(x[0], dtype=np.float32)
+        cnt, s, g = _mean_and_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
+            xd, wd, jnp.asarray(shift)
+        )
+        cnt = float(cnt)
+        mean_c = np.asarray(s, np.float64) / cnt          # mean of (x - shift)
+        gram = np.asarray(g, np.float64)
+        # cov of x = E[(x-shift)(x-shift)ᵀ] - mean_c mean_cᵀ, over n-1.
+        cov = (gram / cnt - np.outer(mean_c, mean_c)) * (cnt / max(cnt - 1, 1))
+        eigvals, eigvecs = np.linalg.eigh(cov)
+        idx = np.argsort(eigvals)[::-1][:k]
+        components = eigvecs[:, idx].T                     # [k, d]
+        variances = np.maximum(eigvals[idx], 0.0)
+        # Deterministic sign: the max-|entry| of each component is positive.
+        flip = np.sign(
+            components[np.arange(k), np.argmax(np.abs(components), axis=1)]
+        )
+        flip[flip == 0] = 1.0
+        components = components * flip[:, None]
+        total_var = float(np.maximum(np.trace(cov), 1e-300))
+        model = PCAModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({
+            "mean": (shift.astype(np.float64) + mean_c)[None, :],
+            "components": components[None, :, :],
+            "explainedVariance": variances[None, :],
+            "explainedVarianceRatio": (variances / total_var)[None, :],
+        }))
+        return model
+
+
+class PCAModel(_PCAParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._mean: Optional[np.ndarray] = None
+        self._components: Optional[np.ndarray] = None
+        self._explained_variance: Optional[np.ndarray] = None
+        self._explained_variance_ratio: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs: Table) -> "PCAModel":
+        (table,) = inputs
+        self._mean = np.asarray(table.column("mean"), np.float64)[0]
+        self._components = np.asarray(table.column("components"), np.float64)[0]
+        self._explained_variance = np.asarray(
+            table.column("explainedVariance"), np.float64
+        )[0]
+        self._explained_variance_ratio = np.asarray(
+            table.column("explainedVarianceRatio"), np.float64
+        )[0]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({
+            "mean": self._mean[None, :],
+            "components": self._components[None, :, :],
+            "explainedVariance": self._explained_variance[None, :],
+            "explainedVarianceRatio": self._explained_variance_ratio[None, :],
+        })]
+
+    @property
+    def components(self) -> np.ndarray:
+        self._require()
+        return self._components
+
+    @property
+    def explained_variance(self) -> np.ndarray:
+        self._require()
+        return self._explained_variance
+
+    @property
+    def explained_variance_ratio(self) -> np.ndarray:
+        self._require()
+        return self._explained_variance_ratio
+
+    def _require(self) -> None:
+        if self._components is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        x = features_matrix(table, self.get(self.INPUT_COL))
+        proj = (x - self._mean) @ self._components.T
+        return (table.with_column(self.get(self.OUTPUT_COL), proj),)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(path, {
+            "mean": self._mean,
+            "components": self._components,
+            "explainedVariance": self._explained_variance,
+            "explainedVarianceRatio": self._explained_variance_ratio,
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "PCAModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._mean = arrays["mean"]
+        model._components = arrays["components"]
+        model._explained_variance = arrays["explainedVariance"]
+        model._explained_variance_ratio = arrays["explainedVarianceRatio"]
+        return model

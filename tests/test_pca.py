@@ -1,0 +1,102 @@
+"""PCA vs sklearn: components, projections, explained variance,
+persistence, pipeline composition."""
+
+import numpy as np
+import pytest
+from sklearn.decomposition import PCA as SkPCA
+
+from flinkml_tpu.models import PCA, PCAModel
+from flinkml_tpu.table import Table
+
+
+def _data(n=400, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    # Anisotropic: a few dominant directions so ordering is unambiguous.
+    basis = rng.normal(size=(d, d))
+    scales = np.array([10.0, 5.0, 2.0, 1.0, 0.5, 0.1])
+    return rng.normal(size=(n, d)) * scales @ basis + rng.normal(size=d) * 3
+
+
+def test_pca_matches_sklearn():
+    x = _data()
+    t = Table({"input": x})
+    model = PCA().set_k(3).fit(t)
+    sk = SkPCA(n_components=3).fit(x)
+    # Eigenvalues (explained variance) match tightly.
+    np.testing.assert_allclose(
+        model.explained_variance, sk.explained_variance_, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        model.explained_variance_ratio, sk.explained_variance_ratio_, rtol=1e-4
+    )
+    # Components match up to sign.
+    for ours, theirs in zip(model.components, sk.components_):
+        dot = abs(float(ours @ theirs))
+        np.testing.assert_allclose(dot, 1.0, atol=1e-4)
+    # Projections match up to per-component sign.
+    (out,) = model.transform(t)
+    ref = sk.transform(x)
+    got = out.column("output")
+    signs = np.sign((got * ref).sum(axis=0))
+    np.testing.assert_allclose(got * signs, ref, atol=1e-3)
+
+
+def test_pca_sign_deterministic():
+    x = _data(seed=1)
+    t = Table({"input": x})
+    c1 = PCA().set_k(2).fit(t).components
+    c2 = PCA().set_k(2).fit(t).components
+    np.testing.assert_array_equal(c1, c2)
+    # Max-|entry| of each component is positive.
+    for comp in c1:
+        assert comp[np.argmax(np.abs(comp))] > 0
+
+
+def test_pca_save_load(tmp_path):
+    x = _data(seed=2)
+    t = Table({"input": x})
+    model = PCA().set_k(4).fit(t)
+    model.save(str(tmp_path / "pca"))
+    loaded = PCAModel.load(str(tmp_path / "pca"))
+    np.testing.assert_array_equal(loaded.components, model.components)
+    np.testing.assert_allclose(
+        loaded.transform(t)[0].column("output"),
+        model.transform(t)[0].column("output"),
+    )
+
+
+def test_pca_model_data_roundtrip():
+    x = _data(seed=3)
+    t = Table({"input": x})
+    model = PCA().set_k(2).fit(t)
+    clone = PCAModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    np.testing.assert_allclose(
+        clone.transform(t)[0].column("output"),
+        model.transform(t)[0].column("output"),
+    )
+
+
+def test_pca_k_validation():
+    t = Table({"input": np.random.default_rng(0).normal(size=(10, 3))})
+    with pytest.raises(ValueError, match="k=5"):
+        PCA().set_k(5).fit(t)
+
+
+def test_pca_in_pipeline_before_trainer():
+    from flinkml_tpu.models import LogisticRegression
+    from flinkml_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(300, 8))
+    y = (x[:, 0] - x[:, 3] > 0).astype(np.float64)
+    t = Table({"input": x, "label": y})
+    pipe = Pipeline([
+        PCA().set_k(5).set_output_col("features"),
+        LogisticRegression().set_max_iter(40).set_global_batch_size(300)
+        .set_learning_rate(1.0).set_seed(0),
+    ])
+    pm = pipe.fit(t)
+    (pred,) = pm.transform(t)
+    assert (pred["prediction"] == y).mean() > 0.9

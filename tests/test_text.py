@@ -1,0 +1,228 @@
+"""Tokenizer / RegexTokenizer / HashingTF / CountVectorizer / IDF:
+semantics vs sklearn and end-to-end sparse text classification."""
+
+import numpy as np
+import pytest
+from sklearn.feature_extraction.text import (
+    CountVectorizer as SkCount,
+    TfidfTransformer,
+)
+
+from flinkml_tpu.models import (
+    CountVectorizer,
+    CountVectorizerModel,
+    HashingTF,
+    IDF,
+    IDFModel,
+    RegexTokenizer,
+    Tokenizer,
+)
+from flinkml_tpu.table import Table
+
+DOCS = [
+    "the cat sat on the mat",
+    "the dog ate the cat",
+    "dogs and cats are friends",
+    "the mat was red",
+]
+
+
+def _docs_table():
+    return Table({"text": np.asarray(DOCS)})
+
+
+def test_tokenizer_lowercase_split():
+    t = Table({"text": np.asarray(["Hello World", "  a  B c "])})
+    (out,) = Tokenizer().set_input_col("text").set_output_col("tok").transform(t)
+    assert out["tok"][0] == ["hello", "world"]
+    assert out["tok"][1] == ["a", "b", "c"]
+
+
+def test_regex_tokenizer_gaps_and_tokens():
+    t = Table({"text": np.asarray(["foo,bar;;baz", "One-Two"])})
+    (gaps,) = (
+        RegexTokenizer().set_input_col("text").set_output_col("tok")
+        .set_pattern(r"[,;]+").transform(t)
+    )
+    assert gaps["tok"][0] == ["foo", "bar", "baz"]
+    (toks,) = (
+        RegexTokenizer().set_input_col("text").set_output_col("tok")
+        .set_pattern(r"\w+").set_gaps(False).set_to_lowercase(False)
+        .transform(t)
+    )
+    assert toks["tok"][1] == ["One", "Two"]
+    (minlen,) = (
+        RegexTokenizer().set_input_col("text").set_output_col("tok")
+        .set_pattern(r"\w+").set_gaps(False).set_min_token_length(4)
+        .transform(t)
+    )
+    assert minlen["tok"][0] == []
+
+
+def _tokenized():
+    (out,) = Tokenizer().set_input_col("text").set_output_col("tok").transform(
+        _docs_table()
+    )
+    return out
+
+
+def test_hashing_tf_counts_and_determinism():
+    tokens = _tokenized()
+    tf = HashingTF().set_input_col("tok").set_output_col("tf").set_num_features(64)
+    (out,) = tf.transform(tokens)
+    v0 = out["tf"][0]
+    assert v0.size() == 64
+    # "the" appears twice in doc 0 — some bucket holds 2.0.
+    assert 2.0 in v0.values.tolist()
+    assert float(v0.values.sum()) == 6.0  # six tokens in doc 0
+    # Deterministic across instances (crc32, not salted hash()).
+    (out2,) = (
+        HashingTF().set_input_col("tok").set_output_col("tf")
+        .set_num_features(64).transform(tokens)
+    )
+    assert out2["tf"][0] == v0
+    # Binary mode: presence only.
+    (binary,) = (
+        HashingTF().set_input_col("tok").set_output_col("tf")
+        .set_num_features(64).set_binary(True).transform(tokens)
+    )
+    assert set(binary["tf"][0].values.tolist()) == {1.0}
+
+
+def test_count_vectorizer_matches_sklearn():
+    tokens = _tokenized()
+    model = (
+        CountVectorizer().set_input_col("tok").set_output_col("tf").fit(tokens)
+    )
+    sk = SkCount(analyzer=str.split, lowercase=False).fit(DOCS)
+    assert set(model.vocabulary.tolist()) == set(sk.get_feature_names_out())
+    (out,) = model.transform(tokens)
+    ref = sk.transform(DOCS).toarray()
+    # Same counts after aligning vocab orders.
+    ours_order = {t: i for i, t in enumerate(model.vocabulary)}
+    perm = [ours_order[t] for t in sk.get_feature_names_out()]
+    got = np.stack([v.to_array() for v in out["tf"]])[:, perm]
+    np.testing.assert_array_equal(got, ref)
+    # Vocabulary is ordered by corpus count desc ("the" is most frequent).
+    assert model.vocabulary[0] == "the"
+
+
+def test_count_vectorizer_df_bounds_and_vocab_size():
+    tokens = _tokenized()
+    # minDF=2 docs: keeps only terms in >= 2 documents.
+    m = (
+        CountVectorizer().set_input_col("tok").set_output_col("tf")
+        .set_min_d_f(2.0).fit(tokens)
+    )
+    assert set(m.vocabulary.tolist()) == {"the", "cat", "mat"}
+    # maxDF as fraction: drop terms in > 50% of docs ("the" is in 3/4).
+    m2 = (
+        CountVectorizer().set_input_col("tok").set_output_col("tf")
+        .set_max_d_f(0.5).fit(tokens)
+    )
+    assert "the" not in m2.vocabulary.tolist()
+    # vocabularySize keeps the top terms.
+    m3 = (
+        CountVectorizer().set_input_col("tok").set_output_col("tf")
+        .set_vocabulary_size(2).fit(tokens)
+    )
+    assert len(m3.vocabulary) == 2 and m3.vocabulary[0] == "the"
+
+
+def test_count_vectorizer_min_tf_and_binary():
+    tokens = _tokenized()
+    m = (
+        CountVectorizer().set_input_col("tok").set_output_col("tf")
+        .set_min_t_f(2.0).fit(tokens)
+    )
+    (out,) = m.transform(tokens)
+    # Doc 0: only "the" (count 2) survives minTF=2.
+    assert out["tf"][0].values.tolist() == [2.0]
+    m2 = (
+        CountVectorizer().set_input_col("tok").set_output_col("tf")
+        .set_binary(True).fit(tokens)
+    )
+    (bout,) = m2.transform(tokens)
+    assert set(bout["tf"][0].values.tolist()) == {1.0}
+
+
+def test_count_vectorizer_save_load(tmp_path):
+    tokens = _tokenized()
+    model = CountVectorizer().set_input_col("tok").set_output_col("tf").fit(tokens)
+    model.save(str(tmp_path / "cv"))
+    loaded = CountVectorizerModel.load(str(tmp_path / "cv"))
+    np.testing.assert_array_equal(loaded.vocabulary, model.vocabulary)
+    assert loaded.transform(tokens)[0]["tf"][2] == model.transform(tokens)[0]["tf"][2]
+
+
+def test_idf_matches_sklearn_formula(tmp_path):
+    tokens = _tokenized()
+    cv = CountVectorizer().set_input_col("tok").set_output_col("tf").fit(tokens)
+    (tf_table,) = cv.transform(tokens)
+    idf_model = IDF().set_input_col("tf").set_output_col("tfidf").fit(tf_table)
+    # sklearn's smooth_idf uses log((n+1)/(df+1)) + 1; ours omits the +1.
+    sk = SkCount(analyzer=str.split, lowercase=False).fit(DOCS)
+    counts = sk.transform(DOCS)
+    sk_idf = TfidfTransformer(smooth_idf=True, norm=None).fit(counts).idf_ - 1.0
+    ours_order = {t: i for i, t in enumerate(cv.vocabulary)}
+    perm = [ours_order[t] for t in sk.get_feature_names_out()]
+    np.testing.assert_allclose(idf_model.idf[perm], sk_idf, rtol=1e-12)
+    # Transform scales counts by idf.
+    (out,) = idf_model.transform(tf_table)
+    got = np.stack([v.to_array() for v in out["tfidf"]])[:, perm]
+    ref = counts.toarray() * sk_idf
+    np.testing.assert_allclose(got, ref, rtol=1e-12)
+    # Persistence.
+    idf_model.save(str(tmp_path / "idf"))
+    loaded = IDFModel.load(str(tmp_path / "idf"))
+    np.testing.assert_array_equal(loaded.idf, idf_model.idf)
+
+
+def test_idf_min_doc_freq_and_dense_input():
+    x = np.asarray([[1.0, 0.0, 3.0], [2.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+    t = Table({"tf": x})
+    model = IDF().set_input_col("tf").set_output_col("o").set_min_doc_freq(2).fit(t)
+    # df = [2, 1, 1]: features 1 and 2 get idf 0.
+    assert model.idf[1] == 0.0 and model.idf[2] == 0.0 and model.idf[0] > 0
+    (out,) = model.transform(t)
+    np.testing.assert_allclose(out["o"][:, 1:], 0.0)
+
+
+def test_text_pipeline_trains_sparse_lr():
+    from flinkml_tpu.models import LogisticRegression
+    from flinkml_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(0)
+    pos_words = ["good", "great", "excellent", "love"]
+    neg_words = ["bad", "awful", "terrible", "hate"]
+    filler = ["the", "movie", "was", "a", "film", "it"]
+    docs, labels = [], []
+    for _ in range(120):
+        y = rng.integers(0, 2)
+        pool = pos_words if y else neg_words
+        words = list(rng.choice(pool, 3)) + list(rng.choice(filler, 4))
+        rng.shuffle(words)
+        docs.append(" ".join(words))
+        labels.append(float(y))
+    t = Table({"text": np.asarray(docs), "label": np.asarray(labels)})
+    pipe = Pipeline([
+        Tokenizer().set_input_col("text").set_output_col("tok"),
+        HashingTF().set_input_col("tok").set_output_col("features")
+        .set_num_features(256),
+        LogisticRegression().set_max_iter(60).set_global_batch_size(120)
+        .set_learning_rate(1.0).set_seed(0),
+    ])
+    pm = pipe.fit(t)
+    (pred,) = pm.transform(t)
+    assert (pred["prediction"] == t["label"]).mean() > 0.95
+
+
+def test_hashing_tf_num_features_change_rehashes():
+    tokens = _tokenized()
+    tf = HashingTF().set_input_col("tok").set_output_col("tf")
+    (big,) = tf.set_num_features(1024).transform(tokens)
+    (small,) = tf.set_num_features(8).transform(tokens)
+    for v in small["tf"]:
+        assert v.size() == 8
+        assert v.indices.max(initial=0) < 8
+    assert big["tf"][0].size() == 1024
