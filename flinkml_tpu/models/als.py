@@ -1,0 +1,334 @@
+"""ALS — alternating least squares matrix factorization (explicit +
+implicit feedback).
+
+Beyond the reference snapshot but a flagship member of the wider Flink ML
+family (recommendation). The TPU-native formulation avoids the
+reference-style per-user sequential solves entirely:
+
+  - Each half-step builds every user's normal equations AT ONCE from the
+    ratings COO: gather the fixed side's factors (``y = Y[item_idx]``),
+    form per-rating outer products, and ``segment_sum`` them into
+    ``A [n, k, k]`` / ``b [n, k]`` — one fused scatter per half-step,
+    the same keyed-aggregation pattern as NaiveBayes
+    (SURVEY.md §2.5 "keyed sharding").
+  - The per-rating work is chunked (``lax``-friendly fixed-size blocks)
+    so peak memory is ``chunk × k²`` instead of ``nnz × k²``.
+  - All user systems solve as ONE batched Cholesky
+    (``jax.scipy.linalg.cho_factor/cho_solve`` over ``[n, k, k]``) —
+    batched dense linear algebra is exactly what the MXU wants.
+  - Multi-device: the COO is sharded over the data axis; per-device
+    partial ``A``/``b`` combine with one ``psum`` (inside
+    ``keyed_aggregate``), factors are replicated.
+
+Regularization follows ALS-WR (the Spark/Flink convention): λ is scaled
+by each user's rating count (``A_u += λ·n_u·I``); users with no ratings
+get a pure-λ system and factor 0. Implicit mode is Hu/Koren/Volinsky:
+confidence ``c = 1 + α·r``, preference 1 for observed pairs,
+``A_u = YᵀY + Σ (c-1) y yᵀ + λ·n_u·I``, ``b_u = Σ c·y``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import HasMaxIter, HasPredictionCol, HasSeed
+from flinkml_tpu.params import (
+    BoolParam,
+    FloatParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+class _ALSParams(HasMaxIter, HasPredictionCol, HasSeed):
+    USER_COL = StringParam("userCol", "User id column.", "user")
+    ITEM_COL = StringParam("itemCol", "Item id column.", "item")
+    RATING_COL = StringParam("ratingCol", "Rating column.", "rating")
+    RANK = IntParam("rank", "Factor dimensionality.", 10, ParamValidators.gt(0))
+    REG_PARAM = FloatParam(
+        "regParam", "ALS-WR regularization (scaled by rating count).", 0.1,
+        ParamValidators.gt_eq(0.0),
+    )
+    IMPLICIT_PREFS = BoolParam(
+        "implicitPrefs", "Implicit-feedback (confidence-weighted) mode.", False
+    )
+    ALPHA = FloatParam(
+        "alpha", "Implicit-mode confidence slope (c = 1 + alpha * r).", 1.0,
+        ParamValidators.gt_eq(0.0),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _normal_eq_chunk_fn(mesh, axis: str, n_segments: int, implicit: bool):
+    """Accumulate one COO chunk into the normal equations.
+
+    Chunk inputs are sharded over the data axis; the returned partial
+    ``A``/``b`` are replicated (segment_sum locally + one psum). Padded
+    entries carry segment id ``n_segments`` and fall into a dummy row.
+    """
+
+    def local(seg, idx, r, fixed, alpha):
+        y = fixed[idx]                  # per-device gather of the fixed side
+        if implicit:
+            conf_minus_1 = alpha * r
+            a_w = conf_minus_1          # Σ (c-1) y yᵀ
+            b_w = 1.0 + conf_minus_1    # Σ c·y (preference = 1)
+        else:
+            a_w = jnp.ones_like(r)      # Σ y yᵀ
+            b_w = r                     # Σ r·y
+        # Padded entries carry seg == n_segments and a_w/b_w of 0 (their
+        # rating is 0; explicit a_w=1 is harmless in the dummy row).
+        outer = (y[:, :, None] * y[:, None, :]) * a_w[:, None, None]
+        a = jax.ops.segment_sum(outer, seg, num_segments=n_segments + 1)
+        b = jax.ops.segment_sum(b_w[:, None] * y, seg,
+                                num_segments=n_segments + 1)
+        cnt = jax.ops.segment_sum(jnp.ones_like(r), seg,
+                                  num_segments=n_segments + 1)
+        return (
+            jax.lax.psum(a[:-1], axis),
+            jax.lax.psum(b[:-1], axis),
+            jax.lax.psum(cnt[:-1], axis),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(), P()),
+            out_specs=(P(), P(), P()),
+        )
+    )
+
+
+@jax.jit
+def _solve_factors(a, b, gram, reg, counts):
+    """Batched solve of every target's system: (A + gram + λ·max(n,1)·I) x = b.
+
+    λ is floored at 1e-4: with regParam=0 an under-determined row (rating
+    count < rank) has a singular system and cho_factor NaN-poisons
+    silently; the floor keeps every system SPD within f32 Cholesky
+    tolerance (1e-6 still produced NaNs) at negligible bias.
+    """
+    k = b.shape[1]
+    lam = jnp.maximum(reg * jnp.maximum(counts, 1.0), 1e-4)
+    eye = jnp.eye(k, dtype=a.dtype)
+    systems = a + gram[None, :, :] + lam[:, None, None] * eye[None, :, :]
+    cho = jax.scipy.linalg.cho_factor(systems)
+    return jax.scipy.linalg.cho_solve(cho, b[:, :, None])[:, :, 0]
+
+
+def _pad_coo(seg: np.ndarray, idx: np.ndarray, r: np.ndarray,
+             n_dummy: int, multiple: int):
+    """Pad the COO to ``multiple``; padded entries get segment id
+    ``n_dummy`` (the dropped dummy row), fixed-side index 0, rating 0 —
+    contributing nothing in either mode."""
+    pad = (-seg.shape[0]) % multiple
+    return (
+        np.concatenate([seg, np.full(pad, n_dummy)]).astype(np.int32),
+        np.concatenate([idx, np.zeros(pad, idx.dtype)]).astype(np.int32),
+        np.concatenate([r, np.zeros(pad, r.dtype)]).astype(np.float32),
+    )
+
+
+def _half_step(
+    mesh: DeviceMesh,
+    seg: np.ndarray, idx: np.ndarray, r: np.ndarray,   # padded COO (host)
+    fixed: jnp.ndarray,            # [m, k] replicated factors of fixed side
+    n_target: int,
+    reg: float,
+    implicit: bool,
+    alpha: float,
+    chunk: int,
+) -> jnp.ndarray:
+    """One ALS half-step: solve all n_target factors given the fixed side.
+
+    Chunks of ``devices × chunk`` COO rows stream through the
+    normal-equation kernel, bounding the [rows, k, k] intermediate to
+    ``chunk × k²`` per device.
+    """
+    k = fixed.shape[1]
+    chunk_g = mesh.axis_size() * chunk
+    fn = _normal_eq_chunk_fn(
+        mesh.mesh, DeviceMesh.DATA_AXIS, n_target, implicit
+    )
+    a = jnp.zeros((n_target, k, k), jnp.float32)
+    b = jnp.zeros((n_target, k), jnp.float32)
+    cnt = jnp.zeros((n_target,), jnp.float32)
+    alpha_j = jnp.asarray(alpha, jnp.float32)
+    for c in range(seg.shape[0] // chunk_g):
+        sl = slice(c * chunk_g, (c + 1) * chunk_g)
+        pa, pb, pc = fn(
+            mesh.shard_batch(seg[sl]), mesh.shard_batch(idx[sl]),
+            mesh.shard_batch(r[sl]), fixed, alpha_j,
+        )
+        a, b, cnt = a + pa, b + pb, cnt + pc
+    if implicit:
+        gram = fixed.T @ fixed
+    else:
+        gram = jnp.zeros((k, k), jnp.float32)
+    return _solve_factors(a, b, gram, jnp.asarray(reg, jnp.float32), cnt)
+
+
+class ALS(_ALSParams, Estimator):
+    """Alternating least squares over (user, item, rating) tables."""
+
+    # Per-device rows handed to one normal-equation dispatch; bounds the
+    # nnz×k² intermediate to chunk×k² per device.
+    CHUNK = 1 << 16
+
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "ALSModel":
+        (table,) = inputs
+        users_raw = np.asarray(table.column(self.get(self.USER_COL)))
+        items_raw = np.asarray(table.column(self.get(self.ITEM_COL)))
+        ratings = np.asarray(
+            table.column(self.get(self.RATING_COL)), dtype=np.float32
+        )
+        implicit = self.get(self.IMPLICIT_PREFS)
+        if implicit and (ratings < 0).any():
+            raise ValueError("implicitPrefs requires non-negative ratings")
+        user_ids, u_idx = np.unique(users_raw, return_inverse=True)
+        item_ids, i_idx = np.unique(items_raw, return_inverse=True)
+        n_users, n_items = len(user_ids), len(item_ids)
+        rank = self.get(self.RANK)
+        reg = self.get(self.REG_PARAM)
+        alpha = self.get(self.ALPHA)
+        mesh = self.mesh or DeviceMesh()
+        chunk = min(
+            self.CHUNK,
+            max(256, -(-len(ratings) // mesh.axis_size())),
+        )
+
+        rng = np.random.default_rng(self.get_seed())
+        # Signed Gaussian init at scale 1/sqrt(rank); the first half-step
+        # solves user factors from these, so no user init is needed
+        # (maxIter is validated > 0).
+        item_f = jnp.asarray(
+            rng.normal(scale=1.0 / np.sqrt(rank), size=(n_items, rank))
+            .astype(np.float32)
+        )
+
+        chunk_g = mesh.axis_size() * chunk
+        by_user = _pad_coo(u_idx, i_idx, ratings, n_users, chunk_g)
+        by_item = _pad_coo(i_idx, u_idx, ratings, n_items, chunk_g)
+        for _ in range(self.get(self.MAX_ITER)):
+            user_f = _half_step(
+                mesh, *by_user, item_f, n_users, reg, implicit, alpha, chunk,
+            )
+            item_f = _half_step(
+                mesh, *by_item, user_f, n_items, reg, implicit, alpha, chunk,
+            )
+        model = ALSModel()
+        model.copy_params_from(self)
+        model._set_factors(
+            user_ids, np.asarray(user_f), item_ids, np.asarray(item_f)
+        )
+        return model
+
+
+class ALSModel(_ALSParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._user_ids: Optional[np.ndarray] = None
+        self._item_ids: Optional[np.ndarray] = None
+        self._user_factors: Optional[np.ndarray] = None
+        self._item_factors: Optional[np.ndarray] = None
+
+    def _set_factors(self, user_ids, user_factors, item_ids, item_factors):
+        self._user_ids = np.asarray(user_ids)
+        self._item_ids = np.asarray(item_ids)
+        self._user_factors = np.asarray(user_factors, np.float64)
+        self._item_factors = np.asarray(item_factors, np.float64)
+
+    @property
+    def user_factors(self) -> np.ndarray:
+        self._require()
+        return self._user_factors
+
+    @property
+    def item_factors(self) -> np.ndarray:
+        self._require()
+        return self._item_factors
+
+    def set_model_data(self, *inputs: Table) -> "ALSModel":
+        user_t, item_t = inputs
+        self._set_factors(
+            user_t.column("id"), user_t.column("factors"),
+            item_t.column("id"), item_t.column("factors"),
+        )
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [
+            Table({"id": self._user_ids, "factors": self._user_factors}),
+            Table({"id": self._item_ids, "factors": self._item_factors}),
+        ]
+
+    def _require(self) -> None:
+        if self._user_factors is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def _positions(self, raw: np.ndarray, ids: np.ndarray):
+        order = np.argsort(ids, kind="stable")
+        sorted_ids = ids[order]
+        pos = np.searchsorted(sorted_ids, raw)
+        pos_c = np.minimum(pos, len(ids) - 1)
+        found = sorted_ids[pos_c] == raw
+        return order[pos_c], found
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        """Predict ratings for (user, item) rows; unseen ids → NaN (the
+        upstream 'nan' cold-start strategy)."""
+        (table,) = inputs
+        self._require()
+        users = np.asarray(table.column(self.get(self.USER_COL)))
+        items = np.asarray(table.column(self.get(self.ITEM_COL)))
+        u_pos, u_ok = self._positions(users, self._user_ids)
+        i_pos, i_ok = self._positions(items, self._item_ids)
+        pred = np.einsum(
+            "nk,nk->n", self._user_factors[u_pos], self._item_factors[i_pos]
+        )
+        pred = np.where(u_ok & i_ok, pred, np.nan)
+        return (table.with_column(self.get(self.PREDICTION_COL), pred),)
+
+    def recommend_for_all_users(self, num_items: int):
+        """Top ``num_items`` items per user: one [users, k] @ [k, items]
+        matmul + top_k on device (the MXU path). Returns
+        (item_id_matrix [n_users, num_items], score_matrix)."""
+        self._require()
+        scores = jnp.asarray(self._user_factors, jnp.float32) @ jnp.asarray(
+            self._item_factors, jnp.float32
+        ).T
+        vals, idx = jax.lax.top_k(scores, min(num_items, len(self._item_ids)))
+        return self._item_ids[np.asarray(idx)], np.asarray(vals)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(path, {
+            "userIds": self._user_ids,
+            "userFactors": self._user_factors,
+            "itemIds": self._item_ids,
+            "itemFactors": self._item_factors,
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "ALSModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._set_factors(
+            arrays["userIds"], arrays["userFactors"],
+            arrays["itemIds"], arrays["itemFactors"],
+        )
+        return model

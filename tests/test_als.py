@@ -1,0 +1,169 @@
+"""ALS: explicit reconstruction, implicit ranking, regularization
+semantics, cold start, persistence, recommendations."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import ALS, ALSModel
+from flinkml_tpu.table import Table
+
+
+def _low_rank_ratings(n_users=40, n_items=30, rank=4, frac=0.6, seed=0,
+                      noise=0.0):
+    rng = np.random.default_rng(seed)
+    u = rng.normal(size=(n_users, rank)) / np.sqrt(rank)
+    v = rng.normal(size=(n_items, rank)) / np.sqrt(rank)
+    full = u @ v.T
+    mask = rng.uniform(size=full.shape) < frac
+    users, items = np.nonzero(mask)
+    r = full[users, items] + noise * rng.normal(size=users.shape[0])
+    return users.astype(np.int64), items.astype(np.int64), r, full
+
+
+def _als(rank=6, iters=12, reg=0.01, **kw):
+    als = (
+        ALS().set_rank(rank).set_max_iter(iters).set_reg_param(reg)
+        .set_seed(0)
+    )
+    for name, v in kw.items():
+        getattr(als, f"set_{name}")(v)
+    return als
+
+
+def test_explicit_reconstructs_low_rank_matrix():
+    users, items, r, full = _low_rank_ratings()
+    t = Table({"user": users, "item": items, "rating": r})
+    model = _als().fit(t)
+    # In-sample predictions recover the observed ratings.
+    (out,) = model.transform(t)
+    rmse = float(np.sqrt(np.mean((out["prediction"] - r) ** 2)))
+    assert rmse < 0.05, rmse
+    # And generalize to the held-out entries of the low-rank matrix.
+    all_u, all_i = np.meshgrid(
+        np.arange(full.shape[0]), np.arange(full.shape[1]), indexing="ij"
+    )
+    t_all = Table({"user": all_u.ravel(), "item": all_i.ravel()})
+    (pred_all,) = model.transform(t_all)
+    rmse_all = float(np.sqrt(np.mean(
+        (pred_all["prediction"] - full.ravel()) ** 2
+    )))
+    assert rmse_all < 0.15, rmse_all
+
+
+def test_regularization_shrinks_factors():
+    users, items, r, _ = _low_rank_ratings(seed=1)
+    t = Table({"user": users, "item": items, "rating": r})
+    small = _als(reg=0.001).fit(t)
+    large = _als(reg=10.0).fit(t)
+    assert (
+        np.linalg.norm(large.user_factors)
+        < 0.2 * np.linalg.norm(small.user_factors)
+    )
+
+
+def test_cold_start_nan_and_unseen_ids():
+    users, items, r, _ = _low_rank_ratings(seed=2)
+    t = Table({"user": users, "item": items, "rating": r})
+    model = _als(iters=3).fit(t)
+    probe = Table({"user": np.asarray([0, 9999]), "item": np.asarray([0, 0])})
+    (out,) = model.transform(probe)
+    assert np.isfinite(out["prediction"][0])
+    assert np.isnan(out["prediction"][1])
+
+
+def test_string_ids_work():
+    users = np.asarray(["alice", "bob", "alice", "carol", "bob", "carol"])
+    items = np.asarray(["x", "x", "y", "y", "z", "z"])
+    r = np.asarray([5.0, 4.0, 1.0, 2.0, 3.0, 5.0])
+    t = Table({"user": users, "item": items, "rating": r})
+    model = _als(rank=2, iters=8, reg=0.1).fit(t)
+    (out,) = model.transform(t)
+    assert np.all(np.isfinite(out["prediction"]))
+    # In-sample ordering is roughly preserved for alice: x (5) > y (1).
+    pa = model.transform(
+        Table({"user": np.asarray(["alice", "alice"]),
+               "item": np.asarray(["x", "y"])})
+    )[0]["prediction"]
+    assert pa[0] > pa[1]
+
+
+def test_implicit_ranks_interacted_items_higher():
+    rng = np.random.default_rng(3)
+    n_users, n_items = 20, 15
+    # Two taste clusters: even users like even items, odd like odd.
+    users, items, counts = [], [], []
+    for u in range(n_users):
+        liked = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(liked, size=5):
+            users.append(u)
+            items.append(i)
+            counts.append(float(rng.integers(1, 10)))
+    t = Table({
+        "user": np.asarray(users), "item": np.asarray(items),
+        "rating": np.asarray(counts),
+    })
+    model = _als(rank=4, iters=10, reg=0.1, implicit_prefs=True,
+                 alpha=10.0).fit(t)
+    ids, scores = model.recommend_for_all_users(5)
+    # Top recommendations for user 0 (even cluster) are mostly even items.
+    top0 = ids[0]
+    assert (top0 % 2 == 0).mean() >= 0.8
+    assert np.all(np.diff(scores[0]) <= 1e-6)  # scores sorted descending
+
+
+def test_implicit_rejects_negative_ratings():
+    t = Table({"user": np.asarray([0]), "item": np.asarray([0]),
+               "rating": np.asarray([-1.0])})
+    with pytest.raises(ValueError, match="non-negative"):
+        _als(implicit_prefs=True).fit(t)
+
+
+def test_save_load_and_model_data_roundtrip(tmp_path):
+    users, items, r, _ = _low_rank_ratings(seed=4)
+    t = Table({"user": users, "item": items, "rating": r})
+    model = _als(iters=4).fit(t)
+    model.save(str(tmp_path / "als"))
+    loaded = ALSModel.load(str(tmp_path / "als"))
+    np.testing.assert_array_equal(loaded.user_factors, model.user_factors)
+    (p1,) = model.transform(t)
+    (p2,) = loaded.transform(t)
+    np.testing.assert_allclose(p2["prediction"], p1["prediction"])
+    clone = ALSModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    (p3,) = clone.transform(t)
+    np.testing.assert_allclose(p3["prediction"], p1["prediction"])
+
+
+def test_chunked_path_matches_single_chunk():
+    users, items, r, _ = _low_rank_ratings(seed=5)
+    t = Table({"user": users, "item": items, "rating": r})
+    big = _als(iters=3).fit(t)
+    small_chunk = _als(iters=3)
+    small_chunk.CHUNK = 64  # force many chunks
+    small = small_chunk.fit(t)
+    np.testing.assert_allclose(
+        small.user_factors, big.user_factors, rtol=2e-4, atol=2e-5
+    )
+
+
+def test_deterministic_given_seed():
+    users, items, r, _ = _low_rank_ratings(seed=6)
+    t = Table({"user": users, "item": items, "rating": r})
+    m1 = _als(iters=3).fit(t)
+    m2 = _als(iters=3).fit(t)
+    np.testing.assert_array_equal(m1.user_factors, m2.user_factors)
+
+
+def test_reg_zero_underdetermined_user_stays_finite():
+    # User 0 has fewer ratings than rank: with regParam=0 its system is
+    # singular; the 1e-6 lambda floor must keep everything finite.
+    users = np.asarray([0, 0, 1, 1, 1, 1, 1, 1, 1, 1])
+    items = np.asarray([0, 1, 0, 1, 2, 3, 4, 5, 6, 7])
+    r = np.linspace(1, 5, 10)
+    t = Table({"user": users, "item": items, "rating": r})
+    model = _als(rank=6, iters=4, reg=0.0).fit(t)
+    assert np.isfinite(model.user_factors).all()
+    assert np.isfinite(model.item_factors).all()
+    (out,) = model.transform(t)
+    assert np.isfinite(out["prediction"]).all()
