@@ -35,6 +35,7 @@ from flinkml_tpu.models.feature_transforms import (
     VectorSlicer,
 )
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
+from flinkml_tpu.models.als import ALS, ALSModel
 from flinkml_tpu.models.pca import PCA, PCAModel
 from flinkml_tpu.models.text import (
     CountVectorizer,
@@ -52,6 +53,11 @@ from flinkml_tpu.models.string_indexer import (
 )
 from flinkml_tpu.models.vector_assembler import VectorAssembler
 from flinkml_tpu.models.evaluation import BinaryClassificationEvaluator
+from flinkml_tpu.models.evaluation_multi import (
+    ClusteringEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
 
 __all__ = [
     "LogisticRegression",
@@ -88,6 +94,8 @@ __all__ = [
     "Bucketizer",
     "Imputer",
     "ImputerModel",
+    "ALS",
+    "ALSModel",
     "PCA",
     "PCAModel",
     "Tokenizer",
@@ -102,4 +110,7 @@ __all__ = [
     "IndexToStringModel",
     "VectorAssembler",
     "BinaryClassificationEvaluator",
+    "MulticlassClassificationEvaluator",
+    "RegressionEvaluator",
+    "ClusteringEvaluator",
 ]

@@ -1,0 +1,147 @@
+"""Multiclass / regression / clustering evaluators vs sklearn."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    accuracy_score,
+    explained_variance_score,
+    f1_score,
+    mean_absolute_error,
+    mean_squared_error,
+    precision_score,
+    r2_score,
+    recall_score,
+    silhouette_score,
+)
+
+from flinkml_tpu.models import (
+    ClusteringEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from flinkml_tpu.models.evaluation_multi import (
+    multiclass_metrics,
+    regression_metrics,
+    simplified_silhouette,
+)
+from flinkml_tpu.table import Table
+
+
+def test_multiclass_matches_sklearn_weighted():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 4, 500).astype(float)
+    p = np.where(rng.uniform(size=500) < 0.7, y, rng.integers(0, 4, 500)).astype(float)
+    m = multiclass_metrics(y, p)
+    assert m["accuracy"] == pytest.approx(accuracy_score(y, p))
+    assert m["weightedPrecision"] == pytest.approx(
+        precision_score(y, p, average="weighted", zero_division=0)
+    )
+    assert m["weightedRecall"] == pytest.approx(
+        recall_score(y, p, average="weighted", zero_division=0)
+    )
+    assert m["weightedF1"] == pytest.approx(
+        f1_score(y, p, average="weighted", zero_division=0)
+    )
+
+
+def test_multiclass_with_sample_weights():
+    y = np.asarray([0.0, 0.0, 1.0, 1.0])
+    p = np.asarray([0.0, 1.0, 1.0, 1.0])
+    w = np.asarray([10.0, 1.0, 1.0, 1.0])
+    m = multiclass_metrics(y, p, w)
+    assert m["accuracy"] == pytest.approx(
+        accuracy_score(y, p, sample_weight=w)
+    )
+    assert m["weightedF1"] == pytest.approx(
+        f1_score(y, p, average="weighted", sample_weight=w)
+    )
+
+
+def test_multiclass_operator_and_validation():
+    t = Table({
+        "label": np.asarray([0.0, 1.0, 2.0, 1.0]),
+        "prediction": np.asarray([0.0, 1.0, 1.0, 1.0]),
+    })
+    (out,) = (
+        MulticlassClassificationEvaluator()
+        .set_metrics_names(["accuracy", "weightedPrecision"])
+        .transform(t)
+    )
+    assert out["accuracy"][0] == pytest.approx(0.75)
+    with pytest.raises(ValueError, match="unsupported"):
+        MulticlassClassificationEvaluator().set_metrics_names(["auc"]).transform(t)
+
+
+def test_regression_matches_sklearn():
+    rng = np.random.default_rng(1)
+    y = rng.normal(size=300) * 3 + 5
+    p = y + rng.normal(size=300) * 0.7 + 0.2
+    m = regression_metrics(y, p)
+    assert m["mse"] == pytest.approx(mean_squared_error(y, p))
+    assert m["rmse"] == pytest.approx(np.sqrt(mean_squared_error(y, p)))
+    assert m["mae"] == pytest.approx(mean_absolute_error(y, p))
+    assert m["r2"] == pytest.approx(r2_score(y, p))
+    assert m["explainedVariance"] == pytest.approx(
+        explained_variance_score(y, p)
+    )
+
+
+def test_regression_weighted_and_operator():
+    y = np.asarray([1.0, 2.0, 3.0])
+    p = np.asarray([1.5, 2.0, 2.0])
+    w = np.asarray([1.0, 2.0, 3.0])
+    m = regression_metrics(y, p, w)
+    assert m["r2"] == pytest.approx(r2_score(y, p, sample_weight=w))
+    t = Table({"label": y, "prediction": p, "w": w})
+    (out,) = (
+        RegressionEvaluator().set_metrics_names(["rmse", "mae"])
+        .set_weight_col("w").transform(t)
+    )
+    assert out["rmse"][0] == pytest.approx(
+        np.sqrt(mean_squared_error(y, p, sample_weight=w))
+    )
+
+
+def test_silhouette_reasonable_vs_sklearn():
+    rng = np.random.default_rng(2)
+    # Well-separated blobs: simplified (centroid) silhouette tracks the
+    # exact pairwise one closely.
+    x = np.concatenate([
+        rng.normal(size=(60, 3)) + np.asarray([5.0, 0, 0]),
+        rng.normal(size=(60, 3)) - np.asarray([5.0, 0, 0]),
+    ])
+    a = np.concatenate([np.zeros(60), np.ones(60)])
+    ours = simplified_silhouette(x, a)
+    exact = silhouette_score(x, a)
+    assert abs(ours - exact) < 0.1
+    assert ours > 0.7
+    # Random assignment scores near zero.
+    bad = simplified_silhouette(x, rng.integers(0, 2, 120))
+    assert bad < 0.1
+
+
+def test_clustering_evaluator_end_to_end():
+    from flinkml_tpu.models import KMeans
+
+    rng = np.random.default_rng(3)
+    x = np.concatenate([
+        rng.normal(size=(50, 2)) + 6, rng.normal(size=(50, 2)) - 6,
+    ]).astype(np.float64)
+    t = Table({"features": x})
+    model = KMeans().set_k(2).set_seed(5).fit(t)
+    (assigned,) = model.transform(t)
+    (out,) = ClusteringEvaluator().transform(assigned)
+    assert out["silhouette"][0] > 0.7
+    with pytest.raises(ValueError, match="2 clusters"):
+        ClusteringEvaluator().transform(
+            Table({"features": x, "prediction": np.zeros(100)})
+        )
+
+
+def test_multiclass_rejects_nan_predictions():
+    t = Table({
+        "label": np.asarray([0.0, 1.0]),
+        "prediction": np.asarray([0.0, np.nan]),
+    })
+    with pytest.raises(ValueError, match="NaN"):
+        MulticlassClassificationEvaluator().transform(t)
