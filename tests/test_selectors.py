@@ -1,0 +1,188 @@
+"""ChiSqTest / VarianceThresholdSelector / UnivariateFeatureSelector vs
+scipy + sklearn."""
+
+import numpy as np
+import pytest
+from scipy.stats import chi2_contingency, f_oneway
+from sklearn.feature_selection import (
+    SelectKBest,
+    VarianceThreshold,
+    chi2 as sk_chi2,
+    f_classif as sk_f_classif,
+)
+
+from flinkml_tpu.models import (
+    ChiSqTest,
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
+from flinkml_tpu.models.selectors import chi_square_test, f_classif_test
+from flinkml_tpu.table import Table
+
+
+def _cat_data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 3, n)
+    # feature 0: depends on label; features 1, 2: independent noise.
+    x0 = (y + rng.integers(0, 2, n)) % 4
+    x1 = rng.integers(0, 5, n)
+    x2 = rng.integers(0, 2, n)
+    return np.stack([x0, x1, x2], axis=1).astype(float), y.astype(float)
+
+
+def test_chi_square_matches_scipy():
+    x, y = _cat_data()
+    stats, pvals, dofs = chi_square_test(x, y)
+    for j in range(x.shape[1]):
+        observed = np.zeros((len(np.unique(x[:, j])), len(np.unique(y))))
+        cats = {v: i for i, v in enumerate(np.unique(x[:, j]))}
+        labs = {v: i for i, v in enumerate(np.unique(y))}
+        for xi, yi in zip(x[:, j], y):
+            observed[cats[xi], labs[yi]] += 1
+        ref = chi2_contingency(observed, correction=False)
+        assert stats[j] == pytest.approx(ref.statistic, rel=1e-10)
+        assert pvals[j] == pytest.approx(ref.pvalue, rel=1e-8, abs=1e-12)
+        assert dofs[j] == ref.dof
+    # Dependent feature is far more significant than the noise ones.
+    assert pvals[0] < 1e-6 < pvals[1]
+
+
+def test_chi_sq_test_operator_layout():
+    x, y = _cat_data(seed=1)
+    t = Table({"features": x, "label": y})
+    (out,) = ChiSqTest().transform(t)
+    assert out.column_names == [
+        "featureIndex", "pValue", "statistic", "degreesOfFreedom",
+    ]
+    assert out.num_rows == 3
+
+
+def test_f_classif_matches_sklearn():
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 3, 200).astype(float)
+    x = rng.normal(size=(200, 4))
+    x[:, 0] += y  # informative
+    f, p = f_classif_test(x, y)
+    f_ref, p_ref = sk_f_classif(x, y)
+    np.testing.assert_allclose(f, f_ref, rtol=1e-10)
+    np.testing.assert_allclose(p, p_ref, rtol=1e-8, atol=1e-14)
+    # Cross-check one feature against scipy's one-way ANOVA too.
+    groups = [x[y == c, 0] for c in np.unique(y)]
+    assert f[0] == pytest.approx(f_oneway(*groups).statistic, rel=1e-10)
+
+
+def test_variance_threshold_matches_sklearn(tmp_path):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(150, 5)) * np.asarray([2.0, 0.0, 0.5, 3.0, 0.01])
+    x[:, 1] = 7.0  # constant
+    t = Table({"features": x})
+    model = VarianceThresholdSelector().set_variance_threshold(0.1).fit(t)
+    ref = VarianceThreshold(threshold=0.1).fit(x)
+    np.testing.assert_array_equal(
+        model.selected_indices, np.nonzero(ref.get_support())[0]
+    )
+    (out,) = model.transform(t)
+    np.testing.assert_allclose(
+        out["output"], ref.transform(x), rtol=1e-6, atol=1e-6
+    )
+    model.save(str(tmp_path / "vts"))
+    loaded = VarianceThresholdSelectorModel.load(str(tmp_path / "vts"))
+    np.testing.assert_array_equal(
+        loaded.selected_indices, model.selected_indices
+    )
+
+
+def test_univariate_chi2_top_k_matches_sklearn():
+    x, y = _cat_data(seed=4)
+    t = Table({"features": x, "label": y})
+    model = (
+        UnivariateFeatureSelector()
+        .set_score_function("chi2")
+        .set_selection_mode("numTopFeatures")
+        .set_selection_threshold(1.0)
+        .fit(t)
+    )
+    # NOTE: sklearn's chi2 is a different statistic (nonnegative-feature
+    # form), but both must agree the label-dependent feature wins.
+    sk = SelectKBest(sk_chi2, k=1).fit(x, y)
+    np.testing.assert_array_equal(
+        model.selected_indices, np.nonzero(sk.get_support())[0]
+    )
+    (out,) = model.transform(t)
+    assert out["output"].shape == (x.shape[0], 1)
+
+
+def test_univariate_fclassif_modes(tmp_path):
+    rng = np.random.default_rng(5)
+    y = rng.integers(0, 2, 300).astype(float)
+    x = rng.normal(size=(300, 10))
+    x[:, 2] += 2 * y
+    x[:, 7] += y
+    t = Table({"features": x, "label": y})
+    top2 = (
+        UnivariateFeatureSelector().set_score_function("fClassif")
+        .set_selection_mode("numTopFeatures").set_selection_threshold(2.0)
+        .fit(t)
+    )
+    np.testing.assert_array_equal(top2.selected_indices, [2, 7])
+    pct = (
+        UnivariateFeatureSelector().set_score_function("fClassif")
+        .set_selection_mode("percentile").set_selection_threshold(0.2)
+        .fit(t)
+    )
+    np.testing.assert_array_equal(pct.selected_indices, [2, 7])
+    fpr = (
+        UnivariateFeatureSelector().set_score_function("fClassif")
+        .set_selection_mode("fpr").set_selection_threshold(1e-6)
+        .fit(t)
+    )
+    assert 2 in fpr.selected_indices and len(fpr.selected_indices) <= 2
+    fpr.save(str(tmp_path / "ufs"))
+    loaded = UnivariateFeatureSelectorModel.load(str(tmp_path / "ufs"))
+    np.testing.assert_array_equal(loaded.selected_indices, fpr.selected_indices)
+
+
+def test_selector_dim_mismatch_rejected():
+    x, y = _cat_data(seed=6)
+    t = Table({"features": x, "label": y})
+    model = (
+        UnivariateFeatureSelector().set_selection_mode("numTopFeatures")
+        .set_selection_threshold(2.0).fit(t)
+    )
+    small = Table({"features": x[:, :1]})
+    with pytest.raises(ValueError, match="dim"):
+        model.transform(small)
+
+
+def test_f_classif_perfectly_discriminative_feature_wins():
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 2, 100).astype(float)
+    x = rng.normal(size=(100, 3))
+    x[:, 0] = y          # zero within-class variance: F = inf, p = 0
+    x[:, 2] = 5.0        # constant: F = 0
+    f, p = f_classif_test(x, y)
+    assert np.isinf(f[0]) and p[0] == 0.0
+    assert f[2] == 0.0
+    model = (
+        UnivariateFeatureSelector().set_score_function("fClassif")
+        .set_selection_mode("numTopFeatures").set_selection_threshold(1.0)
+        .fit(Table({"features": x, "label": y}))
+    )
+    np.testing.assert_array_equal(model.selected_indices, [0])
+
+
+def test_selection_threshold_validation():
+    x, y = _cat_data(seed=8)
+    t = Table({"features": x, "label": y})
+    with pytest.raises(ValueError, match=">= 1"):
+        (
+            UnivariateFeatureSelector().set_selection_mode("numTopFeatures")
+            .set_selection_threshold(-1.0).fit(t)
+        )
+    with pytest.raises(ValueError, match=r"\(0, 1\]"):
+        (
+            UnivariateFeatureSelector().set_selection_mode("percentile")
+            .set_selection_threshold(1.5).fit(t)
+        )
