@@ -37,6 +37,20 @@ from flinkml_tpu.models.feature_transforms import (
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
 from flinkml_tpu.models.als import ALS, ALSModel
 from flinkml_tpu.models.pca import PCA, PCAModel
+from flinkml_tpu.models.misc_transforms import (
+    DCT,
+    FeatureHasher,
+    Interaction,
+    RandomSplitter,
+    StopWordsRemover,
+)
+from flinkml_tpu.models.selectors import (
+    ChiSqTest,
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
 from flinkml_tpu.models.text import (
     CountVectorizer,
     CountVectorizerModel,
@@ -110,6 +124,16 @@ __all__ = [
     "IndexToStringModel",
     "VectorAssembler",
     "BinaryClassificationEvaluator",
+    "FeatureHasher",
+    "Interaction",
+    "DCT",
+    "StopWordsRemover",
+    "RandomSplitter",
+    "ChiSqTest",
+    "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel",
+    "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel",
     "MulticlassClassificationEvaluator",
     "RegressionEvaluator",
     "ClusteringEvaluator",

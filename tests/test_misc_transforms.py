@@ -1,0 +1,157 @@
+"""FeatureHasher / Interaction / DCT / StopWordsRemover / RandomSplitter."""
+
+import numpy as np
+import pytest
+from scipy.fft import dct as scipy_dct
+
+from flinkml_tpu.models import (
+    DCT,
+    FeatureHasher,
+    Interaction,
+    RandomSplitter,
+    StopWordsRemover,
+    Tokenizer,
+)
+from flinkml_tpu.table import Table
+
+
+# -- FeatureHasher -----------------------------------------------------------
+
+def _hash_table():
+    return Table({
+        "age": np.asarray([25.0, 40.0]),
+        "city": np.asarray(["sf", "nyc"]),
+        "clicks": np.asarray([3.0, 0.0]),
+    })
+
+
+def test_feature_hasher_numeric_and_categorical():
+    t = _hash_table()
+    (out,) = (
+        FeatureHasher().set_input_cols(["age", "city", "clicks"])
+        .set_output_col("f").set_num_features(64).transform(t)
+    )
+    v0, v1 = out["f"][0], out["f"][1]
+    assert v0.size() == 64
+    # Row 0: age bucket holds 25.0, clicks bucket 3.0, city=sf bucket 1.0.
+    assert sorted(v0.values.tolist()) == [1.0, 3.0, 25.0]
+    # Row 1: clicks contributes 0.0 at its bucket; age 40, city=nyc 1.
+    assert 40.0 in v1.values.tolist() and 1.0 in v1.values.tolist()
+    # Determinism across instances.
+    (out2,) = (
+        FeatureHasher().set_input_cols(["age", "city", "clicks"])
+        .set_output_col("f").set_num_features(64).transform(t)
+    )
+    assert out2["f"][0] == v0
+
+
+def test_feature_hasher_same_category_same_bucket():
+    t = Table({"city": np.asarray(["sf", "sf", "nyc"])})
+    (out,) = (
+        FeatureHasher().set_input_cols(["city"]).set_output_col("f")
+        .set_num_features(32).transform(t)
+    )
+    assert out["f"][0] == out["f"][1]
+    assert out["f"][0] != out["f"][2]
+
+
+def test_feature_hasher_rejects_vector_columns():
+    t = Table({"v": np.zeros((3, 2))})
+    with pytest.raises(ValueError, match="VectorAssembler"):
+        FeatureHasher().set_input_cols(["v"]).set_output_col("f").transform(t)
+
+
+# -- Interaction -------------------------------------------------------------
+
+def test_interaction_outer_products():
+    a = np.asarray([[1.0, 2.0], [3.0, 4.0]])
+    b = np.asarray([[5.0, 6.0, 7.0], [1.0, 0.0, 2.0]])
+    s = np.asarray([2.0, 10.0])
+    t = Table({"a": a, "b": b, "s": s})
+    (out,) = (
+        Interaction().set_input_cols(["s", "a", "b"]).set_output_col("i")
+        .transform(t)
+    )
+    got = out["i"]
+    assert got.shape == (2, 6)
+    expected0 = 2.0 * np.outer([1.0, 2.0], [5.0, 6.0, 7.0]).ravel()
+    np.testing.assert_allclose(got[0], expected0)
+    with pytest.raises(ValueError, match="at least 2"):
+        Interaction().set_input_cols(["a"]).set_output_col("i").transform(t)
+
+
+# -- DCT ---------------------------------------------------------------------
+
+def test_dct_matches_scipy_and_inverts():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 8))
+    t = Table({"input": x})
+    (out,) = DCT().transform(t)
+    np.testing.assert_allclose(
+        out["output"], scipy_dct(x, type=2, norm="ortho", axis=1), rtol=1e-12
+    )
+    (back,) = DCT().set_inverse(True).transform(
+        out.rename({"output": "input"}).select("input")
+    )
+    np.testing.assert_allclose(back["output"], x, atol=1e-12)
+
+
+# -- StopWordsRemover --------------------------------------------------------
+
+def test_stop_words_default_english():
+    t = Table({"text": np.asarray(["The cat IS on the mat"])})
+    (tok,) = Tokenizer().set_input_col("text").set_output_col("tok").transform(t)
+    (out,) = (
+        StopWordsRemover().set_input_cols(["tok"]).set_output_cols(["clean"])
+        .transform(tok)
+    )
+    assert out["clean"][0] == ["cat", "mat"]
+
+
+def test_stop_words_case_sensitive_and_custom():
+    t = Table({"tok": np.asarray([None], dtype=object)})
+    tok = Table({"tok": np.empty(1, dtype=object)})
+    tok["tok"][0] = ["Keep", "keep", "drop"]
+    (out,) = (
+        StopWordsRemover().set_input_cols(["tok"]).set_output_cols(["c"])
+        .set_stop_words(["keep"]).set_case_sensitive(True)
+        .transform(tok)
+    )
+    assert out["c"][0] == ["Keep", "drop"]
+    (out2,) = (
+        StopWordsRemover().set_input_cols(["tok"]).set_output_cols(["c"])
+        .set_stop_words(["keep"]).transform(tok)
+    )
+    assert out2["c"][0] == ["drop"]
+
+
+# -- RandomSplitter ----------------------------------------------------------
+
+def test_random_splitter_partitions_everything():
+    rng = np.random.default_rng(1)
+    t = Table({"x": rng.normal(size=5000), "id": np.arange(5000)})
+    train, test = RandomSplitter().set_weights([0.8, 0.2]).set_seed(0).transform(t)
+    assert train.num_rows + test.num_rows == 5000
+    assert abs(train.num_rows / 5000 - 0.8) < 0.02
+    # Disjoint and complete.
+    ids = np.concatenate([train["id"], test["id"]])
+    assert len(np.unique(ids)) == 5000
+
+
+def test_random_splitter_deterministic_and_three_way():
+    t = Table({"id": np.arange(1000)})
+    s1 = RandomSplitter().set_weights([1.0, 1.0, 2.0]).set_seed(7).transform(t)
+    s2 = RandomSplitter().set_weights([1.0, 1.0, 2.0]).set_seed(7).transform(t)
+    for a, b in zip(s1, s2):
+        np.testing.assert_array_equal(a["id"], b["id"])
+    assert len(s1) == 3
+    assert abs(s1[2].num_rows / 1000 - 0.5) < 0.06
+    with pytest.raises(ValueError, match="positive"):
+        RandomSplitter().set_weights([1.0, -1.0]).transform(t)
+
+
+def test_stop_words_missing_output_cols_clear_error():
+    t = Table({"tok": np.empty(1, dtype=object)})
+    t["tok"][0] = ["a"]
+    with pytest.raises(ValueError, match="outputCols"):
+        StopWordsRemover().set_input_cols(["tok"]).transform(t)
