@@ -277,12 +277,52 @@ def _inner_sparse() -> float:
     )
 
 
+def _inner_gbt() -> float:
+    """Stage 5: histogram GBT — the whole forest (scan over trees,
+    per-level segment-sum histograms) in one device program. Metric:
+    row-tree builds per second (n * numTrees / elapsed)."""
+    _setup_jax_cache()
+    import jax
+
+    from flinkml_tpu.models.gbt import (
+        _forest_builder, bin_features, quantile_bin_edges,
+    )
+    from flinkml_tpu.parallel import DeviceMesh
+
+    n, d, bins, depth, trees = 262_144, 32, 64, 5, 20
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
+    y = (x[:, 0] * x[:, 1] > 0).astype(np.float32)
+    w = np.ones(n, dtype=np.float32)
+    edges = quantile_bin_edges(x, bins)
+    binned = bin_features(x, edges)
+    mesh = DeviceMesh()
+    builder = _forest_builder(
+        mesh.mesh, DeviceMesh.DATA_AXIS, d, bins, depth, trees, True
+    )
+    import jax.numpy as jnp
+
+    f32 = lambda v: jnp.asarray(v, jnp.float32)
+    args = (
+        mesh.shard_batch(binned), mesh.shard_batch(y), mesh.shard_batch(w),
+        f32(0.0), f32(0.2), f32(1.0), f32(1.0), jax.random.PRNGKey(0),
+    )
+    _log("gbt: compiling + warm-up dispatch ...")
+    np.asarray(builder(*args)[2])
+    _log("gbt: measuring ...")
+    start = time.perf_counter()
+    np.asarray(builder(*args)[2])
+    elapsed = time.perf_counter() - start
+    return n * trees / elapsed
+
+
 _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
     "dense_bf16": _inner_dense_bf16,
     "sparse": _inner_sparse,
     "kmeans": _inner_kmeans,
+    "gbt": _inner_gbt,
 }
 
 
@@ -343,11 +383,13 @@ def main():
     sparse_sps = None
     bf16_sps = None
     kmeans_pps = None
+    gbt_rts = None
     if _run_stage("probe", probe_timeout, deadline) is not None:
         device_sps = _run_stage("dense", total_budget, deadline)
         sparse_sps = _run_stage("sparse", total_budget, deadline)
         bf16_sps = _run_stage("dense_bf16", total_budget, deadline)
         kmeans_pps = _run_stage("kmeans", total_budget, deadline)
+        gbt_rts = _run_stage("gbt", total_budget, deadline)
     else:
         _log("probe failed; skipping device measurement")
 
@@ -382,6 +424,10 @@ def main():
         # KMeans Lloyd, MNIST-784 profile (n=262k, d=784, k=10),
         # whole loop on device.
         extras["kmeans_points_per_sec_per_chip"] = round(kmeans_pps, 1)
+    if gbt_rts is not None:
+        # Histogram GBT forest build (n=262k, d=32, depth 5, 20 trees):
+        # row-tree builds per second.
+        extras["gbt_row_trees_per_sec_per_chip"] = round(gbt_rts, 1)
     if extras:
         # Secondary measurements kept inside the single JSON line.
         record["extras"] = extras

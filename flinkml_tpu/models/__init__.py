@@ -34,8 +34,16 @@ from flinkml_tpu.models.feature_transforms import (
     PolynomialExpansion,
     VectorSlicer,
 )
+from flinkml_tpu.models.gbt import (
+    GBTClassifier,
+    GBTClassifierModel,
+    GBTRegressor,
+    GBTRegressorModel,
+)
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
+from flinkml_tpu.models.agglomerative import AgglomerativeClustering
 from flinkml_tpu.models.als import ALS, ALSModel
+from flinkml_tpu.models.swing import Swing
 from flinkml_tpu.models.pca import PCA, PCAModel
 from flinkml_tpu.models.misc_transforms import (
     DCT,
@@ -110,6 +118,12 @@ __all__ = [
     "ImputerModel",
     "ALS",
     "ALSModel",
+    "AgglomerativeClustering",
+    "Swing",
+    "GBTClassifier",
+    "GBTClassifierModel",
+    "GBTRegressor",
+    "GBTRegressorModel",
     "PCA",
     "PCAModel",
     "Tokenizer",

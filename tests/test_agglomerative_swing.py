@@ -1,0 +1,189 @@
+"""AgglomerativeClustering vs sklearn (all linkages) + Swing semantics."""
+
+import numpy as np
+import pytest
+from sklearn.cluster import AgglomerativeClustering as SkAgg
+from sklearn.metrics import adjusted_rand_score
+
+from flinkml_tpu.models import AgglomerativeClustering, Swing
+from flinkml_tpu.models.agglomerative import agglomerate
+from flinkml_tpu.table import Table
+
+
+def _blobs(n_per=30, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.concatenate([
+        rng.normal(size=(n_per, 2)) * 0.5 + c
+        for c in ([0, 0], [6, 0], [0, 6])
+    ])
+
+
+@pytest.mark.parametrize("linkage", ["ward", "complete", "average", "single"])
+def test_agglomerative_matches_sklearn(linkage):
+    x = _blobs(seed=1)
+    ours = agglomerate(x, linkage=linkage, num_clusters=3)
+    ref = SkAgg(n_clusters=3, linkage=linkage).fit_predict(x)
+    assert adjusted_rand_score(ours, ref) == 1.0
+
+
+def test_agglomerative_distance_threshold_matches_sklearn():
+    x = _blobs(seed=2)
+    for thr in (2.0, 8.0):
+        ours = agglomerate(x, linkage="average", num_clusters=None,
+                           distance_threshold=thr)
+        ref = SkAgg(
+            n_clusters=None, distance_threshold=thr, linkage="average"
+        ).fit_predict(x)
+        assert len(np.unique(ours)) == len(np.unique(ref))
+        assert adjusted_rand_score(ours, ref) == 1.0
+
+
+def test_agglomerative_operator_labels_first_appearance():
+    x = _blobs(n_per=10, seed=3)
+    t = Table({"features": x})
+    (out,) = AgglomerativeClustering().set_num_clusters(3).transform(t)
+    labels = out["prediction"]
+    assert labels[0] == 0.0  # first row defines cluster 0
+    assert set(np.unique(labels)) == {0.0, 1.0, 2.0}
+    with pytest.raises(ValueError, match="numClusters"):
+        AgglomerativeClustering().set_num_clusters(99).transform(
+            Table({"features": x[:5]})
+        )
+
+
+def test_agglomerative_ward_threshold_scale():
+    # Ward reports sqrt of the Ward objective (sklearn convention):
+    # two far blobs at distance ~12 merge only above that threshold.
+    x = _blobs(seed=4)[:60]  # two blobs
+    low = agglomerate(x, "ward", None, distance_threshold=3.0)
+    high = agglomerate(x, "ward", None, distance_threshold=1000.0)
+    assert len(np.unique(low)) >= 2
+    assert len(np.unique(high)) == 1
+
+
+# -- Swing -------------------------------------------------------------------
+
+def _swing(**kw):
+    s = (
+        Swing().set_k(5).set_min_user_behavior(2).set_max_user_behavior(100)
+    )
+    for name, v in kw.items():
+        getattr(s, f"set_{name}")(v)
+    return s
+
+
+def test_swing_finds_co_consumed_items():
+    # Items 0,1 always consumed together; item 2 by disjoint users.
+    users = np.asarray([0, 0, 1, 1, 2, 2, 3, 3, 4, 4])
+    items = np.asarray([0, 1, 0, 1, 0, 1, 2, 3, 2, 3])
+    t = Table({"user": users, "item": items})
+    (out,) = _swing().transform(t)
+    row0 = {it: s for it, s in zip(out["similarItems"][0], out["scores"][0])}
+    assert 1 in row0 and row0[1] > 0
+    assert 2 not in row0 and 3 not in row0   # no shared users
+    # Symmetry.
+    row1 = {it: s for it, s in zip(out["similarItems"][1], out["scores"][1])}
+    assert row1[0] == pytest.approx(row0[1])
+
+
+def test_swing_overlap_damping():
+    # Pair (0,1) supported by users with ONLY those two items; pair (2,3)
+    # supported by users sharing many items -> weaker per-pair evidence.
+    users, items = [], []
+    for u in range(4):  # users 0-3: exactly items {0, 1}
+        users += [u, u]
+        items += [0, 1]
+    for u in range(4, 8):  # users 4-7: items {2, 3, 4, 5, 6}
+        users += [u] * 5
+        items += [2, 3, 4, 5, 6]
+    t = Table({"user": np.asarray(users), "item": np.asarray(items)})
+    (out,) = _swing(alpha1=1.0, beta=0.0).transform(t)
+    by_item = {
+        it: dict(zip(sim, sc))
+        for it, sim, sc in zip(out["item"], out["similarItems"], out["scores"])
+    }
+    assert by_item[0][1] > by_item[2][3]
+
+
+def test_swing_behavior_bounds_filter_users():
+    users = np.asarray([0, 1, 1, 2, 2, 2, 2, 2])
+    items = np.asarray([0, 0, 1, 0, 1, 2, 3, 4])
+    t = Table({"user": users, "item": items})
+    # minUserBehavior=2 drops user 0; maxUserBehavior=4 drops user 2.
+    (out,) = (
+        Swing().set_k(5).set_min_user_behavior(2).set_max_user_behavior(4)
+        .transform(t)
+    )
+    # Only user 1 remains -> no user PAIRS -> no similarities anywhere.
+    assert all(len(s) == 0 for s in out["similarItems"])
+    with pytest.raises(ValueError, match="minUserBehavior"):
+        Swing().set_min_user_behavior(5).set_max_user_behavior(2).transform(t)
+
+
+def test_swing_k_truncates_and_sorts():
+    rng = np.random.default_rng(5)
+    users = np.repeat(np.arange(12), 6)
+    items = np.concatenate([
+        rng.choice(8, size=6, replace=False) for _ in range(12)
+    ])
+    t = Table({"user": users, "item": items})
+    (out,) = _swing(k=3).transform(t)
+    for sc in out["scores"]:
+        assert len(sc) <= 3
+        assert np.all(np.diff(sc) <= 1e-12)
+
+
+def test_swing_cap_gates_contributions():
+    # Items 0,1 shared by users 0,1,2. With maxUserNumPerItem=2, user 2
+    # is evicted from both items' lists, so only the (0,1) user pair may
+    # contribute anywhere.
+    users = np.asarray([0, 0, 1, 1, 2, 2])
+    items = np.asarray([0, 1, 0, 1, 0, 1])
+    t = Table({"user": users, "item": items})
+    (capped,) = (
+        Swing().set_k(5).set_min_user_behavior(2).set_max_user_behavior(10)
+        .set_max_user_num_per_item(2).set_alpha1(1.0).set_beta(0.0)
+        .transform(t)
+    )
+    (full,) = (
+        Swing().set_k(5).set_min_user_behavior(2).set_max_user_behavior(10)
+        .set_alpha1(1.0).set_beta(0.0)
+        .transform(t)
+    )
+    # Full: 3 user pairs x 1/(1+2); capped: 1 user pair.
+    assert full["scores"][0][0] == pytest.approx(3 / 3)
+    assert capped["scores"][0][0] == pytest.approx(1 / 3)
+
+
+def test_swing_output_uses_item_col_name():
+    t = Table({"u": np.asarray([0, 0, 1, 1]),
+               "movie": np.asarray([0, 1, 0, 1])})
+    (out,) = (
+        Swing().set_user_col("u").set_item_col("movie")
+        .set_min_user_behavior(2).set_max_user_behavior(10)
+        .transform(t)
+    )
+    assert "movie" in out.column_names
+
+
+def test_agglomerative_distance_threshold_resettable():
+    op = AgglomerativeClustering().set_distance_threshold(2.0)
+    op.set_distance_threshold(None)
+    x = _blobs(n_per=5, seed=9)
+    (out,) = op.set_num_clusters(3).transform(Table({"features": x}))
+    assert len(np.unique(out["prediction"])) == 3
+
+
+def test_agglomerative_matches_sklearn_fuzz():
+    # Random (unseparated) gaussians: merge order is precision-sensitive;
+    # the f64 distance matrix must track sklearn exactly.
+    from itertools import product
+
+    for seed, linkage in product(range(6), ["ward", "average", "single"]):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(8, 40))
+        x = rng.normal(size=(n, 3))
+        k = int(rng.integers(2, min(6, n)))
+        ours = agglomerate(x, linkage=linkage, num_clusters=k)
+        ref = SkAgg(n_clusters=k, linkage=linkage).fit_predict(x)
+        assert adjusted_rand_score(ours, ref) == 1.0, (seed, linkage, k)
