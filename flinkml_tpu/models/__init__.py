@@ -40,7 +40,16 @@ from flinkml_tpu.models.gbt import (
     GBTRegressor,
     GBTRegressorModel,
 )
+from flinkml_tpu.models.discretizer import (
+    KBinsDiscretizer,
+    KBinsDiscretizerModel,
+)
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
+from flinkml_tpu.models.online_scaler import (
+    OnlineStandardScaler,
+    OnlineStandardScalerModel,
+)
+from flinkml_tpu.models.stats import Correlation
 from flinkml_tpu.models.agglomerative import AgglomerativeClustering
 from flinkml_tpu.models.als import ALS, ALSModel
 from flinkml_tpu.models.swing import Swing
@@ -116,6 +125,11 @@ __all__ = [
     "Bucketizer",
     "Imputer",
     "ImputerModel",
+    "KBinsDiscretizer",
+    "KBinsDiscretizerModel",
+    "OnlineStandardScaler",
+    "OnlineStandardScalerModel",
+    "Correlation",
     "ALS",
     "ALSModel",
     "AgglomerativeClustering",

@@ -1,0 +1,118 @@
+"""OnlineStandardScaler — standardization statistics over an unbounded
+stream.
+
+Member of the wider Flink ML family (upstream ``OnlineStandardScaler``:
+continuously-updated mean/std emitted as versioned models — online
+feature engineering is Flink ML's signature capability). Third user of
+the unbounded-iteration mode after OnlineLogisticRegression /
+OnlineKMeans.
+
+Statistics merge exactly per batch via Chan's parallel
+mean/M2 combination (no accumulation drift regardless of stream
+length); each consumed batch bumps ``model_version``, mirroring the
+other online models. The fitted model transforms exactly like
+``StandardScalerModel`` (``withMean``/``withStd``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from flinkml_tpu.api import Estimator
+from flinkml_tpu.common_params import HasGlobalBatchSize
+from flinkml_tpu.iteration import (
+    IterationConfig,
+    Iterations,
+    TerminateOnMaxIter,
+)
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.models.scalers import StandardScalerModel, _HasInputOutputCol
+from flinkml_tpu.table import Table
+
+
+class OnlineStandardScaler(
+    _HasInputOutputCol, HasGlobalBatchSize, Estimator
+):
+    WITH_MEAN = StandardScalerModel.WITH_MEAN
+    WITH_STD = StandardScalerModel.WITH_STD
+
+    def fit(self, *inputs: Table) -> "OnlineStandardScalerModel":
+        """Consume the table as a stream of globalBatchSize mini-batches."""
+        (table,) = inputs
+        return self.fit_stream(
+            table.batches(self.get(self.GLOBAL_BATCH_SIZE))
+        )
+
+    def fit_stream(self, batches: Iterable[Table]) -> "OnlineStandardScalerModel":
+        input_col = self.get(self.INPUT_COL)
+
+        state = {"n": 0.0, "mean": None, "m2": None, "version": 0}
+
+        def step(carry, batch_table, epoch):
+            x = features_matrix(batch_table, input_col).astype(np.float64)
+            nb = float(x.shape[0])
+            if nb == 0:
+                return carry, None
+            mb = x.mean(axis=0)
+            m2b = ((x - mb) ** 2).sum(axis=0)
+            if carry["mean"] is None:
+                carry["mean"] = mb
+                carry["m2"] = m2b
+                carry["n"] = nb
+            else:
+                # Chan et al. pairwise merge: exact for any batch split.
+                na = carry["n"]
+                delta = mb - carry["mean"]
+                n = na + nb
+                carry["mean"] = carry["mean"] + delta * (nb / n)
+                carry["m2"] = (
+                    carry["m2"] + m2b + delta * delta * (na * nb / n)
+                )
+                carry["n"] = n
+            carry["version"] += 1
+            return carry, None
+
+        result = Iterations.iterate_unbounded_streams(
+            step, state, batches, IterationConfig(TerminateOnMaxIter(2**31 - 1))
+        )
+        final = result.state
+        if final["mean"] is None:
+            raise ValueError("training stream is empty")
+        model = OnlineStandardScalerModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({
+            "mean": final["mean"][None, :],
+            "std": np.sqrt(final["m2"] / final["n"])[None, :],
+        }))
+        model._model_version = final["version"]
+        return model
+
+
+class OnlineStandardScalerModel(StandardScalerModel):
+    """StandardScalerModel + the online model-version counter (persisted,
+    like the other online models')."""
+
+    def __init__(self):
+        super().__init__()
+        self._model_version = 0
+
+    @property
+    def model_version(self) -> int:
+        return self._model_version
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(
+            path, {"mean": self._mean, "std": self._std},
+            extra={"modelVersion": self._model_version},
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "OnlineStandardScalerModel":
+        model, arrays, meta = cls._load_with_arrays(path)
+        model._mean = arrays["mean"]
+        model._std = arrays["std"]
+        model._model_version = int(meta.get("modelVersion", 0))
+        return model

@@ -1,0 +1,90 @@
+"""Correlation — Pearson / Spearman correlation matrix of a features
+column (the Spark/Flink ``Correlation`` stat operator).
+
+Pearson runs on the mesh: the correlation matrix is the normalized
+centered gram, and the gram pass is the same sharded MXU reduction PCA
+uses (per-device ``centered_xᵀ @ centered_x`` + one ``psum``). Spearman
+is Pearson over per-column average ranks; ranking is a host sort (ties
+get average ranks, the scipy convention).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.api import AlgoOperator
+from flinkml_tpu.common_params import HasFeaturesCol
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.models.pca import _mean_and_gram_fn
+from flinkml_tpu.models.scalers import _shard_with_mask
+from flinkml_tpu.params import ParamValidators, StringParam
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+PEARSON = "pearson"
+SPEARMAN = "spearman"
+
+
+def _average_ranks(col: np.ndarray) -> np.ndarray:
+    """1-based average ranks with ties averaged (scipy ``rankdata``)."""
+    order = np.argsort(col, kind="stable")
+    sorted_col = col[order]
+    # Rank span of each tie group -> average rank per group.
+    boundaries = np.concatenate(
+        [[True], sorted_col[1:] != sorted_col[:-1]]
+    )
+    group = np.cumsum(boundaries) - 1
+    start = np.nonzero(boundaries)[0]
+    stop = np.append(start[1:], len(col))
+    avg = (start + stop - 1) / 2.0 + 1.0
+    ranks = np.empty(len(col))
+    ranks[order] = avg[group]
+    return ranks
+
+
+def correlation_matrix(
+    x: np.ndarray, method: str = PEARSON, mesh: DeviceMesh = None
+) -> np.ndarray:
+    x = np.asarray(x, dtype=np.float64)
+    if method == SPEARMAN:
+        x = np.stack([_average_ranks(x[:, j]) for j in range(x.shape[1])],
+                     axis=1)
+    mesh = mesh or DeviceMesh()
+    xd, wd = _shard_with_mask(x, mesh)
+    shift = np.asarray(x[0], dtype=np.float32)
+    cnt, s, g = _mean_and_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
+        xd, wd, jnp.asarray(shift)
+    )
+    cnt = float(cnt)
+    mean_c = np.asarray(s, np.float64) / cnt
+    cov = np.asarray(g, np.float64) / cnt - np.outer(mean_c, mean_c)
+    std = np.sqrt(np.maximum(np.diag(cov), 0.0))
+    safe = np.where(std > 0, std, 1.0)
+    corr = cov / np.outer(safe, safe)
+    # Constant columns correlate NaN with everything but 1 with themselves
+    # (the numpy/scipy convention).
+    const = std == 0
+    corr[const, :] = np.nan
+    corr[:, const] = np.nan
+    np.fill_diagonal(corr, 1.0)
+    return np.clip(corr, -1.0, 1.0, out=corr)
+
+
+class Correlation(HasFeaturesCol, AlgoOperator):
+    METHOD = StringParam(
+        "method", "Correlation method.", PEARSON,
+        ParamValidators.in_array([PEARSON, SPEARMAN]),
+    )
+
+    def __init__(self, mesh: DeviceMesh = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        x = features_matrix(table, self.get(self.FEATURES_COL))
+        corr = correlation_matrix(x, self.get(self.METHOD), self.mesh)
+        return (Table({"corr": corr[None, :, :]}),)

@@ -1,0 +1,158 @@
+"""KBinsDiscretizer / OnlineStandardScaler / Correlation vs sklearn/scipy."""
+
+import numpy as np
+import pytest
+from scipy.stats import spearmanr
+from sklearn.preprocessing import KBinsDiscretizer as SkKBins
+
+from flinkml_tpu.models import (
+    Correlation,
+    KBinsDiscretizer,
+    KBinsDiscretizerModel,
+    OnlineStandardScaler,
+    StandardScaler,
+)
+from flinkml_tpu.models.stats import _average_ranks, correlation_matrix
+from flinkml_tpu.table import Table
+
+
+def _x(n=500, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(loc=2.0, scale=3.0, size=(n, d))
+
+
+# -- KBinsDiscretizer --------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["uniform", "quantile", "kmeans"])
+def test_kbins_matches_sklearn(strategy):
+    x = _x(seed=1)
+    t = Table({"input": x})
+    model = KBinsDiscretizer().set_num_bins(5).set_strategy(strategy).fit(t)
+    (out,) = model.transform(t)
+    ref = SkKBins(
+        n_bins=5, encode="ordinal", strategy=strategy,
+        **({"subsample": None} if strategy != "uniform" else {}),
+    ).fit_transform(x)
+    agreement = (out["output"] == ref).mean()
+    # kmeans bin placement depends on the Lloyd init (ours is
+    # quantile-seeded, sklearn's differs), so only rough agreement is
+    # guaranteed; uniform/quantile should agree everywhere.
+    assert agreement > (0.85 if strategy == "kmeans" else 0.999), agreement
+
+
+def test_kbins_clips_out_of_range_and_roundtrips(tmp_path):
+    x = _x(seed=2)
+    t = Table({"input": x})
+    model = KBinsDiscretizer().set_num_bins(4).fit(t)
+    probe = Table({"input": np.asarray([[-1e9] * 4, [1e9] * 4])})
+    (out,) = model.transform(probe)
+    np.testing.assert_array_equal(out["output"][0], [0.0] * 4)
+    np.testing.assert_array_equal(out["output"][1], [3.0] * 4)
+    model.save(str(tmp_path / "kb"))
+    loaded = KBinsDiscretizerModel.load(str(tmp_path / "kb"))
+    np.testing.assert_array_equal(loaded.bin_edges, model.bin_edges)
+
+
+def test_kbins_constant_feature_single_bin():
+    x = _x(seed=3)
+    x[:, 2] = 5.0
+    t = Table({"input": x})
+    model = KBinsDiscretizer().set_num_bins(4).fit(t)
+    (out,) = model.transform(t)
+    assert np.all(out["output"][:, 2] == 0.0)
+
+
+# -- OnlineStandardScaler ----------------------------------------------------
+
+def test_online_scaler_matches_batch_exactly():
+    x = _x(n=1000, seed=4)
+    t = Table({"input": x})
+    online = OnlineStandardScaler().set_global_batch_size(64).fit(t)
+    batch = StandardScaler().fit(t)
+    (o1,) = online.transform(t)
+    (o2,) = batch.transform(t)
+    # Batch scaler sums in f32 on device; online merges in f64 on the
+    # host — near-zero standardized values can differ at f32 epsilon.
+    np.testing.assert_allclose(o1["output"], o2["output"], rtol=1e-5,
+                               atol=1e-6)
+    assert online._model_version == int(np.ceil(1000 / 64))
+
+
+def test_online_scaler_stream_and_flags():
+    x = _x(n=300, seed=5)
+    batches = [Table({"input": x[i: i + 50]}) for i in range(0, 300, 50)]
+    model = (
+        OnlineStandardScaler().set_with_mean(False).fit_stream(iter(batches))
+    )
+    (out,) = model.transform(Table({"input": x}))
+    std = x.std(axis=0)
+    np.testing.assert_allclose(out["output"], x / std, rtol=1e-9)
+    assert model.model_version == 6
+    with pytest.raises(ValueError, match="empty"):
+        OnlineStandardScaler().fit_stream(iter([]))
+
+
+# -- Correlation -------------------------------------------------------------
+
+def test_average_ranks_matches_scipy():
+    from scipy.stats import rankdata
+
+    rng = np.random.default_rng(6)
+    col = rng.integers(0, 5, 40).astype(float)   # heavy ties
+    np.testing.assert_allclose(_average_ranks(col), rankdata(col))
+
+
+def test_pearson_matches_numpy():
+    x = _x(n=800, seed=7)
+    x[:, 3] = 0.8 * x[:, 0] + 0.2 * x[:, 3]   # induce correlation
+    corr = correlation_matrix(x, "pearson")
+    np.testing.assert_allclose(corr, np.corrcoef(x, rowvar=False),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spearman_matches_scipy():
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(400, 3))
+    x[:, 1] = np.exp(x[:, 0]) + 0.3 * rng.normal(size=400)  # monotone link
+    corr = correlation_matrix(x, "spearman")
+    ref = spearmanr(x).statistic
+    np.testing.assert_allclose(corr, ref, rtol=1e-4, atol=1e-5)
+    assert corr[0, 1] > 0.9
+
+
+def test_correlation_operator_and_constant_columns():
+    x = _x(n=100, seed=9)
+    x[:, 1] = 7.0
+    (out,) = Correlation().transform(Table({"features": x}))
+    corr = out["corr"][0]
+    assert corr.shape == (4, 4)
+    assert corr[1, 1] == 1.0
+    assert np.isnan(corr[0, 1]) and np.isnan(corr[1, 0])
+    np.testing.assert_allclose(np.diag(corr), 1.0)
+
+
+def test_kmeans_strategy_skewed_ties():
+    # 9 zeros + one outlier: quantile seeding over RAW values collapses
+    # to one center; seeding from distinct values must keep 2 bins.
+    col = np.asarray([0.0] * 9 + [100.0])
+    t = Table({"input": col[:, None]})
+    model = KBinsDiscretizer().set_num_bins(2).set_strategy("kmeans").fit(t)
+    (out,) = model.transform(t)
+    np.testing.assert_array_equal(out["output"][:, 0], [0.0] * 9 + [1.0])
+
+
+def test_online_scaler_version_persists(tmp_path):
+    from flinkml_tpu.models import OnlineStandardScalerModel
+
+    x = _x(n=100, seed=10)
+    model = OnlineStandardScaler().set_global_batch_size(10).fit(
+        Table({"input": x})
+    )
+    assert model.model_version == 10
+    model.save(str(tmp_path / "oss"))
+    loaded = OnlineStandardScalerModel.load(str(tmp_path / "oss"))
+    assert loaded.model_version == 10
+    np.testing.assert_allclose(
+        loaded.transform(Table({"input": x}))[0]["output"],
+        model.transform(Table({"input": x}))[0]["output"],
+    )
