@@ -39,6 +39,13 @@ from flinkml_tpu.api import (
 from flinkml_tpu.table import Table
 from flinkml_tpu.pipeline import Pipeline, PipelineModel
 from flinkml_tpu.graph import GraphBuilder, Graph, GraphModel, TableId
+from flinkml_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
 
 __version__ = "0.1.0"
 
@@ -66,5 +73,10 @@ __all__ = [
     "Graph",
     "GraphModel",
     "TableId",
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
     "__version__",
 ]

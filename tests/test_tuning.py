@@ -1,0 +1,152 @@
+"""ParamGridBuilder / CrossValidator / TrainValidationSplit."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    Pipeline,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
+from flinkml_tpu.models import (
+    BinaryClassificationEvaluator,
+    GBTRegressor,
+    LogisticRegression,
+    RegressionEvaluator,
+    StandardScaler,
+)
+from flinkml_tpu.table import Table
+
+
+def _binary_data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 5))
+    y = (x[:, 0] + 0.5 * x[:, 1] + 0.3 * rng.normal(size=n) > 0).astype(float)
+    return Table({"features": x, "label": y})
+
+
+def _lr(max_iter=30):
+    return (
+        LogisticRegression().set_max_iter(max_iter).set_global_batch_size(512)
+        .set_learning_rate(1.0).set_seed(0)
+    )
+
+
+def test_param_grid_builder_cartesian():
+    lr = _lr()
+    grid = (
+        ParamGridBuilder()
+        .add_grid(lr, LogisticRegression.REG, [0.0, 0.1, 1.0])
+        .add_grid(lr, LogisticRegression.MAX_ITER, [10, 20])
+        .build()
+    )
+    assert len(grid) == 6
+    assert all(len(m) == 2 for m in grid)
+    with pytest.raises(ValueError, match="empty"):
+        ParamGridBuilder().add_grid(lr, LogisticRegression.REG, [])
+    with pytest.raises(ValueError, match="not defined"):
+        ParamGridBuilder().add_grid(lr, GBTRegressor.NUM_TREES, [5])
+
+
+def test_cross_validator_picks_sane_reg(tmp_path):
+    t = _binary_data()
+    lr = _lr()
+    grid = (
+        ParamGridBuilder()
+        .add_grid(lr, LogisticRegression.REG, [0.0, 100.0])
+        .build()
+    )
+    cv = CrossValidator(lr, grid, BinaryClassificationEvaluator())
+    cv.set_num_folds(3).set_seed(0)
+    model = cv.fit(t)
+    # Absurd regularization must lose.
+    assert model.best_index == 0
+    assert len(model.avg_metrics) == 2
+    assert model.avg_metrics[0] > model.avg_metrics[1]
+    assert model.param_maps_description[1]["LogisticRegression.reg"] == 100.0
+    (pred,) = model.transform(t)
+    assert (pred["prediction"] == t["label"]).mean() > 0.85
+    # Persistence: wrapper + inner model.
+    model.save(str(tmp_path / "cv"))
+    loaded = CrossValidatorModel.load(str(tmp_path / "cv"))
+    assert loaded.best_index == 0
+    assert loaded.avg_metrics == model.avg_metrics
+    (p2,) = loaded.transform(t)
+    np.testing.assert_array_equal(p2["prediction"], pred["prediction"])
+
+
+def test_cross_validator_validation_errors():
+    t = _binary_data(n=20)
+    lr = _lr()
+    grid = ParamGridBuilder().add_grid(lr, LogisticRegression.REG, [0.0]).build()
+    with pytest.raises(ValueError, match="estimator and evaluator"):
+        CrossValidator(None, grid, None).fit(t)
+    cv = CrossValidator(lr, grid, BinaryClassificationEvaluator())
+    with pytest.raises(ValueError, match="rows < numFolds"):
+        cv.set_num_folds(30).fit(t)
+
+
+def test_train_validation_split_smaller_better_metric(tmp_path):
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-2, 2, size=(600, 4))
+    y = np.where(x[:, 0] > 0, 2.0, -1.0) + x[:, 1] ** 2
+    t = Table({"features": x, "label": y})
+    gbt = GBTRegressor().set_learning_rate(0.2).set_seed(0)
+    grid = (
+        ParamGridBuilder()
+        .add_grid(gbt, GBTRegressor.NUM_TREES, [1, 40])
+        .build()
+    )
+    tvs = TrainValidationSplit(
+        gbt, grid, RegressionEvaluator().set_metrics_names(["rmse"])
+    )
+    tvs.set_larger_better(False).set_seed(0)
+    model = tvs.fit(t)
+    assert model.best_index == 1        # 40 trees beats 1 tree on rmse
+    model.save(str(tmp_path / "tvs"))
+    loaded = TrainValidationSplitModel.load(str(tmp_path / "tvs"))
+    (p1,) = model.transform(t)
+    (p2,) = loaded.transform(t)
+    np.testing.assert_allclose(p2["prediction"], p1["prediction"])
+
+
+def test_tuning_over_pipeline_inner_stage():
+    t = Table({
+        "input": np.random.default_rng(2).normal(size=(300, 4)),
+    })
+    y = (t["input"][:, 0] > 0).astype(float)
+    t = t.with_column("label", y)
+    lr = _lr().set_features_col("features")
+    pipe = Pipeline([
+        StandardScaler().set_output_col("features"),
+        lr,
+    ])
+    grid = (
+        ParamGridBuilder()
+        .add_grid(lr, LogisticRegression.REG, [0.0, 50.0])
+        .build()
+    )
+    cv = CrossValidator(pipe, grid, BinaryClassificationEvaluator())
+    cv.set_num_folds(2).set_seed(0)
+    model = cv.fit(t)
+    assert model.best_index == 0
+    (pred,) = model.transform(t)
+    assert (pred["prediction"] == y).mean() > 0.9
+
+
+def test_metric_name_selection():
+    t = _binary_data(seed=3)
+    lr = _lr()
+    grid = ParamGridBuilder().add_grid(lr, LogisticRegression.REG, [0.0]).build()
+    cv = CrossValidator(
+        lr, grid,
+        BinaryClassificationEvaluator().set_metrics_names(
+            ["areaUnderPR", "areaUnderROC"]
+        ),
+    )
+    cv.set_metric_name("areaUnderROC").set_num_folds(2).set_seed(0)
+    model = cv.fit(t)
+    assert 0.5 < model.avg_metrics[0] <= 1.0
