@@ -44,7 +44,18 @@ from flinkml_tpu.models.discretizer import (
     KBinsDiscretizer,
     KBinsDiscretizerModel,
 )
+from flinkml_tpu.models.fm import (
+    FMClassifier,
+    FMClassifierModel,
+    FMRegressor,
+    FMRegressorModel,
+)
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
+from flinkml_tpu.models.isotonic import (
+    IsotonicRegression,
+    IsotonicRegressionModel,
+)
+from flinkml_tpu.models.mlp import MLPClassifier, MLPClassifierModel
 from flinkml_tpu.models.online_scaler import (
     OnlineStandardScaler,
     OnlineStandardScalerModel,
@@ -138,6 +149,14 @@ __all__ = [
     "GBTClassifierModel",
     "GBTRegressor",
     "GBTRegressorModel",
+    "MLPClassifier",
+    "MLPClassifierModel",
+    "FMClassifier",
+    "FMClassifierModel",
+    "FMRegressor",
+    "FMRegressorModel",
+    "IsotonicRegression",
+    "IsotonicRegressionModel",
     "PCA",
     "PCAModel",
     "Tokenizer",

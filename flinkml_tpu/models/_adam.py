@@ -1,0 +1,91 @@
+"""Shared whole-run Adam trainer: a ``lax.while_loop`` of psum'd
+minibatch steps over a data-sharded mesh.
+
+The scaffold behind MLPClassifier and the factorization machines — any
+model whose parameters are a flat tuple of arrays and whose loss is a
+per-row weighted sum. The differentiated function contains NO
+collectives; local gradient sums are ``psum``'d explicitly and divided
+by the global batch weight, which keeps cross-device semantics
+unambiguous (no reliance on psum-transpose rules).
+
+Convergence: stop when ``|loss_{t-1} - loss_t| <= tol`` or at
+``max_iter`` steps. Minibatch indices come from a per-step
+``fold_in``; the key is replicated, so every device samples the same
+local row positions of its own (distinct) shard.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@functools.lru_cache(maxsize=32)
+def make_adam_trainer(mesh, axis: str, local_bs: int, loss_builder,
+                      n_params: int, frozen_tail: int = 0):
+    """``loss_builder`` is a HASHABLE factory (module-level function)
+    returning ``loss(params_tuple, xb, yb, wb) -> local weighted sum``.
+    Returns a jitted ``trainer(x, y, w, params0, lr, max_iter, tol, key)
+    -> (params, steps, loss)``.
+
+    The last ``frozen_tail`` entries of the params tuple are constants
+    smuggled through the pytree (e.g. a regularization strength the loss
+    reads); their gradients are zeroed so Adam never touches them.
+    """
+    local_loss = loss_builder()
+
+    def local(x, y, w, params, lr, max_iter, tol, key):
+        n_local = x.shape[0]
+        m0 = jax.tree.map(jnp.zeros_like, params)
+        v0 = jax.tree.map(jnp.zeros_like, params)
+
+        def cond(state):
+            step, _, _, _, prev, cur = state
+            return (step < max_iter) & (jnp.abs(prev - cur) > tol)
+
+        def body(state):
+            step, params, m, v, _, last = state
+            k = jax.random.fold_in(key, step)
+            idx = jax.random.randint(k, (local_bs,), 0, n_local)
+            xb, yb, wb = x[idx], y[idx], w[idx]
+            loss_sum, grads = jax.value_and_grad(local_loss)(
+                params, xb, yb, wb
+            )
+            total_w = jnp.maximum(jax.lax.psum(jnp.sum(wb), axis), 1e-12)
+            loss = jax.lax.psum(loss_sum, axis) / total_w
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, axis) / total_w, grads
+            )
+            if frozen_tail:
+                grads = tuple(grads[: n_params - frozen_tail]) + tuple(
+                    jnp.zeros_like(g)
+                    for g in grads[n_params - frozen_tail:]
+                )
+            t = (step + 1).astype(jnp.float32)
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree.map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+            v = jax.tree.map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+            params = jax.tree.map(
+                lambda p, mm, vv: p - lr * (mm / (1 - b1 ** t))
+                / (jnp.sqrt(vv / (1 - b2 ** t)) + eps),
+                params, m, v,
+            )
+            return step + 1, params, m, v, last, loss
+
+        inf = jnp.asarray(jnp.inf, jnp.float32)
+        state = (jnp.asarray(0, jnp.int32), params, m0, v0, inf, -inf)
+        step, params, _, _, _, loss = jax.lax.while_loop(cond, body, state)
+        return params, step, loss
+
+    flat_specs = tuple(P() for _ in range(n_params))
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), flat_specs,
+                      P(), P(), P(), P()),
+            out_specs=(flat_specs, P(), P()),
+        )
+    )

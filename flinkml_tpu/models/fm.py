@@ -1,0 +1,234 @@
+"""Factorization machines: FMClassifier (logistic) and FMRegressor
+(squared loss).
+
+Second-order FMs (Rendle): ``ŷ(x) = w₀ + w·x + ½ Σ_f [(x·V_f)² −
+(x² · V_f²)]`` — the pairwise-interaction term computed with the
+O(n·d·k) "sum-of-squares" identity, which on TPU is two batched MXU
+matmuls (``x @ V`` and ``x² @ V²``); no explicit feature-pair loop
+exists. Training rides the shared whole-run Adam device trainer
+(``_adam.make_adam_trainer``): one program, psum'd minibatch steps over
+the data-sharded mesh. L2 regularization applies to w and V (not the
+intercept), scaled per-minibatch like the loss.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import (
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasReg,
+    HasSeed,
+    HasTol,
+    HasWeightCol,
+)
+from flinkml_tpu.models._adam import make_adam_trainer
+from flinkml_tpu.models._data import (
+    check_binary_labels,
+    features_matrix,
+    labeled_data,
+)
+from flinkml_tpu.params import IntParam, ParamValidators
+from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
+from flinkml_tpu.table import Table
+
+
+class _FMParams(
+    HasFeaturesCol, HasLabelCol, HasPredictionCol, HasRawPredictionCol,
+    HasWeightCol, HasMaxIter, HasLearningRate, HasGlobalBatchSize, HasReg,
+    HasTol, HasSeed,
+):
+    FACTOR_SIZE = IntParam(
+        "factorSize", "Dimensionality of the interaction factors.", 8,
+        ParamValidators.gt(0),
+    )
+
+
+def _fm_margin(params, xb):
+    """params = (w0 [1], w [d], v [d, k]); returns [n] margins."""
+    w0, w, v = params
+    linear = xb @ w
+    xv = xb @ v                       # [n, k] on the MXU
+    x2v2 = (xb * xb) @ (v * v)        # [n, k]
+    pair = 0.5 * jnp.sum(xv * xv - x2v2, axis=1)
+    return w0[0] + linear + pair
+
+
+def _fm_logistic_loss_builder():
+    def local_loss(params, xb, yb, wb):
+        margin = _fm_margin(params[:3], xb)
+        # params[3] is a [1] array holding the L2 strength (a constant
+        # carried through the tuple so the builder stays argument-free).
+        nll = jnp.logaddexp(0.0, margin) - yb * margin
+        w0, w, v = params[:3]
+        reg = params[3][0] * (jnp.sum(w * w) + jnp.sum(v * v))
+        return jnp.sum(nll * wb) + reg * jnp.sum(wb)
+
+    return local_loss
+
+
+def _fm_squared_loss_builder():
+    def local_loss(params, xb, yb, wb):
+        err = _fm_margin(params[:3], xb) - yb
+        w0, w, v = params[:3]
+        reg = params[3][0] * (jnp.sum(w * w) + jnp.sum(v * v))
+        return 0.5 * jnp.sum(err * err * wb) + reg * jnp.sum(wb)
+
+    return local_loss
+
+
+class _FMBase(_FMParams, Estimator):
+    _LOGISTIC = True
+
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table):
+        (table,) = inputs
+        x, y, w = labeled_data(
+            table, self.get(self.FEATURES_COL), self.get(self.LABEL_COL),
+            self.get(self.WEIGHT_COL),
+        )
+        if self._LOGISTIC:
+            check_binary_labels(y, type(self).__name__)
+        d = x.shape[1]
+        k = self.get(self.FACTOR_SIZE)
+        mesh = self.mesh or DeviceMesh()
+        p = mesh.axis_size()
+        x_pad, n_valid = pad_to_multiple(x.astype(np.float32), p)
+        y_pad, _ = pad_to_multiple(y.astype(np.float32), p)
+        w_pad = np.zeros(x_pad.shape[0], np.float32)
+        w_pad[:n_valid] = w[:n_valid].astype(np.float32)
+        local_bs = max(1, self.get(self.GLOBAL_BATCH_SIZE) // p)
+        builder = (
+            _fm_logistic_loss_builder if self._LOGISTIC
+            else _fm_squared_loss_builder
+        )
+        trainer = make_adam_trainer(
+            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs, builder, 4,
+            frozen_tail=1,
+        )
+        key = jax.random.PRNGKey(self.get_seed())
+        v0 = jax.random.normal(key, (d, k), jnp.float32) * 0.01
+        params0 = (
+            jnp.zeros(1, jnp.float32),
+            jnp.zeros(d, jnp.float32),
+            v0,
+            jnp.asarray([self.get(self.REG)], jnp.float32),
+        )
+        f32 = lambda val: jnp.asarray(val, jnp.float32)
+        params, steps, loss = trainer(
+            mesh.shard_batch(x_pad), mesh.shard_batch(y_pad),
+            mesh.shard_batch(w_pad), params0,
+            f32(self.get(self.LEARNING_RATE)),
+            jnp.asarray(self.get(self.MAX_ITER), jnp.int32),
+            f32(self.get(self.TOL)),
+            jax.random.fold_in(key, 321),
+        )
+        model = (FMClassifierModel if self._LOGISTIC else FMRegressorModel)()
+        model.copy_params_from(self)
+        model._set(np.asarray(params[0], np.float64)[0],
+                   np.asarray(params[1], np.float64),
+                   np.asarray(params[2], np.float64))
+        return model
+
+
+class _FMModelBase(_FMParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._w0: Optional[float] = None
+        self._w: Optional[np.ndarray] = None
+        self._v: Optional[np.ndarray] = None
+
+    def _set(self, w0, w, v):
+        self._w0, self._w, self._v = float(w0), np.asarray(w), np.asarray(v)
+
+    def set_model_data(self, *inputs: Table):
+        (table,) = inputs
+        self._set(
+            float(np.asarray(table.column("w0"))[0]),
+            np.asarray(table.column("w"), np.float64)[0],
+            np.asarray(table.column("v"), np.float64)[0],
+        )
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({
+            "w0": np.asarray([self._w0]),
+            "w": self._w[None, :],
+            "v": self._v[None, :, :],
+        })]
+
+    def _require(self) -> None:
+        if self._w is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def _margin(self, table: Table) -> np.ndarray:
+        x = features_matrix(table, self.get(self.FEATURES_COL))
+        xv = x @ self._v
+        x2v2 = (x * x) @ (self._v * self._v)
+        return self._w0 + x @ self._w + 0.5 * (xv * xv - x2v2).sum(axis=1)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(path, {
+            "w0": np.asarray(self._w0), "w": self._w, "v": self._v,
+        })
+
+    @classmethod
+    def load(cls, path: str):
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._set(float(arrays["w0"]), arrays["w"], arrays["v"])
+        return model
+
+
+class FMClassifier(_FMBase):
+    """Binary factorization-machine classifier (logistic loss)."""
+
+    _LOGISTIC = True
+
+
+class FMClassifierModel(_FMModelBase):
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        margin = self._margin(table)
+        prob = 1.0 / (1.0 + np.exp(-margin))
+        out = table.with_column(
+            self.get(self.PREDICTION_COL), (margin >= 0).astype(np.float64)
+        )
+        out = out.with_column(
+            self.get(self.RAW_PREDICTION_COL),
+            np.stack([1.0 - prob, prob], axis=1),
+        )
+        return (out,)
+
+
+class FMRegressor(_FMBase):
+    """Factorization-machine regressor (squared loss)."""
+
+    _LOGISTIC = False
+
+
+class FMRegressorModel(_FMModelBase):
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        return (
+            table.with_column(
+                self.get(self.PREDICTION_COL), self._margin(table)
+            ),
+        )

@@ -1,0 +1,244 @@
+"""MLPClassifier / FMClassifier / FMRegressor / IsotonicRegression."""
+
+import numpy as np
+import pytest
+from sklearn.isotonic import IsotonicRegression as SkIso
+from sklearn.metrics import r2_score, roc_auc_score
+
+from flinkml_tpu.models import (
+    FMClassifier,
+    FMClassifierModel,
+    FMRegressor,
+    FMRegressorModel,
+    IsotonicRegression,
+    IsotonicRegressionModel,
+    MLPClassifier,
+    MLPClassifierModel,
+)
+from flinkml_tpu.models.isotonic import pav
+from flinkml_tpu.table import Table
+
+
+# -- MLP ---------------------------------------------------------------------
+
+def _xor_data(n=1200, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-1, 1, size=(n, 2))
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.float64)
+    return x, y
+
+
+def _mlp(layers, **kw):
+    m = (
+        MLPClassifier().set_layers(layers).set_max_iter(600)
+        .set_learning_rate(0.01).set_global_batch_size(256).set_tol(0.0)
+        .set_seed(0)
+    )
+    for name, v in kw.items():
+        getattr(m, f"set_{name}")(v)
+    return m
+
+
+def test_mlp_solves_xor():
+    x, y = _xor_data()
+    t = Table({"features": x, "label": y})
+    model = _mlp([2, 16, 2]).fit(t)
+    (out,) = model.transform(t)
+    assert (out["prediction"] == y).mean() > 0.97
+    probs = out["rawPrediction"]
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-9)
+
+
+def test_mlp_multiclass():
+    rng = np.random.default_rng(1)
+    x = np.concatenate([
+        rng.normal(size=(100, 2)) * 0.4 + c
+        for c in ([0, 0], [4, 0], [0, 4])
+    ])
+    y = np.repeat([0.0, 1.0, 2.0], 100)
+    t = Table({"features": x, "label": y})
+    model = _mlp([2, 8, 3], max_iter=400).fit(t)
+    (out,) = model.transform(t)
+    assert (out["prediction"] == y).mean() > 0.97
+
+
+def test_mlp_validation_and_persistence(tmp_path):
+    x, y = _xor_data(n=300, seed=2)
+    t = Table({"features": x, "label": y})
+    with pytest.raises(ValueError, match="layers"):
+        MLPClassifier().fit(t)
+    with pytest.raises(ValueError, match="feature dim"):
+        _mlp([5, 2]).fit(t)
+    with pytest.raises(ValueError, match="class ids"):
+        _mlp([2, 2]).fit(Table({"features": x, "label": y + 5}))
+    model = _mlp([2, 8, 2], max_iter=50).fit(t)
+    model.save(str(tmp_path / "mlp"))
+    loaded = MLPClassifierModel.load(str(tmp_path / "mlp"))
+    (p1,) = model.transform(t)
+    (p2,) = loaded.transform(t)
+    np.testing.assert_allclose(p2["rawPrediction"], p1["rawPrediction"])
+    clone = MLPClassifierModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    (p3,) = clone.transform(t)
+    np.testing.assert_allclose(p3["prediction"], p1["prediction"])
+
+
+def test_mlp_deterministic():
+    x, y = _xor_data(n=200, seed=3)
+    t = Table({"features": x, "label": y})
+    m1 = _mlp([2, 4, 2], max_iter=30).fit(t)
+    m2 = _mlp([2, 4, 2], max_iter=30).fit(t)
+    for a, b in zip(m1._weights, m2._weights):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- FM ----------------------------------------------------------------------
+
+def test_fm_classifier_learns_interactions():
+    # Pure pairwise-interaction signal: linear models score ~chance.
+    rng = np.random.default_rng(4)
+    x = rng.choice([0.0, 1.0], size=(1500, 8))
+    y = ((x[:, 0] * x[:, 1] + x[:, 2] * x[:, 3]) > 0.5).astype(np.float64)
+    t = Table({"features": x, "label": y})
+    model = (
+        FMClassifier().set_factor_size(8).set_max_iter(800)
+        .set_learning_rate(0.05).set_global_batch_size(512).set_tol(0.0)
+        .set_seed(0).fit(t)
+    )
+    (out,) = model.transform(t)
+    auc = roc_auc_score(y, out["rawPrediction"][:, 1])
+    assert auc > 0.95, auc
+
+
+def test_fm_regressor_and_persistence(tmp_path):
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(1000, 5))
+    y = 2.0 + x[:, 0] - x[:, 4] + 1.5 * x[:, 1] * x[:, 2]
+    t = Table({"features": x, "label": y})
+    model = (
+        FMRegressor().set_factor_size(6).set_max_iter(1500)
+        .set_learning_rate(0.05).set_global_batch_size(512).set_tol(0.0)
+        .set_seed(0).fit(t)
+    )
+    (out,) = model.transform(t)
+    assert r2_score(y, out["prediction"]) > 0.95
+    model.save(str(tmp_path / "fm"))
+    loaded = FMRegressorModel.load(str(tmp_path / "fm"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0]["prediction"], out["prediction"]
+    )
+    clone = FMRegressorModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    np.testing.assert_allclose(
+        clone.transform(t)[0]["prediction"], out["prediction"]
+    )
+
+
+def test_fm_reg_shrinks_factors():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(300, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    t = Table({"features": x, "label": y})
+
+    def fit(reg):
+        return (
+            FMClassifier().set_factor_size(4).set_max_iter(300)
+            .set_learning_rate(0.05).set_global_batch_size(256)
+            .set_tol(0.0).set_seed(0).set_reg(reg).fit(t)
+        )
+
+    small, large = fit(0.0), fit(1.0)
+    assert np.linalg.norm(large._v) < np.linalg.norm(small._v)
+    assert np.linalg.norm(large._w) < np.linalg.norm(small._w)
+
+
+def test_fm_rejects_nonbinary_labels():
+    t = Table({"features": np.zeros((3, 2)),
+               "label": np.asarray([0.0, 1.0, 2.0])})
+    with pytest.raises(ValueError, match="0, 1"):
+        FMClassifier().fit(t)
+
+
+# -- Isotonic ----------------------------------------------------------------
+
+def test_pav_matches_sklearn():
+    rng = np.random.default_rng(7)
+    x = rng.uniform(0, 10, 200)
+    y = 0.5 * x + rng.normal(size=200)
+    sk = SkIso().fit(x, y)
+    bnd, val = pav(x, y, np.ones_like(x))
+    np.testing.assert_allclose(np.interp(x, bnd, val), sk.predict(x),
+                               rtol=1e-9, atol=1e-9)
+
+
+def test_isotonic_weighted_and_decreasing(tmp_path):
+    x = np.asarray([1.0, 2.0, 3.0, 4.0])
+    y = np.asarray([1.0, 3.0, 2.0, 4.0])
+    w = np.asarray([1.0, 1.0, 3.0, 1.0])
+    t = Table({"features": x, "label": y, "w": w})
+    model = IsotonicRegression().set_weight_col("w").fit(t)
+    sk = SkIso().fit(x, y, sample_weight=w)
+    (out,) = model.transform(t)
+    np.testing.assert_allclose(out["prediction"], sk.predict(x), rtol=1e-12)
+    # Decreasing.
+    td = Table({"features": x, "label": y[::-1].copy()})
+    md = IsotonicRegression().set_isotonic(False).fit(td)
+    skd = SkIso(increasing=False).fit(x, y[::-1])
+    np.testing.assert_allclose(
+        md.transform(td)[0]["prediction"], skd.predict(x), rtol=1e-12
+    )
+    model.save(str(tmp_path / "iso"))
+    loaded = IsotonicRegressionModel.load(str(tmp_path / "iso"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0]["prediction"], out["prediction"]
+    )
+
+
+def test_isotonic_interpolation_and_clamping():
+    x = np.asarray([0.0, 10.0])
+    y = np.asarray([0.0, 1.0])
+    t = Table({"features": x, "label": y})
+    model = IsotonicRegression().fit(t)
+    probe = Table({"features": np.asarray([-5.0, 5.0, 15.0])})
+    (out,) = model.transform(probe)
+    np.testing.assert_allclose(out["prediction"], [0.0, 0.5, 1.0])
+
+
+def test_isotonic_duplicate_x_ties():
+    x = np.asarray([1.0, 1.0, 2.0])
+    y = np.asarray([0.0, 2.0, 0.5])
+    t = Table({"features": x, "label": y})
+    model = IsotonicRegression().fit(t)
+    sk = SkIso().fit(x, y)
+    np.testing.assert_allclose(
+        model.transform(t)[0]["prediction"], sk.predict(x), rtol=1e-12
+    )
+
+
+def test_isotonic_zero_weight_rows_dropped():
+    x = np.asarray([1.0, 2.0, 3.0])
+    y = np.asarray([5.0, 4.0, 3.0])
+    w = np.asarray([0.0, 0.0, 1.0])
+    t = Table({"features": x, "label": y, "w": w})
+    model = IsotonicRegression().set_weight_col("w").fit(t)
+    (out,) = model.transform(t)
+    # Only the weight-1 row matters: constant fit at 3.0.
+    np.testing.assert_allclose(out["prediction"], 3.0)
+    with pytest.raises(ValueError, match="all weights"):
+        IsotonicRegression().set_weight_col("w").fit(
+            Table({"features": x, "label": y, "w": np.zeros(3)})
+        )
+
+
+def test_isotonic_accepts_vector_column():
+    from flinkml_tpu.linalg import Vectors
+
+    col = np.empty(3, dtype=object)
+    for i, v in enumerate([1.0, 2.0, 3.0]):
+        col[i] = Vectors.dense(v)
+    t = Table({"features": col, "label": np.asarray([1.0, 2.0, 3.0])})
+    model = IsotonicRegression().fit(t)
+    (out,) = model.transform(t)
+    np.testing.assert_allclose(out["prediction"], [1.0, 2.0, 3.0])
