@@ -55,7 +55,13 @@ from flinkml_tpu.models.isotonic import (
     IsotonicRegression,
     IsotonicRegressionModel,
 )
+from flinkml_tpu.models.lsh import MinHashLSH, MinHashLSHModel
 from flinkml_tpu.models.mlp import MLPClassifier, MLPClassifierModel
+from flinkml_tpu.models.ngram import NGram
+from flinkml_tpu.models.vector_indexer import (
+    VectorIndexer,
+    VectorIndexerModel,
+)
 from flinkml_tpu.models.online_scaler import (
     OnlineStandardScaler,
     OnlineStandardScalerModel,
@@ -73,7 +79,9 @@ from flinkml_tpu.models.misc_transforms import (
     StopWordsRemover,
 )
 from flinkml_tpu.models.selectors import (
+    ANOVATest,
     ChiSqTest,
+    FValueTest,
     UnivariateFeatureSelector,
     UnivariateFeatureSelectorModel,
     VarianceThresholdSelector,
@@ -176,7 +184,14 @@ __all__ = [
     "DCT",
     "StopWordsRemover",
     "RandomSplitter",
+    "NGram",
+    "VectorIndexer",
+    "VectorIndexerModel",
+    "MinHashLSH",
+    "MinHashLSHModel",
     "ChiSqTest",
+    "ANOVATest",
+    "FValueTest",
     "VarianceThresholdSelector",
     "VarianceThresholdSelectorModel",
     "UnivariateFeatureSelector",

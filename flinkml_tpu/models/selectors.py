@@ -375,3 +375,65 @@ class UnivariateFeatureSelectorModel(_SelectorModelBase):
     SCORE_FUNCTION = UnivariateFeatureSelector.SCORE_FUNCTION
     SELECTION_MODE = UnivariateFeatureSelector.SELECTION_MODE
     SELECTION_THRESHOLD = UnivariateFeatureSelector.SELECTION_THRESHOLD
+
+
+def f_regression_test(x: np.ndarray, y: np.ndarray):
+    """Univariate linear F-test per feature against a CONTINUOUS label
+    (sklearn ``f_regression``): F = r²/(1−r²)·(n−2), p from F(1, n−2)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64).reshape(-1)
+    n = x.shape[0]
+    if n < 3:
+        raise ValueError("f_regression requires at least 3 rows")
+    xc = x - x.mean(axis=0)
+    yc = y - y.mean()
+    denom = np.sqrt((xc * xc).sum(axis=0) * (yc * yc).sum())
+    with np.errstate(divide="ignore", invalid="ignore"):
+        r = np.where(denom > 0, xc.T @ yc / denom, 0.0)
+    r2 = np.clip(r * r, 0.0, 1.0)
+    d2 = n - 2
+    with np.errstate(divide="ignore"):
+        f = r2 / np.maximum(1.0 - r2, 0.0) * d2
+    f = np.where(r2 >= 1.0, np.inf, f)
+    p = np.asarray([
+        0.0 if np.isinf(v) else _f_sf(float(v), 1, d2) for v in f
+    ])
+    return f, p
+
+
+class _UnivariateTestBase(HasFeaturesCol, HasLabelCol, AlgoOperator):
+    """Shared output layout for the per-feature test operators
+    (featureIndex, pValue, statistic — the upstream ANOVATest/FValueTest
+    shape)."""
+
+    def _run(self, x, y):
+        raise NotImplementedError
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        x = features_matrix(table, self.get(self.FEATURES_COL))
+        y = table.column(self.get(self.LABEL_COL))
+        stats, pvals = self._run(x, y)
+        return (
+            Table({
+                "featureIndex": np.arange(x.shape[1]),
+                "pValue": pvals,
+                "statistic": stats,
+            }),
+        )
+
+
+class ANOVATest(_UnivariateTestBase):
+    """One-way ANOVA F-test of continuous features against a categorical
+    label (upstream ``ANOVATest``)."""
+
+    def _run(self, x, y):
+        return f_classif_test(x, y)
+
+
+class FValueTest(_UnivariateTestBase):
+    """Univariate linear F-test of continuous features against a
+    continuous label (upstream ``FValueTest``)."""
+
+    def _run(self, x, y):
+        return f_regression_test(x, y)

@@ -1,0 +1,185 @@
+"""NGram / ANOVATest / FValueTest / VectorIndexer / MinHashLSH."""
+
+import numpy as np
+import pytest
+from sklearn.feature_selection import f_regression as sk_f_regression
+
+from flinkml_tpu.models import (
+    ANOVATest,
+    FValueTest,
+    MinHashLSH,
+    MinHashLSHModel,
+    NGram,
+    Tokenizer,
+    VectorIndexer,
+    VectorIndexerModel,
+)
+from flinkml_tpu.models.selectors import f_regression_test
+from flinkml_tpu.linalg import SparseVector
+from flinkml_tpu.table import Table
+
+
+# -- NGram -------------------------------------------------------------------
+
+def test_ngram_bigrams_and_short_rows():
+    t = Table({"text": np.asarray(["a b c d", "x y", "solo"])})
+    (tok,) = Tokenizer().set_input_col("text").set_output_col("tok").transform(t)
+    (out,) = NGram().set_input_col("tok").set_output_col("ng").transform(tok)
+    assert out["ng"][0] == ["a b", "b c", "c d"]
+    assert out["ng"][1] == ["x y"]
+    assert out["ng"][2] == []
+    (tri,) = NGram().set_n(3).set_input_col("tok").set_output_col("ng").transform(tok)
+    assert tri["ng"][0] == ["a b c", "b c d"]
+
+
+# -- ANOVATest / FValueTest --------------------------------------------------
+
+def test_anova_test_operator():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, 300).astype(float)
+    x = rng.normal(size=(300, 3))
+    x[:, 1] += 2 * y
+    (out,) = ANOVATest().transform(Table({"features": x, "label": y}))
+    assert out.column_names == ["featureIndex", "pValue", "statistic"]
+    assert out["pValue"][1] < 1e-6 < out["pValue"][0]
+
+
+def test_f_value_test_matches_sklearn():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(250, 4))
+    y = 2.0 * x[:, 2] + 0.5 * rng.normal(size=250)
+    f, p = f_regression_test(x, y)
+    f_ref, p_ref = sk_f_regression(x, y)
+    np.testing.assert_allclose(f, f_ref, rtol=1e-9)
+    np.testing.assert_allclose(p, p_ref, rtol=1e-7, atol=1e-14)
+    (out,) = FValueTest().transform(Table({"features": x, "label": y}))
+    assert out["pValue"][2] < 1e-10
+
+
+# -- VectorIndexer -----------------------------------------------------------
+
+def _vi_data():
+    rng = np.random.default_rng(2)
+    cont = rng.normal(size=100)
+    cat = rng.choice([-1.0, 0.0, 5.0], size=100)
+    binary = rng.choice([0.0, 1.0], size=100)
+    return np.stack([cont, cat, binary], axis=1)
+
+
+def test_vector_indexer_detects_and_indexes():
+    x = _vi_data()
+    t = Table({"input": x})
+    model = VectorIndexer().set_max_categories(5).fit(t)
+    assert set(model.category_maps) == {1, 2}
+    (out,) = model.transform(t)
+    o = out["output"]
+    np.testing.assert_array_equal(o[:, 0], x[:, 0])   # continuous untouched
+    # cat values -1,0,5 -> 0,1,2 by sorted order
+    np.testing.assert_array_equal(np.unique(o[:, 1]), [0.0, 1.0, 2.0])
+    assert np.all(o[x[:, 1] == -1.0, 1] == 0.0)
+    assert np.all(o[x[:, 1] == 5.0, 1] == 2.0)
+
+
+def test_vector_indexer_handle_invalid_and_roundtrip(tmp_path):
+    x = _vi_data()
+    t = Table({"input": x})
+    model = VectorIndexer().set_max_categories(5).fit(t)
+    probe = x[:3].copy()
+    probe[0, 1] = 99.0   # unseen category
+    pt = Table({"input": probe})
+    with pytest.raises(ValueError, match="not seen"):
+        model.transform(pt)
+    (skipped,) = model.set_handle_invalid("skip").transform(pt)
+    assert skipped.num_rows == 2
+    (kept,) = model.set_handle_invalid("keep").transform(pt)
+    assert kept["output"][0, 1] == 3.0   # catch-all index
+    model.save(str(tmp_path / "vi"))
+    loaded = VectorIndexerModel.load(str(tmp_path / "vi"))
+    assert set(loaded.category_maps) == set(model.category_maps)
+    clone = VectorIndexerModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    (a,) = clone.set_handle_invalid("keep").transform(pt)
+    np.testing.assert_array_equal(a["output"], kept["output"])
+
+
+# -- MinHashLSH --------------------------------------------------------------
+
+def _sparse_row(size, idx):
+    return SparseVector(size, np.asarray(idx), np.ones(len(idx)))
+
+
+def test_minhash_identical_rows_same_hash_and_queries(tmp_path):
+    size = 64
+    rows = np.empty(5, dtype=object)
+    rows[0] = _sparse_row(size, [1, 5, 9])
+    rows[1] = _sparse_row(size, [1, 5, 9])           # identical to 0
+    rows[2] = _sparse_row(size, [1, 5, 9, 11])       # close
+    rows[3] = _sparse_row(size, [40, 41, 42])        # far
+    rows[4] = _sparse_row(size, [2, 6])
+    t = Table({"input": rows, "id": np.arange(5)})
+    model = MinHashLSH().set_num_hash_tables(4).set_seed(0).fit(t)
+    (hashed,) = model.transform(t)
+    np.testing.assert_array_equal(hashed["output"][0], hashed["output"][1])
+    assert not np.array_equal(hashed["output"][0], hashed["output"][3])
+
+    nn = model.approx_nearest_neighbors(t, _sparse_row(size, [1, 5, 9]), 2)
+    assert set(nn["id"][:2]) == {0, 1}
+    np.testing.assert_allclose(nn["distCol"][:2], 0.0)
+
+    join = model.approx_similarity_join(t, t, threshold=0.5)
+    pairs = set(zip(join["idA"].tolist(), join["idB"].tolist()))
+    assert (0, 1) in pairs and (0, 2) in pairs
+    assert (0, 3) not in pairs
+
+    model.save(str(tmp_path / "lsh"))
+    loaded = MinHashLSHModel.load(str(tmp_path / "lsh"))
+    (h2,) = loaded.transform(t)
+    np.testing.assert_array_equal(h2["output"], hashed["output"])
+
+
+def test_minhash_dense_input_and_recall():
+    rng = np.random.default_rng(3)
+    x = (rng.uniform(size=(200, 32)) < 0.2).astype(np.float64)
+    x[1] = x[0]  # plant a duplicate
+    t = Table({"input": x})
+    model = MinHashLSH().set_num_hash_tables(8).set_seed(1).fit(t)
+    nn = model.approx_nearest_neighbors(t, x[0], 2)
+    assert nn["distCol"][0] == 0.0 and nn["distCol"][1] == 0.0
+
+
+def test_vector_indexer_all_continuous_roundtrip():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(60, 3))  # everything continuous
+    t = Table({"input": x})
+    model = VectorIndexer().set_max_categories(3).fit(t)
+    assert model.category_maps == {}
+    clone = VectorIndexerModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    (out,) = clone.transform(t)
+    np.testing.assert_array_equal(out["output"], x)
+
+
+def test_vector_indexer_nan_handled_as_invalid():
+    x = np.asarray([[0.0], [1.0], [np.nan]])
+    t = Table({"input": x})
+    model = VectorIndexer().set_max_categories(3).fit(t)
+    with pytest.raises(ValueError, match="not seen"):
+        model.transform(t)
+    (kept,) = model.set_handle_invalid("keep").transform(t)
+    np.testing.assert_array_equal(kept["output"][:, 0], [0.0, 1.0, 2.0])
+
+
+def test_lsh_empty_join_result():
+    rows_a = np.empty(1, dtype=object)
+    rows_a[0] = _sparse_row(32, [0, 1])
+    rows_b = np.empty(1, dtype=object)
+    rows_b[0] = _sparse_row(32, [20, 21])
+    model = MinHashLSH().set_num_hash_tables(2).set_seed(0).fit(
+        Table({"input": rows_a})
+    )
+    join = model.approx_similarity_join(
+        Table({"input": rows_a}), Table({"input": rows_b}), threshold=0.01
+    )
+    assert join.num_rows == 0
