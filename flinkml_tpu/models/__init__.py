@@ -50,6 +50,7 @@ from flinkml_tpu.models.fm import (
     FMRegressor,
     FMRegressorModel,
 )
+from flinkml_tpu.models.gmm import GaussianMixture, GaussianMixtureModel
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
 from flinkml_tpu.models.isotonic import (
     IsotonicRegression,
@@ -152,6 +153,8 @@ __all__ = [
     "ALS",
     "ALSModel",
     "AgglomerativeClustering",
+    "GaussianMixture",
+    "GaussianMixtureModel",
     "Swing",
     "GBTClassifier",
     "GBTClassifierModel",

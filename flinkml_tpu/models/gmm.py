@@ -1,0 +1,272 @@
+"""GaussianMixture — EM-fit mixture of diagonal or full-covariance
+Gaussians (the Spark/Flink family member).
+
+TPU-native EM: each iteration is ONE device program over the
+data-sharded mesh —
+
+  - E-step: all per-component log-densities as batched MXU work
+    (full covariance uses precomputed Cholesky factors; solves are
+    ``[k, d, d]`` batched triangular solves), responsibilities via a
+    stable log-sum-exp;
+  - M-step: sufficient statistics (Σr, Σr·x, Σr·x xᵀ) are per-device
+    sums combined with one ``psum`` each — the keyed-aggregation
+    pattern, with k "keys" dense in a leading axis;
+  - the whole EM loop is a host loop around that jitted step (the
+    carry is tiny: weights/means/covs), stopping on log-likelihood
+    change ≤ tol.
+
+Initialization: k-means++-style seeding from the data (seeded), shared
+covariance = data variance. ``covarianceType`` ∈ {"full", "diag"}.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.common_params import (
+    HasFeaturesCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasSeed,
+    HasTol,
+)
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.models.scalers import _shard_with_mask
+from flinkml_tpu.params import IntParam, ParamValidators, StringParam
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+_REG = 1e-6  # covariance ridge, sklearn's reg_covar default
+
+
+class _GMMParams(
+    HasFeaturesCol, HasPredictionCol, HasRawPredictionCol, HasMaxIter,
+    HasTol, HasSeed,
+):
+    K = IntParam("k", "Number of mixture components.", 2, ParamValidators.gt(0))
+    COVARIANCE_TYPE = StringParam(
+        "covarianceType", "Component covariance structure.", "full",
+        ParamValidators.in_array(["full", "diag"]),
+    )
+
+
+def _log_prob(x, weights, means, covs, cov_type: str):
+    """[n, k] log(w_j * N(x | mu_j, Sigma_j)). x: [n, d] (f32)."""
+    n, d = x.shape
+    diff = x[:, None, :] - means[None, :, :]            # [n, k, d]
+    if cov_type == "diag":
+        inv = 1.0 / covs                                # [k, d]
+        maha = jnp.sum(diff * diff * inv[None], axis=2)
+        logdet = jnp.sum(jnp.log(covs), axis=1)         # [k]
+    else:
+        chol = jnp.linalg.cholesky(covs)                # [k, d, d]
+        # One triangular solve per component with ALL samples as the
+        # right-hand-side batch: L_j Z_j = diff[:, j, :]ᵀ  ([d, n] RHS).
+        rhs = jnp.transpose(diff, (1, 2, 0))            # [k, d, n]
+        z = jax.vmap(
+            lambda L, R: jax.scipy.linalg.solve_triangular(L, R, lower=True)
+        )(chol, rhs)                                    # [k, d, n]
+        maha = jnp.sum(z * z, axis=1).T                 # [n, k]
+        logdet = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(chol, axis1=1, axis2=2)), axis=1
+        )
+    log_norm = -0.5 * (d * jnp.log(2.0 * jnp.pi) + logdet)
+    return jnp.log(weights)[None, :] + log_norm[None, :] - 0.5 * maha
+
+
+@functools.lru_cache(maxsize=16)
+def _em_step_fn(mesh, axis: str, k: int, cov_type: str):
+    def local(xl, wl, weights, means, covs):
+        logp = _log_prob(xl, weights, means, covs, cov_type)
+        logz = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+        resp = jnp.exp(logp - logz) * wl[:, None]       # masked rows drop
+        ll_local = jnp.sum(logz[:, 0] * wl)
+        r_k = jax.lax.psum(jnp.sum(resp, axis=0), axis)            # [k]
+        r_x = jax.lax.psum(resp.T @ xl, axis)                      # [k, d]
+        if cov_type == "diag":
+            r_xx = jax.lax.psum(resp.T @ (xl * xl), axis)          # [k, d]
+        else:
+            r_xx = jax.lax.psum(
+                jnp.einsum("nk,nd,ne->kde", resp, xl, xl), axis
+            )                                                      # [k, d, d]
+        ll = jax.lax.psum(ll_local, axis)
+        n_tot = jax.lax.psum(jnp.sum(wl), axis)
+        return r_k, r_x, r_xx, ll, n_tot
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+        )
+    )
+
+
+def _m_step(r_k, r_x, r_xx, cov_type: str):
+    d = r_x.shape[1]
+    safe = np.maximum(r_k, 1e-12)
+    weights = r_k / r_k.sum()
+    means = r_x / safe[:, None]
+    if cov_type == "diag":
+        covs = r_xx / safe[:, None] - means * means + _REG
+        covs = np.maximum(covs, _REG)
+    else:
+        covs = (
+            r_xx / safe[:, None, None]
+            - means[:, :, None] * means[:, None, :]
+            + _REG * np.eye(d)[None]
+        )
+    return weights, means, covs
+
+
+class GaussianMixture(_GMMParams, Estimator):
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "GaussianMixtureModel":
+        (table,) = inputs
+        x = features_matrix(table, self.get(self.FEATURES_COL))
+        n, d = x.shape
+        k = self.get(self.K)
+        if n < k:
+            raise ValueError(f"n_rows={n} < k={k}")
+        cov_type = self.get(self.COVARIANCE_TYPE)
+        mesh = self.mesh or DeviceMesh()
+        # EM runs in CENTERED space: sufficient statistics accumulate on
+        # device in f32, and E[xxᵀ] − μμᵀ cancels catastrophically when
+        # |mean| ≫ std (a +1e4 offset NaN-poisons the Cholesky);
+        # centering once on the host makes the stats magnitude-safe and
+        # is mathematically identical. The shift is added back at the end.
+        shift = x.mean(axis=0)
+        x = x - shift
+        xd, wd = _shard_with_mask(x, mesh)
+        # k-means++ seeding (the shared helper handles degenerate
+        # all-duplicate data) + shared data variance.
+        from flinkml_tpu.models.kmeans import _kmeans_pp_init
+
+        rng = np.random.default_rng(self.get_seed())
+        means = np.asarray(_kmeans_pp_init(x, k, rng), dtype=np.float64)
+        var = np.maximum(x.var(axis=0), _REG)
+        if cov_type == "diag":
+            covs = np.tile(var[None, :], (k, 1))
+        else:
+            covs = np.tile(np.diag(var)[None], (k, 1, 1))
+        weights = np.full(k, 1.0 / k)
+        step = _em_step_fn(mesh.mesh, DeviceMesh.DATA_AXIS, k, cov_type)
+        f32 = lambda a: jnp.asarray(a, jnp.float32)
+        prev_ll = -np.inf
+        for _ in range(self.get(self.MAX_ITER)):
+            r_k, r_x, r_xx, ll, n_tot = step(
+                xd, wd, f32(weights), f32(means), f32(covs)
+            )
+            weights, means, covs = _m_step(
+                np.asarray(r_k, np.float64), np.asarray(r_x, np.float64),
+                np.asarray(r_xx, np.float64), cov_type,
+            )
+            ll = float(ll) / float(n_tot)
+            if not np.isfinite(ll):
+                raise FloatingPointError(
+                    "GaussianMixture log-likelihood became non-finite; "
+                    "the data may be degenerate (try covarianceType='diag' "
+                    "or fewer components)"
+                )
+            if abs(ll - prev_ll) <= self.get(self.TOL):
+                prev_ll = ll
+                break
+            prev_ll = ll
+        model = GaussianMixtureModel()
+        model.copy_params_from(self)
+        model._set(weights, means + shift[None, :], covs)
+        return model
+
+
+class GaussianMixtureModel(_GMMParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._weights: Optional[np.ndarray] = None
+        self._means: Optional[np.ndarray] = None
+        self._covs: Optional[np.ndarray] = None
+
+    def _set(self, weights, means, covs):
+        self._weights = np.asarray(weights, np.float64)
+        self._means = np.asarray(means, np.float64)
+        self._covs = np.asarray(covs, np.float64)
+
+    @property
+    def weights(self) -> np.ndarray:
+        self._require()
+        return self._weights
+
+    @property
+    def means(self) -> np.ndarray:
+        self._require()
+        return self._means
+
+    @property
+    def covariances(self) -> np.ndarray:
+        self._require()
+        return self._covs
+
+    def set_model_data(self, *inputs: Table) -> "GaussianMixtureModel":
+        (table,) = inputs
+        self._set(
+            np.asarray(table.column("weights"), np.float64)[0],
+            np.asarray(table.column("means"), np.float64)[0],
+            np.asarray(table.column("covs"), np.float64)[0],
+        )
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require()
+        return [Table({
+            "weights": self._weights[None, :],
+            "means": self._means[None, :, :],
+            "covs": self._covs[None, ...],
+        })]
+
+    def _require(self) -> None:
+        if self._weights is None:
+            raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        self._require()
+        x = features_matrix(table, self.get(self.FEATURES_COL))
+        logp = np.asarray(_log_prob(
+            jnp.asarray(x, jnp.float32),
+            jnp.asarray(self._weights, jnp.float32),
+            jnp.asarray(self._means, jnp.float32),
+            jnp.asarray(self._covs, jnp.float32),
+            self.get(self.COVARIANCE_TYPE),
+        ), dtype=np.float64)
+        shifted = logp - logp.max(axis=1, keepdims=True)
+        resp = np.exp(shifted)
+        resp /= resp.sum(axis=1, keepdims=True)
+        out = table.with_column(
+            self.get(self.PREDICTION_COL),
+            np.argmax(logp, axis=1).astype(np.float64),
+        )
+        out = out.with_column(self.get(self.RAW_PREDICTION_COL), resp)
+        return (out,)
+
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(path, {
+            "weights": self._weights, "means": self._means,
+            "covs": self._covs,
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "GaussianMixtureModel":
+        model, arrays, _ = cls._load_with_arrays(path)
+        model._set(arrays["weights"], arrays["means"], arrays["covs"])
+        return model
