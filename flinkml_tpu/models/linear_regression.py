@@ -1,12 +1,20 @@
-"""LinearRegression — least squares via proximal SGD.
+"""LinearRegression — least squares via proximal SGD or exact normal
+equations.
 
-Capability target: BASELINE.json config #3. Same shared trainer as
-LogisticRegression/LinearSVC with the squared loss; supports L2 ("ridge"),
-L1 ("lasso") and elastic-net via the proximal step.
+Capability target: BASELINE.json config #3. ``solver='sgd'`` (default)
+uses the shared trainer (LogisticRegression/LinearSVC substrate) with
+the squared loss; L2 ("ridge"), L1 ("lasso") and elastic-net via the
+proximal step. ``solver='normal'`` computes the exact (weighted,
+optionally ridge) OLS solution: the ``[d, d]`` normal matrix ``XᵀWX``
+accumulates as ONE sharded MXU gram pass (the same reduction PCA uses)
+and a tiny host f64 linear solve finishes it — no learning rate, no
+iteration count. elasticNet > 0 requires
+the SGD solver (L1 has no closed form).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -30,6 +38,7 @@ from flinkml_tpu.models import _linear_sgd
 from flinkml_tpu.models._coefficient import CoefficientModelMixin
 from flinkml_tpu.models._data import features_matrix, sparse_features
 from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.params import ParamValidators, StringParam
 from flinkml_tpu.table import Table
 
 
@@ -46,7 +55,63 @@ class _LinearRegressionParams(
     HasSeed,
     HasPredictionCol,
 ):
-    pass
+    SOLVER = StringParam(
+        "solver",
+        "'sgd' (proximal minibatch SGD) or 'normal' (exact weighted "
+        "ridge OLS via one sharded gram pass + host f64 solve).",
+        "sgd", ParamValidators.in_array(["sgd", "normal"]),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _normal_eq_gram_fn(mesh, axis: str):
+    """One sharded MXU pass: A = XᵀWX, b = XᵀWy, s = Σw (psum-combined)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def local(xl, wl, yl):
+        xw = xl * wl[:, None]
+        a = jax.lax.psum(xl.T @ xw, axis)
+        b = jax.lax.psum(xw.T @ yl, axis)
+        s = jax.lax.psum(jnp.sum(wl), axis)
+        return a, b, s
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=(P(), P(), P()),
+        )
+    )
+
+
+def _fit_normal_equations(table, features_col, label_col, weight_col,
+                          mesh: DeviceMesh, reg: float) -> np.ndarray:
+    """Exact weighted ridge OLS, solving the SGD solver's fixed point:
+    the trainer's gradient is ``XᵀW·err + 2·reg·c`` (the L2 term is NOT
+    scaled by Σw — ``_linear_sgd`` adds ``2·reg·coef`` to the summed
+    gradient), so both solvers solve ``(XᵀWX + 2·reg·I) c = XᵀWy`` and
+    ``reg`` means the same thing in both (sklearn Ridge: α = 2·reg)."""
+    from flinkml_tpu.models._data import labeled_data
+    from flinkml_tpu.parallel import pad_to_multiple
+
+    x, y, w = labeled_data(table, features_col, label_col, weight_col)
+    p = mesh.axis_size()
+    x_pad, _ = pad_to_multiple(x.astype(np.float32), p)
+    y_pad, _ = pad_to_multiple(y.astype(np.float32), p)
+    w_pad, _ = pad_to_multiple(w.astype(np.float32), p)
+    a, b, _s = _normal_eq_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
+        mesh.shard_batch(x_pad), mesh.shard_batch(w_pad),
+        mesh.shard_batch(y_pad),
+    )
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    d = a64.shape[0]
+    # Jitter scaled to the gram's own magnitude so tiny-scale features
+    # are not silently over-regularized (an absolute 1e-10 would be a
+    # large perturbation for ~1e-6-scale data).
+    jitter = 1e-12 * max(float(np.trace(a64)) / d, np.finfo(np.float64).tiny)
+    a64 += (2.0 * reg + jitter) * np.eye(d)
+    return np.linalg.solve(a64, b64)
 
 
 class LinearRegression(_LinearRegressionParams, Estimator):
@@ -57,6 +122,28 @@ class LinearRegression(_LinearRegressionParams, Estimator):
     def fit(self, *inputs: Table) -> "LinearRegressionModel":
         (table,) = inputs
         features_col = self.get(_LinearRegressionParams.FEATURES_COL)
+        if self.get(self.SOLVER) == "normal":
+            if self.get(self.ELASTIC_NET) > 0:
+                raise ValueError(
+                    "solver='normal' has no closed form for elasticNet > 0; "
+                    "use solver='sgd'"
+                )
+            if sparse_features(table, features_col) is not None:
+                raise ValueError(
+                    "solver='normal' requires dense features (the [d, d] "
+                    "normal matrix is dense); use solver='sgd' for the "
+                    "sparse path"
+                )
+            coef = _fit_normal_equations(
+                table, features_col,
+                self.get(_LinearRegressionParams.LABEL_COL),
+                self.get(_LinearRegressionParams.WEIGHT_COL),
+                self.mesh or DeviceMesh(), self.get(self.REG),
+            )
+            model = LinearRegressionModel()
+            model.copy_params_from(self)
+            model.set_model_data(Table({"coefficient": coef[None, :]}))
+            return model
         hyper = dict(
             loss="squared",
             mesh=self.mesh or DeviceMesh(),

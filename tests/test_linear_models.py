@@ -261,3 +261,85 @@ def test_multi_device_sparse(rng):
         .set_global_batch_size(333).fit(table)
     )
     assert np.isfinite(model.coefficient).all()
+
+
+def test_linear_regression_normal_solver_exact():
+    from sklearn.linear_model import LinearRegression as SkOLS, Ridge
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(300, 6))
+    true = rng.normal(size=6)
+    y = x @ true + 0.1 * rng.normal(size=300)
+    t = Table({"features": x, "label": y})
+    model = LinearRegression().set_solver("normal").fit(t)
+    ref = SkOLS(fit_intercept=False).fit(x, y)
+    np.testing.assert_allclose(
+        model.coefficient, ref.coef_, rtol=1e-4, atol=1e-5
+    )
+    # Ridge consistency: the SGD fixed point uses 2*reg unscaled by
+    # sum(w), so sklearn alpha = 2 * reg.
+    reg = 5.0
+    ridged = LinearRegression().set_solver("normal").set_reg(reg).fit(t)
+    ref_r = Ridge(alpha=2 * reg, fit_intercept=False).fit(x, y)
+    np.testing.assert_allclose(
+        ridged.coefficient, ref_r.coef_, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_linear_regression_normal_solver_weighted():
+    from sklearn.linear_model import LinearRegression as SkOLS
+
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(200, 3))
+    y = x @ np.asarray([1.0, -2.0, 0.5]) + rng.normal(size=200)
+    w = rng.uniform(0.1, 5.0, size=200)
+    t = Table({"features": x, "label": y, "w": w})
+    model = (
+        LinearRegression().set_solver("normal").set_weight_col("w").fit(t)
+    )
+    ref = SkOLS(fit_intercept=False).fit(x, y, sample_weight=w)
+    np.testing.assert_allclose(
+        model.coefficient, ref.coef_, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_linear_regression_normal_solver_validation():
+    t = Table({"features": np.zeros((4, 2)), "label": np.zeros(4)})
+    with pytest.raises(ValueError, match="elasticNet"):
+        (
+            LinearRegression().set_solver("normal").set_elastic_net(0.5)
+            .set_reg(0.1).fit(t)
+        )
+
+
+def test_normal_solver_matches_sgd_fixed_point():
+    # Same reg in both solvers must land on (nearly) the same optimum.
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(400, 4))
+    y = x @ np.asarray([2.0, -1.0, 0.5, 0.0]) + 0.05 * rng.normal(size=400)
+    t = Table({"features": x, "label": y})
+    reg = 2.0
+    exact = LinearRegression().set_solver("normal").set_reg(reg).fit(t)
+    sgd = (
+        LinearRegression().set_reg(reg).set_max_iter(800)
+        .set_global_batch_size(400).set_learning_rate(0.5).set_tol(0.0)
+        .set_seed(0).fit(t)
+    )
+    np.testing.assert_allclose(
+        sgd.coefficient, exact.coefficient, rtol=2e-3, atol=2e-4
+    )
+
+
+def test_normal_solver_tiny_scale_features():
+    # 1e-6-scale features: an absolute jitter would distort the solve.
+    from sklearn.linear_model import LinearRegression as SkOLS
+
+    rng = np.random.default_rng(14)
+    x = rng.normal(size=(200, 3)) * 1e-6
+    y = x @ np.asarray([1e6, -2e6, 5e5]) + 0.01 * rng.normal(size=200)
+    t = Table({"features": x, "label": y})
+    model = LinearRegression().set_solver("normal").fit(t)
+    ref = SkOLS(fit_intercept=False).fit(x, y)
+    np.testing.assert_allclose(
+        model.coefficient, ref.coef_, rtol=1e-3
+    )
