@@ -59,6 +59,7 @@ from flinkml_tpu.models.isotonic import (
 from flinkml_tpu.models.lsh import MinHashLSH, MinHashLSHModel
 from flinkml_tpu.models.mlp import MLPClassifier, MLPClassifierModel
 from flinkml_tpu.models.ngram import NGram
+from flinkml_tpu.models.word2vec import Word2Vec, Word2VecModel
 from flinkml_tpu.models.vector_indexer import (
     VectorIndexer,
     VectorIndexerModel,
@@ -188,6 +189,8 @@ __all__ = [
     "StopWordsRemover",
     "RandomSplitter",
     "NGram",
+    "Word2Vec",
+    "Word2VecModel",
     "VectorIndexer",
     "VectorIndexerModel",
     "MinHashLSH",

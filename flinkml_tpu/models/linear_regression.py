@@ -73,13 +73,12 @@ def _normal_eq_gram_fn(mesh, axis: str):
         xw = xl * wl[:, None]
         a = jax.lax.psum(xl.T @ xw, axis)
         b = jax.lax.psum(xw.T @ yl, axis)
-        s = jax.lax.psum(jnp.sum(wl), axis)
-        return a, b, s
+        return a, b
 
     return jax.jit(
         jax.shard_map(
             local, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
-            out_specs=(P(), P(), P()),
+            out_specs=(P(), P()),
         )
     )
 
@@ -99,19 +98,22 @@ def _fit_normal_equations(table, features_col, label_col, weight_col,
     x_pad, _ = pad_to_multiple(x.astype(np.float32), p)
     y_pad, _ = pad_to_multiple(y.astype(np.float32), p)
     w_pad, _ = pad_to_multiple(w.astype(np.float32), p)
-    a, b, _s = _normal_eq_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
+    a, b = _normal_eq_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
         mesh.shard_batch(x_pad), mesh.shard_batch(w_pad),
         mesh.shard_batch(y_pad),
     )
     a64 = np.asarray(a, np.float64)
     b64 = np.asarray(b, np.float64)
-    d = a64.shape[0]
-    # Jitter scaled to the gram's own magnitude so tiny-scale features
-    # are not silently over-regularized (an absolute 1e-10 would be a
-    # large perturbation for ~1e-6-scale data).
-    jitter = 1e-12 * max(float(np.trace(a64)) / d, np.finfo(np.float64).tiny)
-    a64 += (2.0 * reg + jitter) * np.eye(d)
-    return np.linalg.solve(a64, b64)
+    if reg > 0:
+        # SPD by construction: direct solve.
+        a64 += 2.0 * reg * np.eye(a64.shape[0])
+        return np.linalg.solve(a64, b64)
+    # reg == 0: rank-deficient (collinear) grams must yield the stable
+    # min-norm solution, matching sklearn's lstsq — a jittered direct
+    # solve would silently split weight arbitrarily between collinear
+    # columns. (pinv(XᵀWX)·XᵀWy is the min-norm weighted OLS solution.)
+    coef, _, _, _ = np.linalg.lstsq(a64, b64, rcond=None)
+    return coef
 
 
 class LinearRegression(_LinearRegressionParams, Estimator):

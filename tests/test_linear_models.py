@@ -343,3 +343,22 @@ def test_normal_solver_tiny_scale_features():
     np.testing.assert_allclose(
         model.coefficient, ref.coef_, rtol=1e-3
     )
+
+
+def test_normal_solver_collinear_min_norm():
+    # Duplicated column, reg=0: must match sklearn's min-norm solution,
+    # not an arbitrary split from a jittered near-singular solve.
+    from sklearn.linear_model import LinearRegression as SkOLS
+
+    rng = np.random.default_rng(15)
+    base = rng.normal(size=(150, 2))
+    x = np.concatenate([base, base[:, :1]], axis=1)  # col 2 == col 0
+    y = base @ np.asarray([1.0, -1.0]) + 0.01 * rng.normal(size=150)
+    t = Table({"features": x, "label": y})
+    model = LinearRegression().set_solver("normal").fit(t)
+    ref = SkOLS(fit_intercept=False).fit(x, y)
+    np.testing.assert_allclose(model.coefficient, ref.coef_, atol=1e-3)
+    # Min-norm: the duplicated columns share the weight equally.
+    np.testing.assert_allclose(
+        model.coefficient[0], model.coefficient[2], atol=1e-3
+    )
