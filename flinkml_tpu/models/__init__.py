@@ -50,6 +50,10 @@ from flinkml_tpu.models.fm import (
     FMRegressor,
     FMRegressorModel,
 )
+from flinkml_tpu.models.bisecting_kmeans import (
+    BisectingKMeans,
+    BisectingKMeansModel,
+)
 from flinkml_tpu.models.gmm import GaussianMixture, GaussianMixtureModel
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
 from flinkml_tpu.models.isotonic import (
@@ -154,6 +158,8 @@ __all__ = [
     "ALS",
     "ALSModel",
     "AgglomerativeClustering",
+    "BisectingKMeans",
+    "BisectingKMeansModel",
     "GaussianMixture",
     "GaussianMixtureModel",
     "Swing",

@@ -1,0 +1,98 @@
+"""BisectingKMeans — top-down hierarchical k-means (the Spark/Flink
+family member).
+
+Start with all rows in one cluster; repeatedly split the cluster with
+the largest within-cluster sum of squared distances using a seeded
+2-means (each split is the existing whole-loop-on-device KMeans program
+over that cluster's rows) until ``k`` leaf clusters exist. Degenerate
+splits (a cluster of identical points) retire the cluster from further
+splitting. Prediction is nearest-centroid over the leaf centroids —
+the model is a :class:`KMeansModel` with bisecting-derived centroids,
+so the broadcast-predict path and persistence are shared.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from flinkml_tpu.api import Estimator
+from flinkml_tpu.models.kmeans import KMeansModel, _KMeansParams, train_kmeans
+from flinkml_tpu.models._data import features_matrix
+from flinkml_tpu.ops import blas
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.table import Table
+
+
+class BisectingKMeans(_KMeansParams, Estimator):
+    def __init__(self, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self.mesh = mesh
+
+    def fit(self, *inputs: Table) -> "BisectingKMeansModel":
+        (table,) = inputs
+        if self.get(self.DISTANCE_MEASURE) != "euclidean":
+            raise ValueError(
+                "BisectingKMeans trains on squared-euclidean WCSS; "
+                "distanceMeasure must be 'euclidean' (same constraint as "
+                "KMeans.fit)"
+            )
+        x = features_matrix(table, self.get(self.FEATURES_COL))
+        k = self.get(self.K)
+        n = x.shape[0]
+        if n < k:
+            raise ValueError(f"n_rows={n} < k={k}")
+        mesh = self.mesh or DeviceMesh()
+        max_iter = self.get(self.MAX_ITER)
+        init_mode = self.get(self.INIT_MODE)
+        seed = self.get_seed()
+
+        # Leaf clusters as (member_index_array, centroid, splittable).
+        members = [np.arange(n)]
+        centroids = [x.mean(axis=0)]
+        splittable = [True]
+        split_round = 0
+        while len(members) < k and any(
+            s and len(m) >= 2 for s, m in zip(splittable, members)
+        ):
+            # Pick the splittable cluster with the largest WCSS.
+            wcss = [
+                float(((x[m] - c) ** 2).sum()) if s and len(m) >= 2 else -1.0
+                for m, c, s in zip(members, centroids, splittable)
+            ]
+            target = int(np.argmax(wcss))
+            idx = members[target]
+            sub_centroids = train_kmeans(
+                x[idx], 2, mesh, max_iter, seed + split_round,
+                init_mode=init_mode,
+            )
+            split_round += 1
+            assign = np.asarray(jnp.argmin(blas.squared_distances(
+                jnp.asarray(x[idx], jnp.float32),
+                jnp.asarray(sub_centroids, jnp.float32),
+            ), axis=1))
+            left, right = idx[assign == 0], idx[assign == 1]
+            if len(left) == 0 or len(right) == 0:
+                # Identical points (or collapsed split): retire the leaf.
+                splittable[target] = False
+                continue
+            members[target] = left
+            centroids[target] = x[left].mean(axis=0)
+            splittable[target] = True
+            members.append(right)
+            centroids.append(x[right].mean(axis=0))
+            splittable.append(True)
+
+        model = BisectingKMeansModel()
+        model.copy_params_from(self)
+        model.set_model_data(
+            Table({"centroids": np.stack(centroids)[None, :, :]})
+        )
+        return model
+
+
+class BisectingKMeansModel(KMeansModel):
+    """Nearest-centroid prediction over the bisecting-derived leaf
+    centroids (shares KMeansModel's predict + persistence)."""

@@ -1,0 +1,76 @@
+"""BisectingKMeans: recovery, degenerate splits, persistence."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from flinkml_tpu.models import BisectingKMeans, BisectingKMeansModel
+from flinkml_tpu.table import Table
+
+
+def _blobs(seed=0, n_per=80, k=4, spread=8.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, 3)) * spread
+    xs, ys = [], []
+    for i, c in enumerate(centers):
+        xs.append(rng.normal(size=(n_per, 3)) + c)
+        ys.append(np.full(n_per, i))
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def test_recovers_well_separated_blobs():
+    x, y = _blobs()
+    t = Table({"features": x})
+    model = BisectingKMeans().set_k(4).set_max_iter(20).set_seed(0).fit(t)
+    assert model.centroids.shape == (4, 3)
+    (out,) = model.transform(t)
+    assert adjusted_rand_score(y, out["prediction"]) > 0.95
+
+
+def test_degenerate_duplicates_stop_early():
+    x = np.ones((30, 2))
+    x[15:] = 5.0  # only two distinct points: at most 2 real clusters
+    t = Table({"features": x})
+    model = BisectingKMeans().set_k(4).set_max_iter(5).set_seed(1).fit(t)
+    # Can't split identical-point leaves: fewer than k centroids is fine.
+    assert 2 <= model.centroids.shape[0] <= 4
+    (out,) = model.transform(t)
+    assert len(np.unique(out["prediction"])) == 2
+
+
+def test_validation_and_persistence(tmp_path):
+    x, _ = _blobs(seed=2, n_per=30)
+    t = Table({"features": x})
+    with pytest.raises(ValueError, match="n_rows"):
+        BisectingKMeans().set_k(10_000).fit(t)
+    model = BisectingKMeans().set_k(3).set_max_iter(10).set_seed(3).fit(t)
+    model.save(str(tmp_path / "bkm"))
+    loaded = BisectingKMeansModel.load(str(tmp_path / "bkm"))
+    np.testing.assert_array_equal(loaded.centroids, model.centroids)
+    (p1,) = model.transform(t)
+    (p2,) = loaded.transform(t)
+    np.testing.assert_array_equal(p1["prediction"], p2["prediction"])
+
+
+def test_deterministic():
+    x, _ = _blobs(seed=4, n_per=40)
+    t = Table({"features": x})
+    m1 = BisectingKMeans().set_k(3).set_seed(5).fit(t)
+    m2 = BisectingKMeans().set_k(3).set_seed(5).fit(t)
+    np.testing.assert_array_equal(m1.centroids, m2.centroids)
+
+
+def test_rejects_non_euclidean_and_honors_init_mode():
+    x, _ = _blobs(seed=6, n_per=30)
+    t = Table({"features": x})
+    with pytest.raises(ValueError, match="euclidean"):
+        BisectingKMeans().set_distance_measure("cosine").set_k(2).fit(t)
+    m_pp = (
+        BisectingKMeans().set_k(3).set_init_mode("k-means++")
+        .set_seed(7).fit(t)
+    )
+    m_rand = (
+        BisectingKMeans().set_k(3).set_init_mode("random")
+        .set_seed(7).fit(t)
+    )
+    assert m_pp.centroids.shape == m_rand.centroids.shape == (3, 3)
