@@ -54,7 +54,12 @@ from flinkml_tpu.models.bisecting_kmeans import (
     BisectingKMeans,
     BisectingKMeansModel,
 )
+from flinkml_tpu.models.fpgrowth import FPGrowth, FPGrowthModel
 from flinkml_tpu.models.gmm import GaussianMixture, GaussianMixtureModel
+from flinkml_tpu.models.survival import (
+    AFTSurvivalRegression,
+    AFTSurvivalRegressionModel,
+)
 from flinkml_tpu.models.imputer import Imputer, ImputerModel
 from flinkml_tpu.models.isotonic import (
     IsotonicRegression,
@@ -175,6 +180,10 @@ __all__ = [
     "FMRegressorModel",
     "IsotonicRegression",
     "IsotonicRegressionModel",
+    "AFTSurvivalRegression",
+    "AFTSurvivalRegressionModel",
+    "FPGrowth",
+    "FPGrowthModel",
     "PCA",
     "PCAModel",
     "Tokenizer",

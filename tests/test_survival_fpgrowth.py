@@ -1,0 +1,192 @@
+"""AFTSurvivalRegression + FPGrowth."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import (
+    AFTSurvivalRegression,
+    AFTSurvivalRegressionModel,
+    FPGrowth,
+    FPGrowthModel,
+)
+from flinkml_tpu.models.fpgrowth import fpgrowth
+from flinkml_tpu.models.text import _object_column
+from flinkml_tpu.table import Table
+
+
+# -- AFT ---------------------------------------------------------------------
+
+def _weibull_data(n=2000, seed=0, censor_frac=0.3):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3))
+    beta = np.asarray([0.8, -0.5, 0.2])
+    sigma = 0.5
+    # log T = beta.x + sigma * extreme_value
+    eps = np.log(rng.exponential(size=n))       # standard Gumbel(min)-ish
+    t_true = np.exp(x @ beta + sigma * eps)
+    c_time = np.quantile(t_true, 1 - censor_frac) * rng.uniform(0.5, 1.5, n)
+    observed = t_true <= c_time
+    t = np.where(observed, t_true, c_time)
+    return x, t, observed.astype(np.float64), beta, sigma
+
+
+def _aft(**kw):
+    m = (
+        AFTSurvivalRegression().set_max_iter(1500).set_learning_rate(0.05)
+        .set_global_batch_size(1024).set_tol(0.0).set_seed(0)
+    )
+    for name, v in kw.items():
+        getattr(m, f"set_{name}")(v)
+    return m
+
+
+def test_aft_recovers_weibull_parameters():
+    x, t, censor, beta, sigma = _weibull_data()
+    table = Table({"features": x, "label": t, "censor": censor})
+    model = _aft().fit(table)
+    np.testing.assert_allclose(model.coefficients, beta, atol=0.1)
+    assert abs(model.scale - sigma) < 0.1
+    # Median predictions track the observed times; the ceiling is set
+    # by the irreducible sigma*Gumbel noise (sd ~0.64 vs signal sd
+    # ~0.96 -> max corr ~0.83) and the censoring selection effect.
+    (out,) = model.transform(table)
+    finite = censor == 1.0
+    corr = np.corrcoef(np.log(out["prediction"][finite]),
+                       np.log(t[finite]))[0, 1]
+    assert corr > 0.65, corr
+
+
+def test_aft_quantiles_and_persistence(tmp_path):
+    x, t, censor, _, _ = _weibull_data(n=500, seed=1)
+    table = Table({"features": x, "label": t, "censor": censor})
+    model = _aft(max_iter=300, quantile_probabilities=[0.25, 0.5, 0.75]).fit(table)
+    (out,) = model.transform(table)
+    q = out["quantiles"]
+    assert q.shape == (500, 3)
+    assert np.all(np.diff(q, axis=1) > 0)       # quantiles increase
+    np.testing.assert_allclose(q[:, 1], out["prediction"], rtol=1e-9)
+    model.save(str(tmp_path / "aft"))
+    loaded = AFTSurvivalRegressionModel.load(str(tmp_path / "aft"))
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    assert loaded.scale == model.scale
+
+
+def test_aft_validation():
+    table = Table({
+        "features": np.ones((3, 2)),
+        "label": np.asarray([1.0, -1.0, 2.0]),
+        "censor": np.asarray([1.0, 1.0, 1.0]),
+    })
+    with pytest.raises(ValueError, match="positive"):
+        _aft().fit(table)
+    table2 = Table({
+        "features": np.ones((3, 2)),
+        "label": np.ones(3),
+        "censor": np.zeros(3),
+    })
+    with pytest.raises(ValueError, match="censored"):
+        _aft().fit(table2)
+
+
+# -- FPGrowth ----------------------------------------------------------------
+
+BASKETS = [
+    ["bread", "milk"],
+    ["bread", "diapers", "beer", "eggs"],
+    ["milk", "diapers", "beer", "cola"],
+    ["bread", "milk", "diapers", "beer"],
+    ["bread", "milk", "diapers", "cola"],
+]
+
+
+def test_fpgrowth_matches_bruteforce():
+    from itertools import combinations
+
+    out = fpgrowth(BASKETS, min_support=0.4)    # min_count = 2
+    # Brute-force reference.
+    items = sorted({it for b in BASKETS for it in b})
+    expected = {}
+    for r in range(1, len(items) + 1):
+        for combo in combinations(items, r):
+            cnt = sum(1 for b in BASKETS if set(combo) <= set(b))
+            if cnt >= 2:
+                expected[tuple(sorted(combo))] = cnt
+    assert out == expected
+
+
+def test_fpgrowth_rules_and_transform(tmp_path):
+    t = Table({"items": _object_column(BASKETS)})
+    model = (
+        FPGrowth().set_min_support(0.4).set_min_confidence(0.7).fit(t)
+    )
+    fi = model.freq_itemsets()
+    assert fi.num_rows > 0
+    assert int(fi["freq"][0]) >= int(fi["freq"][fi.num_rows - 1])
+
+    rules = model.association_rules()
+    pairs = {
+        (tuple(a), c): conf
+        for a, c, conf in zip(rules["antecedent"], rules["consequent"],
+                              rules["confidence"])
+    }
+    # beer appears in 3 baskets, all containing diapers: conf 1.0.
+    assert pairs[(("beer",), "diapers")] == pytest.approx(1.0)
+
+    (pred,) = model.transform(Table({"items": _object_column([["beer"]])}))
+    assert "diapers" in pred["prediction"][0]
+    # Items already in the basket are not re-predicted.
+    (pred2,) = model.transform(
+        Table({"items": _object_column([["beer", "diapers"]])})
+    )
+    assert "diapers" not in pred2["prediction"][0]
+
+    model.save(str(tmp_path / "fp"))
+    loaded = FPGrowthModel.load(str(tmp_path / "fp"))
+    (pred3,) = loaded.transform(Table({"items": _object_column([["beer"]])}))
+    assert pred3["prediction"][0] == pred["prediction"][0]
+    clone = FPGrowthModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    assert clone.freq_itemsets().num_rows == fi.num_rows
+
+
+def test_fpgrowth_random_corpus_matches_bruteforce():
+    from itertools import combinations
+
+    rng = np.random.default_rng(2)
+    universe = [f"i{j}" for j in range(8)]
+    baskets = [
+        list(rng.choice(universe, size=rng.integers(1, 6), replace=False))
+        for _ in range(60)
+    ]
+    out = fpgrowth(baskets, min_support=0.15)
+    min_count = int(np.ceil(0.15 * 60))
+    expected = {}
+    for r in range(1, 6):
+        for combo in combinations(universe, r):
+            cnt = sum(1 for b in baskets if set(combo) <= set(b))
+            if cnt >= min_count:
+                expected[tuple(sorted(combo))] = cnt
+    assert out == expected
+
+
+def test_fpgrowth_empty_model_roundtrip():
+    t = Table({"items": _object_column([["a"], ["b"], ["c"]])})
+    model = FPGrowth().set_min_support(0.9).fit(t)
+    assert model.freq_itemsets().num_rows == 0
+    clone = FPGrowthModel()
+    clone.copy_params_from(model)
+    clone.set_model_data(*model.get_model_data())
+    assert clone.freq_itemsets().num_rows == 0
+    assert clone._n_baskets == 3
+    (pred,) = clone.transform(t)
+    assert all(p == [] for p in pred["prediction"])
+
+
+def test_aft_rejects_bad_quantile_probabilities():
+    x = np.ones((4, 1))
+    table = Table({"features": x, "label": np.ones(4),
+                   "censor": np.ones(4)})
+    model = _aft(max_iter=5, quantile_probabilities=[0.5, 1.5]).fit(table)
+    with pytest.raises(ValueError, match="quantileProbabilities"):
+        model.transform(table)
