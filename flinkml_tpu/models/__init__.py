@@ -11,6 +11,7 @@ from flinkml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
 )
+from flinkml_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
 from flinkml_tpu.models.online_kmeans import OnlineKMeans, OnlineKMeansModel
 from flinkml_tpu.models.online_logistic_regression import (
     OnlineLogisticRegression,
@@ -174,6 +175,8 @@ __all__ = [
     "GBTRegressorModel",
     "MLPClassifier",
     "MLPClassifierModel",
+    "OneVsRest",
+    "OneVsRestModel",
     "FMClassifier",
     "FMClassifierModel",
     "FMRegressor",

@@ -140,7 +140,7 @@ class FPGrowthModel(Model):
     def _set(self, itemsets, n_baskets: int) -> None:
         self._itemsets = dict(itemsets)
         self._n_baskets = int(n_baskets)
-        self._rule_cache = None   # rebuilt lazily; itemsets are immutable
+        self._rule_cache = None   # (minConfidence, rules); lazy
 
     def _require(self) -> None:
         if self._itemsets is None:
@@ -192,14 +192,15 @@ class FPGrowthModel(Model):
         })
 
     def _rules_for_transform(self):
-        if self._rule_cache is None:
+        conf = self.get(self.MIN_CONFIDENCE)
+        if self._rule_cache is None or self._rule_cache[0] != conf:
             rules = self.association_rules()
-            self._rule_cache = [
+            self._rule_cache = (conf, [
                 (frozenset(a), c)
                 for a, c in zip(rules["antecedent"], rules["consequent"])
                 if len(a)
-            ]
-        return self._rule_cache
+            ])
+        return self._rule_cache[1]
 
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         """Per basket: consequents of rules whose antecedent ⊆ basket,
@@ -253,7 +254,12 @@ class FPGrowthModel(Model):
 
     def save(self, path: str) -> None:
         self._require()
-        # Itemsets serialize as joined strings (items contain no NUL).
+        # Itemsets serialize as NUL-joined strings; a NUL inside an item
+        # would silently change itemset arity on load, so reject it.
+        if any("\x00" in it for k in self._itemsets for it in k):
+            raise ValueError(
+                "item strings must not contain NUL characters to be saved"
+            )
         keys = ["\x00".join(k) for k in self._itemsets]
         self._save_with_arrays(
             path,

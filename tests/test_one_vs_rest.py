@@ -1,0 +1,116 @@
+"""OneVsRest over LogisticRegression and LinearSVC."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import (
+    LinearSVC,
+    LogisticRegression,
+    OneVsRest,
+    OneVsRestModel,
+)
+from flinkml_tpu.table import Table
+
+
+def _three_class(n_per=120, seed=0):
+    # Angularly separated clusters: the framework's linear models carry
+    # no intercept (reference parity), so each one-vs-rest subproblem
+    # must be separable by a halfspace THROUGH THE ORIGIN — three
+    # clusters at 120-degree angles are.
+    rng = np.random.default_rng(seed)
+    centers = [
+        (5.0, 0.0), (-2.5, 4.33), (-2.5, -4.33),
+    ]
+    x = np.concatenate([
+        rng.normal(size=(n_per, 2)) * 0.6 + c for c in centers
+    ])
+    y = np.repeat([0.0, 1.0, 2.0], n_per)
+    return x, y
+
+
+def _lr():
+    return (
+        LogisticRegression().set_max_iter(60).set_global_batch_size(512)
+        .set_learning_rate(1.0).set_seed(0)
+    )
+
+
+def test_ovr_multiclass_with_lr():
+    x, y = _three_class()
+    t = Table({"features": x, "label": y})
+    model = OneVsRest(_lr()).fit(t)
+    np.testing.assert_array_equal(model.classes, [0.0, 1.0, 2.0])
+    (out,) = model.transform(t)
+    assert (out["prediction"] == y).mean() > 0.95
+    assert out["rawPrediction"].shape == (len(y), 3)
+
+
+def test_ovr_with_margin_classifier():
+    x, y = _three_class(seed=1)
+    t = Table({"features": x, "label": y})
+    svc = (
+        LinearSVC().set_max_iter(60).set_global_batch_size(512)
+        .set_learning_rate(0.5).set_seed(0)
+    )
+    model = OneVsRest(svc).fit(t)
+    (out,) = model.transform(t)
+    assert (out["prediction"] == y).mean() > 0.9
+
+
+def test_ovr_non_contiguous_class_ids():
+    x, y = _three_class(seed=2)
+    y = y * 3 + 5   # classes {5, 8, 11}
+    t = Table({"features": x, "label": y})
+    model = OneVsRest(_lr()).fit(t)
+    (out,) = model.transform(t)
+    assert set(np.unique(out["prediction"])) <= {5.0, 8.0, 11.0}
+    assert (out["prediction"] == y).mean() > 0.95
+
+
+def test_ovr_save_load(tmp_path):
+    x, y = _three_class(n_per=60, seed=3)
+    t = Table({"features": x, "label": y})
+    model = OneVsRest(_lr()).fit(t)
+    model.save(str(tmp_path / "ovr"))
+    loaded = OneVsRestModel.load(str(tmp_path / "ovr"))
+    (p1,) = model.transform(t)
+    (p2,) = loaded.transform(t)
+    np.testing.assert_array_equal(p2["prediction"], p1["prediction"])
+    np.testing.assert_allclose(p2["rawPrediction"], p1["rawPrediction"])
+
+
+def test_ovr_validation():
+    t = Table({"features": np.zeros((4, 2)), "label": np.zeros(4)})
+    with pytest.raises(ValueError, match="classifier"):
+        OneVsRest().fit(t)
+    with pytest.raises(ValueError, match="2 classes"):
+        OneVsRest(_lr()).fit(t)
+    t2 = Table({"features": np.zeros((4, 2)),
+                "label": np.asarray([0.5, 1.0, 0.5, 1.0])})
+    with pytest.raises(ValueError, match="integral"):
+        OneVsRest(_lr()).fit(t2)
+
+
+def test_ovr_custom_label_col_propagates():
+    x, y = _three_class(n_per=50, seed=4)
+    t = Table({"features": x, "target": y})
+    inner = _lr().set_label_col("target")
+    model = OneVsRest(inner).set_label_col("target").fit(t)
+    (out,) = model.transform(t)
+    assert (out["prediction"] == y).mean() > 0.95
+
+
+def test_ovr_margin_scores_used_for_ties():
+    from flinkml_tpu.models import LinearSVC
+
+    x, y = _three_class(seed=5)
+    t = Table({"features": x, "label": y})
+    svc = (
+        LinearSVC().set_max_iter(60).set_global_batch_size(512)
+        .set_learning_rate(0.5).set_seed(0)
+    )
+    model = OneVsRest(svc).fit(t)
+    (out,) = model.transform(t)
+    # Raw scores are continuous margins, not 0/1 fallbacks.
+    raw = out["rawPrediction"]
+    assert len(np.unique(raw)) > 10

@@ -190,3 +190,22 @@ def test_aft_rejects_bad_quantile_probabilities():
     model = _aft(max_iter=5, quantile_probabilities=[0.5, 1.5]).fit(table)
     with pytest.raises(ValueError, match="quantileProbabilities"):
         model.transform(table)
+
+
+def test_fpgrowth_rule_cache_tracks_confidence():
+    t = Table({"items": _object_column(BASKETS)})
+    model = FPGrowth().set_min_support(0.4).set_min_confidence(0.99).fit(t)
+    (strict,) = model.transform(Table({"items": _object_column([["beer"]])}))
+    model.set_min_confidence(0.5)
+    (loose,) = model.transform(Table({"items": _object_column([["beer"]])}))
+    assert len(loose["prediction"][0]) >= len(strict["prediction"][0])
+    assert "diapers" in loose["prediction"][0]
+
+
+def test_fpgrowth_save_rejects_nul_items():
+    t = Table({"items": _object_column([["a\x00b", "c"], ["a\x00b", "c"]])})
+    model = FPGrowth().set_min_support(0.5).fit(t)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="NUL"):
+        model.save("/tmp/never-created-fp")
