@@ -12,6 +12,7 @@ from flinkml_tpu.models.linear_regression import (
     LinearRegressionModel,
 )
 from flinkml_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
+from flinkml_tpu.models.pic import PowerIterationClustering
 from flinkml_tpu.models.online_kmeans import OnlineKMeans, OnlineKMeansModel
 from flinkml_tpu.models.online_logistic_regression import (
     OnlineLogisticRegression,
@@ -166,6 +167,7 @@ __all__ = [
     "AgglomerativeClustering",
     "BisectingKMeans",
     "BisectingKMeansModel",
+    "PowerIterationClustering",
     "GaussianMixture",
     "GaussianMixtureModel",
     "Swing",

@@ -97,16 +97,27 @@ class OneVsRestModel(_OneVsRestParams, Model):
         if self._models is None:
             raise ValueError("Model data is not set; fit first or load")
 
+    @staticmethod
+    def _inner_col(model: Model, param_name: str, fallback: str) -> str:
+        """The column the INNER model writes (its own configured param,
+        not OneVsRest's — mirroring fit's labelCol resolution)."""
+        p = model.get_param(param_name)
+        return model.get(p) if p is not None else fallback
+
     def _class_score(self, model: Model, table: Table) -> np.ndarray:
         (scored,) = model.transform(table)
-        raw_col = self.get(self.RAW_PREDICTION_COL)
+        raw_col = self._inner_col(
+            model, "rawPredictionCol", self.get(self.RAW_PREDICTION_COL)
+        )
         if raw_col in scored.column_names:
             raw = np.asarray(scored.column(raw_col), np.float64)
             if raw.ndim == 2 and raw.shape[1] == 2:
                 return raw[:, 1]           # probability pair: P(class)
             if raw.ndim == 1:
                 return raw                 # margin (LinearSVC's layout)
-        pred_col = self.get(self.PREDICTION_COL)
+        pred_col = self._inner_col(
+            model, "predictionCol", self.get(self.PREDICTION_COL)
+        )
         return np.asarray(scored.column(pred_col), np.float64)
 
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:

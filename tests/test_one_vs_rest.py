@@ -114,3 +114,12 @@ def test_ovr_margin_scores_used_for_ties():
     # Raw scores are continuous margins, not 0/1 fallbacks.
     raw = out["rawPrediction"]
     assert len(np.unique(raw)) > 10
+
+
+def test_ovr_inner_custom_raw_prediction_col():
+    x, y = _three_class(n_per=50, seed=6)
+    t = Table({"features": x, "label": y})
+    inner = _lr().set_raw_prediction_col("innerRaw")
+    model = OneVsRest(inner).fit(t)
+    (out,) = model.transform(t)
+    assert (out["prediction"] == y).mean() > 0.95

@@ -1,0 +1,97 @@
+"""PowerIterationClustering on block-structured affinity graphs."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from flinkml_tpu.models import PowerIterationClustering
+from flinkml_tpu.table import Table
+
+
+def _block_graph(sizes=(40, 40), p_in=0.5, p_out=0.01, seed=0):
+    """Random graph with dense within-block, sparse cross-block edges."""
+    rng = np.random.default_rng(seed)
+    n = sum(sizes)
+    labels = np.repeat(np.arange(len(sizes)), sizes)
+    src, dst = [], []
+    for i in range(n):
+        for j in range(i + 1, n):
+            p = p_in if labels[i] == labels[j] else p_out
+            if rng.uniform() < p:
+                src.append(i)
+                dst.append(j)
+    return np.asarray(src), np.asarray(dst), labels
+
+
+def test_recovers_two_blocks():
+    src, dst, truth = _block_graph()
+    t = Table({"src": src, "dst": dst})
+    (out,) = (
+        PowerIterationClustering().set_k(2).set_max_iter(30).set_seed(0)
+        .transform(t)
+    )
+    assert out.num_rows == len(truth)
+    order = np.argsort(out["id"])
+    ari = adjusted_rand_score(truth, out[
+        "prediction"][order])
+    assert ari > 0.9, ari
+
+
+def test_three_blocks_weighted():
+    src, dst, truth = _block_graph(sizes=(30, 30, 30), p_in=0.6,
+                                   p_out=0.02, seed=1)
+    w = np.ones(len(src))
+    t = Table({"src": src, "dst": dst, "w": w})
+    (out,) = (
+        PowerIterationClustering().set_k(3).set_max_iter(40)
+        .set_weight_col("w").set_seed(0).transform(t)
+    )
+    order = np.argsort(out["id"])
+    ari = adjusted_rand_score(truth, out["prediction"][order])
+    assert ari > 0.8, ari
+
+
+def test_string_vertex_ids_and_labeling():
+    # Two triangles joined by one weak edge.
+    src = np.asarray(["a", "b", "c", "x", "y", "z", "a"])
+    dst = np.asarray(["b", "c", "a", "y", "z", "x", "x"])
+    w = np.asarray([1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 0.01])
+    t = Table({"src": src, "dst": dst, "w": w})
+    (out,) = (
+        PowerIterationClustering().set_k(2).set_max_iter(50)
+        .set_weight_col("w").set_seed(0).transform(t)
+    )
+    by_id = dict(zip(out["id"], out["prediction"]))
+    assert by_id["a"] == by_id["b"] == by_id["c"]
+    assert by_id["x"] == by_id["y"] == by_id["z"]
+    assert by_id["a"] != by_id["x"]
+    assert by_id[sorted(by_id)[0]] == 0.0   # first-appearance labeling
+
+
+def test_validation():
+    t = Table({"src": np.asarray([0, 1]), "dst": np.asarray([1, 2]),
+               "w": np.asarray([1.0, -1.0])})
+    with pytest.raises(ValueError, match="non-negative"):
+        (
+            PowerIterationClustering().set_weight_col("w").set_k(2)
+            .transform(t)
+        )
+    t2 = Table({"src": np.asarray([0]), "dst": np.asarray([1])})
+    with pytest.raises(ValueError, match="vertices"):
+        PowerIterationClustering().set_k(5).transform(t2)
+
+
+def test_complete_graph_constant_embedding_single_cluster():
+    # K4 with equal weights: the pseudo-eigenvector is constant; the 1-D
+    # k-means must terminate (used to infinite-loop) with one cluster.
+    src, dst = [], []
+    for i in range(4):
+        for j in range(i + 1, 4):
+            src.append(i)
+            dst.append(j)
+    t = Table({"src": np.asarray(src), "dst": np.asarray(dst)})
+    (out,) = (
+        PowerIterationClustering().set_k(2).set_max_iter(60).set_seed(0)
+        .transform(t)
+    )
+    assert set(np.unique(out["prediction"])) == {0.0}
