@@ -67,6 +67,7 @@ from flinkml_tpu.models.isotonic import (
     IsotonicRegression,
     IsotonicRegressionModel,
 )
+from flinkml_tpu.models.lda import LDA, LDAModel
 from flinkml_tpu.models.lsh import MinHashLSH, MinHashLSHModel
 from flinkml_tpu.models.mlp import MLPClassifier, MLPClassifierModel
 from flinkml_tpu.models.ngram import NGram
@@ -211,6 +212,8 @@ __all__ = [
     "NGram",
     "Word2Vec",
     "Word2VecModel",
+    "LDA",
+    "LDAModel",
     "VectorIndexer",
     "VectorIndexerModel",
     "MinHashLSH",

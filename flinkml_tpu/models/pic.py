@@ -124,10 +124,13 @@ class PowerIterationClustering(_PICParams, AlgoOperator):
             jnp.asarray(v0), jnp.asarray(self.get(self.MAX_ITER), jnp.int32),
         ), dtype=np.float64)
         labels = _kmeans_1d(v, k, rng)
-        # First-appearance relabeling for determinism.
+        # First-appearance relabeling for determinism (vectorized: a
+        # k-sized LUT instead of a Python loop over all n vertices).
         _, first = np.unique(labels, return_index=True)
-        remap = {labels[i]: r for r, i in enumerate(np.sort(first))}
-        labels = np.asarray([remap[l] for l in labels], dtype=np.float64)
+        lut = np.empty(labels.max() + 1, dtype=np.int64)
+        for rank, i in enumerate(np.sort(first)):
+            lut[labels[i]] = rank
+        labels = lut[labels].astype(np.float64)
         return (
             Table({
                 "id": vertex_ids,

@@ -123,3 +123,6 @@ def test_ovr_inner_custom_raw_prediction_col():
     model = OneVsRest(inner).fit(t)
     (out,) = model.transform(t)
     assert (out["prediction"] == y).mean() > 0.95
+    # Scores must be the inner model's continuous probabilities, not the
+    # 0/1 prediction fallback (which also reaches high accuracy here).
+    assert len(np.unique(out["rawPrediction"])) > 10
