@@ -127,6 +127,7 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
             node = jnp.zeros(n_local, jnp.int32)   # index within level
             feat_arr = jnp.zeros(n_inner, jnp.int32)
             bin_arr = jnp.zeros(n_inner, jnp.int32)
+            gain_arr = jnp.zeros(n_inner, jnp.float32)
             for level in range(depth):
                 ids = ((node[:, None] * n_feat + feat_ids) * n_bins
                        + binned).reshape(-1)
@@ -153,15 +154,16 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
                 # The last bin's "split" sends everything left: force its
                 # gain to 0 so argmax prefers real splits.
                 gain = gain.at[:, :, -1].set(0.0)
-                best = jnp.argmax(
-                    gain.reshape(n_leaves, n_feat * n_bins), axis=1
-                )
+                flat_gain = gain.reshape(n_leaves, n_feat * n_bins)
+                best = jnp.argmax(flat_gain, axis=1)
+                best_gain = jnp.max(flat_gain, axis=1)
                 bf = (best // n_bins).astype(jnp.int32)     # [n_leaves]
                 bb = (best % n_bins).astype(jnp.int32)
                 start = (1 << level) - 1
                 idx = start + jnp.arange(1 << level)
                 feat_arr = feat_arr.at[idx].set(bf[: 1 << level])
                 bin_arr = bin_arr.at[idx].set(bb[: 1 << level])
+                gain_arr = gain_arr.at[idx].set(best_gain[: 1 << level])
                 sample_bin = jnp.take_along_axis(
                     binned, bf[node][:, None], axis=1
                 )[:, 0]
@@ -173,7 +175,7 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
             # Empty leaves have lh == 0; with lam == 0 the division would
             # be 0/0 — floor the denominator so they get value 0.
             leaf = -lg / jnp.maximum(lh + lam, 1e-12)
-            return feat_arr, bin_arr, leaf, node
+            return feat_arr, bin_arr, gain_arr, leaf, node
 
         def tree_step(carry, tree_key):
             pred = carry
@@ -181,9 +183,11 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
             mask = (
                 jax.random.uniform(tree_key, (n_local,)) < subsample
             ).astype(g.dtype)
-            feat_arr, bin_arr, leaf, node = build_tree(g * mask, h * mask)
+            feat_arr, bin_arr, gain_arr, leaf, node = build_tree(
+                g * mask, h * mask
+            )
             pred = (pred + lr * leaf[node]).astype(jnp.float32)
-            return pred, (feat_arr, bin_arr, leaf)
+            return pred, (feat_arr, bin_arr, gain_arr, leaf)
 
         keys = jax.random.split(key, num_trees)
         # Derive the initial carry from a sharded input so it is marked
@@ -197,7 +201,7 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
         jax.shard_map(
             local, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
-            out_specs=(P(), P(), P()),
+            out_specs=(P(), P(), P(), P()),
         )
     )
 
@@ -251,7 +255,7 @@ class _GBTBase(_GBTParams, Estimator):
             self.get(self.NUM_TREES), self._LOGISTIC,
         )
         f32 = lambda v: jnp.asarray(v, jnp.float32)
-        feats, bins, leaves = builder(
+        feats, bins, gains, leaves = builder(
             mesh.shard_batch(b_pad), mesh.shard_batch(y_pad),
             mesh.shard_batch(w_pad),
             f32(base), f32(self.get(self.LEARNING_RATE)),
@@ -266,15 +270,18 @@ class _GBTBase(_GBTParams, Estimator):
             [edges, np.full((edges.shape[0], 1), np.inf)], axis=1
         )
         thrs = edges_inf[feats, np.minimum(bins, edges_inf.shape[1] - 1)]
-        return feats, thrs, np.asarray(leaves), base, depth
+        return (feats, thrs, np.asarray(gains), np.asarray(leaves), base,
+                depth, x.shape[1])
 
     def fit(self, *inputs: Table):
         (table,) = inputs
-        feats, thrs, leaves, base, depth = self._fit_forest(table)
+        feats, thrs, gains, leaves, base, depth, n_features = (
+            self._fit_forest(table)
+        )
         model = (GBTClassifierModel if self._LOGISTIC else GBTRegressorModel)()
         model.copy_params_from(self)
         model._set_forest(feats, thrs, leaves, base, depth,
-                          self.get(self.LEARNING_RATE))
+                          self.get(self.LEARNING_RATE), gains, n_features)
         return model
 
 
@@ -289,14 +296,25 @@ class _GBTModelBase(_GBTParams, Model):
         self._base: float = 0.0
         self._depth: int = 0
         self._lr: float = 0.1
+        self._gains: Optional[np.ndarray] = None
+        self._n_features: int = 0
 
-    def _set_forest(self, feats, thrs, leaves, base, depth, lr):
+    def _set_forest(self, feats, thrs, leaves, base, depth, lr,
+                    gains=None, n_features=None):
         self._feats = np.asarray(feats, np.int64)
         self._thrs = np.asarray(thrs, np.float64)
         self._leaves = np.asarray(leaves, np.float64)
         self._base = float(base)
         self._depth = int(depth)
         self._lr = float(lr)
+        self._gains = (
+            np.asarray(gains, np.float64) if gains is not None
+            else np.ones_like(self._feats, dtype=np.float64)
+        )
+        self._n_features = (
+            int(n_features) if n_features is not None
+            else int(self._feats.max()) + 1
+        )
 
     def set_model_data(self, *inputs: Table):
         (table,) = inputs
@@ -306,6 +324,11 @@ class _GBTModelBase(_GBTParams, Model):
             float(table.column("base")[0]),
             int(table.column("depth")[0]),
             float(table.column("learningRate")[0]),
+            gains=table.column("gain") if "gain" in table else None,
+            n_features=(
+                int(table.column("numFeatures")[0])
+                if "numFeatures" in table else None
+            ),
         )
         return self
 
@@ -314,15 +337,38 @@ class _GBTModelBase(_GBTParams, Model):
         t = self._feats.shape[0]
         return [Table({
             "feat": self._feats, "threshold": self._thrs,
-            "leaf": self._leaves,
+            "gain": self._gains, "leaf": self._leaves,
             "base": np.full(t, self._base),
             "depth": np.full(t, self._depth),
             "learningRate": np.full(t, self._lr),
+            "numFeatures": np.full(t, self._n_features),
         })]
 
     def _require(self) -> None:
         if self._feats is None:
             raise ValueError("Model data is not set; fit or set_model_data first")
+
+    def feature_importances(self, num_features: Optional[int] = None) -> np.ndarray:
+        """Gain importance (the XGBoost convention): each feature's share
+        of the total split gain across the forest, normalized to sum
+        to 1. Degenerate nodes (empty/pure — zero gain) contribute
+        nothing, so deep complete trees don't inflate feature 0.
+        Default length = the training feature count."""
+        self._require()
+        d = self._n_features if num_features is None else int(num_features)
+        max_feat = int(self._feats.max())
+        if d <= max_feat:
+            raise ValueError(
+                f"num_features={d} but the forest splits on feature "
+                f"{max_feat}"
+            )
+        imp = np.bincount(
+            self._feats.reshape(-1),
+            weights=self._gains.reshape(-1),
+            minlength=d,
+        )
+        total = imp.sum()
+        return imp / total if total > 0 else imp
 
     def _margin(self, table: Table) -> np.ndarray:
         x = np.asarray(
@@ -343,10 +389,11 @@ class _GBTModelBase(_GBTParams, Model):
         self._require()
         self._save_with_arrays(path, {
             "feat": self._feats, "threshold": self._thrs,
-            "leaf": self._leaves,
+            "gain": self._gains, "leaf": self._leaves,
             "base": np.asarray(self._base),
             "depth": np.asarray(self._depth),
             "learningRate": np.asarray(self._lr),
+            "numFeatures": np.asarray(self._n_features),
         })
 
     @classmethod
@@ -356,6 +403,10 @@ class _GBTModelBase(_GBTParams, Model):
             arrays["feat"], arrays["threshold"], arrays["leaf"],
             float(arrays["base"]), int(arrays["depth"]),
             float(arrays["learningRate"]),
+            gains=arrays.get("gain"),
+            n_features=(
+                int(arrays["numFeatures"]) if "numFeatures" in arrays else None
+            ),
         )
         return model
 

@@ -175,3 +175,34 @@ def test_reg_lambda_zero_still_learns():
     (out,) = model.transform(t)
     assert (out["prediction"] == y).mean() > 0.8
     assert np.isfinite(model._leaves).all()
+
+
+def test_feature_importances_rank_informative_features():
+    rng = np.random.default_rng(9)
+    x = rng.uniform(-1, 1, size=(800, 5))
+    y = ((x[:, 1] > 0) ^ (x[:, 3] > 0)).astype(np.float64)  # 1 and 3 matter
+    t = Table({"features": x, "label": y})
+    model = _clf(num_trees=20, max_depth=3).fit(t)
+    imp = model.feature_importances()
+    assert imp.shape == (5,)    # training feature count, persisted
+    np.testing.assert_allclose(imp.sum(), 1.0, rtol=1e-9)
+    assert set(np.argsort(-imp)[:2]) == {1, 3}
+    # Gain-weighted: the noise features carry almost nothing.
+    assert imp[[1, 3]].sum() > 0.9
+    # num_features pads unseen trailing features with zero, and rejects
+    # counts smaller than features actually split on.
+    imp8 = model.feature_importances(num_features=8)
+    np.testing.assert_allclose(imp8[:5], imp)
+    np.testing.assert_allclose(imp8[5:], 0.0)
+    with pytest.raises(ValueError, match="splits on feature"):
+        model.feature_importances(num_features=1)
+    # Deep trees on one-split data: degenerate nodes must not inflate
+    # feature 0 (the zero-gain argmax default).
+    rng2 = np.random.default_rng(10)
+    x2 = rng2.uniform(-1, 1, size=(600, 3))
+    y2 = (x2[:, 2] > 0).astype(np.float64)
+    deep = _clf(num_trees=10, max_depth=5).fit(
+        Table({"features": x2, "label": y2})
+    )
+    imp_deep = deep.feature_importances()
+    assert np.argmax(imp_deep) == 2 and imp_deep[2] > 0.9, imp_deep
