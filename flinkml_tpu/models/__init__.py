@@ -41,6 +41,10 @@ from flinkml_tpu.models.gbt import (
     GBTClassifierModel,
     GBTRegressor,
     GBTRegressorModel,
+    RandomForestClassifier,
+    RandomForestClassifierModel,
+    RandomForestRegressor,
+    RandomForestRegressorModel,
 )
 from flinkml_tpu.models.discretizer import (
     KBinsDiscretizer,
@@ -176,6 +180,10 @@ __all__ = [
     "GBTClassifierModel",
     "GBTRegressor",
     "GBTRegressorModel",
+    "RandomForestClassifier",
+    "RandomForestClassifierModel",
+    "RandomForestRegressor",
+    "RandomForestRegressorModel",
     "MLPClassifier",
     "MLPClassifierModel",
     "OneVsRest",

@@ -103,11 +103,21 @@ def bin_features(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
 
 @functools.lru_cache(maxsize=16)
 def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
-                    num_trees: int, logistic: bool):
+                    num_trees: int, logistic: bool, boosting: bool = True,
+                    feat_subset: int = 0):
     """One compiled program that builds the whole forest.
 
     Static config in the cache key; runtime inputs are the sharded
     binned matrix / labels / weights and scalar hyperparams.
+
+    ``boosting=False`` turns the scan into BAGGING (random forest):
+    every tree fits the same base-score residual independently (the
+    prediction carry is not updated), row weights become Poisson
+    bootstrap multiplicities (diversity even at subsample=1.0), and
+    ``feat_subset > 0`` draws exactly that many features per tree (a
+    permutation prefix — never empty), masking the rest's gains to -inf
+    so an excluded feature can never win the argmax even when every
+    in-subset gain is negative.
     """
     n_leaves = 1 << depth
     n_inner = n_leaves - 1          # heap: level L starts at 2^L - 1
@@ -123,7 +133,7 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
         n_local = binned.shape[0]
         feat_ids = jnp.arange(n_feat, dtype=jnp.int32)[None, :]
 
-        def build_tree(g, h):
+        def build_tree(g, h, fmask):
             node = jnp.zeros(n_local, jnp.int32)   # index within level
             feat_arr = jnp.zeros(n_inner, jnp.int32)
             bin_arr = jnp.zeros(n_inner, jnp.int32)
@@ -154,9 +164,16 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
                 # The last bin's "split" sends everything left: force its
                 # gain to 0 so argmax prefers real splits.
                 gain = gain.at[:, :, -1].set(0.0)
+                # Per-tree feature subset (bagging): -inf, NOT a zero
+                # multiply — zeroed gains would still beat negative
+                # in-subset gains (possible under regLambda) and leak
+                # excluded features into the forest.
+                gain = jnp.where(
+                    fmask[None, :, None] > 0, gain, -jnp.inf
+                )
                 flat_gain = gain.reshape(n_leaves, n_feat * n_bins)
                 best = jnp.argmax(flat_gain, axis=1)
-                best_gain = jnp.max(flat_gain, axis=1)
+                best_gain = jnp.maximum(jnp.max(flat_gain, axis=1), 0.0)
                 bf = (best // n_bins).astype(jnp.int32)     # [n_leaves]
                 bb = (best % n_bins).astype(jnp.int32)
                 start = (1 << level) - 1
@@ -180,13 +197,31 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
         def tree_step(carry, tree_key):
             pred = carry
             g, h = grad_hess(pred, y, w)
-            mask = (
-                jax.random.uniform(tree_key, (n_local,)) < subsample
-            ).astype(g.dtype)
+            k_rows, k_feats = jax.random.split(tree_key)
+            if boosting:
+                mask = (
+                    jax.random.uniform(k_rows, (n_local,)) < subsample
+                ).astype(g.dtype)
+            else:
+                # Poisson bootstrap: multiplicity weights give the
+                # classic with-replacement resample (diverse trees even
+                # at subsample = 1.0, where a Bernoulli mask would make
+                # every tree identical).
+                mask = jax.random.poisson(
+                    k_rows, subsample, (n_local,)
+                ).astype(g.dtype)
+            if feat_subset:
+                perm = jax.random.permutation(k_feats, n_feat)
+                fmask = jnp.zeros(n_feat, jnp.float32).at[
+                    perm[:feat_subset]
+                ].set(1.0)
+            else:
+                fmask = jnp.ones(n_feat, jnp.float32)
             feat_arr, bin_arr, gain_arr, leaf, node = build_tree(
-                g * mask, h * mask
+                g * mask, h * mask, fmask
             )
-            pred = (pred + lr * leaf[node]).astype(jnp.float32)
+            if boosting:
+                pred = (pred + lr * leaf[node]).astype(jnp.float32)
             return pred, (feat_arr, bin_arr, gain_arr, leaf)
 
         keys = jax.random.split(key, num_trees)
@@ -223,10 +258,14 @@ def _walk_forest(x: np.ndarray, feats, thrs, leaves, depth: int) -> np.ndarray:
 
 class _GBTBase(_GBTParams, Estimator):
     _LOGISTIC = True
+    _BOOSTING = True
 
     def __init__(self, mesh: Optional[DeviceMesh] = None):
         super().__init__()
         self.mesh = mesh
+
+    def _feat_fraction(self, d: int) -> float:
+        return 1.0
 
     def _fit_forest(self, table: Table):
         x, y, w = labeled_data(
@@ -250,9 +289,14 @@ class _GBTBase(_GBTParams, Estimator):
         y_pad, _ = pad_to_multiple(y.astype(np.float32), p)
         w_pad = np.zeros(b_pad.shape[0], np.float32)
         w_pad[:n_valid] = w[:n_valid].astype(np.float32)
+        f = self._feat_fraction(x.shape[1])
+        feat_subset = (
+            0 if f >= 1.0 else max(1, int(round(f * x.shape[1])))
+        )
         builder = _forest_builder(
             mesh.mesh, DeviceMesh.DATA_AXIS, x.shape[1], max_bins, depth,
             self.get(self.NUM_TREES), self._LOGISTIC,
+            boosting=self._BOOSTING, feat_subset=feat_subset,
         )
         f32 = lambda v: jnp.asarray(v, jnp.float32)
         feats, bins, gains, leaves = builder(
@@ -273,15 +317,23 @@ class _GBTBase(_GBTParams, Estimator):
         return (feats, thrs, np.asarray(gains), np.asarray(leaves), base,
                 depth, x.shape[1])
 
+    _MODEL_CLS = None   # set per concrete estimator
+
     def fit(self, *inputs: Table):
         (table,) = inputs
         feats, thrs, gains, leaves, base, depth, n_features = (
             self._fit_forest(table)
         )
-        model = (GBTClassifierModel if self._LOGISTIC else GBTRegressorModel)()
+        model = self._MODEL_CLS()
         model.copy_params_from(self)
-        model._set_forest(feats, thrs, leaves, base, depth,
-                          self.get(self.LEARNING_RATE), gains, n_features)
+        # Bagged forests predict the MEAN of tree outputs (lr = 1/T);
+        # boosted forests scale each tree by the learning rate.
+        lr = (
+            self.get(self.LEARNING_RATE) if self._BOOSTING
+            else 1.0 / feats.shape[0]
+        )
+        model._set_forest(feats, thrs, leaves, base, depth, lr,
+                          gains, n_features)
         return model
 
 
@@ -452,3 +504,58 @@ class GBTRegressorModel(_GBTModelBase):
         return (
             table.with_column(self.get(self.PREDICTION_COL), self._margin(table)),
         )
+
+
+class _RandomForestParams(_GBTParams):
+    FEATURE_SUBSET_FRACTION = FloatParam(
+        "featureSubsetFraction",
+        "Fraction of features drawn per tree (None = sqrt(d)/d for the "
+        "classifier, all features for the regressor — the sklearn "
+        "conventions).",
+        None, lambda v: v is None or 0 < v <= 1,
+    )
+
+
+class _RFBase(_RandomForestParams, _GBTBase):
+    """Random forest = the same device forest builder in BAGGING mode:
+    every tree fits the base-score residual independently on a row
+    subsample and a per-tree feature subset; prediction averages the
+    tree outputs (Newton-step leaves at the constant base score)."""
+
+    _BOOSTING = False
+
+    def _feat_fraction(self, d: int) -> float:
+        f = self.get(self.FEATURE_SUBSET_FRACTION)
+        return float(f) if f is not None else min(1.0, np.sqrt(d) / d)
+
+
+class RandomForestClassifier(_RFBase):
+    """Bagged binary classifier (defaults: subsample 1.0 — set e.g. 0.7
+    for extra diversity; feature subset sqrt(d))."""
+
+    _LOGISTIC = True
+
+
+class RandomForestClassifierModel(_RandomForestParams, GBTClassifierModel):
+    pass
+
+
+class RandomForestRegressor(_RFBase):
+    _LOGISTIC = False
+
+    def _feat_fraction(self, d: int) -> float:
+        # Regression forests default to ALL features per tree (the
+        # sklearn convention; sqrt is the classification default).
+        f = self.get(self.FEATURE_SUBSET_FRACTION)
+        return float(f) if f is not None else 1.0
+
+
+class RandomForestRegressorModel(_RandomForestParams, GBTRegressorModel):
+    pass
+
+
+# Estimator -> model wiring (assigned after all classes exist).
+GBTClassifier._MODEL_CLS = GBTClassifierModel
+GBTRegressor._MODEL_CLS = GBTRegressorModel
+RandomForestClassifier._MODEL_CLS = RandomForestClassifierModel
+RandomForestRegressor._MODEL_CLS = RandomForestRegressorModel

@@ -206,3 +206,95 @@ def test_feature_importances_rank_informative_features():
     )
     imp_deep = deep.feature_importances()
     assert np.argmax(imp_deep) == 2 and imp_deep[2] > 0.9, imp_deep
+
+
+def test_random_forest_classifier_quality_and_diversity():
+    from flinkml_tpu.models import RandomForestClassifier
+
+    x, y = _nonlinear_classification(n=1500, seed=11)
+    t = Table({"features": x, "label": y})
+    rf = (
+        RandomForestClassifier().set_num_trees(40).set_max_depth(5)
+        .set_subsample(0.7).set_seed(0)
+    )
+    model = rf.fit(t)
+    (out,) = model.transform(t)
+    auc = roc_auc_score(y, out["rawPrediction"][:, 1])
+    # Poisson(0.7) bootstrap rows + sqrt feature subsets on a noisy task.
+    assert auc > 0.8, auc
+    # Feature subsets differ across trees (sqrt(6)/6 fraction).
+    assert len({tuple(np.unique(model._feats[i])) for i in range(10)}) > 1
+    # Prediction scale is the MEAN of tree outputs, not a sum.
+    assert model._lr == pytest.approx(1.0 / 40)
+
+
+def test_random_forest_regressor_and_persistence(tmp_path):
+    from flinkml_tpu.models import (
+        RandomForestRegressor,
+        RandomForestRegressorModel,
+    )
+
+    x, y = _nonlinear_regression(n=1200, seed=12)
+    t = Table({"features": x, "label": y})
+    model = (
+        RandomForestRegressor().set_num_trees(40).set_max_depth(6)
+        .set_subsample(0.7).set_seed(0).fit(t)
+    )
+    (out,) = model.transform(t)
+    assert r2_score(y, out["prediction"]) > 0.7
+    model.save(str(tmp_path / "rf"))
+    loaded = RandomForestRegressorModel.load(str(tmp_path / "rf"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0]["prediction"], out["prediction"]
+    )
+
+
+def test_random_forest_feature_fraction_param():
+    from flinkml_tpu.models import RandomForestClassifier
+
+    rng = np.random.default_rng(13)
+    x = rng.uniform(-1, 1, size=(400, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    t = Table({"features": x, "label": y})
+    full = (
+        RandomForestClassifier().set_num_trees(8).set_max_depth(2)
+        .set_feature_subset_fraction(1.0).set_seed(0).fit(t)
+    )
+    # With all features available, every tree roots on the true one.
+    assert np.all(full._feats[:, 0] == 0)
+
+
+def test_random_forest_trees_are_diverse_at_default_params():
+    from flinkml_tpu.models import RandomForestRegressor
+
+    x, y = _nonlinear_regression(n=600, seed=14)
+    t = Table({"features": x, "label": y})
+    model = (
+        RandomForestRegressor().set_num_trees(6).set_max_depth(3)
+        .set_seed(0).fit(t)    # defaults: subsample 1.0, all features
+    )
+    # Poisson bootstrap must make default-param trees differ.
+    leaves = [tuple(np.round(model._leaves[i], 6)) for i in range(6)]
+    assert len(set(leaves)) > 1
+
+
+def test_random_forest_subset_contract_is_strict():
+    from flinkml_tpu.models import RandomForestClassifier
+
+    rng = np.random.default_rng(15)
+    x = rng.uniform(-1, 1, size=(500, 6))
+    y = (x[:, 2] > 0).astype(np.float64)
+    t = Table({"features": x, "label": y})
+    model = (
+        RandomForestClassifier().set_num_trees(50).set_max_depth(3)
+        .set_feature_subset_fraction(0.34).set_seed(0).fit(t)
+    )
+    # Every tree's POSITIVE-gain splits use at most 2 distinct features
+    # (round(0.34 * 6) = 2) — zero-gain degenerate nodes are excluded.
+    for i in range(50):
+        used = {
+            int(f) for f, g in zip(model._feats[i], model._gains[i]) if g > 0
+        }
+        assert len(used) <= 2, (i, used)
+    # The param survives into the fitted model's map.
+    assert "featureSubsetFraction" in model.get_param_map_json()
