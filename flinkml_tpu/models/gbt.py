@@ -72,6 +72,13 @@ class _GBTParams(
         "subsample", "Per-tree row sampling fraction.", 1.0,
         ParamValidators.in_range(0.0, 1.0, lower_inclusive=False),
     )
+    VALIDATION_FRACTION = FloatParam(
+        "validationFraction",
+        "Held-out fraction for early stopping: the forest is truncated "
+        "to the prefix with the best holdout loss (0 = off; boosted "
+        "estimators only).",
+        0.0, ParamValidators.in_range(0.0, 0.9),
+    )
 
 
 # -- binning ------------------------------------------------------------------
@@ -241,12 +248,31 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
     )
 
 
+def _walk_forest_per_tree(x: np.ndarray, feats, thrs, leaves,
+                          depth: int) -> np.ndarray:
+    """[T, n] per-tree leaf values for raw features (host numpy)."""
+    n = x.shape[0]
+    out = np.empty((feats.shape[0], n))
+    for t in range(feats.shape[0]):
+        node = np.zeros(n, dtype=np.int64)   # index within level
+        for level in range(depth):
+            start = (1 << level) - 1
+            f = feats[t, start + node]
+            thr = thrs[t, start + node]
+            node = node * 2 + (x[np.arange(n), f] > thr)
+        out[t] = leaves[t, node]
+    return out
+
+
 def _walk_forest(x: np.ndarray, feats, thrs, leaves, depth: int) -> np.ndarray:
-    """Sum of leaf values over all trees for raw features (host numpy)."""
+    """Sum of leaf values over all trees (host numpy). Streams one tree
+    at a time — an O(n) accumulator, NOT the [T, n] matrix the
+    early-stopping path materializes (that would be gigabytes for big
+    forests scoring big batches)."""
     n = x.shape[0]
     total = np.zeros(n)
     for t in range(feats.shape[0]):
-        node = np.zeros(n, dtype=np.int64)   # index within level
+        node = np.zeros(n, dtype=np.int64)
         for level in range(depth):
             start = (1 << level) - 1
             f = feats[t, start + node]
@@ -273,7 +299,27 @@ class _GBTBase(_GBTParams, Estimator):
             self.get(self.WEIGHT_COL),
         )
         if self._LOGISTIC:
+            # Validate on the FULL label column, before any holdout split
+            # (an invalid label permuted into the holdout would silently
+            # corrupt the early-stopping loss instead of raising).
             check_binary_labels(y, type(self).__name__)
+        vf = self.get(self.VALIDATION_FRACTION)
+        holdout = None
+        if vf > 0:
+            if not self._BOOSTING:
+                raise ValueError(
+                    "validationFraction applies to boosted estimators only "
+                    "(bagged forests don't overfit with more trees)"
+                )
+            rng = np.random.default_rng(self.get_seed())
+            perm = rng.permutation(x.shape[0])
+            n_hold = max(1, int(round(vf * x.shape[0])))
+            if n_hold >= x.shape[0]:
+                raise ValueError("validationFraction leaves no training rows")
+            hold_idx, train_idx = perm[:n_hold], perm[n_hold:]
+            holdout = (x[hold_idx], y[hold_idx], w[hold_idx])
+            x, y, w = x[train_idx], y[train_idx], w[train_idx]
+        if self._LOGISTIC:
             pos = float(np.sum(w * y))
             neg = float(np.sum(w * (1 - y)))
             base = float(np.log(max(pos, 1e-12) / max(neg, 1e-12)))
@@ -314,8 +360,32 @@ class _GBTBase(_GBTParams, Estimator):
             [edges, np.full((edges.shape[0], 1), np.inf)], axis=1
         )
         thrs = edges_inf[feats, np.minimum(bins, edges_inf.shape[1] - 1)]
-        return (feats, thrs, np.asarray(gains), np.asarray(leaves), base,
-                depth, x.shape[1])
+        gains = np.asarray(gains)
+        leaves = np.asarray(leaves)
+        if holdout is not None:
+            feats, thrs, gains, leaves = self._truncate_to_best_prefix(
+                holdout, feats, thrs, gains, leaves, base, depth,
+            )
+        return (feats, thrs, gains, leaves, base, depth, x.shape[1])
+
+    def _truncate_to_best_prefix(self, holdout, feats, thrs, gains, leaves,
+                                 base, depth):
+        """Early stopping: keep the tree prefix with the best holdout
+        loss (cumulative per-tree margins on the held-out rows)."""
+        hx, hy, hw = holdout
+        lr = self.get(self.LEARNING_RATE)
+        contribs = _walk_forest_per_tree(hx, feats, thrs, leaves, depth)
+        margins = base + lr * np.cumsum(contribs, axis=0)   # [T, n_hold]
+        if self._LOGISTIC:
+            # NLL = log(1 + e^m) - y*m, computed stably.
+            losses = (
+                np.logaddexp(0.0, margins) - hy[None, :] * margins
+            )
+        else:
+            losses = 0.5 * (margins - hy[None, :]) ** 2
+        per_prefix = (losses * hw[None, :]).sum(axis=1)
+        best = int(np.argmin(per_prefix)) + 1
+        return feats[:best], thrs[:best], gains[:best], leaves[:best]
 
     _MODEL_CLS = None   # set per concrete estimator
 

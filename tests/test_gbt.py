@@ -298,3 +298,49 @@ def test_random_forest_subset_contract_is_strict():
         assert len(used) <= 2, (i, used)
     # The param survives into the fitted model's map.
     assert "featureSubsetFraction" in model.get_param_map_json()
+
+
+def test_early_stopping_truncates_overfitting_forest():
+    # Tiny noisy data + many deep trees: holdout-best prefix must be
+    # shorter than the full forest and generalize at least as well.
+    rng = np.random.default_rng(16)
+    x = rng.uniform(-2, 2, size=(400, 4))
+    logits = 1.5 * x[:, 0]
+    y = (rng.uniform(size=400) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    t = Table({"features": x[:300], "label": y[:300]})
+    full = _clf(num_trees=80, max_depth=5, learning_rate=0.4).fit(t)
+    stopped = _clf(
+        num_trees=80, max_depth=5, learning_rate=0.4,
+        validation_fraction=0.25,
+    ).fit(t)
+    assert stopped._feats.shape[0] < 80
+    probe = Table({"features": x[300:]})
+    (pf,) = full.transform(probe)
+    (ps,) = stopped.transform(probe)
+    full_auc = roc_auc_score(y[300:], pf["rawPrediction"][:, 1])
+    stop_auc = roc_auc_score(y[300:], ps["rawPrediction"][:, 1])
+    assert stop_auc >= full_auc - 0.02
+
+
+def test_early_stopping_rejected_for_bagging():
+    from flinkml_tpu.models import RandomForestClassifier
+
+    t = Table({"features": np.zeros((10, 2)),
+               "label": np.asarray([0.0, 1.0] * 5)})
+    with pytest.raises(ValueError, match="boosted"):
+        (
+            RandomForestClassifier().set_validation_fraction(0.2)
+            .set_num_trees(2).fit(t)
+        )
+
+
+def test_labels_validated_before_holdout_split():
+    rng = np.random.default_rng(17)
+    x = rng.uniform(-1, 1, size=(40, 2))
+    y = np.zeros(40)
+    y[::2] = 1.0
+    y[7] = 2.0   # invalid label that a split could hide in the holdout
+    t = Table({"features": x, "label": y})
+    for vf in (0.0, 0.25):
+        with pytest.raises(ValueError, match="0, 1"):
+            _clf(num_trees=2, validation_fraction=vf).fit(t)
