@@ -126,3 +126,22 @@ def test_ovr_inner_custom_raw_prediction_col():
     # Scores must be the inner model's continuous probabilities, not the
     # 0/1 prediction fallback (which also reaches high accuracy here).
     assert len(np.unique(out["rawPrediction"])) > 10
+
+
+def test_ovr_composes_with_gbt():
+    from flinkml_tpu.models import GBTClassifier
+
+    rng = np.random.default_rng(7)
+    x = rng.uniform(-2, 2, size=(450, 2))
+    # Three nonlinear regions: |x| small / x0*x1 positive / negative.
+    y = np.where(
+        np.abs(x).sum(1) < 1.2, 0.0, np.where(x[:, 0] * x[:, 1] > 0, 1.0, 2.0)
+    )
+    t = Table({"features": x, "label": y})
+    gbt = (
+        GBTClassifier().set_num_trees(25).set_max_depth(4)
+        .set_learning_rate(0.3).set_seed(0)
+    )
+    model = OneVsRest(gbt).fit(t)
+    (out,) = model.transform(t)
+    assert (out["prediction"] == y).mean() > 0.9
