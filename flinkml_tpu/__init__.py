@@ -47,7 +47,7 @@ from flinkml_tpu.tuning import (
     TrainValidationSplitModel,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Param",
