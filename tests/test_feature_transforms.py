@@ -280,3 +280,39 @@ def test_most_frequent_tie_breaks_smallest():
         .set_strategy("mostFrequent").fit(t).transform(t)
     )
     assert out.column("o")[4] == 2.0
+
+
+def test_imputer_vector_columns(tmp_path):
+    rng = np.random.default_rng(20)
+    vec = rng.normal(size=(30, 3))
+    vec[5, 1] = np.nan
+    vec[9, 2] = np.nan
+    scalar = rng.normal(size=30)
+    scalar[3] = np.nan
+    t = Table({"v": vec, "s": scalar})
+    model = (
+        Imputer().set_input_cols(["v", "s"]).set_output_cols(["ov", "os"])
+        .set_strategy("mean").fit(t)
+    )
+    (out,) = model.transform(t)
+    assert not np.isnan(out["ov"]).any()
+    assert not np.isnan(out["os"]).any()
+    # Per-dimension means, not a global one.
+    expected = np.nanmean(vec[:, 1])
+    np.testing.assert_allclose(out["ov"][5, 1], expected)
+    np.testing.assert_allclose(out["ov"][5, [0, 2]], vec[5, [0, 2]])
+    # Persistence keeps the widths.
+    model.save(str(tmp_path / "vimp"))
+    loaded = ImputerModel.load(str(tmp_path / "vimp"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0]["ov"], out["ov"]
+    )
+    # Shape mismatches are rejected clearly.
+    with pytest.raises(ValueError, match="fit as"):
+        model.transform(Table({"v": scalar, "s": scalar}))
+
+
+def test_imputer_rejects_zero_width_vector():
+    t = Table({"v": np.zeros((5, 0))})
+    with pytest.raises(ValueError, match="d >= 1"):
+        Imputer().set_input_cols(["v"]).set_output_cols(["o"]).fit(t)
