@@ -37,7 +37,7 @@ from flinkml_tpu.common_params import (
     HasInputCols,
     HasOutputCols,
 )
-from flinkml_tpu.params import ParamValidators, StringParam
+from flinkml_tpu.params import IntParam, ParamValidators, StringParam
 from flinkml_tpu.table import Table
 
 ARBITRARY = "arbitrary"
@@ -48,6 +48,13 @@ ALPHABET_DESC = "alphabetDesc"
 
 
 class _StringIndexerParams(HasInputCols, HasOutputCols, HasHandleInvalid):
+    MAX_INDEX_NUM = IntParam(
+        "maxIndexNum",
+        "Cap each column's vocabulary at the first N values in order "
+        "(the upstream param; values beyond the cap are handled as "
+        "unseen by handleInvalid).",
+        2**31 - 1, ParamValidators.gt(0),
+    )
     STRING_ORDER_TYPE = StringParam(
         "stringOrderType",
         "How to order distinct values before assigning indices.",
@@ -123,8 +130,9 @@ class StringIndexer(_StringIndexerParams, Estimator):
         if not input_cols:
             raise ValueError("inputCols must be set")
         order_type = self.get(self.STRING_ORDER_TYPE)
+        cap = self.get(self.MAX_INDEX_NUM)
         vocabs = [
-            _ordered_vocab(_column_values(table, col), order_type)
+            _ordered_vocab(_column_values(table, col), order_type)[:cap]
             for col in input_cols
         ]
         model = StringIndexerModel()

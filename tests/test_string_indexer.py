@@ -190,3 +190,16 @@ def test_all_nan_column_rejected_at_fit():
     t = Table({"v": np.asarray([np.nan, np.nan])})
     with pytest.raises(ValueError, match="non-NaN"):
         StringIndexer().set_input_cols(["v"]).set_output_cols(["i"]).fit(t)
+
+
+def test_max_index_num_caps_vocabulary():
+    t = _table()
+    model = (
+        _indexer("frequencyDesc", handle="keep")
+        .set_max_index_num(2).fit(t)
+    )
+    (out,) = model.transform(t)
+    # color vocab capped at {b, a}; "c" becomes the catch-all index 2.
+    np.testing.assert_array_equal(out.column("colorIdx"), [0, 1, 0, 2, 0, 1])
+    with pytest.raises(ValueError, match="not seen"):
+        model.set_handle_invalid("error").transform(t)
