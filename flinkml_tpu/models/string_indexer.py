@@ -50,10 +50,13 @@ ALPHABET_DESC = "alphabetDesc"
 class _StringIndexerParams(HasInputCols, HasOutputCols, HasHandleInvalid):
     MAX_INDEX_NUM = IntParam(
         "maxIndexNum",
-        "Cap each column's vocabulary at the first N values in order "
-        "(the upstream param; values beyond the cap are handled as "
-        "unseen by handleInvalid).",
-        2**31 - 1, ParamValidators.gt(0),
+        "Cap each column's vocabulary at the first N values in order; "
+        "beyond-cap values are handled as unseen by handleInvalid. "
+        "Deliberate divergence from upstream Flink ML: the cap applies "
+        "under EVERY stringOrderType here (upstream honors it only for "
+        "frequencyDesc) — capping an alphabetical order keeps the N "
+        "alphabetically-first values.",
+        2**31 - 1, ParamValidators.gt(1),
     )
     STRING_ORDER_TYPE = StringParam(
         "stringOrderType",
