@@ -26,7 +26,7 @@ def test_all_exports_exist_and_are_stages():
     for name in M.__all__:
         assert hasattr(M, name), f"{name} in __all__ but not importable"
     classes = _exported_classes()
-    assert len(classes) >= 104   # the catalog should only grow
+    assert len(classes) >= 108   # the catalog should only grow
     for name, cls in classes:
         assert issubclass(cls, Stage), f"{name} is not a Stage"
 
