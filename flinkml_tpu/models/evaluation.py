@@ -3,7 +3,8 @@
 Member of the wider Flink ML operator family (the reference snapshot has
 no evaluator; apache/flink-ml's ``BinaryClassificationEvaluator`` defines
 the metric set mirrored here): ``areaUnderROC``, ``areaUnderPR``, ``ks``
-(max |TPR - FPR|), and ``accuracy`` (at the 0.5 threshold). Weighted rows
+(max |TPR - FPR|), ``accuracy`` (at the 0.5 threshold), and ``logLoss``
+(clipped cross-entropy over probability scores). Weighted rows
 supported; ties in the score column are handled exactly (metrics are
 computed on the unique-threshold step curve, not per-row).
 
@@ -28,7 +29,7 @@ from flinkml_tpu.common_params import (
 from flinkml_tpu.params import StringArrayParam
 from flinkml_tpu.table import Table
 
-_SUPPORTED = ("areaUnderROC", "areaUnderPR", "ks", "accuracy")
+_SUPPORTED = ("areaUnderROC", "areaUnderPR", "ks", "accuracy", "logLoss")
 
 
 def binary_metrics(scores, labels, weights=None, predictions=None) -> dict:
@@ -79,11 +80,20 @@ def binary_metrics(scores, labels, weights=None, predictions=None) -> dict:
     else:
         pred = (s >= 0.5).astype(np.float64)
     accuracy = float(np.sum(w * (pred == y)) / np.sum(w))
+    # logLoss needs probability scores; clip to keep finite on hard 0/1
+    # outputs (sklearn's convention). Meaningless for unbounded margins —
+    # same caveat as the 0.5-threshold accuracy above.
+    p_clip = np.clip(s, 1e-15, 1 - 1e-15)
+    log_loss = float(
+        -np.sum(w * (y * np.log(p_clip) + (1 - y) * np.log1p(-p_clip)))
+        / np.sum(w)
+    )
     return {
         "areaUnderROC": auc_roc,
         "areaUnderPR": auc_pr,
         "ks": ks,
         "accuracy": accuracy,
+        "logLoss": log_loss,
     }
 
 

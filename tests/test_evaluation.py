@@ -119,3 +119,21 @@ def test_accuracy_uses_prediction_column_for_margins():
 def test_nan_scores_rejected():
     with pytest.raises(ValueError, match="NaN"):
         binary_metrics(np.asarray([0.1, np.nan]), np.asarray([0.0, 1.0]))
+
+
+def test_log_loss_matches_sklearn():
+    from sklearn.metrics import log_loss as sk_log_loss
+
+    rng = np.random.default_rng(11)
+    y = rng.integers(0, 2, 300).astype(float)
+    p = np.clip(rng.beta(2, 2, 300) * 0.6 + y * 0.3, 0, 1)
+    m = binary_metrics(p, y)
+    assert m["logLoss"] == pytest.approx(sk_log_loss(y, p))
+    w = rng.uniform(0.5, 2.0, 300)
+    mw = binary_metrics(p, y, w)
+    assert mw["logLoss"] == pytest.approx(
+        sk_log_loss(y, p, sample_weight=w)
+    )
+    # Hard 0/1 scores stay finite (clipped).
+    hard = binary_metrics(y, y)
+    assert np.isfinite(hard["logLoss"])
