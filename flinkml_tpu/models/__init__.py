@@ -73,7 +73,12 @@ from flinkml_tpu.models.isotonic import (
 )
 from flinkml_tpu.models.lda import LDA, LDAModel
 from flinkml_tpu.models.lsh import MinHashLSH, MinHashLSHModel
-from flinkml_tpu.models.mlp import MLPClassifier, MLPClassifierModel
+from flinkml_tpu.models.mlp import (
+    MLPClassifier,
+    MLPClassifierModel,
+    MLPRegressor,
+    MLPRegressorModel,
+)
 from flinkml_tpu.models.ngram import NGram
 from flinkml_tpu.models.word2vec import Word2Vec, Word2VecModel
 from flinkml_tpu.models.vector_indexer import (
@@ -186,6 +191,8 @@ __all__ = [
     "RandomForestRegressorModel",
     "MLPClassifier",
     "MLPClassifierModel",
+    "MLPRegressor",
+    "MLPRegressorModel",
     "OneVsRest",
     "OneVsRestModel",
     "FMClassifier",

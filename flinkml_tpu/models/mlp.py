@@ -83,16 +83,35 @@ def _mlp_loss_builder():
     return local_loss
 
 
-class MLPClassifier(_MLPParams, Estimator):
+def _mlp_squared_loss_builder():
+    def local_loss(params, xb, yb, wb):
+        pred = _forward(params, xb)[:, 0]
+        err = pred - yb
+        return 0.5 * jnp.sum(err * err * wb)
+
+    return local_loss
+
+
+class _MLPBase(_MLPParams, Estimator):
+    """Shared fit scaffold: the subclasses differ only in label
+    preparation/validation and the loss builder (same pairing pattern as
+    ``fm._FMBase``)."""
+
+    _MODEL_CLS = None
+    _LOSS_BUILDER = None
+
     def __init__(self, mesh: Optional[DeviceMesh] = None):
         super().__init__()
         self.mesh = mesh
 
-    def fit(self, *inputs: Table) -> "MLPClassifierModel":
+    def _prepare_labels(self, y: np.ndarray, layers) -> np.ndarray:
+        raise NotImplementedError
+
+    def fit(self, *inputs: Table):
         (table,) = inputs
         layers = self.get(self.LAYERS)
         if layers is None or len(layers) < 2:
-            raise ValueError("layers must list at least [inputDim, numClasses]")
+            raise ValueError("layers must list at least [inputDim, outputDim]")
         x, y, w = labeled_data(
             table, self.get(self.FEATURES_COL), self.get(self.LABEL_COL)
         )
@@ -100,29 +119,23 @@ class MLPClassifier(_MLPParams, Estimator):
             raise ValueError(
                 f"layers[0]={layers[0]} != feature dim {x.shape[1]}"
             )
-        n_classes = layers[-1]
-        yi = y.astype(np.int64)
-        if not np.all(y == yi) or yi.min() < 0 or yi.max() >= n_classes:
-            raise ValueError(
-                f"labels must be class ids in [0, {n_classes}), got "
-                f"[{y.min()}, {y.max()}]"
-            )
+        y_dev = self._prepare_labels(y, layers)
         mesh = self.mesh or DeviceMesh()
         p = mesh.axis_size()
         x_pad, n_valid = pad_to_multiple(x.astype(np.float32), p)
-        y_pad, _ = pad_to_multiple(yi.astype(np.int32), p)
+        y_pad, _ = pad_to_multiple(y_dev, p)
         w_pad = np.zeros(x_pad.shape[0], np.float32)
         w_pad[:n_valid] = w[:n_valid].astype(np.float32)
         local_bs = max(1, self.get(self.GLOBAL_BATCH_SIZE) // p)
         trainer = make_adam_trainer(
-            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs, _mlp_loss_builder,
-            2 * (len(layers) - 1),
+            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
+            type(self)._LOSS_BUILDER, 2 * (len(layers) - 1),
         )
         key = jax.random.PRNGKey(self.get_seed())
         init = _init_params(list(layers), key)
         flat0 = tuple(t for wb in init for t in wb)
         f32 = lambda v: jnp.asarray(v, jnp.float32)
-        flat, steps, loss = trainer(
+        flat, _steps, _loss = trainer(
             mesh.shard_batch(x_pad), mesh.shard_batch(y_pad),
             mesh.shard_batch(w_pad), flat0,
             f32(self.get(self.LEARNING_RATE)),
@@ -130,13 +143,28 @@ class MLPClassifier(_MLPParams, Estimator):
             f32(self.get(self.TOL)),
             jax.random.fold_in(key, 123),
         )
-        model = MLPClassifierModel()
+        model = self._MODEL_CLS()
         model.copy_params_from(self)
         model._weights = [np.asarray(t, np.float64) for t in flat]
         return model
 
 
-class MLPClassifierModel(_MLPParams, Model):
+class MLPClassifier(_MLPBase):
+    def _prepare_labels(self, y: np.ndarray, layers) -> np.ndarray:
+        n_classes = layers[-1]
+        yi = y.astype(np.int64)
+        if not np.all(y == yi) or yi.min() < 0 or yi.max() >= n_classes:
+            raise ValueError(
+                f"labels must be class ids in [0, {n_classes}), got "
+                f"[{y.min()}, {y.max()}]"
+            )
+        return yi.astype(np.int32)
+
+
+class _MLPModelBase(_MLPParams, Model):
+    """Weight storage, forward pass, and persistence shared by the
+    sibling classifier/regressor models."""
+
     def __init__(self):
         super().__init__()
         self._weights: Optional[List[np.ndarray]] = None
@@ -169,6 +197,23 @@ class MLPClassifierModel(_MLPParams, Model):
             h = np.tanh(h @ self._weights[2 * i] + self._weights[2 * i + 1])
         return h @ self._weights[-2] + self._weights[-1]
 
+    def save(self, path: str) -> None:
+        self._require()
+        self._save_with_arrays(
+            path,
+            {f"arr{i}": a for i, a in enumerate(self._weights)},
+            extra={"numArrays": len(self._weights)},
+        )
+
+    @classmethod
+    def load(cls, path: str):
+        model, arrays, meta = cls._load_with_arrays(path)
+        n = int(meta["numArrays"])
+        model._weights = [arrays[f"arr{i}"] for i in range(n)]
+        return model
+
+
+class MLPClassifierModel(_MLPModelBase):
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require()
@@ -183,17 +228,34 @@ class MLPClassifierModel(_MLPParams, Model):
         out = out.with_column(self.get(self.RAW_PREDICTION_COL), probs)
         return (out,)
 
-    def save(self, path: str) -> None:
+
+class MLPRegressor(_MLPBase):
+    """Multilayer perceptron regressor: ``layers = [d_in, h..., 1]``,
+    tanh hidden activations, linear output, squared loss — the same
+    whole-run Adam device trainer as the classifier."""
+
+    def _prepare_labels(self, y: np.ndarray, layers) -> np.ndarray:
+        if layers[-1] != 1:
+            raise ValueError(
+                "layers must be [inputDim, hidden..., 1] for regression"
+            )
+        return y.astype(np.float32)
+
+
+class MLPRegressorModel(_MLPModelBase):
+    """Sibling of the classifier model (not a subclass of it): the
+    transform emits the linear output directly."""
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
         self._require()
-        self._save_with_arrays(
-            path,
-            {f"arr{i}": a for i, a in enumerate(self._weights)},
-            extra={"numArrays": len(self._weights)},
+        pred = self._logits(table)[:, 0]
+        return (
+            table.with_column(self.get(self.PREDICTION_COL), pred),
         )
 
-    @classmethod
-    def load(cls, path: str) -> "MLPClassifierModel":
-        model, arrays, meta = cls._load_with_arrays(path)
-        n = int(meta["numArrays"])
-        model._weights = [arrays[f"arr{i}"] for i in range(n)]
-        return model
+
+MLPClassifier._MODEL_CLS = MLPClassifierModel
+MLPClassifier._LOSS_BUILDER = staticmethod(_mlp_loss_builder)
+MLPRegressor._MODEL_CLS = MLPRegressorModel
+MLPRegressor._LOSS_BUILDER = staticmethod(_mlp_squared_loss_builder)

@@ -242,3 +242,28 @@ def test_isotonic_accepts_vector_column():
     model = IsotonicRegression().fit(t)
     (out,) = model.transform(t)
     np.testing.assert_allclose(out["prediction"], [1.0, 2.0, 3.0])
+
+
+def test_mlp_regressor_fits_nonlinear_function(tmp_path):
+    from sklearn.metrics import r2_score as _r2
+
+    from flinkml_tpu.models import MLPRegressor, MLPRegressorModel
+
+    rng = np.random.default_rng(21)
+    x = rng.uniform(-2, 2, size=(1500, 2))
+    y = np.sin(x[:, 0]) * 2 + x[:, 1] ** 2
+    t = Table({"features": x, "label": y})
+    model = (
+        MLPRegressor().set_layers([2, 32, 1]).set_max_iter(1500)
+        .set_learning_rate(0.01).set_global_batch_size(512).set_tol(0.0)
+        .set_seed(0).fit(t)
+    )
+    (out,) = model.transform(t)
+    assert _r2(y, out["prediction"]) > 0.95
+    model.save(str(tmp_path / "mlpr"))
+    loaded = MLPRegressorModel.load(str(tmp_path / "mlpr"))
+    np.testing.assert_allclose(
+        loaded.transform(t)[0]["prediction"], out["prediction"]
+    )
+    with pytest.raises(ValueError, match=r"hidden\.\.\., 1"):
+        MLPRegressor().set_layers([2, 8, 2]).fit(t)
