@@ -13,6 +13,7 @@ from flinkml_tpu.models.linear_regression import (
 )
 from flinkml_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
 from flinkml_tpu.models.pic import PowerIterationClustering
+from flinkml_tpu.models.prefixspan import PrefixSpan
 from flinkml_tpu.models.online_kmeans import OnlineKMeans, OnlineKMeansModel
 from flinkml_tpu.models.online_logistic_regression import (
     OnlineLogisticRegression,
@@ -205,6 +206,7 @@ __all__ = [
     "AFTSurvivalRegressionModel",
     "FPGrowth",
     "FPGrowthModel",
+    "PrefixSpan",
     "PCA",
     "PCAModel",
     "Tokenizer",

@@ -41,14 +41,19 @@ from flinkml_tpu.table import Table
 
 
 class _MLPParams(
-    HasFeaturesCol, HasLabelCol, HasPredictionCol, HasRawPredictionCol,
+    HasFeaturesCol, HasLabelCol, HasPredictionCol,
     HasMaxIter, HasLearningRate, HasGlobalBatchSize, HasTol, HasSeed,
 ):
     LAYERS = IntArrayParam(
         "layers",
-        "Sizes of every layer, input first, classes last.",
+        "Sizes of every layer, input first, output last.",
         None, ParamValidators.non_empty_array(),
     )
+
+
+class _MLPClassifierParams(_MLPParams, HasRawPredictionCol):
+    """Only the classifier emits a rawPrediction column; the regressor
+    must not carry the dead param."""
 
 
 def _init_params(layers: List[int], key) -> List:
@@ -149,7 +154,7 @@ class _MLPBase(_MLPParams, Estimator):
         return model
 
 
-class MLPClassifier(_MLPBase):
+class MLPClassifier(_MLPClassifierParams, _MLPBase):
     def _prepare_labels(self, y: np.ndarray, layers) -> np.ndarray:
         n_classes = layers[-1]
         yi = y.astype(np.int64)
@@ -213,7 +218,7 @@ class _MLPModelBase(_MLPParams, Model):
         return model
 
 
-class MLPClassifierModel(_MLPModelBase):
+class MLPClassifierModel(_MLPClassifierParams, _MLPModelBase):
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require()

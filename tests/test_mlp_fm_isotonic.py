@@ -267,3 +267,9 @@ def test_mlp_regressor_fits_nonlinear_function(tmp_path):
     )
     with pytest.raises(ValueError, match=r"hidden\.\.\., 1"):
         MLPRegressor().set_layers([2, 8, 2]).fit(t)
+
+
+def test_mlp_regressor_has_no_raw_prediction_param():
+    from flinkml_tpu.models import MLPRegressor
+
+    assert MLPRegressor().get_param("rawPredictionCol") is None

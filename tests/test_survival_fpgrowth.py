@@ -209,3 +209,63 @@ def test_fpgrowth_save_rejects_nul_items():
 
     with _pytest.raises(ValueError, match="NUL"):
         model.save("/tmp/never-created-fp")
+
+
+def test_prefixspan_matches_bruteforce():
+    from itertools import product as iproduct
+
+    from flinkml_tpu.models import PrefixSpan
+    from flinkml_tpu.models.prefixspan import prefixspan
+
+    seqs = [
+        ["a", "b", "c", "a"],
+        ["a", "c", "b"],
+        ["b", "a", "c"],
+        ["a", "b"],
+    ]
+    out = prefixspan(seqs, min_support=0.5, max_length=3)   # min_count 2
+
+    def is_subseq(pat, seq):
+        it = iter(seq)
+        return all(x in it for x in pat)
+
+    items = sorted({x for s in seqs for x in s})
+    expected = {}
+    for L in range(1, 4):
+        for pat in iproduct(items, repeat=L):
+            cnt = sum(1 for s in seqs if is_subseq(pat, s))
+            if cnt >= 2:
+                expected[pat] = cnt
+    assert out == expected
+
+    (t_out,) = (
+        PrefixSpan().set_min_support(0.5).set_max_pattern_length(3)
+        .transform(Table({"sequence": _object_column(seqs)}))
+    )
+    assert t_out.num_rows == len(expected)
+    assert int(t_out["freq"][0]) == max(expected.values())
+    # ("a", "b") must appear: ordered subsequence of 3 sequences.
+    pats = {tuple(p) for p in t_out["sequence"]}
+    assert ("a", "b") in pats and ("b", "a") in pats
+
+
+def test_prefixspan_max_length_and_empty():
+    from flinkml_tpu.models import PrefixSpan
+    from flinkml_tpu.models.prefixspan import prefixspan
+
+    seqs = [["x", "y", "z"]] * 3
+    out = prefixspan(seqs, 0.9, max_length=2)
+    assert max(len(k) for k in out) == 2
+    (empty,) = (
+        PrefixSpan().set_min_support(0.9).transform(
+            Table({"sequence": _object_column([["a"], ["b"], ["c"]])})
+        )
+    )
+    assert empty.num_rows == 0
+
+
+def test_prefixspan_deep_patterns_no_recursion_limit():
+    from flinkml_tpu.models.prefixspan import prefixspan
+
+    out = prefixspan([["x"] * 1500] * 2, 0.5, max_length=1500)
+    assert max(len(k) for k in out) == 1500
