@@ -344,3 +344,18 @@ def test_labels_validated_before_holdout_split():
     for vf in (0.0, 0.25):
         with pytest.raises(ValueError, match="0, 1"):
             _clf(num_trees=2, validation_fraction=vf).fit(t)
+
+
+def test_early_stopping_regressor_path():
+    rng = np.random.default_rng(18)
+    x = rng.uniform(-2, 2, size=(300, 3))
+    y = x[:, 0] + 0.5 * rng.normal(size=300)   # noisy linear target
+    t = Table({"features": x[:220], "label": y[:220]})
+    stopped = (
+        GBTRegressor().set_num_trees(60).set_max_depth(5)
+        .set_learning_rate(0.4).set_validation_fraction(0.25)
+        .set_seed(0).fit(t)
+    )
+    assert stopped._feats.shape[0] < 60
+    (out,) = stopped.transform(Table({"features": x[220:]}))
+    assert r2_score(y[220:], out["prediction"]) > 0.5
