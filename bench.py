@@ -325,6 +325,64 @@ def _inner_gbt() -> float:
     return n * trees / elapsed
 
 
+def _inner_als() -> float:
+    """Stage: ALS-WR normal-equation half-steps through the product path
+    (`ALS.fit`: chunked COO -> segment-sum normal equations -> batched
+    Cholesky). Metric: rating visits per second (nnz x 2 sides x iters)."""
+    _setup_jax_cache()
+    from flinkml_tpu.models.als import ALS
+    from flinkml_tpu.table import Table
+
+    n_users, n_items, nnz, rank, iters = 16_384, 16_384, 1 << 21, 32, 10
+    rng = np.random.default_rng(0)
+    users = rng.integers(0, n_users, size=nnz).astype(np.int32)
+    items = rng.integers(0, n_items, size=nnz).astype(np.int32)
+    ratings = rng.uniform(1, 5, size=nnz).astype(np.float32)
+    table = Table({"user": users, "item": items, "rating": ratings})
+    _log("als: compiling + warm-up fit ...")
+    ALS().set_rank(rank).set_max_iter(1).set_seed(0).fit(table)
+    _log("als: measuring ...")
+    start = time.perf_counter()
+    ALS().set_rank(rank).set_max_iter(iters).set_seed(0).fit(table)
+    elapsed = time.perf_counter() - start
+    return nnz * 2 * iters / elapsed
+
+
+def _inner_word2vec() -> float:
+    """Stage: skip-gram negative-sampling SGD through the product trainer
+    (`word2vec._sgns_trainer`: whole loop in one dispatch, dense psum of
+    embedding grads). Metric: (center, context) pairs per second."""
+    _setup_jax_cache()
+    import jax
+    import jax.numpy as jnp
+    from flinkml_tpu.models.word2vec import _sgns_trainer
+    from flinkml_tpu.parallel import DeviceMesh
+
+    vocab, dim, n_pairs, bs, n_neg, steps = 32_768, 128, 1 << 20, 8_192, 5, 200
+    rng = np.random.default_rng(0)
+    centers = rng.integers(0, vocab, size=n_pairs).astype(np.int32)
+    contexts = rng.integers(0, vocab, size=n_pairs).astype(np.int32)
+    pool = rng.integers(0, vocab, size=1 << 17).astype(np.int32)
+    v0 = (rng.random((vocab, dim)) - 0.5).astype(np.float32) / dim
+    u0 = np.zeros((vocab, dim), np.float32)
+    mesh = DeviceMesh()
+    local_bs = max(1, bs // mesh.axis_size())
+    trainer = _sgns_trainer(mesh.mesh, DeviceMesh.DATA_AXIS, local_bs, n_neg)
+    args = (
+        mesh.shard_batch(centers), mesh.shard_batch(contexts),
+        jnp.asarray(pool), jnp.asarray(v0), jnp.asarray(u0),
+        jnp.asarray(0.025, jnp.float32),
+    )
+    key = jax.random.PRNGKey(0)
+    _log("word2vec: compiling + warm-up dispatch ...")
+    np.asarray(trainer(*args, jnp.asarray(5, jnp.int32), key)[0])
+    _log("word2vec: measuring ...")
+    start = time.perf_counter()
+    np.asarray(trainer(*args, jnp.asarray(steps, jnp.int32), key)[0])
+    elapsed = time.perf_counter() - start
+    return local_bs * mesh.axis_size() * steps / elapsed
+
+
 _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
@@ -332,6 +390,8 @@ _INNER_STAGES = {
     "sparse": _inner_sparse,
     "kmeans": _inner_kmeans,
     "gbt": _inner_gbt,
+    "als": _inner_als,
+    "word2vec": _inner_word2vec,
 }
 
 
@@ -379,9 +439,14 @@ def _run_stage(stage: str, timeout_s: float, deadline: float, retries: int = 1):
 
 
 def main():
+    from flinkml_tpu.utils.device_lock import device_client_lock
+
     inner = os.environ.get(_INNER_ENV)
     if inner:
-        print(f"{_INNER_STAGES[inner]():.1f}")
+        # Stage children inherit the parent's held-lock marker and skip
+        # re-acquiring; a stage run standalone takes the lock itself.
+        with device_client_lock():
+            print(f"{_INNER_STAGES[inner]():.1f}")
         return
 
     # FLINKML_BENCH_TIMEOUT is the TOTAL device-bench budget (same meaning
@@ -389,7 +454,7 @@ def main():
     # FLINKML_BENCH_STAGE_TIMEOUT so one pathological compile cannot
     # starve every stage behind it (observed: a d=784 kmeans compile ate
     # the whole budget and the stages after it were skipped).
-    total_budget = float(os.environ.get("FLINKML_BENCH_TIMEOUT", "1500"))
+    total_budget = float(os.environ.get("FLINKML_BENCH_TIMEOUT", "2100"))
     probe_timeout = float(os.environ.get("FLINKML_BENCH_PROBE_TIMEOUT", "360"))
     stage_cap = float(os.environ.get("FLINKML_BENCH_STAGE_TIMEOUT", "600"))
     deadline = time.monotonic() + total_budget
@@ -399,14 +464,25 @@ def main():
     bf16_sps = None
     kmeans_pps = None
     gbt_rts = None
-    if _run_stage("probe", probe_timeout, deadline) is not None:
-        device_sps = _run_stage("dense", stage_cap, deadline)
-        sparse_sps = _run_stage("sparse", stage_cap, deadline)
-        bf16_sps = _run_stage("dense_bf16", stage_cap, deadline)
-        kmeans_pps = _run_stage("kmeans", stage_cap, deadline)
-        gbt_rts = _run_stage("gbt", stage_cap, deadline)
-    else:
-        _log("probe failed; skipping device measurement")
+    als_ups = None
+    w2v_wps = None
+    # Hold the single-tenant device mutex across ALL device stages: two
+    # concurrent clients wedged the tunnel for 8+ hours in round 2
+    # (BASELINE.md). Children inherit the held marker via os.environ.
+    try:
+        with device_client_lock(timeout_s=120.0):
+            if _run_stage("probe", probe_timeout, deadline) is not None:
+                device_sps = _run_stage("dense", stage_cap, deadline)
+                sparse_sps = _run_stage("sparse", stage_cap, deadline)
+                bf16_sps = _run_stage("dense_bf16", stage_cap, deadline)
+                kmeans_pps = _run_stage("kmeans", stage_cap, deadline)
+                gbt_rts = _run_stage("gbt", stage_cap, deadline)
+                als_ups = _run_stage("als", stage_cap, deadline)
+                w2v_wps = _run_stage("word2vec", stage_cap, deadline)
+            else:
+                _log("probe failed; skipping device measurement")
+    except TimeoutError as e:
+        _log(f"device busy: {e}; skipping device measurement")
 
     _log("measuring CPU reference-style baseline ...")
     n_cpu = 200_000
@@ -445,6 +521,13 @@ def main():
         # Histogram GBT forest build (n=262k, d=16, 32 bins, depth 4,
         # 20 trees): row-tree builds per second.
         extras["gbt_row_trees_per_sec_per_chip"] = round(gbt_rts, 1)
+    if als_ups is not None:
+        # ALS-WR (16k x 16k, 2M ratings, rank 32): rating visits/sec
+        # across both half-steps, through the public ALS.fit path.
+        extras["als_rating_visits_per_sec_per_chip"] = round(als_ups, 1)
+    if w2v_wps is not None:
+        # Word2Vec SGNS (vocab 32k, d=128, 5 negatives): pairs/sec.
+        extras["word2vec_pairs_per_sec_per_chip"] = round(w2v_wps, 1)
     if extras:
         # Secondary measurements kept inside the single JSON line.
         record["extras"] = extras

@@ -283,12 +283,34 @@ def _walk_forest(x: np.ndarray, feats, thrs, leaves, depth: int) -> np.ndarray:
 
 
 class _GBTBase(_GBTParams, Estimator):
+    """``fit`` accepts, besides a single in-RAM :class:`Table`:
+
+      - an **iterable of batch Tables** — the out-of-core path: the
+        stream is cached once (spilling to ``cache_dir`` beyond
+        ``cache_memory_budget_bytes``), bin edges come from a seeded
+        reservoir row sample, and every tree level accumulates its
+        histograms by replaying the binned cache with bounded HBM
+        residency (see :mod:`flinkml_tpu.models._gbt_stream`);
+      - a sealed :class:`~flinkml_tpu.iteration.datacache.DataCache`
+        whose batches carry this estimator's features/label(/weight)
+        columns.
+
+    Streamed mode is boosting-only and excludes ``validationFraction``.
+    """
+
     _LOGISTIC = True
     _BOOSTING = True
 
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
 
     def _feat_fraction(self, d: int) -> float:
         return 1.0
@@ -387,13 +409,77 @@ class _GBTBase(_GBTParams, Estimator):
         best = int(np.argmin(per_prefix)) + 1
         return feats[:best], thrs[:best], gains[:best], leaves[:best]
 
+    def _fit_stream_forest(self, source):
+        """Out-of-core forest build (see class docstring;
+        ``ReplayOperator.java:62-250`` parity)."""
+        from flinkml_tpu.iteration.datacache import DataCache, cache_stream
+        from flinkml_tpu.models._gbt_stream import train_gbt_stream
+
+        if not self._BOOSTING:
+            raise ValueError(
+                "streamed fits support boosted estimators only; random "
+                "forests need the in-RAM path (independent bagged trees)"
+            )
+        if self.get(self.VALIDATION_FRACTION) > 0:
+            raise ValueError(
+                "validationFraction is not supported in streamed fits "
+                "(a holdout needs a second materialized stream)"
+            )
+        features_col = self.get(self.FEATURES_COL)
+        label_col = self.get(self.LABEL_COL)
+        weight_col = self.get(self.WEIGHT_COL)
+        if isinstance(source, DataCache):
+            cache = source
+            columns = (features_col, label_col, weight_col)
+        else:
+            def batches():
+                for t in source:
+                    x, y, w = labeled_data(
+                        t, features_col, label_col, weight_col
+                    )
+                    yield {"x": x.astype(np.float32),
+                           "y": y.astype(np.float32),
+                           "w": w.astype(np.float32)}
+
+            cache = cache_stream(
+                batches(), self.cache_dir, self.cache_memory_budget_bytes
+            )
+            columns = ("x", "y", "w")
+        label_check = (
+            (lambda y: check_binary_labels(y, type(self).__name__))
+            if self._LOGISTIC else None
+        )
+        max_bins = self.get(self.MAX_BINS)
+        depth = self.get(self.MAX_DEPTH)
+        feats, bins, gains, leaves, base, edges = train_gbt_stream(
+            cache,
+            mesh=self.mesh or DeviceMesh(),
+            logistic=self._LOGISTIC,
+            num_trees=self.get(self.NUM_TREES),
+            depth=depth,
+            max_bins=max_bins,
+            learning_rate=self.get(self.LEARNING_RATE),
+            reg_lambda=self.get(self.REG_LAMBDA),
+            subsample=self.get(self.SUBSAMPLE),
+            seed=self.get_seed(),
+            columns=columns,
+            label_check=label_check,
+        )
+        edges_inf = np.concatenate(
+            [edges, np.full((edges.shape[0], 1), np.inf)], axis=1
+        )
+        thrs = edges_inf[feats, np.minimum(bins, edges_inf.shape[1] - 1)]
+        return (feats, thrs, gains, leaves, base, depth, edges.shape[0])
+
     _MODEL_CLS = None   # set per concrete estimator
 
-    def fit(self, *inputs: Table):
+    def fit(self, *inputs):
         (table,) = inputs
-        feats, thrs, gains, leaves, base, depth, n_features = (
-            self._fit_forest(table)
-        )
+        if isinstance(table, Table):
+            forest = self._fit_forest(table)
+        else:
+            forest = self._fit_stream_forest(table)
+        feats, thrs, gains, leaves, base, depth, n_features = forest
         model = self._MODEL_CLS()
         model.copy_params_from(self)
         # Bagged forests predict the MEAN of tree outputs (lr = 1/T);

@@ -68,34 +68,88 @@ class _KMeansParams(
 
 
 class KMeans(_KMeansParams, Estimator):
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    """``fit`` accepts, besides a single in-RAM :class:`Table`:
+
+      - an **iterable of batch Tables** — the out-of-core path: epoch 0
+        caches the stream (spilling to ``cache_dir`` beyond
+        ``cache_memory_budget_bytes``) while reservoir-sampling init
+        centroids; each Lloyd iteration then replays the cache through a
+        prefetching device feed, accumulating per-cluster sums/counts
+        batch-by-batch with bounded HBM residency (reference:
+        ``ReplayOperator.java:62-250`` + the point-caching
+        ``SelectNearestCentroidOperator``, ``KMeans.java:239-312``);
+      - a sealed :class:`~flinkml_tpu.iteration.datacache.DataCache`
+        whose batches carry this estimator's features column.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
 
-    def fit(self, *inputs: Table) -> "KMeansModel":
+    def fit(self, *inputs) -> "KMeansModel":
         (table,) = inputs
-        x = features_matrix(table, self.get(_KMeansParams.FEATURES_COL))
         k = self.get(_KMeansParams.K)
-        if x.shape[0] < k:
-            raise ValueError(f"k={k} exceeds number of points {x.shape[0]}")
         measure = self.get(_KMeansParams.DISTANCE_MEASURE)
         if measure != "euclidean":
             raise ValueError(
                 "KMeans currently supports the euclidean distance measure "
                 f"(parity with the reference), got {measure!r}"
             )
-        centroids = train_kmeans(
-            x,
+        if isinstance(table, Table):
+            x = features_matrix(table, self.get(_KMeansParams.FEATURES_COL))
+            if x.shape[0] < k:
+                raise ValueError(
+                    f"k={k} exceeds number of points {x.shape[0]}"
+                )
+            centroids = train_kmeans(
+                x,
+                k=k,
+                mesh=self.mesh or DeviceMesh(),
+                max_iter=self.get(_KMeansParams.MAX_ITER),
+                seed=self.get_seed(),
+                init_mode=self.get(_KMeansParams.INIT_MODE),
+            )
+        else:
+            centroids = self._fit_stream(table, k)
+        model = KMeansModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"centroids": centroids[None, :, :]}))
+        return model
+
+    def _fit_stream(self, source, k: int) -> np.ndarray:
+        from flinkml_tpu.iteration.datacache import DataCache
+
+        features_col = self.get(_KMeansParams.FEATURES_COL)
+        if isinstance(source, DataCache):
+            batches = source
+        else:
+            def batches_gen():
+                for t in source:
+                    yield {
+                        "x": features_matrix(t, features_col)
+                        .astype(np.float32)
+                    }
+            batches = batches_gen()
+        return train_kmeans_stream(
+            batches,
             k=k,
             mesh=self.mesh or DeviceMesh(),
             max_iter=self.get(_KMeansParams.MAX_ITER),
             seed=self.get_seed(),
             init_mode=self.get(_KMeansParams.INIT_MODE),
+            cache_dir=self.cache_dir,
+            memory_budget_bytes=self.cache_memory_budget_bytes,
+            column=(
+                features_col if isinstance(source, DataCache) else "x"
+            ),
         )
-        model = KMeansModel()
-        model.copy_params_from(self)
-        model.set_model_data(Table({"centroids": centroids[None, :, :]}))
-        return model
 
 
 class KMeansModel(_KMeansParams, Model):
@@ -214,10 +268,15 @@ def train_kmeans(
     max_iter: int,
     seed: int,
     init_mode: str = "random",
+    initial_centroids: Optional[np.ndarray] = None,
 ) -> np.ndarray:
-    """Returns centroids [k, d]; the full loop runs on device."""
+    """Returns centroids [k, d]; the full loop runs on device.
+    ``initial_centroids`` overrides the seeded init (used by tests and by
+    warm restarts)."""
     rng = np.random.default_rng(seed)
-    if init_mode == "k-means++":
+    if initial_centroids is not None:
+        init_centroids = np.asarray(initial_centroids, x.dtype)
+    elif init_mode == "k-means++":
         init_centroids = _kmeans_pp_init(x, k, rng)
     else:
         init_idx = rng.choice(x.shape[0], size=k, replace=False)
@@ -229,6 +288,154 @@ def train_kmeans(
         xd, wd, jnp.asarray(init_centroids), jnp.asarray(max_iter, jnp.int32)
     )
     return np.asarray(centroids)
+
+
+@functools.lru_cache(maxsize=64)
+def _kmeans_partial_fn(mesh, k: int, axis: str):
+    """Per-batch Lloyd partials: psum'd per-cluster (sums, counts) for one
+    sharded batch against replicated centroids. The streamed trainer
+    accumulates these across batches, then updates centroids once per
+    epoch — identical math to :func:`_kmeans_trainer`'s body with the
+    batch axis split."""
+
+    def per_device(xb, wb, centroids):
+        d2 = blas.squared_distances(xb, centroids)
+        assign = jnp.argmin(d2, axis=-1)
+        onehot = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
+        return (
+            jax.lax.psum(onehot.T @ xb, axis),
+            jax.lax.psum(jnp.sum(onehot, axis=0), axis),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+        )
+    )
+
+
+def train_kmeans_stream(
+    batches,
+    k: int,
+    mesh: DeviceMesh,
+    max_iter: int,
+    seed: int,
+    init_mode: str = "random",
+    cache_dir: Optional[str] = None,
+    memory_budget_bytes: Optional[int] = None,
+    prefetch_depth: int = 2,
+    column: str = "x",
+    init_sample_size: int = 65_536,
+    initial_centroids: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Out-of-core Lloyd: train from a one-shot stream of batch dicts (or
+    a sealed :class:`DataCache`) with bounded HBM residency.
+
+    Reference parity: ``ReplayOperator.java:62-250`` (epoch-0 cache +
+    per-epoch replay) + ``SelectNearestCentroidOperator``'s ListState
+    point cache (``KMeans.java:239-312``). Pass 0 caches the stream
+    (spilling beyond ``memory_budget_bytes`` to ``cache_dir``) while
+    feeding a seeded :class:`RowReservoir` for centroid init —
+    ``init_mode='random'`` takes k reservoir rows (uniform over the
+    stream, exactly the reference's random init); ``'k-means++'`` runs
+    the seeding on a ``init_sample_size`` uniform row sample. Each Lloyd
+    iteration replays the cache through a prefetching device feed,
+    accumulating per-cluster sums/counts on device; centroids update once
+    per epoch (empty clusters keep their previous centroid). Only one
+    batch (plus prefetch depth) is device-resident at a time.
+    """
+    from flinkml_tpu.iteration.datacache import (
+        DataCache,
+        DataCacheWriter,
+        PrefetchingDeviceFeed,
+    )
+    from flinkml_tpu.utils.sampling import RowReservoir
+
+    p_size = mesh.axis_size()
+    row_tile = p_size * 8
+    axis = DeviceMesh.DATA_AXIS
+    fn = _kmeans_partial_fn(mesh.mesh, k, axis)
+    n_feat = [None]  # first-seen feature dim; every batch must match
+
+    def check_dims(x):
+        if x.ndim != 2:
+            raise ValueError(f"stream batches must be [n, d], got {x.shape}")
+        if x.shape[0] == 0:
+            raise ValueError("stream batch has zero rows; drop empty batches")
+        if n_feat[0] is None:
+            n_feat[0] = x.shape[1]
+        elif x.shape[1] != n_feat[0]:
+            raise ValueError(
+                f"batch feature dim {x.shape[1]} != first batch's {n_feat[0]}"
+            )
+
+    def place(batch):
+        x = np.asarray(batch[column], dtype=np.float32)
+        check_dims(x)
+        x_pad, n_valid = pad_to_multiple(x, row_tile)
+        w = np.zeros(x_pad.shape[0], np.float32)
+        w[:n_valid] = 1.0  # padded rows never influence centroids
+        return mesh.shard_batch(x_pad), mesh.shard_batch(w)
+
+    # -- pass 0: cache (if needed) + reservoir sample for init -------------
+    reservoir_cap = (
+        k if init_mode == "random" else max(k, init_sample_size)
+    )
+    reservoir = RowReservoir(reservoir_cap, seed=seed)
+    if isinstance(batches, DataCache):
+        cache = batches
+        if initial_centroids is None:
+            for batch in cache.reader():
+                reservoir.add(np.asarray(batch[column], np.float32))
+    else:
+        writer = DataCacheWriter(cache_dir, memory_budget_bytes)
+        for b in batches:
+            x = np.asarray(b[column], np.float32)
+            check_dims(x)
+            writer.append({column: np.array(x)})
+            reservoir.add(x)
+        cache = writer.finish()
+    if cache.num_rows < k:
+        raise ValueError(f"k={k} exceeds number of points {cache.num_rows}")
+
+    rng = np.random.default_rng(seed)
+    if initial_centroids is not None:
+        centroids = np.asarray(initial_centroids, np.float32)
+        if centroids.shape[0] != k:
+            raise ValueError(
+                f"initial_centroids has {centroids.shape[0]} rows, need {k}"
+            )
+    else:
+        sample = reservoir.sample()
+        if init_mode == "k-means++":
+            centroids = _kmeans_pp_init(sample, k, rng).astype(np.float32)
+        else:
+            # The reservoir IS the uniform k-row sample; a fixed order
+            # would bias nothing, but shuffle for parity with the
+            # reference's shuffled selection (KMeans.java:314-335).
+            centroids = sample[rng.permutation(sample.shape[0])[:k]]
+
+    cent_dev = jnp.asarray(centroids)
+    for _ in range(max_iter):
+        sums = None
+        counts = None
+        feed = PrefetchingDeviceFeed(
+            cache.reader(), place=place, depth=prefetch_depth
+        )
+        try:
+            for xb, wb in feed:
+                s, c = fn(xb, wb, cent_dev)
+                sums = s if sums is None else sums + s
+                counts = c if counts is None else counts + c
+        finally:
+            feed.close()
+        if sums is None:
+            raise ValueError("training stream is empty")
+        safe = jnp.maximum(counts, 1.0)[:, None]
+        cent_dev = jnp.where(counts[:, None] > 0, sums / safe, cent_dev)
+    return np.asarray(cent_dev)
 
 
 def prepare_kmeans_data(x: np.ndarray, mesh: DeviceMesh):

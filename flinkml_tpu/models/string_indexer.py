@@ -114,9 +114,13 @@ def _lookup(
     if len(sorted_vocab) == 0:
         z = np.zeros(len(values), dtype=np.int64)
         return z, np.zeros(len(values), dtype=bool)
-    if sorted_vocab.dtype.kind in "US" or values.dtype.kind in "US":
-        sorted_vocab = np.asarray(sorted_vocab, dtype=str)
+    if sorted_vocab.dtype.kind in "US":
         values = np.asarray(values, dtype=str)
+    elif values.dtype.kind in "US":
+        raise TypeError(
+            "string queries against a numeric-sorted vocabulary: pass the "
+            "stringified lookup table (see _VocabModelBase._str_lookup)"
+        )
     pos = np.searchsorted(sorted_vocab, values)
     pos_clipped = np.minimum(pos, len(sorted_vocab) - 1)
     found = sorted_vocab[pos_clipped] == values
@@ -154,12 +158,30 @@ class _VocabModelBase(_StringIndexerParams, Model):
         super().__init__()
         self._vocabs: Optional[List[np.ndarray]] = None
         self._lookup_tables: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._str_lookup_tables: List[
+            Optional[Tuple[np.ndarray, np.ndarray]]
+        ] = []
 
     def _set_vocabs(self, vocabs: List[np.ndarray]) -> None:
         self._vocabs = [np.asarray(v) for v in vocabs]
         # (sorted_vocab, order) per column, fixed at fit time so transform
         # never re-sorts a (possibly high-cardinality) vocabulary.
         self._lookup_tables = [_sorted_lookup_table(v) for v in self._vocabs]
+        self._str_lookup_tables: List[
+            Optional[Tuple[np.ndarray, np.ndarray]]
+        ] = [None] * len(self._vocabs)
+
+    def _str_lookup(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Stringified lookup table for column ``i``, built once on first
+        use: a numeric-sorted vocab is not lexicographically sorted after
+        str coercion (e.g. [2, 10] -> ['2', '10']), so it must be
+        re-sorted — but once per model, not per transform."""
+        if self._str_lookup_tables[i] is None:
+            sorted_vocab, order = self._lookup_tables[i]
+            as_str = np.asarray(sorted_vocab, dtype=str)
+            resort = np.argsort(as_str, kind="stable")
+            self._str_lookup_tables[i] = (as_str[resort], order[resort])
+        return self._str_lookup_tables[i]
 
     def set_model_data(self, *inputs: Table):
         (table,) = inputs
@@ -218,10 +240,17 @@ class StringIndexerModel(_VocabModelBase):
         self._check_columns(input_cols, output_cols)
         out = table
         keep_mask = np.ones(table.num_rows, dtype=bool)
-        for col, out_col, vocab, (sorted_vocab, order) in zip(
-            input_cols, output_cols, self._vocabs, self._lookup_tables
+        for i, (col, out_col, vocab) in enumerate(
+            zip(input_cols, output_cols, self._vocabs)
         ):
             values = _column_values(table, col)
+            sorted_vocab, order = self._lookup_tables[i]
+            if (
+                values.dtype.kind in "US"
+                and len(sorted_vocab)
+                and sorted_vocab.dtype.kind not in "US"
+            ):
+                sorted_vocab, order = self._str_lookup(i)
             idx, found = _lookup(values, sorted_vocab, order)
             if handle_invalid == HasHandleInvalid.ERROR_INVALID:
                 if not found.all():
@@ -243,7 +272,15 @@ class StringIndexerModel(_VocabModelBase):
 class IndexToStringModel(_VocabModelBase):
     """Inverse of StringIndexerModel: double indices → original values,
     driven by the same model data (the upstream family's
-    ``IndexToStringModel``)."""
+    ``IndexToStringModel``).
+
+    The catch-all index ``len(vocab)`` — what StringIndexerModel emits for
+    unseen values under ``handleInvalid='keep'`` — round-trips to a
+    sentinel instead of raising: ``'__unknown__'`` for string
+    vocabularies, ``NaN`` for numeric ones. Indices outside
+    ``[0, len(vocab)]`` still raise."""
+
+    UNKNOWN_SENTINEL = "__unknown__"
 
     @staticmethod
     def from_indexer(indexer: StringIndexerModel) -> "IndexToStringModel":
@@ -267,11 +304,28 @@ class IndexToStringModel(_VocabModelBase):
                 raise ValueError(
                     f"Column {col!r} contains non-integral indices"
                 )
-            invalid = (idx < 0) | (idx >= len(vocab))
+            invalid = (idx < 0) | (idx > len(vocab))
             if invalid.any():
                 raise ValueError(
                     f"Column {col!r} contains indices outside "
-                    f"[0, {len(vocab) - 1}]: {idx[invalid][:5]}"
+                    f"[0, {len(vocab)}]: {idx[invalid][:5]}"
                 )
-            out = out.with_column(out_col, vocab[idx])
+            catch_all = idx == len(vocab)
+            if len(vocab) == 0:  # every index is the catch-all
+                res = np.zeros(len(idx), dtype=np.float64)
+                catch_all = np.ones(len(idx), dtype=bool)
+            else:
+                res = vocab[np.where(catch_all, 0, idx)]
+            # keep-mode round-trip: the catch-all index becomes a
+            # sentinel rather than an error. The output dtype is fixed
+            # per vocab kind (object for strings, float64 for numerics)
+            # REGARDLESS of whether this batch contains a catch-all, so
+            # downstream schema checks never flip dtype between batches.
+            if vocab.dtype.kind in "USO":
+                res = res.astype(object)
+                res[catch_all] = self.UNKNOWN_SENTINEL
+            else:
+                res = res.astype(np.float64)
+                res[catch_all] = np.nan
+            out = out.with_column(out_col, res)
         return (out,)

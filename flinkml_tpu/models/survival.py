@@ -38,7 +38,7 @@ from flinkml_tpu.common_params import (
 )
 from flinkml_tpu.models._adam import make_adam_trainer
 from flinkml_tpu.models._data import features_matrix
-from flinkml_tpu.params import FloatArrayParam, StringParam
+from flinkml_tpu.params import BoolParam, FloatArrayParam, StringParam
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
 
@@ -49,6 +49,13 @@ class _AFTParams(
 ):
     CENSOR_COL = StringParam(
         "censorCol", "1.0 = event observed, 0.0 = right-censored.", "censor"
+    )
+    FIT_INTERCEPT = BoolParam(
+        "fitIntercept",
+        "Whether to fit an intercept term (matches Spark AFT's "
+        "fitIntercept=true default; without it, data whose log survival "
+        "times have nonzero mean biases the scale/coefficients).",
+        True,
     )
     QUANTILE_PROBABILITIES = FloatArrayParam(
         "quantileProbabilities",
@@ -95,6 +102,11 @@ class AFTSurvivalRegression(_AFTParams, Estimator):
             raise ValueError("all rows are censored; nothing to fit")
         mesh = self.mesh or DeviceMesh()
         p = mesh.axis_size()
+        fit_intercept = self.get(self.FIT_INTERCEPT)
+        if fit_intercept:
+            # Intercept as an appended constant feature: the optimized β
+            # gains one entry, split back out after training.
+            x = np.concatenate([x, np.ones((x.shape[0], 1), x.dtype)], axis=1)
         x_pad, n_valid = pad_to_multiple(x.astype(np.float32), p)
         y = np.stack([np.log(t), censor], axis=1).astype(np.float32)
         y_pad, _ = pad_to_multiple(y, p)
@@ -119,8 +131,11 @@ class AFTSurvivalRegression(_AFTParams, Estimator):
         )
         model = AFTSurvivalRegressionModel()
         model.copy_params_from(self)
-        model._set(np.asarray(beta, np.float64),
-                   float(np.exp(np.asarray(log_sigma)[0])))
+        beta = np.asarray(beta, np.float64)
+        intercept = float(beta[-1]) if fit_intercept else 0.0
+        if fit_intercept:
+            beta = beta[:-1]
+        model._set(beta, float(np.exp(np.asarray(log_sigma)[0])), intercept)
         return model
 
 
@@ -129,10 +144,13 @@ class AFTSurvivalRegressionModel(_AFTParams, Model):
         super().__init__()
         self._beta: Optional[np.ndarray] = None
         self._sigma: float = 1.0
+        self._intercept: float = 0.0
 
-    def _set(self, beta: np.ndarray, sigma: float) -> None:
+    def _set(self, beta: np.ndarray, sigma: float,
+             intercept: float = 0.0) -> None:
         self._beta = np.asarray(beta, np.float64)
         self._sigma = float(sigma)
+        self._intercept = float(intercept)
 
     @property
     def coefficients(self) -> np.ndarray:
@@ -144,11 +162,21 @@ class AFTSurvivalRegressionModel(_AFTParams, Model):
         self._require()
         return self._sigma
 
+    @property
+    def intercept(self) -> float:
+        self._require()
+        return self._intercept
+
     def set_model_data(self, *inputs: Table) -> "AFTSurvivalRegressionModel":
         (table,) = inputs
+        intercept = (
+            float(np.asarray(table.column("intercept"))[0])
+            if "intercept" in table.column_names else 0.0
+        )
         self._set(
             np.asarray(table.column("beta"), np.float64)[0],
             float(np.asarray(table.column("sigma"))[0]),
+            intercept,
         )
         return self
 
@@ -156,6 +184,7 @@ class AFTSurvivalRegressionModel(_AFTParams, Model):
         self._require()
         return [Table({
             "beta": self._beta[None, :], "sigma": np.asarray([self._sigma]),
+            "intercept": np.asarray([self._intercept]),
         })]
 
     def _require(self) -> None:
@@ -166,7 +195,7 @@ class AFTSurvivalRegressionModel(_AFTParams, Model):
         (table,) = inputs
         self._require()
         x = features_matrix(table, self.get(self.FEATURES_COL))
-        eta = x @ self._beta
+        eta = x @ self._beta + self._intercept
         # Weibull median: exp(eta) * ln(2)^sigma.
         median = np.exp(eta) * np.log(2.0) ** self._sigma
         out = table.with_column(self.get(self.PREDICTION_COL), median)
@@ -187,11 +216,13 @@ class AFTSurvivalRegressionModel(_AFTParams, Model):
     def save(self, path: str) -> None:
         self._require()
         self._save_with_arrays(
-            path, {"beta": self._beta, "sigma": np.asarray(self._sigma)}
+            path, {"beta": self._beta, "sigma": np.asarray(self._sigma),
+                   "intercept": np.asarray(self._intercept)},
         )
 
     @classmethod
     def load(cls, path: str) -> "AFTSurvivalRegressionModel":
         model, arrays, _ = cls._load_with_arrays(path)
-        model._set(arrays["beta"], float(arrays["sigma"]))
+        model._set(arrays["beta"], float(arrays["sigma"]),
+                   float(arrays.get("intercept", 0.0)))
         return model

@@ -1,0 +1,86 @@
+"""Single-tenant device-client mutex.
+
+The TPU in this image is reached through a single-tenant tunnel: two
+concurrent clients can wedge it for every later client (observed round 2:
+a second client during a bench run left the device unreachable for 8+
+hours — BASELINE.md "Tunnel wedge observed"). The reference has no analog
+because Flink multiplexes one cluster across jobs; here the mutex is the
+framework's admission control for the device, the way Flink's slot pool is
+for TaskManagers.
+
+Mechanism: an exclusive ``flock`` on a well-known file. Every process that
+may open the real device (bench stages, probe tools, ad-hoc scripts) takes
+the lock first; CPU-only processes (``JAX_PLATFORMS=cpu``, as set by
+``tests/conftest.py``) skip it. A parent that holds the lock marks the
+environment so its child processes — bench stage children inherit
+``os.environ`` — do not deadlock re-acquiring it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import time
+
+LOCK_PATH_ENV = "FLINKML_TPU_DEVICE_LOCK"
+DEFAULT_LOCK_PATH = "/tmp/flinkml_tpu.device.lock"
+_HELD_ENV = "_FLINKML_TPU_DEVICE_LOCK_HELD"
+
+
+def _targets_cpu_only() -> bool:
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not platforms:
+        return False
+    return all(p.strip() in ("cpu", "") for p in platforms.split(","))
+
+
+@contextlib.contextmanager
+def device_client_lock(timeout_s: float = 900.0, poll_s: float = 0.5,
+                       force: bool = False):
+    """Hold the exclusive device-client lock for the duration of the block.
+
+    Yields True when this process acquired the lock, False when the lock
+    was skipped (CPU-only process, or an ancestor already holds it).
+    Raises TimeoutError if another client holds the lock past
+    ``timeout_s`` — the caller should NOT proceed to the device.
+
+    ``force=True`` bypasses the CPU-only skip (for tests of the lock
+    itself).
+    """
+    if not force:
+        if _targets_cpu_only():
+            yield False
+            return
+        if os.environ.get(_HELD_ENV):
+            yield False
+            return
+    path = os.environ.get(LOCK_PATH_ENV, DEFAULT_LOCK_PATH)
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
+    try:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"device-client lock {path} held by another process "
+                        f"for > {timeout_s:.0f}s; refusing to open a second "
+                        "client against the single-tenant device"
+                    )
+                time.sleep(poll_s)
+        try:
+            os.ftruncate(fd, 0)
+            os.write(fd, f"pid={os.getpid()}\n".encode())
+        except OSError:
+            pass  # lock content is diagnostic only
+        os.environ[_HELD_ENV] = "1"
+        try:
+            yield True
+        finally:
+            os.environ.pop(_HELD_ENV, None)
+            fcntl.flock(fd, fcntl.LOCK_UN)
+    finally:
+        os.close(fd)

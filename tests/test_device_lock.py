@@ -1,0 +1,70 @@
+"""Device-client mutex: exclusivity, timeout, held-marker inheritance.
+
+The mutex is the framework's admission control for the single-tenant
+tunneled device (BASELINE.md round-2 "Tunnel wedge observed"): the analog
+of Flink's slot pool serializing access to TaskManager slots.
+"""
+
+import os
+import subprocess
+import sys
+
+from flinkml_tpu.utils.device_lock import (
+    _HELD_ENV,
+    LOCK_PATH_ENV,
+    device_client_lock,
+)
+
+
+def test_cpu_process_skips_lock(tmp_path, monkeypatch):
+    # tests/conftest.py sets JAX_PLATFORMS=cpu; a CPU-only process must
+    # not serialize on (or create) the device lock.
+    monkeypatch.setenv(LOCK_PATH_ENV, str(tmp_path / "lock"))
+    with device_client_lock() as acquired:
+        assert acquired is False
+    assert not (tmp_path / "lock").exists()
+
+
+def test_exclusive_across_processes(tmp_path, monkeypatch):
+    path = str(tmp_path / "lock")
+    monkeypatch.setenv(LOCK_PATH_ENV, path)
+    with device_client_lock(force=True) as acquired:
+        assert acquired is True
+        # A second CLIENT process must time out rather than proceed.
+        code = (
+            "import os\n"
+            "os.environ.pop('_FLINKML_TPU_DEVICE_LOCK_HELD', None)\n"
+            "from flinkml_tpu.utils.device_lock import device_client_lock\n"
+            "try:\n"
+            "    with device_client_lock(timeout_s=0.5, poll_s=0.1,"
+            " force=True):\n"
+            "        print('ACQUIRED')\n"
+            "except TimeoutError:\n"
+            "    print('TIMEOUT')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, LOCK_PATH_ENV: path},
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.stdout.strip() == "TIMEOUT", (out.stdout, out.stderr)
+    # Released: the same child program now acquires immediately.
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, LOCK_PATH_ENV: path},
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.stdout.strip() == "ACQUIRED", (out.stdout, out.stderr)
+
+
+def test_child_of_holder_skips(tmp_path, monkeypatch):
+    # bench.py stage children inherit os.environ from the lock-holding
+    # parent; they must skip re-acquiring instead of deadlocking.
+    monkeypatch.setenv(LOCK_PATH_ENV, str(tmp_path / "lock"))
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    with device_client_lock(force=True) as acquired:
+        assert acquired is True
+        assert os.environ.get(_HELD_ENV) == "1"
+        with device_client_lock() as nested:
+            assert nested is False
+    assert _HELD_ENV not in os.environ

@@ -212,3 +212,208 @@ def test_one_shot_stream_rejects_resume(mesh):
 def test_empty_stream_raises(mesh):
     with pytest.raises(ValueError, match="empty"):
         _train(iter([]), mesh)
+
+
+# -- streamed KMeans (round-3: out-of-core beyond linear models) -------------
+
+def _blob_batches(n_batches=6, rows=64, d=5, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=5.0, size=(k, d)).astype(np.float32)
+    out = []
+    for _ in range(n_batches):
+        a = rng.integers(0, k, size=rows)
+        x = centers[a] + rng.normal(scale=0.3, size=(rows, d)).astype(
+            np.float32
+        )
+        out.append({"x": x.astype(np.float32)})
+    return out, centers
+
+
+def test_kmeans_stream_spilled_matches_in_ram_exactly(tmp_path, mesh):
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    batches, _ = _blob_batches()
+    args = dict(k=3, mesh=mesh, max_iter=5, seed=7)
+    in_ram = train_kmeans_stream(iter(batches), **args)
+    spilled = train_kmeans_stream(
+        iter(batches), cache_dir=str(tmp_path / "spill"),
+        memory_budget_bytes=1, **args,
+    )
+    np.testing.assert_array_equal(spilled, in_ram)
+    assert any((tmp_path / "spill").glob("segment-*.bin"))
+
+
+def test_kmeans_stream_matches_whole_loop_device_program(mesh):
+    """Streamed batch-accumulated Lloyd == the whole-loop-on-device
+    program, given the same init (the batch split only reorders f32
+    additions)."""
+    from flinkml_tpu.models.kmeans import train_kmeans, train_kmeans_stream
+
+    batches, _ = _blob_batches()
+    x_all = np.concatenate([b["x"] for b in batches])
+    k, iters = 3, 5
+    rng = np.random.default_rng(42)
+    init = np.ascontiguousarray(
+        x_all[rng.choice(x_all.shape[0], size=k, replace=False)]
+    )
+    whole = train_kmeans(
+        x_all, k=k, mesh=mesh, max_iter=iters, seed=0,
+        initial_centroids=init,
+    )
+    streamed = train_kmeans_stream(
+        iter(batches), k=k, mesh=mesh, max_iter=iters, seed=0,
+        initial_centroids=init,
+    )
+    np.testing.assert_allclose(streamed, whole, rtol=1e-4, atol=1e-5)
+
+
+def test_kmeans_estimator_streamed_fit_clusters(tmp_path, mesh):
+    from flinkml_tpu.models import KMeans
+    from flinkml_tpu.table import Table
+
+    batches, centers = _blob_batches(n_batches=8, rows=128)
+    tables = [Table({"features": b["x"]}) for b in batches]
+    model = (
+        KMeans(mesh=mesh, cache_dir=str(tmp_path / "km"),
+               cache_memory_budget_bytes=1)
+        .set_k(3).set_max_iter(10).set_seed(1)
+        .fit(iter(tables))
+    )
+    got = np.sort(np.round(model.centroids).astype(int), axis=0)
+    want = np.sort(np.round(centers).astype(int), axis=0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_kmeans_stream_from_sealed_cache(mesh):
+    from flinkml_tpu.models import KMeans
+
+    batches, _ = _blob_batches()
+    cache = cache_stream({"features": b["x"]} for b in batches)
+    model = KMeans(mesh=mesh).set_k(3).set_max_iter(5).set_seed(3).fit(cache)
+    assert model.centroids.shape == (3, 5)
+
+
+def test_kmeans_stream_kmeanspp_init(mesh):
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    batches, _ = _blob_batches()
+    out = train_kmeans_stream(
+        iter(batches), k=3, mesh=mesh, max_iter=5, seed=0,
+        init_mode="k-means++",
+    )
+    assert out.shape == (3, 5)
+    assert np.isfinite(out).all()
+
+
+# -- streamed GBT (round-3: out-of-core beyond linear models) ----------------
+
+def _gbt_batches(n_batches=5, rows=96, d=4, seed=0, regression=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        x = rng.uniform(-1, 1, size=(rows, d)).astype(np.float32)
+        raw = x[:, 0] * x[:, 1] + 0.5 * x[:, 2]
+        y = raw if regression else (raw > 0).astype(np.float32)
+        out.append({
+            "x": x, "y": y.astype(np.float32),
+            "w": np.ones(rows, np.float32),
+        })
+    return out
+
+
+def test_gbt_stream_spilled_matches_in_ram_exactly(tmp_path, mesh):
+    from flinkml_tpu.iteration.datacache import cache_stream
+    from flinkml_tpu.models._gbt_stream import train_gbt_stream
+
+    batches = _gbt_batches()
+    args = dict(
+        mesh=mesh, logistic=True, num_trees=4, depth=3, max_bins=16,
+        learning_rate=0.3, reg_lambda=1.0, subsample=1.0, seed=0,
+    )
+    ram = train_gbt_stream(cache_stream(iter(batches)), **args)
+    spill_cache = cache_stream(
+        iter(batches), directory=str(tmp_path / "spill"),
+        memory_budget_bytes=1,
+    )
+    spilled = train_gbt_stream(spill_cache, **args)
+    for a, b in zip(ram, spilled):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert any((tmp_path / "spill").glob("segment-*.bin"))
+
+
+def test_gbt_stream_matches_in_ram_builder(mesh):
+    """With the reservoir covering every row (exact edges), identical
+    subsampling (off), and the same seed, the streamed level-wise build
+    must pick the same splits as the whole-forest device program."""
+    from flinkml_tpu.models.gbt import GBTClassifier
+    from flinkml_tpu.table import Table
+
+    batches = _gbt_batches()
+    x_all = np.concatenate([b["x"] for b in batches])
+    y_all = np.concatenate([b["y"] for b in batches])
+    t = Table({"features": x_all, "label": y_all})
+    est = lambda: (
+        GBTClassifier(mesh=mesh).set_num_trees(4).set_max_depth(3)
+        .set_max_bins(16).set_learning_rate(0.3).set_seed(0)
+    )
+    in_ram = est().fit(t)
+    tables = [Table({"features": b["x"], "label": b["y"]}) for b in batches]
+    streamed = est().fit(iter(tables))
+    np.testing.assert_array_equal(streamed._feats, in_ram._feats)
+    np.testing.assert_allclose(streamed._thrs, in_ram._thrs, rtol=1e-6)
+    np.testing.assert_allclose(
+        streamed._leaves, in_ram._leaves, rtol=2e-3, atol=2e-4
+    )
+
+
+def test_gbt_classifier_streamed_fit_learns(tmp_path, mesh):
+    from flinkml_tpu.models import GBTClassifier
+    from flinkml_tpu.table import Table
+
+    batches = _gbt_batches(n_batches=8, rows=128)
+    tables = [Table({"features": b["x"], "label": b["y"]}) for b in batches]
+    model = (
+        GBTClassifier(mesh=mesh, cache_dir=str(tmp_path / "gbt"),
+                      cache_memory_budget_bytes=1)
+        .set_num_trees(20).set_max_depth(4).set_max_bins(32)
+        .set_learning_rate(0.3).set_seed(0)
+        .fit(iter(tables))
+    )
+    x_all = np.concatenate([b["x"] for b in batches])
+    y_all = np.concatenate([b["y"] for b in batches])
+    (out,) = model.transform(Table({"features": x_all}))
+    acc = float(np.mean(out["prediction"] == y_all))
+    assert acc > 0.9, acc
+
+
+def test_gbt_regressor_streamed_fit_learns(mesh):
+    from flinkml_tpu.models import GBTRegressor
+    from flinkml_tpu.table import Table
+
+    batches = _gbt_batches(n_batches=8, rows=128, regression=True)
+    tables = [Table({"features": b["x"], "label": b["y"]}) for b in batches]
+    model = (
+        GBTRegressor(mesh=mesh).set_num_trees(30).set_max_depth(4)
+        .set_max_bins(32).set_learning_rate(0.3).set_seed(0)
+        .fit(iter(tables))
+    )
+    x_all = np.concatenate([b["x"] for b in batches])
+    y_all = np.concatenate([b["y"] for b in batches])
+    (out,) = model.transform(Table({"features": x_all}))
+    rmse = float(np.sqrt(np.mean((out["prediction"] - y_all) ** 2)))
+    assert rmse < 0.15, rmse
+
+
+def test_gbt_stream_rejects_rf_and_validation_fraction(mesh):
+    from flinkml_tpu.models import GBTClassifier, RandomForestClassifier
+    from flinkml_tpu.table import Table
+
+    tables = [
+        Table({"features": b["x"], "label": b["y"]})
+        for b in _gbt_batches(n_batches=2)
+    ]
+    with pytest.raises(ValueError, match="boosted"):
+        RandomForestClassifier(mesh=mesh).fit(iter(tables))
+    with pytest.raises(ValueError, match="validationFraction"):
+        (GBTClassifier(mesh=mesh).set_validation_fraction(0.2)
+         .fit(iter(tables)))

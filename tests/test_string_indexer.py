@@ -203,3 +203,40 @@ def test_max_index_num_caps_vocabulary():
     np.testing.assert_array_equal(out.column("colorIdx"), [0, 1, 0, 2, 0, 1])
     with pytest.raises(ValueError, match="not seen"):
         model.set_handle_invalid("error").transform(t)
+
+
+def test_numeric_vocab_queried_with_strings():
+    # ADVICE r2: numeric-sorted vocab [2, 10] stringifies to ['2', '10'],
+    # which is NOT lexicographically sorted; the lookup must re-sort on
+    # dtype coercion or it silently treats present values as unseen.
+    t = Table({"c": np.asarray([2.0, 10.0, 2.0])})
+    model = (
+        StringIndexer().set_input_cols(["c"]).set_output_cols(["i"])
+        .set_handle_invalid("keep").fit(t)
+    )
+    ts = Table({"c": np.asarray(["2.0", "10.0", "nope"], dtype=object)})
+    (out,) = model.transform(ts)
+    # '2.0' and '10.0' must be FOUND (same indices as the numeric query);
+    # only 'nope' is the catch-all.
+    (num_out,) = model.transform(t)
+    np.testing.assert_array_equal(out.column("i")[:2], num_out.column("i")[:2])
+    assert out.column("i")[2] == 2.0  # len(vocab) catch-all
+
+
+def test_keep_catch_all_round_trips_through_index_to_string():
+    # ADVICE r2: handleInvalid='keep' emits index len(vocab); the inverse
+    # transform maps it to a sentinel instead of raising.
+    t = _table()
+    indexer = _indexer(handle="keep").fit(t)
+    unseen = Table({
+        "color": np.asarray(["a", "zzz"]),
+        "size": np.asarray([1.0, 99.0]),
+    })
+    (indexed,) = indexer.transform(unseen)
+    inv = IndexToStringModel.from_indexer(indexer)
+    inv.set_input_cols(["colorIdx", "sizeIdx"]).set_output_cols(["c2", "s2"])
+    (out,) = inv.transform(indexed)
+    assert out.column("c2")[0] == "a"
+    assert out.column("c2")[1] == IndexToStringModel.UNKNOWN_SENTINEL
+    assert out.column("s2")[0] == 1.0
+    assert np.isnan(out.column("s2")[1])

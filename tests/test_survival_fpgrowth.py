@@ -269,3 +269,33 @@ def test_prefixspan_deep_patterns_no_recursion_limit():
 
     out = prefixspan([["x"] * 1500] * 2, 0.5, max_length=1500)
     assert max(len(k) for k in out) == 1500
+
+
+def test_aft_intercept_absorbs_log_time_offset(tmp_path):
+    # ADVICE r2: Spark AFT fits an intercept by default; on data whose
+    # log survival times have nonzero mean the offset must land in the
+    # intercept, not bias the coefficients/scale.
+    x, t, censor, beta, sigma = _weibull_data()
+    offset = 2.0
+    table = Table({"features": x, "label": t * np.exp(offset),
+                   "censor": censor})
+    model = _aft().fit(table)
+    np.testing.assert_allclose(model.coefficients, beta, atol=0.1)
+    assert abs(model.intercept - offset) < 0.1, model.intercept
+    assert abs(model.scale - sigma) < 0.1
+    # Round-trips through save/load and model-data tables.
+    model.save(str(tmp_path / "aft_i"))
+    loaded = AFTSurvivalRegressionModel.load(str(tmp_path / "aft_i"))
+    assert abs(loaded.intercept - model.intercept) < 1e-12
+    m2 = AFTSurvivalRegressionModel()
+    m2.copy_params_from(model)
+    m2.set_model_data(*model.get_model_data())
+    assert abs(m2.intercept - model.intercept) < 1e-12
+
+
+def test_aft_fit_intercept_false_preserves_old_behavior():
+    x, t, censor, beta, sigma = _weibull_data()
+    table = Table({"features": x, "label": t, "censor": censor})
+    model = _aft(fit_intercept=False).fit(table)
+    assert model.intercept == 0.0
+    np.testing.assert_allclose(model.coefficients, beta, atol=0.1)

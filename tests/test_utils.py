@@ -86,3 +86,31 @@ def test_trace_context_is_safe_without_profiler(tmp_path):
 def test_annotate_context():
     with annotate("my-region"):
         pass
+
+
+# -- RowReservoir ------------------------------------------------------------
+
+def test_row_reservoir_uniform_and_deterministic():
+    from flinkml_tpu.utils.sampling import RowReservoir
+
+    # Fill phase: capacity >= stream -> the sample IS the stream, in order.
+    r = RowReservoir(100, seed=0)
+    block = np.arange(30, dtype=np.float64).reshape(10, 3)
+    r.add(block)
+    np.testing.assert_array_equal(r.sample(), block)
+    assert r.rows_seen == 10
+
+    # Replacement phase: bounded size, deterministic for a fixed seed,
+    # and approximately uniform over the stream.
+    def run(seed):
+        rr = RowReservoir(64, seed=seed)
+        for s in range(50):
+            rr.add(np.arange(s * 100, (s + 1) * 100, dtype=np.float64)[:, None])
+        return rr.sample()
+
+    a, b = run(1), run(1)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (64, 1)
+    # Uniformity: the sample mean of row ids is near the stream mean.
+    mean = float(np.mean(run(2)))
+    assert abs(mean - 2499.5) < 600, mean

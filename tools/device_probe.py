@@ -1,0 +1,36 @@
+"""Tiny device health probe: init + one transfer + one matmul.
+
+Exit 0 and print HEALTHY if the device answers; used by the health-watch
+loop and as a preflight before any device work. Takes the single-tenant
+device-client lock so it can never itself be the second client that
+wedges the tunnel (BASELINE.md round-2 "Tunnel wedge observed").
+"""
+
+import sys
+import time
+
+
+def main() -> int:
+    from flinkml_tpu.utils.device_lock import device_client_lock
+
+    with device_client_lock(timeout_s=60.0):
+        t0 = time.time()
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        devices = jax.devices()
+        t1 = time.time()
+        x = jnp.ones((1024, 1024))
+        r = np.asarray(x @ x)
+        t2 = time.time()
+        print(
+            f"HEALTHY devices={devices} init={t1 - t0:.1f}s "
+            f"matmul={t2 - t1:.1f}s checksum={float(r[0, 0])}",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flinkml_tpu.utils.device_lock import device_client_lock
+
 n_cells, dim, steps = 262_144 * 39, 1_000_000, 20
 rng = np.random.default_rng(0)
 ids = rng.integers(0, dim, n_cells).astype(np.int32)
@@ -34,17 +36,24 @@ def loop(ids_dev, flag):
     return run
 
 
-for name, i_np, v_np, flag in [
-    ("unsorted         ", ids, vals, False),
-    ("sorted+flag      ", ids_sorted, vals_sorted, True),
-    ("sorted, no flag  ", ids_sorted, vals_sorted, False),
-]:
-    i_dev = jnp.asarray(i_np)
-    v_dev = jnp.asarray(v_np)
-    fn = loop(i_dev, flag)
-    np.asarray(fn(v_dev))          # compile + warm
-    t0 = time.perf_counter()
-    np.asarray(fn(v_dev))
-    dt = time.perf_counter() - t0
-    sps = 262_144 * steps / dt
-    print(f"{name}: {dt*1e3/steps:7.2f} ms/step  -> {sps/1e6:8.2f}M samples/s")
+def main():
+    for name, i_np, v_np, flag in [
+        ("unsorted         ", ids, vals, False),
+        ("sorted+flag      ", ids_sorted, vals_sorted, True),
+        ("sorted, no flag  ", ids_sorted, vals_sorted, False),
+    ]:
+        i_dev = jnp.asarray(i_np)
+        v_dev = jnp.asarray(v_np)
+        fn = loop(i_dev, flag)
+        np.asarray(fn(v_dev))          # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(fn(v_dev))
+        dt = time.perf_counter() - t0
+        sps = 262_144 * steps / dt
+        print(f"{name}: {dt*1e3/steps:7.2f} ms/step  -> "
+              f"{sps/1e6:8.2f}M samples/s", flush=True)
+
+
+if __name__ == "__main__":
+    with device_client_lock():
+        main()
