@@ -129,13 +129,17 @@ def bench_tpu_sparse(indptr, indices, values, dim, y, w,
 
     mesh = DeviceMesh()
     p = mesh.axis_size()
-    # Same pack/pad/shard/batching policy as the product fit path.
+    # Same pack/pad/shard/batching policy as the product fit path —
+    # including the FLINKML_TPU_SORTED_SCATTER A/B gate, so setting it
+    # to 0 really benchmarks the per-step-sort layout.
+    sorted_scatter = _linear_sgd._sorted_scatter_enabled()
     data_args, local_bss = _linear_sgd.prepare_sparse_buckets(
         indptr, indices, values, dim, y, w, mesh, global_batch_size,
-        seed=0,
+        seed=0, sorted_scatter=sorted_scatter,
     )
     trainer = _linear_sgd._sparse_trainer_bucketed(
-        mesh.mesh, "logistic", local_bss, DeviceMesh.DATA_AXIS, int(dim)
+        mesh.mesh, "logistic", local_bss, DeviceMesh.DATA_AXIS, int(dim),
+        sorted_scatter,
     )
     f32 = lambda v: jnp.asarray(v, jnp.float32)
     carry0 = (

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -38,6 +39,13 @@ from flinkml_tpu.ops import pallas_kernels
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 
 _LOSS_KEYS = ("logistic", "hinge", "squared")
+
+
+def _sorted_scatter_enabled() -> bool:
+    """A/B gate for the sorted-scatter sparse layout (default ON).
+    ``FLINKML_TPU_SORTED_SCATTER=0`` restores the per-step-sort layout —
+    kept so the win stays measurable on any backend/TPU generation."""
+    return os.environ.get("FLINKML_TPU_SORTED_SCATTER", "1") != "0"
 
 
 # The margin-gradient math is shared verbatim with the fused Pallas kernel
@@ -147,38 +155,72 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
 
 
 def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
-                              axis: str, dim: int):
-    """nnz-bucketed sparse step: one window per bucket, one fused scatter.
+                              axis: str, dim: int,
+                              sorted_scatter: bool = True):
+    """nnz-bucketed sparse step: one window per bucket, fused scatters.
 
     The batch is stratified across the nnz buckets (``ops.sparse.
     pack_ell_buckets``): each bucket contributes a window sized
     proportionally to its row count, so every step sees a representative
-    nnz mix and every epoch covers every bucket's rows. All bucket
-    contributions concatenate into a single ``segment_sum`` so XLA emits
-    one HBM scatter regardless of bucket count.
+    nnz mix and every epoch covers every bucket's rows.
+
+    ``sorted_scatter`` (the round-3 sort-elimination layout): the ELL
+    cell→column mapping is static across steps and the minibatch windows
+    are deterministic rotating tiles, so the pack step pre-sorts each
+    window's cells by column once and the scatter runs with
+    ``indices_are_sorted=True`` — XLA's sort-based ``segment_sum``
+    lowering skips its per-step sort, which round-2 measured as the
+    ~400× bottleneck at Criteo shapes (BASELINE.md "Sparse
+    sort-elimination groundwork"). The runtime cost is one O(cells)
+    gather of the contributions through the precomputed permutation;
+    blocks carry two extra arrays (perm, sorted ids) per bucket. One
+    sorted scatter per bucket (concatenating buckets would break the
+    global order); the ≤ max_buckets dense [dim] adds are noise next to
+    the removed sort.
     """
 
     def step(coef, epoch, blocks, learning_rate, reg_l2, reg_l1):
         acc = _acc_dt(coef.dtype)
+        per_bucket = 6 if sorted_scatter else 4
         contribs, flat_idx = [], []
+        grad_local = jnp.zeros((dim,), coef.dtype)
         loss_l = jnp.zeros((), acc)
         wsum_l = jnp.zeros((), acc)
         for b, local_bs in enumerate(local_bss):
-            idxl, vall, yl, wl = blocks[4 * b : 4 * b + 4]
+            block = blocks[per_bucket * b : per_bucket * (b + 1)]
+            idxl, vall, yl, wl = block[:4]
             ib = _window(idxl, epoch, local_bs)
             vb = _window(vall, epoch, local_bs)
             yb = _window(yl, epoch, local_bs)
             wb = _window(wl, epoch, local_bs)
             dot = jnp.sum(vb * coef[ib], axis=1)
             mult, per_ex = _margin_grad(loss, dot, yb, wb)
-            contribs.append((vb * mult[:, None]).reshape(-1))
-            flat_idx.append(ib.reshape(-1))
+            contrib = (vb * mult[:, None]).reshape(-1)
+            if sorted_scatter:
+                perml, sidsl = block[4:]
+                n_windows = perml.shape[0]
+                cells = perml.shape[1]
+                wnum = jnp.asarray(epoch, jnp.int32) % n_windows
+                perm_w = jax.lax.dynamic_slice(
+                    perml, (wnum, jnp.zeros((), jnp.int32)), (1, cells)
+                ).reshape(-1)
+                sids_w = jax.lax.dynamic_slice(
+                    sidsl, (wnum, jnp.zeros((), jnp.int32)), (1, cells)
+                ).reshape(-1)
+                grad_local = grad_local + jax.ops.segment_sum(
+                    jnp.take(contrib, perm_w), sids_w,
+                    num_segments=dim, indices_are_sorted=True,
+                )
+            else:
+                contribs.append(contrib)
+                flat_idx.append(ib.reshape(-1))
             loss_l = loss_l + jnp.sum(per_ex.astype(acc))
             wsum_l = wsum_l + jnp.sum(wb.astype(acc))
-        grad_local = jax.ops.segment_sum(
-            jnp.concatenate(contribs), jnp.concatenate(flat_idx),
-            num_segments=dim,
-        )
+        if not sorted_scatter:
+            grad_local = jax.ops.segment_sum(
+                jnp.concatenate(contribs), jnp.concatenate(flat_idx),
+                num_segments=dim,
+            )
         grad = jax.lax.psum(grad_local, axis)
         loss_sum = jax.lax.psum(loss_l, axis)
         wsum = jax.lax.psum(wsum_l, axis)
@@ -196,12 +238,16 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
 
 @functools.lru_cache(maxsize=128)
 def _sparse_trainer_bucketed(mesh, loss: str, local_bss: Tuple[int, ...],
-                             axis: str, dim: int):
+                             axis: str, dim: int,
+                             sorted_scatter: bool = True):
     """Bucketed counterpart of :func:`_sparse_trainer` — same carry-style
-    contract; the data args are ``4·len(local_bss)`` sharded arrays
-    (indices, values, y, w per bucket)."""
-    local_step = make_sparse_step_bucketed(loss, local_bss, axis, dim)
-    n_args = 4 * len(local_bss)
+    contract; the data args are ``6·len(local_bss)`` sharded arrays
+    (indices, values, y, w, window perm, sorted ids per bucket), or
+    ``4·len(local_bss)`` with ``sorted_scatter=False``."""
+    local_step = make_sparse_step_bucketed(
+        loss, local_bss, axis, dim, sorted_scatter
+    )
+    n_args = (6 if sorted_scatter else 4) * len(local_bss)
 
     def per_device(coef, epoch, cur_loss, *rest):
         blocks = rest[:n_args]
@@ -521,22 +567,56 @@ def train_linear_model_sparse(
     )
 
 
+def _window_sort_tables(
+    idx_pad: np.ndarray, p_size: int, local_bs: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-device, per-window scatter sort tables for the sorted-scatter
+    layout: ``(perm, sorted_ids)``, each ``[p * n_windows, local_bs *
+    width]``, sharded so device d sees its own ``[n_windows, cells]``.
+
+    Window w on a device covers local rows ``min(w·bs, n_local−bs) ..
+    +bs`` — exactly :func:`_window`'s clamped rotating tile — and its
+    flattened cells are argsorted by column id once here, so the step's
+    ``segment_sum`` can assert ``indices_are_sorted``.
+    """
+    n_total, width = idx_pad.shape
+    n_local = n_total // p_size
+    n_windows = max(-(-n_local // local_bs), 1)
+    cells = local_bs * width
+    perm = np.empty((p_size * n_windows, cells), np.int32)
+    sids = np.empty((p_size * n_windows, cells), np.int32)
+    for d in range(p_size):
+        shard = idx_pad[d * n_local:(d + 1) * n_local]
+        for wnum in range(n_windows):
+            start = min(wnum * local_bs, max(n_local - local_bs, 0))
+            flat = shard[start:start + local_bs].reshape(-1)
+            order = np.argsort(flat, kind="stable").astype(np.int32)
+            row = d * n_windows + wnum
+            perm[row] = order
+            sids[row] = flat[order]
+    return perm, sids
+
+
 def prepare_sparse_buckets(
     indptr, indices, values, dim: int, y, w, mesh: DeviceMesh,
     global_batch_size: int, max_buckets: int = 4, dtype=np.float32,
-    seed: Optional[int] = None,
+    seed: Optional[int] = None, sorted_scatter: bool = True,
 ) -> Tuple[Tuple, Tuple[int, ...]]:
     """Pack, shuffle, pad, and shard CSR data for the bucketed trainer.
 
     Returns ``(data_args, local_bss)``: the flat per-bucket sharded arrays
-    (indices, values, y, w per bucket) and each bucket's per-device window
-    size (proportional share of ``global_batch_size``, ≥ 1). The single
-    source of the batching policy — the bench measures exactly what the
-    product trains with.
+    (indices, values, y, w[, window-sort perm, sorted ids] per bucket) and
+    each bucket's per-device window size (proportional share of
+    ``global_batch_size``, ≥ 1). The single source of the batching policy
+    — the bench measures exactly what the product trains with.
 
     ``seed`` shuffles rows *within* each bucket (bucket membership depends
     only on nnz, so this is the reference's partition shuffle applied
     post-bucketing — no re-gather of the full CSR needed).
+    ``sorted_scatter`` adds the per-window sort tables
+    (:func:`_window_sort_tables`) that let the gradient scatter skip its
+    per-step sort — +8 bytes/cell of HBM for the removal of the step's
+    dominant cost at high dim (see ``make_sparse_step_bucketed``).
     """
     from flinkml_tpu.ops.sparse import pack_ell_buckets
 
@@ -566,7 +646,11 @@ def prepare_sparse_buckets(
         ]
         n_local = idx_pad.shape[0] // p_size
         share = max(1, math.ceil(global_batch_size * rows.size / (n * p_size)))
-        local_bss.append(min(share, n_local))
+        local_bs = min(share, n_local)
+        local_bss.append(local_bs)
+        if sorted_scatter:
+            perm, sids = _window_sort_tables(idx_pad, p_size, local_bs)
+            data_args += [mesh.shard_batch(perm), mesh.shard_batch(sids)]
     return tuple(data_args), tuple(local_bss)
 
 
@@ -609,12 +693,15 @@ def train_linear_model_sparse_csr(
     n = np.asarray(indptr).size - 1
     if n == 0:
         raise ValueError("training table is empty")
+    sorted_scatter = _sorted_scatter_enabled()
     data_args, local_bss = prepare_sparse_buckets(
         indptr, indices, values, dim, y, w, mesh, global_batch_size,
         max_buckets=max_buckets, dtype=dtype, seed=seed,
+        sorted_scatter=sorted_scatter,
     )
     trainer = _sparse_trainer_bucketed(
-        mesh.mesh, loss, tuple(local_bss), DeviceMesh.DATA_AXIS, int(dim)
+        mesh.mesh, loss, tuple(local_bss), DeviceMesh.DATA_AXIS, int(dim),
+        sorted_scatter,
     )
     return _run_chunked(
         trainer, tuple(data_args), int(dim), jnp.dtype(dtype),

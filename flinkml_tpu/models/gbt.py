@@ -43,7 +43,12 @@ from flinkml_tpu.common_params import (
     HasSeed,
     HasWeightCol,
 )
-from flinkml_tpu.models._data import check_binary_labels, labeled_data
+from flinkml_tpu.models._data import (
+    check_binary_labels,
+    hashed_feature_matrix,
+    labeled_data,
+    sparse_features,
+)
 from flinkml_tpu.params import FloatParam, IntParam, ParamValidators
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
@@ -78,6 +83,15 @@ class _GBTParams(
         "to the prefix with the best holdout loss (0 = off; boosted "
         "estimators only).",
         0.0, ParamValidators.in_range(0.0, 0.9),
+    )
+    NUM_HASH_FEATURES = IntParam(
+        "numHashFeatures",
+        "Bundle width for SparseVector feature columns: sparse inputs "
+        "(one-hot / hashed text) are hash-bundled into this many dense "
+        "features before binning, so trees train in O(n x numHashFeatures) "
+        "memory regardless of the sparse dimensionality. Dense inputs "
+        "ignore it.",
+        256, ParamValidators.in_range(2, 1 << 16),
     )
 
 
@@ -315,11 +329,39 @@ class _GBTBase(_GBTParams, Estimator):
     def _feat_fraction(self, d: int) -> float:
         return 1.0
 
-    def _fit_forest(self, table: Table):
-        x, y, w = labeled_data(
-            table, self.get(self.FEATURES_COL), self.get(self.LABEL_COL),
-            self.get(self.WEIGHT_COL),
+    def _labeled_maybe_hashed(self, table: Table):
+        """(x, y, w, hash_features): SparseVector feature columns are
+        hash-bundled to ``numHashFeatures`` dense columns (0 = dense
+        input) so one-hot/text pipelines feed trees without densifying
+        to the full sparse dimensionality."""
+        features_col = self.get(self.FEATURES_COL)
+        sp = sparse_features(table, features_col)
+        if sp is None:
+            x, y, w = labeled_data(
+                table, features_col, self.get(self.LABEL_COL),
+                self.get(self.WEIGHT_COL),
+            )
+            return x, y, w, 0
+        n_hash = self.get(self.NUM_HASH_FEATURES)
+        x = hashed_feature_matrix(sp, n_hash).astype(np.float64)
+        y = np.asarray(
+            table.column(self.get(self.LABEL_COL)), np.float64
+        ).reshape(-1)
+        if y.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"label column has {y.shape[0]} rows, features have "
+                f"{x.shape[0]}"
+            )
+        weight_col = self.get(self.WEIGHT_COL)
+        w = (
+            np.asarray(table.column(weight_col), np.float64).reshape(-1)
+            if weight_col is not None
+            else np.ones(x.shape[0], np.float64)
         )
+        return x, y, w, n_hash
+
+    def _fit_forest(self, table: Table):
+        x, y, w, hash_features = self._labeled_maybe_hashed(table)
         if self._LOGISTIC:
             # Validate on the FULL label column, before any holdout split
             # (an invalid label permuted into the holdout would silently
@@ -388,7 +430,8 @@ class _GBTBase(_GBTParams, Estimator):
             feats, thrs, gains, leaves = self._truncate_to_best_prefix(
                 holdout, feats, thrs, gains, leaves, base, depth,
             )
-        return (feats, thrs, gains, leaves, base, depth, x.shape[1])
+        return (feats, thrs, gains, leaves, base, depth, x.shape[1],
+                hash_features)
 
     def _truncate_to_best_prefix(self, holdout, feats, thrs, gains, leaves,
                                  base, depth):
@@ -432,11 +475,21 @@ class _GBTBase(_GBTParams, Estimator):
             cache = source
             columns = (features_col, label_col, weight_col)
         else:
+            hash_seen = [None]  # None until first batch decides the mode
+
             def batches():
                 for t in source:
-                    x, y, w = labeled_data(
-                        t, features_col, label_col, weight_col
-                    )
+                    # The hashing is stateless (pure function of column
+                    # id), so per-batch bundling is consistent across the
+                    # stream — but the mode must not flip mid-stream.
+                    x, y, w, nh = self._labeled_maybe_hashed(t)
+                    if hash_seen[0] is None:
+                        hash_seen[0] = nh
+                    elif hash_seen[0] != nh:
+                        raise ValueError(
+                            "stream mixes sparse and dense feature "
+                            "batches; use one representation throughout"
+                        )
                     yield {"x": x.astype(np.float32),
                            "y": y.astype(np.float32),
                            "w": w.astype(np.float32)}
@@ -469,7 +522,11 @@ class _GBTBase(_GBTParams, Estimator):
             [edges, np.full((edges.shape[0], 1), np.inf)], axis=1
         )
         thrs = edges_inf[feats, np.minimum(bins, edges_inf.shape[1] - 1)]
-        return (feats, thrs, gains, leaves, base, depth, edges.shape[0])
+        hash_features = (
+            0 if isinstance(source, DataCache) else (hash_seen[0] or 0)
+        )
+        return (feats, thrs, gains, leaves, base, depth, edges.shape[0],
+                hash_features)
 
     _MODEL_CLS = None   # set per concrete estimator
 
@@ -479,7 +536,8 @@ class _GBTBase(_GBTParams, Estimator):
             forest = self._fit_forest(table)
         else:
             forest = self._fit_stream_forest(table)
-        feats, thrs, gains, leaves, base, depth, n_features = forest
+        (feats, thrs, gains, leaves, base, depth, n_features,
+         hash_features) = forest
         model = self._MODEL_CLS()
         model.copy_params_from(self)
         # Bagged forests predict the MEAN of tree outputs (lr = 1/T);
@@ -489,7 +547,7 @@ class _GBTBase(_GBTParams, Estimator):
             else 1.0 / feats.shape[0]
         )
         model._set_forest(feats, thrs, leaves, base, depth, lr,
-                          gains, n_features)
+                          gains, n_features, hash_features)
         return model
 
 
@@ -506,9 +564,10 @@ class _GBTModelBase(_GBTParams, Model):
         self._lr: float = 0.1
         self._gains: Optional[np.ndarray] = None
         self._n_features: int = 0
+        self._hash_features: int = 0
 
     def _set_forest(self, feats, thrs, leaves, base, depth, lr,
-                    gains=None, n_features=None):
+                    gains=None, n_features=None, hash_features=0):
         self._feats = np.asarray(feats, np.int64)
         self._thrs = np.asarray(thrs, np.float64)
         self._leaves = np.asarray(leaves, np.float64)
@@ -523,6 +582,9 @@ class _GBTModelBase(_GBTParams, Model):
             int(n_features) if n_features is not None
             else int(self._feats.max()) + 1
         )
+        # > 0 when the forest was trained on hash-bundled sparse input:
+        # transform must apply the same stateless bundling.
+        self._hash_features = int(hash_features)
 
     def set_model_data(self, *inputs: Table):
         (table,) = inputs
@@ -537,6 +599,10 @@ class _GBTModelBase(_GBTParams, Model):
                 int(table.column("numFeatures")[0])
                 if "numFeatures" in table else None
             ),
+            hash_features=(
+                int(table.column("hashFeatures")[0])
+                if "hashFeatures" in table else 0
+            ),
         )
         return self
 
@@ -550,6 +616,7 @@ class _GBTModelBase(_GBTParams, Model):
             "depth": np.full(t, self._depth),
             "learningRate": np.full(t, self._lr),
             "numFeatures": np.full(t, self._n_features),
+            "hashFeatures": np.full(t, self._hash_features),
         })]
 
     def _require(self) -> None:
@@ -579,9 +646,15 @@ class _GBTModelBase(_GBTParams, Model):
         return imp / total if total > 0 else imp
 
     def _margin(self, table: Table) -> np.ndarray:
-        x = np.asarray(
-            table.column(self.get(self.FEATURES_COL)), dtype=np.float64
-        )
+        col = table.column(self.get(self.FEATURES_COL))
+        if self._hash_features and col.dtype == object:
+            # Hash-trained forest scoring sparse input: apply the same
+            # stateless bundling the estimator used.
+            x = hashed_feature_matrix(
+                col, self._hash_features
+            ).astype(np.float64)
+        else:
+            x = np.asarray(col, dtype=np.float64)
         if x.ndim != 2:
             raise ValueError(f"features must be [n, d], got {x.shape}")
         if self._feats.size and self._feats.max() >= x.shape[1]:
@@ -602,6 +675,7 @@ class _GBTModelBase(_GBTParams, Model):
             "depth": np.asarray(self._depth),
             "learningRate": np.asarray(self._lr),
             "numFeatures": np.asarray(self._n_features),
+            "hashFeatures": np.asarray(self._hash_features),
         })
 
     @classmethod
@@ -615,6 +689,7 @@ class _GBTModelBase(_GBTParams, Model):
             n_features=(
                 int(arrays["numFeatures"]) if "numFeatures" in arrays else None
             ),
+            hash_features=int(arrays.get("hashFeatures", 0)),
         )
         return model
 

@@ -128,12 +128,28 @@ def _m_step(r_k, r_x, r_xx, cov_type: str):
 
 
 class GaussianMixture(_GMMParams, Estimator):
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    """``fit`` accepts, besides a single in-RAM :class:`Table`, an
+    iterable of batch Tables or a sealed
+    :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
+    out-of-core path (round 3): each EM iteration replays the cache,
+    accumulating the psum'd sufficient statistics batch-by-batch with
+    bounded HBM residency (reference: ``ReplayOperator.java:62-250``)."""
+
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
 
-    def fit(self, *inputs: Table) -> "GaussianMixtureModel":
+    def fit(self, *inputs) -> "GaussianMixtureModel":
         (table,) = inputs
+        if not isinstance(table, Table):
+            return self._fit_stream(table)
         x = features_matrix(table, self.get(self.FEATURES_COL))
         n, d = x.shape
         k = self.get(self.K)
@@ -168,6 +184,128 @@ class GaussianMixture(_GMMParams, Estimator):
             r_k, r_x, r_xx, ll, n_tot = step(
                 xd, wd, f32(weights), f32(means), f32(covs)
             )
+            weights, means, covs = _m_step(
+                np.asarray(r_k, np.float64), np.asarray(r_x, np.float64),
+                np.asarray(r_xx, np.float64), cov_type,
+            )
+            ll = float(ll) / float(n_tot)
+            if not np.isfinite(ll):
+                raise FloatingPointError(
+                    "GaussianMixture log-likelihood became non-finite; "
+                    "the data may be degenerate (try covarianceType='diag' "
+                    "or fewer components)"
+                )
+            if abs(ll - prev_ll) <= self.get(self.TOL):
+                prev_ll = ll
+                break
+            prev_ll = ll
+        model = GaussianMixtureModel()
+        model.copy_params_from(self)
+        model._set(weights, means + shift[None, :], covs)
+        return model
+
+    def _fit_stream(self, source) -> "GaussianMixtureModel":
+        """Out-of-core EM (see class docstring). Pass 0 caches the stream
+        while accumulating mean/variance sums (for the centering shift
+        and init covariances) and reservoir-sampling rows for k-means++
+        seeding; each EM iteration replays the cache batch-by-batch."""
+        from flinkml_tpu.iteration.datacache import (
+            DataCache,
+            DataCacheWriter,
+            PrefetchingDeviceFeed,
+        )
+        from flinkml_tpu.models.kmeans import _kmeans_pp_init
+        from flinkml_tpu.parallel import pad_to_multiple
+        from flinkml_tpu.utils.sampling import RowReservoir
+
+        features_col = self.get(self.FEATURES_COL)
+        k = self.get(self.K)
+        cov_type = self.get(self.COVARIANCE_TYPE)
+        mesh = self.mesh or DeviceMesh()
+        row_tile = mesh.axis_size() * 8
+        column = features_col if isinstance(source, DataCache) else "x"
+
+        # -- pass 0: cache + running moments + init row sample -------------
+        reservoir = RowReservoir(65_536, seed=self.get_seed())
+        sum_x = None
+        sum_xx = None
+        count = 0
+        d = None
+
+        def ingest(x):
+            nonlocal sum_x, sum_xx, count, d
+            if x.ndim != 2 or x.shape[0] == 0:
+                raise ValueError(
+                    f"stream batches must be non-empty [n, d], got {x.shape}"
+                )
+            if d is None:
+                d = x.shape[1]
+            elif x.shape[1] != d:
+                raise ValueError(
+                    f"batch feature dim {x.shape[1]} != first batch's {d}"
+                )
+            reservoir.add(x)
+            s = x.astype(np.float64)
+            sum_x = s.sum(0) if sum_x is None else sum_x + s.sum(0)
+            sq = (s * s).sum(0)
+            sum_xx = sq if sum_xx is None else sum_xx + sq
+            count += x.shape[0]
+
+        if isinstance(source, DataCache):
+            cache = source
+            for batch in cache.reader():
+                ingest(np.asarray(batch[column], np.float32))
+        else:
+            writer = DataCacheWriter(
+                self.cache_dir, self.cache_memory_budget_bytes
+            )
+            for t in source:
+                x = features_matrix(t, features_col).astype(np.float32)
+                ingest(x)
+                writer.append({column: np.array(x)})
+            cache = writer.finish()
+        if count < k:
+            raise ValueError(f"n_rows={count} < k={k}")
+
+        mean = sum_x / count
+        var = np.maximum(sum_xx / count - mean * mean, _REG)
+        shift = mean  # centered-space EM, as the in-RAM path (f32 safety)
+
+        rng = np.random.default_rng(self.get_seed())
+        sample = reservoir.sample().astype(np.float64) - shift[None, :]
+        means = np.asarray(_kmeans_pp_init(sample, k, rng), np.float64)
+        if cov_type == "diag":
+            covs = np.tile(var[None, :], (k, 1))
+        else:
+            covs = np.tile(np.diag(var)[None], (k, 1, 1))
+        weights = np.full(k, 1.0 / k)
+
+        step = _em_step_fn(mesh.mesh, DeviceMesh.DATA_AXIS, k, cov_type)
+        f32 = lambda a: jnp.asarray(a, jnp.float32)
+
+        def place(batch):
+            x = np.asarray(batch[column], np.float32) - shift.astype(
+                np.float32
+            )[None, :]
+            x_pad, n_valid = pad_to_multiple(x, row_tile)
+            wl = np.zeros(x_pad.shape[0], np.float32)
+            wl[:n_valid] = 1.0
+            return mesh.shard_batch(x_pad), mesh.shard_batch(wl)
+
+        prev_ll = -np.inf
+        for _ in range(self.get(self.MAX_ITER)):
+            acc = None
+            feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
+            try:
+                for xb, wl in feed:
+                    out = step(xb, wl, f32(weights), f32(means), f32(covs))
+                    acc = (
+                        out if acc is None
+                        else tuple(a + b for a, b in zip(acc, out))
+                    )
+            finally:
+                feed.close()
+            r_k, r_x, r_xx, ll, n_tot = acc
             weights, means, covs = _m_step(
                 np.asarray(r_k, np.float64), np.asarray(r_x, np.float64),
                 np.asarray(r_xx, np.float64), cov_type,

@@ -1,0 +1,38 @@
+"""Margin-loss math shared by every linear trainer.
+
+The single source of ``d loss/d margin`` (and per-example loss) for the
+linear-model family — the TPU counterpart of the reference's per-record
+loss kernels (``LogisticGradient.java:50-96`` for logistic; hinge and
+squared extend the family). Lives in its own module so every consumer
+(dense stepper, sparse steppers, streamed stepper) uses identical math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def margin_terms(loss: str, dot, y, w):
+    """(d loss/d margin, per-example loss), weighted.
+
+    Labels ``y`` are {0, 1}; margin losses map them to ``ys = 2y - 1``.
+    """
+    if loss == "logistic":
+        ys = 2.0 * y - 1.0
+        margin = dot * ys
+        mult = w * (-ys * jax.nn.sigmoid(-margin))
+        per_ex = w * jax.nn.softplus(-margin)
+    elif loss == "hinge":
+        ys = 2.0 * y - 1.0
+        margin = dot * ys
+        active = (margin < 1.0).astype(dot.dtype)
+        mult = w * (-ys * active)
+        per_ex = w * jnp.maximum(0.0, 1.0 - margin)
+    elif loss == "squared":
+        resid = dot - y
+        mult = w * resid
+        per_ex = 0.5 * w * resid * resid
+    else:  # pragma: no cover - guarded by callers
+        raise ValueError(f"unknown loss {loss!r}")
+    return mult, per_ex

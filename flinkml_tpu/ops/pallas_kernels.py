@@ -101,31 +101,10 @@ def _interpret() -> bool:
 # Fused linear-model gradient
 # ---------------------------------------------------------------------------
 
-def _margin_terms(loss: str, dot, y, w):
-    """(d loss/d margin, per-example loss), weighted.
-
-    The single source of the margin math — ``models._linear_sgd`` aliases
-    this as ``_margin_grad`` so the fused and unfused paths cannot drift.
-    Losses mirror the reference (``LogisticGradient.java:50-96`` for
-    logistic; hinge/squared extend the family)."""
-    if loss == "logistic":
-        ys = 2.0 * y - 1.0
-        margin = dot * ys
-        mult = w * (-ys * jax.nn.sigmoid(-margin))
-        per_ex = w * jax.nn.softplus(-margin)
-    elif loss == "hinge":
-        ys = 2.0 * y - 1.0
-        margin = dot * ys
-        active = (margin < 1.0).astype(dot.dtype)
-        mult = w * (-ys * active)
-        per_ex = w * jnp.maximum(0.0, 1.0 - margin)
-    elif loss == "squared":
-        resid = dot - y
-        mult = w * resid
-        per_ex = 0.5 * w * resid * resid
-    else:  # pragma: no cover - guarded by callers
-        raise ValueError(f"unknown loss {loss!r}")
-    return mult, per_ex
+# The single source of the margin math is ``ops.losses.margin_terms``;
+# the fused kernels and every unfused stepper share it so the paths
+# cannot drift.
+from flinkml_tpu.ops.losses import margin_terms as _margin_terms  # noqa: E402
 
 
 def _linear_grad_kernel(loss: str, acc_dt, x_ref, y_ref, w_ref, coef_ref,

@@ -99,6 +99,27 @@ class DeviceMesh:
             lambda a: jax.device_put(a, sharding), tree
         )
 
+    def global_batch(self, local_rows) -> jax.Array:
+        """Assemble a globally-sharded batch from THIS PROCESS's rows.
+
+        The multi-host ingest primitive (the reference's per-subtask
+        stream partitions): each host passes only its
+        :func:`~flinkml_tpu.parallel.process_slice` of the dataset; the
+        returned array is the concatenation of every host's rows, sharded
+        over the data axis, without any host materializing the whole
+        dataset. Single-process this is exactly :meth:`shard_batch`.
+
+        ``local_rows`` must be divisible by the local device count (every
+        process contributes equally per device — pad the *global* dataset
+        so every host slice divides evenly).
+        """
+        local_rows = np.asarray(local_rows)
+        if jax.process_count() == 1:
+            return self.shard_batch(local_rows)
+        return jax.make_array_from_process_local_data(
+            self.data_sharding(), local_rows
+        )
+
 
 def pad_to_multiple(array: np.ndarray, multiple: int, axis: int = 0):
     """Zero-pad ``array`` along ``axis`` to a multiple; returns (padded, n_valid).

@@ -1,0 +1,25 @@
+"""IT: the user-facing multi-host pod recipe actually runs as a 2-process
+Gloo pod (VERDICT r2 item 7 'done' criterion).
+
+The reference's analog is its MiniCluster system tests exercising the
+multi-worker control plane (``SharedProgressAligner.java:127-158``,
+SURVEY.md §4 tier 3).
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_multihost_pod_example_local_demo():
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    example = os.path.join(repo_root, "examples", "multihost_pod.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, example, "--local-demo"],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "LOCAL DEMO OK" in out.stdout, out.stdout

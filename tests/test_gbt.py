@@ -359,3 +359,104 @@ def test_early_stopping_regressor_path():
     assert stopped._feats.shape[0] < 60
     (out,) = stopped.transform(Table({"features": x[220:]}))
     assert r2_score(y[220:], out["prediction"]) > 0.5
+
+
+# -- sparse (hash-bundled) inputs (round-3: VERDICT r2 item 8) ---------------
+
+def _sparse_cat_table(n=600, cardinality=1_000_000, seed=0):
+    """SparseVector features at a cardinality where densifying would need
+    n x 1e6 floats (the pipeline shape sparse LR consumes): a handful of
+    informative real-valued columns + high-cardinality one-hot noise.
+    The hash bundling must land each informative column in a stable
+    bucket whose value the trees can split on."""
+    from flinkml_tpu.linalg import SparseVector
+
+    rng = np.random.default_rng(seed)
+    info_cols = [12_345, 777_777, 424_242]
+    col = np.empty(n, dtype=object)
+    y = np.empty(n, np.float64)
+    for i in range(n):
+        v = rng.normal(size=len(info_cols))
+        noise_ids = rng.choice(cardinality, size=4, replace=False)
+        ids = np.concatenate([np.asarray(info_cols), noise_ids])
+        vals = np.concatenate([v, np.full(4, 0.01)])
+        uniq, first = np.unique(ids, return_index=True)
+        col[i] = SparseVector(cardinality, uniq, vals[first])
+        y[i] = float(v[0] + 0.5 * v[1] - 0.5 * v[2] > 0)
+    return Table({"features": col, "label": y}), y
+
+
+def test_gbt_trains_on_sparse_without_densifying():
+    from flinkml_tpu.models import GBTClassifier
+
+    table, y = _sparse_cat_table()
+    model = (
+        GBTClassifier().set_num_trees(15).set_max_depth(4)
+        .set_max_bins(32).set_num_hash_features(512)
+        .set_learning_rate(0.5).set_seed(0)
+        .fit(table)
+    )
+    # The forest was trained on the bundled space, not the 1e6-dim one.
+    assert model._hash_features == 512
+    assert model._n_features == 512
+    (out,) = model.transform(table)
+    acc = float(np.mean(out["prediction"] == y))
+    # Memorization regime: hash buckets of ~half-positive/half-negative
+    # categories bound the ceiling; well above chance proves learning.
+    assert acc > 0.8, acc
+
+
+def test_gbt_sparse_model_persistence_round_trip(tmp_path):
+    from flinkml_tpu.models import GBTClassifier, GBTClassifierModel
+
+    table, _ = _sparse_cat_table(n=200)
+    model = (
+        GBTClassifier().set_num_trees(5).set_max_depth(3)
+        .set_num_hash_features(64).set_seed(0).fit(table)
+    )
+    (out,) = model.transform(table)
+    model.save(str(tmp_path / "sgbt"))
+    loaded = GBTClassifierModel.load(str(tmp_path / "sgbt"))
+    assert loaded._hash_features == 64
+    (out2,) = loaded.transform(table)
+    np.testing.assert_array_equal(out["prediction"], out2["prediction"])
+    # Model-data tables carry the bundling width too.
+    m3 = GBTClassifierModel()
+    m3.copy_params_from(model)
+    m3.set_model_data(*model.get_model_data())
+    (out3,) = m3.transform(table)
+    np.testing.assert_array_equal(out["prediction"], out3["prediction"])
+
+
+def test_gbt_sparse_streamed_fit(tmp_path):
+    from flinkml_tpu.models import GBTClassifier
+
+    tables = []
+    ys = []
+    for s in range(4):
+        t, y = _sparse_cat_table(n=200, seed=s)
+        tables.append(t)
+        ys.append(y)
+    model = (
+        GBTClassifier(cache_dir=str(tmp_path / "sp"),
+                      cache_memory_budget_bytes=1)
+        .set_num_trees(10).set_max_depth(4).set_num_hash_features(256)
+        .set_learning_rate(0.5).set_seed(0)
+        .fit(iter(tables))
+    )
+    assert model._hash_features == 256
+    (out,) = model.transform(tables[0])
+    acc = float(np.mean(out["prediction"] == ys[0]))
+    assert acc > 0.7, acc
+
+
+def test_random_forest_on_sparse_input():
+    from flinkml_tpu.models import RandomForestClassifier
+
+    table, y = _sparse_cat_table(n=300)
+    model = (
+        RandomForestClassifier().set_num_trees(20).set_max_depth(6)
+        .set_num_hash_features(128).set_seed(0).fit(table)
+    )
+    (out,) = model.transform(table)
+    assert float(np.mean(out["prediction"] == y)) > 0.7

@@ -417,3 +417,59 @@ def test_gbt_stream_rejects_rf_and_validation_fraction(mesh):
     with pytest.raises(ValueError, match="validationFraction"):
         (GBTClassifier(mesh=mesh).set_validation_fraction(0.2)
          .fit(iter(tables)))
+
+
+# -- streamed GMM (round-3) --------------------------------------------------
+
+def test_gmm_streamed_fit_recovers_components(tmp_path, mesh):
+    from flinkml_tpu.models import GaussianMixture
+    from flinkml_tpu.table import Table
+
+    rng = np.random.default_rng(0)
+    true_means = np.asarray([[-4.0, 0.0], [4.0, 2.0], [0.0, -5.0]])
+    tables = []
+    for _ in range(6):
+        a = rng.integers(0, 3, 256)
+        x = true_means[a] + rng.normal(scale=0.4, size=(256, 2))
+        tables.append(Table({"features": x.astype(np.float32)}))
+    model = (
+        GaussianMixture(mesh=mesh, cache_dir=str(tmp_path / "gmm"),
+                        cache_memory_budget_bytes=1)
+        .set_k(3).set_max_iter(30).set_tol(1e-5).set_seed(0)
+        .fit(iter(tables))
+    )
+    got = np.sort(np.round(model.means).astype(int), axis=0)
+    want = np.sort(true_means.astype(int), axis=0)
+    np.testing.assert_array_equal(got, want)
+    assert np.allclose(model.weights.sum(), 1.0)
+
+
+def test_gmm_streamed_matches_in_ram(mesh):
+    """Same data, same seed: the streamed EM (batch-accumulated stats,
+    reservoir-covering-all-rows init) matches the in-RAM fit closely."""
+    from flinkml_tpu.models import GaussianMixture
+    from flinkml_tpu.table import Table
+
+    rng = np.random.default_rng(3)
+    true_means = np.asarray([[-3.0, 1.0], [3.0, -1.0]])
+    a = rng.integers(0, 2, 600)
+    x = (true_means[a] + rng.normal(scale=0.5, size=(600, 2))).astype(
+        np.float32
+    )
+    est = lambda: (
+        GaussianMixture(mesh=mesh).set_k(2).set_max_iter(25)
+        .set_tol(0.0).set_seed(0).set_covariance_type("diag")
+    )
+    in_ram = est().fit(Table({"features": x}))
+    tables = [
+        Table({"features": x[i * 150:(i + 1) * 150]}) for i in range(4)
+    ]
+    streamed = est().fit(iter(tables))
+    order_a = np.argsort(in_ram.means[:, 0])
+    order_b = np.argsort(streamed.means[:, 0])
+    np.testing.assert_allclose(
+        streamed.means[order_b], in_ram.means[order_a], atol=0.05
+    )
+    np.testing.assert_allclose(
+        streamed.weights[order_b], in_ram.weights[order_a], atol=0.02
+    )
