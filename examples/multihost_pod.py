@@ -60,6 +60,7 @@ def worker(workdir: str) -> None:
         host_barrier,
         init_distributed,
         process_slice,
+        synced_loop,
     )
 
     def log(msg):
@@ -112,10 +113,18 @@ def worker(workdir: str) -> None:
 
     coef = jnp.zeros(dim, jnp.float32)
     lr = jnp.asarray(1.0, jnp.float32)
-    for i in range(60):
-        coef = stepper(coef, xg, yg, lr)
+
+    # synced_loop bounds in-flight cross-process dispatches (the framework's
+    # backpressure policy — see flinkml_tpu.parallel.dispatch): a bare
+    # `for` loop that enqueues all 60 collective steps without host sync
+    # wedges the multi-process backend permanently.
+    def one_step(c, i):
+        c = stepper(c, xg, yg, lr)
         if i == 0:
             log("first step compiled + ran")
+        return c
+
+    coef = synced_loop(60, one_step, coef)
     coef_host = np.asarray(coef)
     log("training done")
 
@@ -183,6 +192,15 @@ def _local_demo() -> None:
 
 
 if __name__ == "__main__":
+    # Runnable standalone from any cwd (including the spawned --worker
+    # subprocesses, whose sys.path[0] is examples/): put the repo root on
+    # sys.path when flinkml_tpu isn't already importable.
+    try:
+        import flinkml_tpu  # noqa: F401
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
     if "--worker" in sys.argv:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         import jax

@@ -265,6 +265,13 @@ def iterate(
     outputs: List[Any] = []
     epoch = start_epoch
     terminated = False
+    # Criteria-less loops never touch host values, so nothing would bound
+    # in-flight dispatch on a multi-process mesh — the guard is the
+    # framework backpressure policy (no-op single-process). Loops that
+    # return a criteria already sync via float(criteria) each epoch.
+    from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+    guard = DispatchGuard()
     while not terminated:
         batch, exhausted = _epoch_data(data, epoch, data_iter)
         if exhausted:
@@ -283,6 +290,8 @@ def iterate(
             outputs.append(output)
 
         criteria_value = None if criteria is None else float(criteria)
+        if criteria_value is None:
+            guard.after_dispatch(state)
         criteria_history.append(criteria_value)
 
         for listener in listeners:
@@ -298,6 +307,7 @@ def iterate(
         ):
             config.checkpoint_manager.save(state, epoch)
 
+    guard.flush(state)  # back-to-back phases must not stack in-flight work
     if config.checkpoint_manager is not None and hasattr(
         config.checkpoint_manager, "wait"
     ):

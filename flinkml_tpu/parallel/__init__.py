@@ -10,6 +10,11 @@ from flinkml_tpu.parallel.broadcast_utils import (
     get_broadcast_variable,
     with_broadcast,
 )
+from flinkml_tpu.parallel.dispatch import (
+    DispatchGuard,
+    default_sync_interval,
+    synced_loop,
+)
 from flinkml_tpu.parallel.distributed import (
     host_barrier,
     init_distributed,
@@ -34,6 +39,9 @@ __all__ = [
     "BroadcastContext",
     "get_broadcast_variable",
     "with_broadcast",
+    "DispatchGuard",
+    "default_sync_interval",
+    "synced_loop",
     "host_barrier",
     "init_distributed",
     "process_slice",

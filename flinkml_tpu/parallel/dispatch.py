@@ -1,0 +1,106 @@
+"""Bounded in-flight dispatch — backpressure for multi-process meshes.
+
+JAX dispatch is asynchronous: a jitted call enqueues an XLA program and
+returns futures immediately. On a single process the runtime's own queue
+depth bounds outstanding work, but on a multi-process mesh nothing bounds
+the number of *cross-process collective* programs in flight — and the CPU
+(Gloo) backend wedges permanently when a host loop enqueues too many
+collective steps without ever synchronizing (measured on a 2-process
+mesh: ≤20 in-flight ``psum`` steps drain in milliseconds; 60 deadlock
+the pod).
+
+The reference never faces this because Flink's credit-based network flow
+control backpressures every shuffle a collective rides
+(``AllReduceImpl.java:52-299`` runs on those channels). This module is
+that policy for SPMD hosts: materialize the loop carry every ``interval``
+dispatches, so at most ``interval`` collective programs are ever
+outstanding. Single-process meshes default to unbounded (XLA's own queue
+is sufficient and extra host syncs only add latency).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import jax
+
+_ENV_INTERVAL = "FLINKML_SYNC_INTERVAL"
+_DEFAULT_MULTIPROCESS_INTERVAL = 8
+
+
+def default_sync_interval() -> int:
+    """The framework's in-flight dispatch bound for this process.
+
+    ``0`` means unbounded (single-process meshes: the local runtime queue
+    is bound enough). Multi-process meshes default to
+    ``8`` — comfortably under the measured ~20-dispatch wedge threshold
+    of the Gloo CPU backend while keeping the device pipeline fed.
+    Override with ``FLINKML_SYNC_INTERVAL`` (any positive integer, or
+    ``0`` to disable at your own risk).
+    """
+    env = os.environ.get(_ENV_INTERVAL)
+    if env is not None:
+        return max(0, int(env))
+    if jax.process_count() > 1:
+        return _DEFAULT_MULTIPROCESS_INTERVAL
+    return 0
+
+
+class DispatchGuard:
+    """Counts dispatches and blocks on the carry every ``interval`` steps.
+
+    Usage::
+
+        guard = DispatchGuard()           # policy from default_sync_interval()
+        for i in range(n_steps):
+            carry = stepper(carry, batch)
+            carry = guard.after_dispatch(carry)
+
+    ``after_dispatch`` returns its argument unchanged so it can be chained
+    into the loop carry assignment. Pass ``interval=0`` to make it a no-op
+    (single-process default), or an explicit positive bound.
+    """
+
+    def __init__(self, interval: Optional[int] = None):
+        self.interval = (
+            default_sync_interval() if interval is None else max(0, int(interval))
+        )
+        self._since_sync = 0
+
+    def after_dispatch(self, carry: Any) -> Any:
+        self._since_sync += 1
+        if self.interval and self._since_sync >= self.interval:
+            jax.block_until_ready(carry)
+            self._since_sync = 0
+        return carry
+
+    def flush(self, carry: Any) -> Any:
+        """Force a synchronization point (end of a training phase)."""
+        if self._since_sync:
+            jax.block_until_ready(carry)
+            self._since_sync = 0
+        return carry
+
+
+def synced_loop(
+    n_steps: int,
+    step_fn: Callable[[Any, int], Any],
+    init: Any,
+    interval: Optional[int] = None,
+) -> Any:
+    """Run ``carry = step_fn(carry, i)`` ``n_steps`` times with bounded
+    in-flight dispatch.
+
+    The host-loop counterpart of ``iteration.device_loop.device_iterate``
+    for bodies that must stay host-driven (per-step data feeding,
+    listeners) on a multi-process mesh: every ``interval`` dispatches the
+    carry is materialized, so cross-process collectives can never pile up
+    past the backend's safe queue depth. With ``interval=None`` the
+    framework default applies (unbounded single-process, 8 multi-process).
+    """
+    guard = DispatchGuard(interval)
+    carry = init
+    for i in range(int(n_steps)):
+        carry = guard.after_dispatch(step_fn(carry, i))
+    return guard.flush(carry)

@@ -1,0 +1,52 @@
+"""Unit tests for the bounded in-flight dispatch policy (single-process
+half; the 2-process sustained-dispatch IT lives in test_distributed.py).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from flinkml_tpu.parallel import (
+    DispatchGuard,
+    default_sync_interval,
+    synced_loop,
+)
+
+
+def test_default_interval_single_process_unbounded(monkeypatch):
+    monkeypatch.delenv("FLINKML_SYNC_INTERVAL", raising=False)
+    assert default_sync_interval() == 0
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("FLINKML_SYNC_INTERVAL", "4")
+    assert default_sync_interval() == 4
+    monkeypatch.setenv("FLINKML_SYNC_INTERVAL", "0")
+    assert default_sync_interval() == 0
+
+
+def test_guard_blocks_every_interval(monkeypatch):
+    syncs = []
+    guard = DispatchGuard(interval=3)
+    monkeypatch.setattr(
+        "flinkml_tpu.parallel.dispatch.jax.block_until_ready",
+        lambda c: syncs.append(c) or c,
+    )
+    for i in range(7):
+        guard.after_dispatch(i)
+    assert syncs == [2, 5]  # after dispatches 3 and 6
+    guard.flush(99)
+    assert syncs == [2, 5, 99]  # one pending dispatch forced out
+    guard.flush(100)
+    assert syncs == [2, 5, 99]  # nothing pending: no extra sync
+
+
+def test_synced_loop_runs_all_steps_and_returns_carry():
+    out = synced_loop(10, lambda c, i: c + jnp.float32(i), jnp.float32(0),
+                      interval=4)
+    assert float(out) == sum(range(10))
+
+
+def test_synced_loop_zero_steps():
+    init = jnp.arange(3.0)
+    out = synced_loop(0, lambda c, i: pytest.fail("must not run"), init)
+    assert out is init
