@@ -28,6 +28,36 @@ import jax
 import numpy as np
 
 
+def begin_resume(manager: Optional["CheckpointManager"], resume: bool,
+                 world_size: int) -> Optional[int]:
+    """Step 1 of the streamed-trainer checkpoint protocol (shared by the
+    linear/KMeans/GBT/GMM streamed fits): validate the resume/manager
+    pairing and pin the rescale guard to the mesh that trains (NOT the
+    process-global device count). Returns the epoch to restore from, or
+    None for a fresh start — returned *before* any data pass so callers
+    can skip init work (reservoir sampling, seeding) whose result a
+    restore would discard."""
+    if resume and manager is None:
+        raise ValueError("resume=True requires a checkpoint_manager")
+    if manager is None:
+        return None
+    manager.world_size = world_size
+    return manager.latest_epoch() if resume else None
+
+
+def should_snapshot(manager: Optional["CheckpointManager"], interval: int,
+                    step: int, total: int) -> bool:
+    """Step 2 of the protocol — the save cadence: snapshot every
+    ``interval`` completed steps and always at the final step (so a
+    finished run resumes as a no-op). ``step`` counts completed units
+    (1-based), ``total`` is the run length in the same units."""
+    return (
+        manager is not None
+        and interval > 0
+        and (step == total or step % interval == 0)
+    )
+
+
 class CheckpointManager:
     """Numbered checkpoints of an arbitrary pytree under one directory.
 

@@ -156,12 +156,25 @@ def train_gbt_stream(
     reservoir_capacity: int = 65_536,
     prefetch_depth: int = 2,
     label_check: Optional[Callable[[np.ndarray], None]] = None,
+    checkpoint_manager=None,
+    checkpoint_interval: int = 0,
+    resume: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, float]:
     """Build a boosted forest from a sealed raw-feature ``DataCache``.
 
     Returns ``(feats[T, n_inner], bins[T, n_inner], gains[T, n_inner],
     leaves[T, n_leaves], base, edges[d, max_bins-1])`` — see the module
     docstring for the pass structure.
+
+    Fault tolerance (``Checkpoints.java:43-211``; the reference checkpoints
+    every bounded iteration's cached state): ``checkpoint_manager`` +
+    ``checkpoint_interval`` snapshot the between-tree state — per-row
+    margins, the partial forest, trees-built — every N trees (the unit of
+    recovery: a crash replays at most the in-flight tree's
+    ``depth + 1`` cache passes). ``resume=True`` restores the latest
+    snapshot and continues bit-exactly: passes A/B (edges + binned cache)
+    re-run deterministically from the same seed/cache, and the subsample
+    RNG is fast-forwarded one draw per completed tree.
     """
     from flinkml_tpu.models.gbt import bin_features, quantile_bin_edges
     from flinkml_tpu.utils.sampling import RowReservoir
@@ -253,6 +266,8 @@ def train_gbt_stream(
             n=n, base=base, edges=edges, learning_rate=learning_rate,
             reg_lambda=reg_lambda, subsample=subsample, rng=rng,
             prefetch_depth=prefetch_depth,
+            checkpoint_manager=checkpoint_manager,
+            checkpoint_interval=checkpoint_interval, resume=resume,
         )
     finally:
         if spill_dir is not None:
@@ -261,7 +276,8 @@ def train_gbt_stream(
 def _build_forest(
     binned_cache, ranges, *, mesh, logistic, num_trees, depth, max_bins,
     n_feat, n, base, edges, learning_rate, reg_lambda, subsample, rng,
-    prefetch_depth,
+    prefetch_depth, checkpoint_manager=None, checkpoint_interval=0,
+    resume=False,
 ):
     """The level-wise replay build over a sealed binned cache (see module
     docstring); split out of :func:`train_gbt_stream` so the binned spill
@@ -314,8 +330,28 @@ def _build_forest(
     gains_out = np.zeros((num_trees, n_inner), np.float32)
     leaves_out = np.zeros((num_trees, n_leaves), np.float32)
 
+    # -- checkpoint/resume: unit of recovery = one completed tree ----------
+    from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
+
+    resume_tree = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
+    start_tree = 0
+    if resume_tree is not None:
+        like = (pred, feats_out, bins_out, gains_out, leaves_out)
+        state, start_tree = checkpoint_manager.restore(resume_tree, like)
+        # np.array: these are mutated in place below; the restore must
+        # own its buffers.
+        pred, feats_out, bins_out, gains_out, leaves_out = (
+            np.array(a) for a in state
+        )
+        if subsample < 1.0:
+            # Fast-forward the subsample RNG one draw per completed tree
+            # so resumed trees see exactly the masks the uninterrupted
+            # run would have drawn (no generator-state serialization).
+            for _ in range(start_tree):
+                rng.random(n)
+
     lam = np.float64(reg_lambda)
-    for t in range(num_trees):
+    for t in range(start_tree, num_trees):
         if subsample < 1.0:
             mask = (rng.random(n) < subsample).astype(np.float32)
         node[:] = 0
@@ -378,4 +414,9 @@ def _build_forest(
         # Margin update is pure host work: node and pred are already
         # host-resident and leaf is [n_leaves] — no cache replay needed.
         pred += learning_rate * leaf[node]
+        if should_snapshot(checkpoint_manager, checkpoint_interval,
+                           t + 1, num_trees):
+            checkpoint_manager.save(
+                (pred, feats_out, bins_out, gains_out, leaves_out), t + 1
+            )
     return feats_out, bins_out, gains_out, leaves_out, base, edges

@@ -397,21 +397,15 @@ def _run_chunked(
       the host loop in ``iterate``; the device loop surfaces only chunk
       boundaries to the host).
     """
-    if resume and checkpoint_manager is None:
-        raise ValueError("resume=True requires a checkpoint_manager")
-    if checkpoint_manager is not None:
-        # Rescale guard compares against THIS trainer's mesh, not the
-        # process-global device count (they differ on subset meshes).
-        checkpoint_manager.world_size = mesh.mesh.size
+    from flinkml_tpu.iteration.checkpoint import begin_resume
 
+    resume_epoch = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
     coef = jnp.zeros(dim, dtype=dt)
     epoch = 0
     cur_loss = float("inf")
-    if resume:
-        restored = _restore_carry(checkpoint_manager, dim, dt)
-        if restored is not None:
-            coef_h, epoch, cur_loss = restored
-            coef = jnp.asarray(coef_h, dt)
+    if resume_epoch is not None:
+        coef_h, epoch, cur_loss = _restore_carry(checkpoint_manager, dim, dt)
+        coef = jnp.asarray(coef_h, dt)
 
     chunk = (
         checkpoint_interval
@@ -987,10 +981,9 @@ def train_linear_model_stream(
             "resume=True requires a durable DataCache input: a one-shot "
             "stream cannot be replayed from the start after a failure"
         )
-    if resume and checkpoint_manager is None:
-        raise ValueError("resume=True requires a checkpoint_manager")
-    if checkpoint_manager is not None:
-        checkpoint_manager.world_size = mesh.mesh.size
+    from flinkml_tpu.iteration.checkpoint import begin_resume
+
+    begin_resume(checkpoint_manager, resume, mesh.mesh.size)
 
     p_size = mesh.axis_size()
     row_tile = p_size * 8  # bounds the set of padded shapes → compilations

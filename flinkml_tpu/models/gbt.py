@@ -320,11 +320,17 @@ class _GBTBase(_GBTParams, Estimator):
         mesh: Optional[DeviceMesh] = None,
         cache_dir: Optional[str] = None,
         cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
     ):
         super().__init__()
         self.mesh = mesh
         self.cache_dir = cache_dir
         self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
     def _feat_fraction(self, d: int) -> float:
         return 1.0
@@ -517,6 +523,9 @@ class _GBTBase(_GBTParams, Estimator):
             seed=self.get_seed(),
             columns=columns,
             label_check=label_check,
+            checkpoint_manager=self.checkpoint_manager,
+            checkpoint_interval=self.checkpoint_interval,
+            resume=self.resume,
         )
         edges_inf = np.concatenate(
             [edges, np.full((edges.shape[0], 1), np.inf)], axis=1
@@ -533,6 +542,13 @@ class _GBTBase(_GBTParams, Estimator):
     def fit(self, *inputs):
         (table,) = inputs
         if isinstance(table, Table):
+            if self.checkpoint_manager is not None or self.resume:
+                raise ValueError(
+                    "checkpointing is supported for streamed fits only "
+                    "(pass an iterable of batch Tables or a DataCache); "
+                    "the in-RAM fit builds the whole forest in one device "
+                    "program"
+                )
             forest = self._fit_forest(table)
         else:
             forest = self._fit_stream_forest(table)

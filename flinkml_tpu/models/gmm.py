@@ -140,16 +140,27 @@ class GaussianMixture(_GMMParams, Estimator):
         mesh: Optional[DeviceMesh] = None,
         cache_dir: Optional[str] = None,
         cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
     ):
         super().__init__()
         self.mesh = mesh
         self.cache_dir = cache_dir
         self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
     def fit(self, *inputs) -> "GaussianMixtureModel":
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
+        if self.checkpoint_manager is not None or self.resume:
+            raise ValueError(
+                "checkpointing is supported for streamed fits only "
+                "(pass an iterable of batch Tables or a DataCache)"
+            )
         x = features_matrix(table, self.get(self.FEATURES_COL))
         n, d = x.shape
         k = self.get(self.K)
@@ -218,12 +229,24 @@ class GaussianMixture(_GMMParams, Estimator):
         from flinkml_tpu.parallel import pad_to_multiple
         from flinkml_tpu.utils.sampling import RowReservoir
 
+        from flinkml_tpu.iteration.checkpoint import (
+            begin_resume,
+            should_snapshot,
+        )
+
         features_col = self.get(self.FEATURES_COL)
         k = self.get(self.K)
         cov_type = self.get(self.COVARIANCE_TYPE)
         mesh = self.mesh or DeviceMesh()
         row_tile = mesh.axis_size() * 8
         column = features_col if isinstance(source, DataCache) else "x"
+
+        # Resume target decided BEFORE pass 0: pass 0 must still run (the
+        # centering shift comes from its moments) but a restore skips the
+        # reservoir sampling + k-means++ seeding it would discard.
+        resume_epoch = begin_resume(
+            self.checkpoint_manager, self.resume, mesh.mesh.size
+        )
 
         # -- pass 0: cache + running moments + init row sample -------------
         reservoir = RowReservoir(65_536, seed=self.get_seed())
@@ -244,7 +267,8 @@ class GaussianMixture(_GMMParams, Estimator):
                 raise ValueError(
                     f"batch feature dim {x.shape[1]} != first batch's {d}"
                 )
-            reservoir.add(x)
+            if resume_epoch is None:
+                reservoir.add(x)
             s = x.astype(np.float64)
             sum_x = s.sum(0) if sum_x is None else sum_x + s.sum(0)
             sq = (s * s).sum(0)
@@ -271,14 +295,17 @@ class GaussianMixture(_GMMParams, Estimator):
         var = np.maximum(sum_xx / count - mean * mean, _REG)
         shift = mean  # centered-space EM, as the in-RAM path (f32 safety)
 
-        rng = np.random.default_rng(self.get_seed())
-        sample = reservoir.sample().astype(np.float64) - shift[None, :]
-        means = np.asarray(_kmeans_pp_init(sample, k, rng), np.float64)
         if cov_type == "diag":
             covs = np.tile(var[None, :], (k, 1))
         else:
             covs = np.tile(np.diag(var)[None], (k, 1, 1))
         weights = np.full(k, 1.0 / k)
+        if resume_epoch is None:
+            rng = np.random.default_rng(self.get_seed())
+            sample = reservoir.sample().astype(np.float64) - shift[None, :]
+            means = np.asarray(_kmeans_pp_init(sample, k, rng), np.float64)
+        else:
+            means = np.zeros((k, d))  # placeholder; restored below
 
         step = _em_step_fn(mesh.mesh, DeviceMesh.DATA_AXIS, k, cov_type)
         f32 = lambda a: jnp.asarray(a, jnp.float32)
@@ -292,8 +319,33 @@ class GaussianMixture(_GMMParams, Estimator):
             wl[:n_valid] = 1.0
             return mesh.shard_batch(x_pad), mesh.shard_batch(wl)
 
+        # -- checkpoint/resume: state = (weights, means, covs, prev_ll,
+        # terminated) -- each EM epoch is a pure function of (state, cache),
+        # so restoring the latest snapshot and continuing is bit-exact with
+        # the uninterrupted run (Checkpoints.java:43-211 contract).
+        mgr = self.checkpoint_manager
         prev_ll = -np.inf
-        for _ in range(self.get(self.MAX_ITER)):
+        start_epoch = 0
+        terminated = False
+        if resume_epoch is not None:
+            like = (weights, means, covs, np.float64(0.0), np.asarray(False))
+            (weights, means, covs, prev_ll, term), start_epoch = mgr.restore(
+                resume_epoch, like
+            )
+            prev_ll = float(prev_ll)
+            terminated = bool(term)
+
+        def snapshot(epoch):
+            mgr.save(
+                (weights, means, covs, np.float64(prev_ll),
+                 np.asarray(terminated)),
+                epoch,
+            )
+
+        max_iter = self.get(self.MAX_ITER)
+        for epoch in range(start_epoch, max_iter):
+            if terminated:
+                break  # restored from a tol-terminated run: no-op resume
             acc = None
             feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
             try:
@@ -317,10 +369,16 @@ class GaussianMixture(_GMMParams, Estimator):
                     "the data may be degenerate (try covarianceType='diag' "
                     "or fewer components)"
                 )
-            if abs(ll - prev_ll) <= self.get(self.TOL):
-                prev_ll = ll
-                break
+            terminated = abs(ll - prev_ll) <= self.get(self.TOL)
             prev_ll = ll
+            if mgr is not None and self.checkpoint_interval > 0 and (
+                terminated  # tol-stop writes its terminal snapshot
+                or should_snapshot(mgr, self.checkpoint_interval,
+                                   epoch + 1, max_iter)
+            ):
+                snapshot(epoch + 1)
+            if terminated:
+                break
         model = GaussianMixtureModel()
         model.copy_params_from(self)
         model._set(weights, means + shift[None, :], covs)

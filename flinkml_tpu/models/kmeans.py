@@ -87,11 +87,17 @@ class KMeans(_KMeansParams, Estimator):
         mesh: Optional[DeviceMesh] = None,
         cache_dir: Optional[str] = None,
         cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
     ):
         super().__init__()
         self.mesh = mesh
         self.cache_dir = cache_dir
         self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
     def fit(self, *inputs) -> "KMeansModel":
         (table,) = inputs
@@ -103,6 +109,12 @@ class KMeans(_KMeansParams, Estimator):
                 f"(parity with the reference), got {measure!r}"
             )
         if isinstance(table, Table):
+            if self.checkpoint_manager is not None or self.resume:
+                raise ValueError(
+                    "checkpointing is supported for streamed fits only "
+                    "(pass an iterable of batch Tables or a DataCache); "
+                    "the in-RAM fit runs as one whole-loop device program"
+                )
             x = features_matrix(table, self.get(_KMeansParams.FEATURES_COL))
             if x.shape[0] < k:
                 raise ValueError(
@@ -149,6 +161,9 @@ class KMeans(_KMeansParams, Estimator):
             column=(
                 features_col if isinstance(source, DataCache) else "x"
             ),
+            checkpoint_manager=self.checkpoint_manager,
+            checkpoint_interval=self.checkpoint_interval,
+            resume=self.resume,
         )
 
 
@@ -329,6 +344,9 @@ def train_kmeans_stream(
     column: str = "x",
     init_sample_size: int = 65_536,
     initial_centroids: Optional[np.ndarray] = None,
+    checkpoint_manager=None,
+    checkpoint_interval: int = 0,
+    resume: bool = False,
 ) -> np.ndarray:
     """Out-of-core Lloyd: train from a one-shot stream of batch dicts (or
     a sealed :class:`DataCache`) with bounded HBM residency.
@@ -345,13 +363,27 @@ def train_kmeans_stream(
     accumulating per-cluster sums/counts on device; centroids update once
     per epoch (empty clusters keep their previous centroid). Only one
     batch (plus prefetch depth) is device-resident at a time.
+
+    Fault tolerance (``KMeans.java:239-312`` ListState recovery;
+    ``Checkpoints.java:43-211``): ``checkpoint_manager`` +
+    ``checkpoint_interval`` snapshot ``(centroids, epoch)`` every N Lloyd
+    epochs; ``resume=True`` restores the latest snapshot and continues —
+    bit-exact with the uninterrupted run, because each epoch is a pure
+    function of (centroids, cache). Resume requires the same durable
+    cache (or re-fed identical stream) the crashed run trained from.
     """
+    from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
     from flinkml_tpu.iteration.datacache import (
         DataCache,
         DataCacheWriter,
         PrefetchingDeviceFeed,
     )
     from flinkml_tpu.utils.sampling import RowReservoir
+
+    # Decide the resume target BEFORE pass 0, so a successful restore
+    # skips the reservoir pass + seeding whose centroids it would discard
+    # (on a spilled cache that pass re-reads the whole dataset).
+    resume_epoch = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
 
     p_size = mesh.axis_size()
     row_tile = p_size * 8
@@ -383,10 +415,11 @@ def train_kmeans_stream(
     reservoir_cap = (
         k if init_mode == "random" else max(k, init_sample_size)
     )
+    need_init = initial_centroids is None and resume_epoch is None
     reservoir = RowReservoir(reservoir_cap, seed=seed)
     if isinstance(batches, DataCache):
         cache = batches
-        if initial_centroids is None:
+        if need_init:
             for batch in cache.reader():
                 reservoir.add(np.asarray(batch[column], np.float32))
     else:
@@ -395,13 +428,24 @@ def train_kmeans_stream(
             x = np.asarray(b[column], np.float32)
             check_dims(x)
             writer.append({column: np.array(x)})
-            reservoir.add(x)
+            if need_init:
+                reservoir.add(x)
         cache = writer.finish()
     if cache.num_rows < k:
         raise ValueError(f"k={k} exceeds number of points {cache.num_rows}")
 
     rng = np.random.default_rng(seed)
-    if initial_centroids is not None:
+    start_epoch = 0
+    if resume_epoch is not None:
+        # Shape discovery without a full pass: one cached batch gives d.
+        reader = cache.reader()
+        d_feat = np.asarray(next(iter(reader))[column]).shape[1]
+        if hasattr(reader, "close"):
+            reader.close()
+        centroids, start_epoch = checkpoint_manager.restore(
+            resume_epoch, like=np.zeros((k, d_feat), np.float32)
+        )
+    elif initial_centroids is not None:
         centroids = np.asarray(initial_centroids, np.float32)
         if centroids.shape[0] != k:
             raise ValueError(
@@ -418,7 +462,7 @@ def train_kmeans_stream(
             centroids = sample[rng.permutation(sample.shape[0])[:k]]
 
     cent_dev = jnp.asarray(centroids)
-    for _ in range(max_iter):
+    for epoch in range(start_epoch, max_iter):
         sums = None
         counts = None
         feed = PrefetchingDeviceFeed(
@@ -435,6 +479,9 @@ def train_kmeans_stream(
             raise ValueError("training stream is empty")
         safe = jnp.maximum(counts, 1.0)[:, None]
         cent_dev = jnp.where(counts[:, None] > 0, sums / safe, cent_dev)
+        if should_snapshot(checkpoint_manager, checkpoint_interval,
+                           epoch + 1, max_iter):
+            checkpoint_manager.save(np.asarray(cent_dev), epoch + 1)
     return np.asarray(cent_dev)
 
 
