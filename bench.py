@@ -84,8 +84,7 @@ def bench_tpu(x, y, w, global_batch_size, n_steps):
     p = mesh.axis_size()
     xd, yd, wd = _shard_training_data(x, y, w, mesh)
     # Same batch alignment as the product fit path (round-1 finding: a
-    # hand-computed local_bs here could disagree with the product program
-    # under Pallas gating).
+    # hand-computed local_bs here could disagree with the product program).
     local_bs = _linear_sgd.align_local_bs(
         global_batch_size, p, xd.shape[0] // p
     )
@@ -252,9 +251,9 @@ def _inner_kmeans() -> float:
     x = rng.normal(size=(n, dim)).astype(np.float32)
     mesh = DeviceMesh()
     # Same pad/mask/shard + kernel gate as the product fit path.
-    xd, wd, _, use_pallas = prepare_kmeans_data(x, mesh)
+    xd, wd, _ = prepare_kmeans_data(x, mesh)
     cent0 = jnp.asarray(x[rng.choice(n, size=k, replace=False)])
-    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS, use_pallas)
+    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS)
     _log("kmeans: compiling + warm-up dispatch ...")
     np.asarray(trainer(xd, wd, cent0, jnp.asarray(3, jnp.int32)))
     _log("kmeans: measuring ...")

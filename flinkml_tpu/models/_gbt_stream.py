@@ -177,6 +177,9 @@ def train_gbt_stream(
     RNG is fast-forwarded one draw per completed tree.
     """
     from flinkml_tpu.models.gbt import bin_features, quantile_bin_edges
+    from flinkml_tpu.parallel.distributed import require_single_controller
+
+    require_single_controller("train_gbt_stream")
     from flinkml_tpu.utils.sampling import RowReservoir
 
     x_key, y_key, w_key = columns

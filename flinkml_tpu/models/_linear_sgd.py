@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from flinkml_tpu.ops import pallas_kernels
+from flinkml_tpu.ops.losses import margin_terms as _margin_grad
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 
 _LOSS_KEYS = ("logistic", "hinge", "squared")
@@ -46,11 +46,6 @@ def _sorted_scatter_enabled() -> bool:
     ``FLINKML_TPU_SORTED_SCATTER=0`` restores the per-step-sort layout —
     kept so the win stays measurable on any backend/TPU generation."""
     return os.environ.get("FLINKML_TPU_SORTED_SCATTER", "1") != "0"
-
-
-# The margin-gradient math is shared verbatim with the fused Pallas kernel
-# (single source of truth — the fused and unfused paths must agree exactly).
-_margin_grad = pallas_kernels._margin_terms
 
 
 def _soft_threshold(x, t):
@@ -65,14 +60,9 @@ def _acc_dt(dt):
 
 
 def align_local_bs(global_batch_size: int, p_size: int, n_local: int) -> int:
-    """Per-device batch: ceil(global/p), rounded up to the 8-row tile when
-    the Pallas path is in play (so the fused kernel stays reachable at any
-    requested batch size), clamped to the shard. Without Pallas the
-    requested batch is honored exactly — no silent inflation."""
-    bs = max(1, math.ceil(global_batch_size / p_size))
-    if pallas_kernels.pallas_active("linear"):
-        bs = ((bs + 7) // 8) * 8
-    return min(bs, n_local)
+    """Per-device batch: ceil(global/p), clamped to the shard — the
+    requested batch is honored exactly, no silent inflation."""
+    return min(max(1, math.ceil(global_batch_size / p_size)), n_local)
 
 
 def _window(arr, epoch, local_bs):
@@ -86,29 +76,24 @@ def _window(arr, epoch, local_bs):
     return jax.lax.dynamic_slice(arr, (start, zero), (local_bs, arr.shape[1]))
 
 
-def make_dense_step(loss: str, local_bs: int, axis: str, use_pallas: bool = False):
+def make_dense_step(loss: str, local_bs: int, axis: str):
     """Per-device epoch: window → margin grad on MXU → psum → prox update.
 
-    With ``use_pallas`` (batch must be tile-aligned), the gradient uses the
-    fused Pallas kernel (``ops.pallas_kernels.fused_linear_grad``) — one HBM
-    pass over the batch instead of XLA's two (forward + back matmul)."""
+    A hand-fused Pallas version of this step was measured LOSING to this
+    plain lowering at every shape (0.70-0.82x; BASELINE.md "Kernel-path
+    verdict") and was removed — XLA's forward + back-product pair is the
+    fast path on current TPU generations."""
 
     def step(coef, epoch, xl, yl, wl, learning_rate, reg_l2, reg_l1):
         xb = _window(xl, epoch, local_bs)
         yb = _window(yl, epoch, local_bs)
         wb = _window(wl, epoch, local_bs)
         acc = _acc_dt(xb.dtype)
-        if use_pallas:
-            grad_l, loss_l, wsum_l = pallas_kernels.fused_linear_grad(
-                xb, yb, wb, coef, loss=loss
-            )
-            loss_l, wsum_l = loss_l.astype(acc), wsum_l.astype(acc)
-        else:
-            dot = xb @ coef
-            mult, per_ex = _margin_grad(loss, dot, yb, wb)
-            grad_l = xb.T @ mult
-            loss_l = jnp.sum(per_ex.astype(acc))
-            wsum_l = jnp.sum(wb.astype(acc))
+        dot = xb @ coef
+        mult, per_ex = _margin_grad(loss, dot, yb, wb)
+        grad_l = xb.T @ mult
+        loss_l = jnp.sum(per_ex.astype(acc))
+        wsum_l = jnp.sum(wb.astype(acc))
         grad = jax.lax.psum(grad_l, axis)
         loss_sum = jax.lax.psum(loss_l, axis)
         wsum = jax.lax.psum(wsum_l, axis)
@@ -277,7 +262,7 @@ def _sparse_trainer_bucketed(mesh, loss: str, local_bss: Tuple[int, ...],
 
 
 @functools.lru_cache(maxsize=128)
-def _dense_trainer(mesh, loss: str, local_bs: int, axis: str, use_pallas: bool):
+def _dense_trainer(mesh, loss: str, local_bs: int, axis: str):
     """Carry-style whole-loop trainer: runs epochs from ``epoch`` up to
     ``epoch_end`` (or until ``loss <= tol``) entirely on device and returns
     the full carry ``(coef, epoch, loss)``.
@@ -290,7 +275,7 @@ def _dense_trainer(mesh, loss: str, local_bs: int, axis: str, use_pallas: bool):
     TPU-native answer to the reference's always-on mid-iteration
     checkpointing (``Checkpoints.java:43-211``): the unit of recovery is
     the dispatch, and the only state is the carry."""
-    local_step = make_dense_step(loss, local_bs, axis, use_pallas)
+    local_step = make_dense_step(loss, local_bs, axis)
 
     def per_device(coef, epoch, cur_loss, xl, yl, wl,
                    learning_rate, reg_l2, reg_l1, tol, epoch_end):
@@ -314,9 +299,6 @@ def _dense_trainer(mesh, loss: str, local_bs: int, axis: str, use_pallas: bool):
             in_specs=(P(), P(), P(), P(axis), P(axis), P(axis),
                       P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P()),
-            # pallas_call out_shapes carry no vma; keep the replication
-            # check whenever the plain-XLA path runs.
-            check_vma=not use_pallas,
         )
     )
 
@@ -481,9 +463,7 @@ def train_linear_model(
         x, y, w = x.astype(dtype), y.astype(dtype), w.astype(dtype)
     perm = np.random.default_rng(seed).permutation(n)
     x, y, w = x[perm], y[perm], w[perm]
-    # Shards align to the 8-row tile only when the Pallas path is in play;
-    # otherwise pad exactly to the mesh (identical windows to the baseline).
-    row_tile = p_size * 8 if pallas_kernels.pallas_active() else p_size
+    row_tile = p_size  # pad exactly to the mesh: identical windows always
     x_pad, _ = pad_to_multiple(x, row_tile)
     y_pad, _ = pad_to_multiple(y, row_tile)
     w_pad, _ = pad_to_multiple(w, row_tile)
@@ -492,10 +472,7 @@ def train_linear_model(
     wd = mesh.shard_batch(w_pad)
     n_local = xd.shape[0] // p_size
     local_bs = align_local_bs(global_batch_size, p_size, n_local)
-    trainer = _dense_trainer(
-        mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS,
-        pallas_kernels.pallas_enabled(local_bs),
-    )
+    trainer = _dense_trainer(mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS)
     return _run_chunked(
         trainer, (xd, yd, wd), x.shape[1], xd.dtype,
         learning_rate, reg * (1.0 - elastic_net), reg * elastic_net,
@@ -982,7 +959,9 @@ def train_linear_model_stream(
             "stream cannot be replayed from the start after a failure"
         )
     from flinkml_tpu.iteration.checkpoint import begin_resume
+    from flinkml_tpu.parallel.distributed import require_single_controller
 
+    require_single_controller("train_linear_model_stream")
     begin_resume(checkpoint_manager, resume, mesh.mesh.size)
 
     p_size = mesh.axis_size()

@@ -179,18 +179,55 @@ def _half_step(
 
 
 class ALS(_ALSParams, Estimator):
-    """Alternating least squares over (user, item, rating) tables."""
+    """Alternating least squares over (user, item, rating) tables.
+
+    ``fit`` accepts, besides a single in-RAM :class:`Table`:
+
+      - an **iterable of batch Tables** — the out-of-core path: the COO
+        stream is cached once (spilling to ``cache_dir`` beyond
+        ``cache_memory_budget_bytes``) while the id vocabularies
+        accumulate; every half-step then replays the cache, building the
+        target side's normal equations batch-by-batch with bounded HBM
+        residency (reference: ``ReplayOperator.java:62-250`` — every
+        bounded iteration trains from replayed cached partitions);
+      - a sealed :class:`~flinkml_tpu.iteration.datacache.DataCache`
+        whose batches carry this estimator's user/item/rating columns.
+
+    ``checkpoint_manager`` + ``checkpoint_interval`` snapshot
+    ``(user_factors, item_factors)`` every N outer iterations of the
+    streamed fit; ``resume=True`` restores and continues bit-exactly.
+    """
 
     # Per-device rows handed to one normal-equation dispatch; bounds the
     # nnz×k² intermediate to chunk×k² per device.
     CHUNK = 1 << 16
 
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
-    def fit(self, *inputs: Table) -> "ALSModel":
+    def fit(self, *inputs) -> "ALSModel":
         (table,) = inputs
+        if not isinstance(table, Table):
+            return self._fit_stream(table)
+        if self.checkpoint_manager is not None or self.resume:
+            raise ValueError(
+                "checkpointing is supported for streamed fits only "
+                "(pass an iterable of batch Tables or a DataCache)"
+            )
         users_raw = np.asarray(table.column(self.get(self.USER_COL)))
         items_raw = np.asarray(table.column(self.get(self.ITEM_COL)))
         ratings = np.asarray(
@@ -230,6 +267,174 @@ class ALS(_ALSParams, Estimator):
             item_f = _half_step(
                 mesh, *by_item, user_f, n_items, reg, implicit, alpha, chunk,
             )
+        model = ALSModel()
+        model.copy_params_from(self)
+        model._set_factors(
+            user_ids, np.asarray(user_f), item_ids, np.asarray(item_f)
+        )
+        return model
+
+    def _fit_stream(self, source) -> "ALSModel":
+        """Out-of-core ALS (see class docstring): one caching pass
+        accumulates the sorted id vocabularies; each half-step replays
+        the cache, padding every batch to the row tile and accumulating
+        the psum'd normal-equation partials on device. Only one batch
+        (plus prefetch depth) of the COO is device-resident at a time."""
+        from flinkml_tpu.iteration.checkpoint import (
+            begin_resume,
+            should_snapshot,
+        )
+        from flinkml_tpu.iteration.datacache import (
+            DataCache,
+            DataCacheWriter,
+            PrefetchingDeviceFeed,
+        )
+
+        from flinkml_tpu.parallel.distributed import require_single_controller
+
+        require_single_controller("ALS streamed fit")
+        from flinkml_tpu.iteration.datacache import DataCache as _DC
+
+        if self.resume and not isinstance(source, _DC):
+            raise ValueError(
+                "resume=True requires a durable DataCache input: a one-shot "
+                "stream cannot be replayed from the start after a failure"
+            )
+        user_col = self.get(self.USER_COL)
+        item_col = self.get(self.ITEM_COL)
+        rating_col = self.get(self.RATING_COL)
+        implicit = self.get(self.IMPLICIT_PREFS)
+        rank = self.get(self.RANK)
+        reg = self.get(self.REG_PARAM)
+        alpha = self.get(self.ALPHA)
+        mesh = self.mesh or DeviceMesh()
+        resume_epoch = begin_resume(
+            self.checkpoint_manager, self.resume, mesh.mesh.size
+        )
+
+        # -- pass 0: cache + per-batch uniques (one global sort at the end:
+        # union1d per batch would re-sort the whole vocabulary B times) ----
+        user_parts = []
+        item_parts = []
+        nnz = 0
+
+        def ingest(u, i, r):
+            nonlocal nnz
+            if implicit and (r < 0).any():
+                raise ValueError(
+                    "implicitPrefs requires non-negative ratings"
+                )
+            user_parts.append(np.unique(u))
+            item_parts.append(np.unique(i))
+            nnz += r.shape[0]
+
+        def batch_arrays(b):
+            if isinstance(b, Table):
+                return (
+                    np.asarray(b.column(user_col)),
+                    np.asarray(b.column(item_col)),
+                    np.asarray(b.column(rating_col), np.float32),
+                )
+            return (
+                np.asarray(b[user_col]),
+                np.asarray(b[item_col]),
+                np.asarray(b[rating_col], np.float32),
+            )
+
+        if isinstance(source, DataCache):
+            cache = source
+            for b in cache.reader():
+                ingest(*batch_arrays(b))
+        else:
+            writer = DataCacheWriter(
+                self.cache_dir, self.cache_memory_budget_bytes
+            )
+            for b in source:
+                u, i, r = batch_arrays(b)
+                ingest(u, i, r)
+                writer.append({user_col: np.array(u), item_col: np.array(i),
+                               rating_col: np.array(r)})
+            cache = writer.finish()
+        if nnz == 0:
+            raise ValueError("training stream is empty")
+        user_ids = np.unique(np.concatenate(user_parts))
+        item_ids = np.unique(np.concatenate(item_parts))
+        n_users, n_items = len(user_ids), len(item_ids)
+
+        row_tile = mesh.axis_size() * 8
+        chunk_fns = {
+            True: _normal_eq_chunk_fn(
+                mesh.mesh, DeviceMesh.DATA_AXIS, n_users, implicit
+            ),
+            False: _normal_eq_chunk_fn(
+                mesh.mesh, DeviceMesh.DATA_AXIS, n_items, implicit
+            ),
+        }
+        alpha_j = jnp.asarray(alpha, jnp.float32)
+
+        def replay_half(fixed, by_user: bool):
+            """One half-step's accumulation over the replayed cache."""
+            n_target = n_users if by_user else n_items
+            k = fixed.shape[1]
+            a = jnp.zeros((n_target, k, k), jnp.float32)
+            bvec = jnp.zeros((n_target, k), jnp.float32)
+            cnt = jnp.zeros((n_target,), jnp.float32)
+            fn = chunk_fns[by_user]
+
+            def place(batch):
+                u, i, r = batch_arrays(batch)
+                u_idx = np.searchsorted(user_ids, u).astype(np.int32)
+                i_idx = np.searchsorted(item_ids, i).astype(np.int32)
+                seg, idx = (u_idx, i_idx) if by_user else (i_idx, u_idx)
+                seg, idx, r = _pad_coo(seg, idx, r, n_target, row_tile)
+                return (
+                    mesh.shard_batch(seg), mesh.shard_batch(idx),
+                    mesh.shard_batch(r),
+                )
+
+            feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
+            try:
+                for seg, idx, r in feed:
+                    pa, pb, pc = fn(seg, idx, r, fixed, alpha_j)
+                    a, bvec, cnt = a + pa, bvec + pb, cnt + pc
+            finally:
+                feed.close()
+            if implicit:
+                gram = fixed.T @ fixed
+            else:
+                gram = jnp.zeros((k, k), jnp.float32)
+            return _solve_factors(
+                a, bvec, gram, jnp.asarray(reg, jnp.float32), cnt
+            )
+
+        user_f = jnp.zeros((n_users, rank), jnp.float32)
+        start_epoch = 0
+        if resume_epoch is None:
+            rng = np.random.default_rng(self.get_seed())
+            item_f = jnp.asarray(
+                rng.normal(scale=1.0 / np.sqrt(rank), size=(n_items, rank))
+                .astype(np.float32)
+            )
+        else:
+            item_f = jnp.zeros((n_items, rank), jnp.float32)  # restored below
+            like = (np.zeros((n_users, rank), np.float32),
+                    np.zeros((n_items, rank), np.float32))
+            (user_h, item_h), start_epoch = self.checkpoint_manager.restore(
+                resume_epoch, like
+            )
+            user_f = jnp.asarray(user_h)
+            item_f = jnp.asarray(item_h)
+
+        max_iter = self.get(self.MAX_ITER)
+        for epoch in range(start_epoch, max_iter):
+            user_f = replay_half(item_f, by_user=True)
+            item_f = replay_half(user_f, by_user=False)
+            if should_snapshot(self.checkpoint_manager,
+                               self.checkpoint_interval, epoch + 1, max_iter):
+                self.checkpoint_manager.save(
+                    (np.asarray(user_f), np.asarray(item_f)), epoch + 1
+                )
+
         model = ALSModel()
         model.copy_params_from(self)
         model._set_factors(

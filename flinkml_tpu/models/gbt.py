@@ -323,6 +323,7 @@ class _GBTBase(_GBTParams, Estimator):
         checkpoint_manager=None,
         checkpoint_interval: int = 0,
         resume: bool = False,
+        stream_reservoir_capacity: int = 65_536,
     ):
         super().__init__()
         self.mesh = mesh
@@ -331,6 +332,11 @@ class _GBTBase(_GBTParams, Estimator):
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_interval = checkpoint_interval
         self.resume = resume
+        # Streamed-fit bin-edge sample size (see _gbt_stream: edges come
+        # from a seeded uniform row reservoir; capacity >= n gives exact
+        # edges, smaller capacities trade accuracy for a bounded sample —
+        # envelope quantified in tests/test_gbt_reservoir.py).
+        self.stream_reservoir_capacity = stream_reservoir_capacity
 
     def _feat_fraction(self, d: int) -> float:
         return 1.0
@@ -474,6 +480,11 @@ class _GBTBase(_GBTParams, Estimator):
                 "validationFraction is not supported in streamed fits "
                 "(a holdout needs a second materialized stream)"
             )
+        if self.resume and not isinstance(source, DataCache):
+            raise ValueError(
+                "resume=True requires a durable DataCache input: a one-shot "
+                "stream cannot be replayed from the start after a failure"
+            )
         features_col = self.get(self.FEATURES_COL)
         label_col = self.get(self.LABEL_COL)
         weight_col = self.get(self.WEIGHT_COL)
@@ -523,6 +534,7 @@ class _GBTBase(_GBTParams, Estimator):
             seed=self.get_seed(),
             columns=columns,
             label_check=label_check,
+            reservoir_capacity=self.stream_reservoir_capacity,
             checkpoint_manager=self.checkpoint_manager,
             checkpoint_interval=self.checkpoint_interval,
             resume=self.resume,

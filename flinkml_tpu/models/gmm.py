@@ -233,7 +233,14 @@ class GaussianMixture(_GMMParams, Estimator):
             begin_resume,
             should_snapshot,
         )
+        from flinkml_tpu.parallel.distributed import require_single_controller
 
+        require_single_controller("GaussianMixture streamed fit")
+        if self.resume and not isinstance(source, DataCache):
+            raise ValueError(
+                "resume=True requires a durable DataCache input: a one-shot "
+                "stream cannot be replayed from the start after a failure"
+            )
         features_col = self.get(self.FEATURES_COL)
         k = self.get(self.K)
         cov_type = self.get(self.COVARIANCE_TYPE)
@@ -371,11 +378,8 @@ class GaussianMixture(_GMMParams, Estimator):
                 )
             terminated = abs(ll - prev_ll) <= self.get(self.TOL)
             prev_ll = ll
-            if mgr is not None and self.checkpoint_interval > 0 and (
-                terminated  # tol-stop writes its terminal snapshot
-                or should_snapshot(mgr, self.checkpoint_interval,
-                                   epoch + 1, max_iter)
-            ):
+            if should_snapshot(mgr, self.checkpoint_interval, epoch + 1,
+                               max_iter, terminal=terminated):
                 snapshot(epoch + 1)
             if terminated:
                 break

@@ -40,7 +40,7 @@ from flinkml_tpu.common_params import (
 )
 from flinkml_tpu.models._data import features_matrix
 from flinkml_tpu.params import IntParam, ParamValidators, StringParam
-from flinkml_tpu.ops import blas, pallas_kernels
+from flinkml_tpu.ops import blas
 from flinkml_tpu.ops.distance import DistanceMeasure
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
@@ -220,27 +220,23 @@ class KMeansModel(_KMeansParams, Model):
 
 
 @functools.lru_cache(maxsize=64)
-def _kmeans_trainer(mesh, k: int, axis: str, use_pallas: bool):
-    """Whole Lloyd loop as one XLA program, cached per (mesh, k)."""
+def _kmeans_trainer(mesh, k: int, axis: str):
+    """Whole Lloyd loop as one XLA program, cached per (mesh, k).
+
+    Round-2 measured a hand-fused Pallas Lloyd pass losing to this plain
+    lowering at every shape (0.39-0.72x; BASELINE.md "Kernel-path
+    verdict"), so the argmin + one-hot-matmul form below IS the fast
+    path — XLA's fusion already reads the points once per pass."""
 
     def per_device(xl, wl, init_centroids, max_iter):
         def body(_, centroids):
-            if use_pallas:
-                # Fused Pallas Lloyd pass: distances + argmin + one-hot
-                # accumulation in one read of the points.
-                sums_l, counts_l = pallas_kernels.fused_kmeans_step(
-                    xl, wl, centroids
-                )
-            else:
-                # Assignment: argmin over pairwise squared distances (MXU).
-                d2 = blas.squared_distances(xl, centroids)
-                assign = jnp.argmin(d2, axis=-1)
-                # Per-cluster sums via one-hot matmul; padded rows have w=0.
-                onehot = jax.nn.one_hot(assign, k, dtype=xl.dtype) * wl[:, None]
-                sums_l = onehot.T @ xl
-                counts_l = jnp.sum(onehot, axis=0)
-            sums = jax.lax.psum(sums_l, axis)
-            counts = jax.lax.psum(counts_l, axis)
+            # Assignment: argmin over pairwise squared distances (MXU).
+            d2 = blas.squared_distances(xl, centroids)
+            assign = jnp.argmin(d2, axis=-1)
+            # Per-cluster sums via one-hot matmul; padded rows have w=0.
+            onehot = jax.nn.one_hot(assign, k, dtype=xl.dtype) * wl[:, None]
+            sums = jax.lax.psum(onehot.T @ xl, axis)
+            counts = jax.lax.psum(jnp.sum(onehot, axis=0), axis)
             # Empty clusters keep their previous centroid.
             safe = jnp.maximum(counts, 1.0)[:, None]
             new_centroids = jnp.where(
@@ -256,9 +252,6 @@ def _kmeans_trainer(mesh, k: int, axis: str, use_pallas: bool):
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P()),
             out_specs=P(),
-            # pallas_call out_shapes carry no vma; keep the replication
-            # check whenever the plain-XLA path runs.
-            check_vma=not use_pallas,
         )
     )
 
@@ -297,8 +290,8 @@ def train_kmeans(
         init_idx = rng.choice(x.shape[0], size=k, replace=False)
         init_centroids = np.ascontiguousarray(x[init_idx])
 
-    xd, wd, _, use_pallas = prepare_kmeans_data(x, mesh)
-    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS, use_pallas)
+    xd, wd, _ = prepare_kmeans_data(x, mesh)
+    trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS)
     centroids = trainer(
         xd, wd, jnp.asarray(init_centroids), jnp.asarray(max_iter, jnp.int32)
     )
@@ -373,6 +366,16 @@ def train_kmeans_stream(
     cache (or re-fed identical stream) the crashed run trained from.
     """
     from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
+    from flinkml_tpu.parallel.distributed import require_single_controller
+
+    require_single_controller("train_kmeans_stream")
+    from flinkml_tpu.iteration.datacache import DataCache as _DC
+
+    if resume and not isinstance(batches, _DC):
+        raise ValueError(
+            "resume=True requires a durable DataCache input: a one-shot "
+            "stream cannot be replayed from the start after a failure"
+        )
     from flinkml_tpu.iteration.datacache import (
         DataCache,
         DataCacheWriter,
@@ -487,18 +490,12 @@ def train_kmeans_stream(
 
 def prepare_kmeans_data(x: np.ndarray, mesh: DeviceMesh):
     """Pad/mask/shard points for the Lloyd trainer; returns
-    ``(xd, wd, n_valid, use_pallas)``. The single source of the padding
-    and kernel-gating policy — the bench measures exactly what
-    :func:`train_kmeans` runs."""
+    ``(xd, wd, n_valid)``. The single source of the padding policy — the
+    bench measures exactly what :func:`train_kmeans` runs."""
     p_size = mesh.axis_size()
-    # Pad local shards to the Pallas row tile (8) so the fused Lloyd
-    # kernel applies; zero-weight rows are exact no-ops either way.
+    # 8-row tile: keeps local shards sublane-aligned; zero-weight rows
+    # are exact no-ops.
     x_pad, n_valid = pad_to_multiple(x, p_size * 8)
     w = np.zeros(x_pad.shape[0], dtype=x.dtype)
     w[:n_valid] = 1.0  # mask: padded rows never influence centroids
-    return (
-        mesh.shard_batch(x_pad),
-        mesh.shard_batch(w),
-        n_valid,
-        pallas_kernels.pallas_enabled(x_pad.shape[0] // p_size, "kmeans"),
-    )
+    return mesh.shard_batch(x_pad), mesh.shard_batch(w), n_valid

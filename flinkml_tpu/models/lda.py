@@ -142,29 +142,61 @@ def _vb_pass_fn(mesh, axis: str, k: int):
             (exp_elog_theta * rows_w[:, None]).T @ (counts / phi_norm),
             axis,
         )
-        # Per-token log-likelihood bound proxy for the stop criterion.
+        # Per-token log-likelihood bound proxy for the stop criterion —
+        # returned UNNORMALIZED (sum + token count) so streamed callers
+        # can combine batch partials before dividing.
         ll = jax.lax.psum(
             jnp.sum(counts * jnp.log(phi_norm) * rows_w[:, None]), axis
         )
         tokens = jax.lax.psum(jnp.sum(counts * rows_w[:, None]), axis)
-        return sstats, gamma, ll / jnp.maximum(tokens, 1e-30)
+        return sstats, gamma, ll, tokens
 
     return jax.jit(
         jax.shard_map(
             local, mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P(), P()),
-            out_specs=(P(), P(axis), P()),
+            out_specs=(P(), P(axis), P(), P()),
         )
     )
 
 
 class LDA(_LDAParams, Estimator):
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    """``fit`` accepts, besides a single in-RAM :class:`Table`, an
+    iterable of batch Tables or a sealed
+    :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
+    out-of-core path: each outer VB pass replays the cached corpus,
+    accumulating the psum'd topic sufficient statistics batch-by-batch
+    with bounded HBM residency (reference:
+    ``ReplayOperator.java:62-250``). ``checkpoint_manager`` +
+    ``checkpoint_interval`` snapshot ``(lambda, prev_ll)`` every N outer
+    passes of the streamed fit; ``resume=True`` continues bit-exactly."""
+
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
-    def fit(self, *inputs: Table) -> "LDAModel":
+    def fit(self, *inputs) -> "LDAModel":
         (table,) = inputs
+        if not isinstance(table, Table):
+            return self._fit_stream(table)
+        if self.checkpoint_manager is not None or self.resume:
+            raise ValueError(
+                "checkpointing is supported for streamed fits only "
+                "(pass an iterable of batch Tables or a DataCache)"
+            )
         counts = _counts_matrix(table, self.get(self.FEATURES_COL))
         if (counts < 0).any():
             raise ValueError("token counts must be non-negative")
@@ -186,7 +218,7 @@ class LDA(_LDAParams, Estimator):
         step = _vb_pass_fn(mesh.mesh, DeviceMesh.DATA_AXIS, k)
         prev_ll = -np.inf
         for it in range(self.get(self.MAX_ITER)):
-            sstats, _, ll = step(
+            sstats, _, ll_sum, tokens = step(
                 mesh.shard_batch(c_pad), mesh.shard_batch(rows_w),
                 jnp.asarray(lam, jnp.float32),
                 jnp.asarray(alpha, jnp.float32),
@@ -196,11 +228,168 @@ class LDA(_LDAParams, Estimator):
                 jnp.asarray(lam, jnp.float32)
             ), np.float64)
             lam = eta + exp_elog_beta * np.asarray(sstats, np.float64)
-            ll = float(ll)
+            ll = float(ll_sum) / max(float(tokens), 1e-30)
             if abs(ll - prev_ll) <= self.get(self.TOL):
                 prev_ll = ll
                 break
             prev_ll = ll
+        model = LDAModel()
+        model.copy_params_from(self)
+        model._set(lam)
+        return model
+
+    def _fit_stream(self, source) -> "LDAModel":
+        """Out-of-core VB (see class docstring): pass 0 caches the
+        corpus; each outer pass replays it, accumulating sstats / ll /
+        token partials per batch. Per-batch E-step gamma inits draw from
+        ``fold_in(fold_in(key, it), batch_index)`` so the trajectory is
+        deterministic (and independent of the RAM/spill split)."""
+        from flinkml_tpu.iteration.checkpoint import (
+            begin_resume,
+            should_snapshot,
+        )
+        from flinkml_tpu.iteration.datacache import (
+            DataCache,
+            DataCacheWriter,
+            PrefetchingDeviceFeed,
+        )
+
+        from flinkml_tpu.parallel.distributed import require_single_controller
+
+        require_single_controller("LDA streamed fit")
+        from flinkml_tpu.iteration.datacache import DataCache as _DC
+
+        if self.resume and not isinstance(source, _DC):
+            raise ValueError(
+                "resume=True requires a durable DataCache input: a one-shot "
+                "stream cannot be replayed from the start after a failure"
+            )
+        features_col = self.get(self.FEATURES_COL)
+        k = self.get(self.K)
+        alpha = self.get(self.DOC_CONCENTRATION)
+        alpha = 1.0 / k if alpha is None else alpha
+        eta = self.get(self.TOPIC_CONCENTRATION)
+        eta = 1.0 / k if eta is None else eta
+        mesh = self.mesh or DeviceMesh()
+        p = mesh.axis_size()
+        resume_epoch = begin_resume(
+            self.checkpoint_manager, self.resume, mesh.mesh.size
+        )
+        column = features_col if isinstance(source, DataCache) else "x"
+
+        vocab = [None]
+
+        def to_counts(batch) -> np.ndarray:
+            if isinstance(batch, Table):
+                c = _counts_matrix(batch, features_col)
+            else:
+                c = np.asarray(batch[column], np.float64)
+            if c.ndim != 2 or c.shape[0] == 0:
+                raise ValueError(
+                    f"stream batches must be non-empty [n, V], got {c.shape}"
+                )
+            if (c < 0).any():
+                raise ValueError("token counts must be non-negative")
+            if vocab[0] is None:
+                vocab[0] = c.shape[1]
+            elif c.shape[1] != vocab[0]:
+                raise ValueError(
+                    f"batch vocab size {c.shape[1]} != first batch's "
+                    f"{vocab[0]}"
+                )
+            return c
+
+        if isinstance(source, DataCache):
+            cache = source
+            if cache.num_rows == 0:
+                raise ValueError("training stream is empty")
+            reader = cache.reader()
+            to_counts(next(iter(reader)))  # vocab from the first batch
+            if hasattr(reader, "close"):
+                reader.close()
+        else:
+            writer = DataCacheWriter(
+                self.cache_dir, self.cache_memory_budget_bytes
+            )
+            for t in source:
+                writer.append({column: to_counts(t).astype(np.float32)})
+            cache = writer.finish()
+            if vocab[0] is None:
+                raise ValueError("training stream is empty")
+
+        key = jax.random.PRNGKey(self.get_seed())
+        if resume_epoch is None:
+            lam = np.asarray(
+                jax.random.gamma(key, 100.0, (k, vocab[0])) * 0.01,
+                np.float64,
+            )
+        else:
+            lam = np.zeros((k, vocab[0]))  # placeholder; restored below
+        step = _vb_pass_fn(mesh.mesh, DeviceMesh.DATA_AXIS, k)
+
+        prev_ll = -np.inf
+        start_epoch = 0
+        terminated = False
+        if resume_epoch is not None:
+            like = (lam, np.float64(0.0), np.asarray(False))
+            (lam, prev_ll, term), start_epoch = (
+                self.checkpoint_manager.restore(resume_epoch, like)
+            )
+            prev_ll = float(prev_ll)
+            terminated = bool(term)
+
+        def place_for(it):
+            counter = [0]
+
+            def place(batch):
+                c = to_counts(batch).astype(np.float32)
+                c_pad, n_valid = pad_to_multiple(c, p)
+                rows_w = np.zeros(c_pad.shape[0], np.float32)
+                rows_w[:n_valid] = 1.0
+                b = counter[0]
+                counter[0] += 1
+                return (
+                    mesh.shard_batch(c_pad), mesh.shard_batch(rows_w),
+                    jax.random.fold_in(jax.random.fold_in(key, it), b),
+                )
+
+            return place
+
+        max_iter = self.get(self.MAX_ITER)
+        for it in range(start_epoch, max_iter):
+            if terminated:
+                break  # restored from a tol-terminated run: no-op resume
+            lam_dev = jnp.asarray(lam, jnp.float32)
+            alpha_dev = jnp.asarray(alpha, jnp.float32)
+            sstats = ll_sum = tok_sum = None
+            feed = PrefetchingDeviceFeed(
+                cache.reader(), place=place_for(it), depth=2
+            )
+            try:
+                for cb, wb, kb in feed:
+                    s, _, ll_b, tok_b = step(cb, wb, lam_dev, alpha_dev, kb)
+                    sstats = s if sstats is None else sstats + s
+                    ll_sum = ll_b if ll_sum is None else ll_sum + ll_b
+                    tok_sum = tok_b if tok_sum is None else tok_sum + tok_b
+            finally:
+                feed.close()
+            exp_elog_beta = np.asarray(
+                _exp_dirichlet_expectation(lam_dev), np.float64
+            )
+            lam = eta + exp_elog_beta * np.asarray(sstats, np.float64)
+            ll = float(ll_sum) / max(float(tok_sum), 1e-30)
+            terminated = abs(ll - prev_ll) <= self.get(self.TOL)
+            prev_ll = ll
+            mgr = self.checkpoint_manager
+            if should_snapshot(mgr, self.checkpoint_interval, it + 1,
+                               max_iter, terminal=terminated):
+                mgr.save(
+                    (lam, np.float64(prev_ll), np.asarray(terminated)),
+                    it + 1,
+                )
+            if terminated:
+                break
+
         model = LDAModel()
         model.copy_params_from(self)
         model._set(lam)

@@ -61,7 +61,6 @@ from flinkml_tpu.models._data import (
     labeled_sparse_data,
     sparse_features,
 )
-from flinkml_tpu.ops import pallas_kernels
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 from flinkml_tpu.table import Table
 
@@ -365,11 +364,10 @@ def _softmax_from_logits(logits: np.ndarray):
 
 
 def _shard_training_data(x, y, w, mesh: DeviceMesh):
-    """Pad to the mesh (× the 8-row tile when the Pallas path is in play)
-    and shard; padded rows carry weight 0 so they never contribute to any
-    weighted sum."""
+    """Pad to the mesh and shard; padded rows carry weight 0 so they never
+    contribute to any weighted sum."""
     p_size = mesh.axis_size()
-    row_tile = p_size * 8 if pallas_kernels.pallas_active() else p_size
+    row_tile = p_size
     x_pad, _ = pad_to_multiple(x, row_tile)
     y_pad, _ = pad_to_multiple(y, row_tile)
     w_pad, _ = pad_to_multiple(w, row_tile)
@@ -384,10 +382,7 @@ def _shard_training_data(x, y, w, mesh: DeviceMesh):
 # shuffled SGD with full-bandwidth streaming reads.
 def _device_trainer(mesh, local_bs: int, axis: str):
     """Whole-training-run XLA program for logistic loss (cached)."""
-    return _linear_sgd._dense_trainer(
-        mesh, "logistic", local_bs, axis,
-        pallas_kernels.pallas_enabled(local_bs),
-    )
+    return _linear_sgd._dense_trainer(mesh, "logistic", local_bs, axis)
 
 
 def train_logistic_regression(
@@ -466,16 +461,12 @@ def train_logistic_regression(
     axis = DeviceMesh.DATA_AXIS
     dt = xd.dtype
 
-    use_pallas = pallas_kernels.pallas_enabled(local_bs)
-    local_step = _linear_sgd.make_dense_step("logistic", local_bs, axis, use_pallas)
+    local_step = _linear_sgd.make_dense_step("logistic", local_bs, axis)
     sharded_step = jax.shard_map(
         local_step,
         mesh=mesh.mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P(), P()),
         out_specs=(P(), P()),
-        # pallas_call out_shapes carry no vma; keep the replication check
-        # whenever the plain-XLA path runs.
-        check_vma=not use_pallas,
     )
 
     @jax.jit

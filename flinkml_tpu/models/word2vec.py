@@ -146,12 +146,48 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
 
 
 class Word2Vec(_Word2VecParams, Estimator):
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    """``fit`` accepts, besides a single in-RAM :class:`Table`, an
+    **iterable of batch Tables** — the out-of-core path: pass A encodes
+    the token stream to an int-coded doc cache (strings never spill; the
+    vocabulary dictionary is model-sized host state), pass B replays it
+    into a (center, context) pair cache, and each training epoch replays
+    the pair cache chunk-by-chunk — SGNS minibatches sample within the
+    resident chunk, the classic word2vec sequential-corpus discipline
+    (reference replay parity: ``ReplayOperator.java:62-250``).
+    ``checkpoint_manager`` + ``checkpoint_interval`` snapshot both
+    embedding matrices every N epochs; ``resume=True`` continues
+    bit-exactly PROVIDED the caller re-feeds the complete identical
+    stream — Word2Vec cannot take a sealed DataCache (no string
+    vocabulary), so the durable-input guard the other streamed fits
+    enforce cannot apply here; passes A/B re-run deterministically from
+    the same seed over the re-fed stream."""
+
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
-    def fit(self, *inputs: Table) -> "Word2VecModel":
+    def fit(self, *inputs) -> "Word2VecModel":
         (table,) = inputs
+        if not isinstance(table, Table):
+            return self._fit_stream(table)
+        if self.checkpoint_manager is not None or self.resume:
+            raise ValueError(
+                "checkpointing is supported for streamed fits only "
+                "(pass an iterable of batch Tables)"
+            )
         docs = _token_column(table, self.get(self.INPUT_COL))
         min_count = self.get(self.MIN_COUNT)
         counts: Dict[str, int] = {}
@@ -209,6 +245,196 @@ class Word2Vec(_Word2VecParams, Estimator):
             jnp.asarray(n_steps, jnp.int32),
             jax.random.PRNGKey(self.get_seed()),
         )
+        model = Word2VecModel()
+        model.copy_params_from(self)
+        model._set(np.asarray(vocab, dtype=str), np.asarray(v, np.float64))
+        return model
+
+    # Pair-chunk row tile: bounds the set of padded chunk shapes (and so
+    # trainer recompiles) while keeping chunks MXU-sized.
+    _PAIR_TILE = 2048
+
+    def _fit_stream(self, source) -> "Word2VecModel":
+        """Out-of-core SGNS (see class docstring)."""
+        import os
+        import shutil
+        import tempfile
+
+        from flinkml_tpu.iteration.checkpoint import (
+            begin_resume,
+            should_snapshot,
+        )
+        from flinkml_tpu.iteration.datacache import (
+            DataCache,
+            DataCacheWriter,
+        )
+
+        if isinstance(source, DataCache):
+            raise ValueError(
+                "Word2Vec streamed fit takes an iterable of batch Tables "
+                "(token documents are encoded internally; a raw DataCache "
+                "carries no string vocabulary)"
+            )
+        from flinkml_tpu.parallel.distributed import require_single_controller
+
+        require_single_controller("Word2Vec streamed fit")
+        input_col = self.get(self.INPUT_COL)
+        min_count = self.get(self.MIN_COUNT)
+        window = self.get(self.WINDOW_SIZE)
+        mesh = self.mesh or DeviceMesh()
+        p = mesh.axis_size()
+        resume_epoch = begin_resume(
+            self.checkpoint_manager, self.resume, mesh.mesh.size
+        )
+
+        # -- pass A: count tokens + cache int-coded docs -------------------
+        # The doc cache is transient (consumed once by pass B), so it
+        # lives in a private temp dir; the pair cache — replayed every
+        # epoch — goes to the user's cache_dir.
+        if self.cache_dir is not None:
+            os.makedirs(self.cache_dir, exist_ok=True)
+        doc_dir = tempfile.mkdtemp(prefix="flinkml-w2v-docs-",
+                                   dir=self.cache_dir)
+        pid: Dict[str, int] = {}
+        counts_list: List[int] = []
+        try:
+            doc_writer = DataCacheWriter(
+                doc_dir, self.cache_memory_budget_bytes
+            )
+            for t in source:
+                docs = _token_column(t, input_col)
+                codes: List[int] = []
+                lengths: List[int] = []
+                for toks in docs:
+                    start = len(codes)
+                    for tok in map(str, toks):
+                        i = pid.get(tok)
+                        if i is None:
+                            i = pid[tok] = len(counts_list)
+                            counts_list.append(0)
+                        counts_list[i] += 1
+                        codes.append(i)
+                    lengths.append(len(codes) - start)
+                if lengths:
+                    # Flat single-column record (columns of a cached batch
+                    # must agree on row count): [n_docs, *lengths, *codes].
+                    doc_writer.append({
+                        "rec": np.concatenate([
+                            [len(lengths)], lengths, codes
+                        ]).astype(np.int32),
+                    })
+            doc_cache = doc_writer.finish()
+
+            counts_arr = np.asarray(counts_list, np.int64)
+            tokens = np.empty(len(pid), dtype=object)
+            for tok, i in pid.items():
+                tokens[i] = tok
+            kept = [i for i in range(len(counts_list))
+                    if counts_arr[i] >= min_count]
+            kept.sort(key=lambda i: (-counts_arr[i], tokens[i]))
+            if not kept:
+                raise ValueError(
+                    f"no token reaches minCount={min_count}; vocabulary "
+                    "is empty"
+                )
+            vocab = [tokens[i] for i in kept]
+            final_of_pid = np.full(len(counts_list), -1, np.int32)
+            for f, i in enumerate(kept):
+                final_of_pid[i] = f
+
+            # -- pass B: replay doc cache into the pair cache --------------
+            rng = np.random.default_rng(self.get_seed())
+            pair_writer = DataCacheWriter(
+                self.cache_dir, self.cache_memory_budget_bytes
+            )
+            n_pairs = 0
+            for batch in doc_cache.reader():
+                rec = batch["rec"]
+                n_docs = int(rec[0])
+                lengths_b = rec[1:1 + n_docs]
+                fids = final_of_pid[rec[1 + n_docs:]]
+                centers: List[int] = []
+                contexts: List[int] = []
+                off = 0
+                for length in lengths_b:
+                    ids = [int(c) for c in fids[off:off + length] if c >= 0]
+                    off += int(length)
+                    for i, c in enumerate(ids):
+                        w = int(rng.integers(1, window + 1))
+                        for j in range(max(0, i - w),
+                                       min(len(ids), i + w + 1)):
+                            if j != i:
+                                centers.append(c)
+                                contexts.append(ids[j])
+                if centers:
+                    pair_writer.append({
+                        "c": np.asarray(centers, np.int32),
+                        "x": np.asarray(contexts, np.int32),
+                    })
+                    n_pairs += len(centers)
+            pair_cache = pair_writer.finish()
+        finally:
+            shutil.rmtree(doc_dir, ignore_errors=True)
+        if n_pairs == 0:
+            raise ValueError("no (center, context) pairs; documents too short")
+
+        # unigram^0.75 negative pool over the FINAL vocab.
+        freq = counts_arr[kept].astype(np.float64) ** 0.75
+        pool = rng.choice(
+            len(vocab), size=_NEG_POOL, p=freq / freq.sum()
+        ).astype(np.int32)
+        pool_dev = jnp.asarray(pool)
+
+        dim = self.get(self.VECTOR_SIZE)
+        batch_size = self.get(self.BATCH_SIZE)
+        local_bs = max(1, batch_size // p)
+        trainer = _sgns_trainer(
+            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
+            self.get(self.NUM_NEGATIVES),
+        )
+        lr = jnp.asarray(self.get(self.LEARNING_RATE), jnp.float32)
+        base_key = jax.random.PRNGKey(self.get_seed())
+        tile = p * self._PAIR_TILE
+
+        u = jnp.zeros((len(vocab), dim), jnp.float32)
+        start_epoch = 0
+        if resume_epoch is None:
+            v = jnp.asarray(
+                (rng.random((len(vocab), dim)) - 0.5).astype(np.float32)
+                / dim
+            )
+        else:
+            v = jnp.zeros((len(vocab), dim), jnp.float32)  # restored below
+        if resume_epoch is not None:
+            like = (np.zeros((len(vocab), dim), np.float32),) * 2
+            (v_h, u_h), start_epoch = self.checkpoint_manager.restore(
+                resume_epoch, like
+            )
+            v, u = jnp.asarray(v_h), jnp.asarray(u_h)
+
+        max_iter = self.get(self.MAX_ITER)
+        for epoch in range(start_epoch, max_iter):
+            for ci, batch in enumerate(pair_cache.reader()):
+                c, x = batch["c"], batch["x"]
+                rows = max(tile, -(-len(c) // tile) * tile)
+                # Pad by CYCLING real pairs (a zero pad would be a genuine
+                # (0, 0) positive pair — see the in-RAM path's rationale).
+                c_p, x_p = np.resize(c, rows), np.resize(x, rows)
+                steps = max(1, len(c) // batch_size)
+                v, u = trainer(
+                    mesh.shard_batch(c_p), mesh.shard_batch(x_p),
+                    pool_dev, v, u, lr, jnp.asarray(steps, jnp.int32),
+                    jax.random.fold_in(
+                        jax.random.fold_in(base_key, epoch), ci
+                    ),
+                )
+            if should_snapshot(self.checkpoint_manager,
+                               self.checkpoint_interval, epoch + 1,
+                               max_iter):
+                self.checkpoint_manager.save(
+                    (np.asarray(v), np.asarray(u)), epoch + 1
+                )
+
         model = Word2VecModel()
         model.copy_params_from(self)
         model._set(np.asarray(vocab, dtype=str), np.asarray(v, np.float64))

@@ -111,6 +111,27 @@ def host_barrier(mesh=None, tag: int = 0) -> int:
     return int(np.asarray(summed.addressable_shards[0].data)[0])
 
 
+def require_single_controller(what: str) -> None:
+    """Raise a clear error when ``what`` runs under a multi-process mesh.
+
+    The streamed out-of-core fits keep per-row state host-resident and
+    place full global batches from one host — on a multi-process mesh
+    that would die opaquely inside ``device_put`` (non-addressable
+    devices). Until streams are ``process_slice``-sharded, the defined
+    behavior is this explicit rejection; multi-host training uses the
+    in-RAM paths with ``mesh.global_batch`` per-host ingest
+    (``examples/multihost_pod.py``).
+    """
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            f"{what} is single-controller: it places full global batches "
+            "from one process, which cannot address a multi-process "
+            "mesh's remote devices. Run it single-process, or use the "
+            "in-RAM fit with per-host `mesh.global_batch` ingest "
+            "(docs/development/parallelism.md, examples/multihost_pod.py)."
+        )
+
+
 def process_slice(n: int, process_index: Optional[int] = None,
                   process_count: Optional[int] = None) -> slice:
     """This host's contiguous row range of a global dataset of ``n`` rows.

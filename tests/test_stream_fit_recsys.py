@@ -1,0 +1,240 @@
+"""Out-of-core streamed fit ITs for the recommendation/text families —
+ALS, LDA, Word2Vec (round-4: VERDICT r3 item 5; reference parity
+``ReplayOperator.java:62-250`` — every bounded iteration trains from
+replayed cached partitions).
+
+Contract (mirrors test_stream_fit.py): spill==RAM EXACT (the memory
+budget is a capacity knob, not a numerics knob), the estimator stream
+path works end-to-end and learns, and checkpoint/resume reproduces the
+uninterrupted run exactly.
+"""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.iteration import CheckpointManager
+from flinkml_tpu.iteration.datacache import cache_stream
+from flinkml_tpu.table import Table
+
+
+def _crash_manager_cls(crash_at_epoch):
+    class Crash(CheckpointManager):
+        fired = False
+
+        def save(self, state, epoch, extra=None):
+            p = super().save(state, epoch, extra)
+            if not Crash.fired and epoch >= crash_at_epoch:
+                Crash.fired = True
+                raise RuntimeError("injected crash")
+            return p
+
+    return Crash
+
+
+# -- ALS ---------------------------------------------------------------------
+
+def _rating_batches(n_users=40, n_items=30, rank=3, per_batch=256,
+                    n_batches=4, seed=0):
+    rng = np.random.default_rng(seed)
+    uf = rng.normal(size=(n_users, rank))
+    vf = rng.normal(size=(n_items, rank))
+    out = []
+    for _ in range(n_batches):
+        u = rng.integers(0, n_users, size=per_batch).astype(np.int64)
+        i = rng.integers(0, n_items, size=per_batch).astype(np.int64)
+        r = np.einsum("nk,nk->n", uf[u], vf[i]).astype(np.float32)
+        out.append({"user": u, "item": i, "rating": r})
+    return out
+
+
+def _als(mesh, **kw):
+    from flinkml_tpu.models.als import ALS
+
+    return (
+        ALS(mesh=mesh, **kw)
+        .set_rank(4).set_max_iter(5).set_reg_param(0.05).set_seed(0)
+    )
+
+
+def test_als_stream_spilled_matches_in_ram_exactly(tmp_path, mesh):
+    batches = _rating_batches()
+    ram = _als(mesh).fit(cache_stream(iter(batches)))
+    spill_cache = cache_stream(
+        iter(batches), directory=str(tmp_path / "spill"),
+        memory_budget_bytes=1,
+    )
+    spilled = _als(mesh).fit(spill_cache)
+    np.testing.assert_array_equal(spilled.user_factors, ram.user_factors)
+    np.testing.assert_array_equal(spilled.item_factors, ram.item_factors)
+    assert any((tmp_path / "spill").glob("segment-*.bin"))
+
+
+def test_als_stream_learns_and_tables_path(tmp_path, mesh):
+    """Estimator path from an iterable of Tables: the streamed model
+    reconstructs the observed ratings (same sanity bar as the in-RAM
+    ALS tests)."""
+    batches = _rating_batches(n_batches=6)
+    tables = [Table(b) for b in batches]
+    model = _als(
+        mesh, cache_dir=str(tmp_path / "als"), cache_memory_budget_bytes=1
+    ).set_max_iter(10).fit(iter(tables))
+    big = {k: np.concatenate([b[k] for b in batches]) for k in batches[0]}
+    (out,) = model.transform(Table({"user": big["user"], "item": big["item"]}))
+    pred = out.column("prediction")
+    rmse = float(np.sqrt(np.mean((pred - big["rating"]) ** 2)))
+    assert rmse < 0.25, rmse
+
+
+def test_als_stream_resume_exact(tmp_path, mesh):
+    cache = cache_stream(iter(_rating_batches()))
+    golden = _als(mesh).set_max_iter(6).fit(cache)
+
+    mgr = _crash_manager_cls(2)(str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="injected"):
+        _als(mesh, checkpoint_manager=mgr,
+             checkpoint_interval=2).set_max_iter(6).fit(cache)
+    assert mgr.latest_epoch() == 2
+
+    rec = _als(mesh, checkpoint_manager=mgr, checkpoint_interval=2,
+               resume=True).set_max_iter(6).fit(cache)
+    np.testing.assert_array_equal(rec.user_factors, golden.user_factors)
+    np.testing.assert_array_equal(rec.item_factors, golden.item_factors)
+
+
+def test_als_in_ram_rejects_checkpoint_knobs(mesh):
+    b = _rating_batches(n_batches=1)[0]
+    with pytest.raises(ValueError, match="streamed fits only"):
+        _als(mesh, checkpoint_manager=CheckpointManager("/tmp/x")).fit(
+            Table(b)
+        )
+
+
+# -- LDA ---------------------------------------------------------------------
+
+def _doc_batches(n_batches=4, per_batch=48, vocab=30, seed=0):
+    """Two topic blocks: docs draw tokens from the low or high half."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        c = np.zeros((per_batch, vocab), np.float32)
+        for r in range(per_batch):
+            half = rng.integers(0, 2)
+            lo, hi = (0, vocab // 2) if half == 0 else (vocab // 2, vocab)
+            idx = rng.integers(lo, hi, size=20)
+            np.add.at(c[r], idx, 1.0)
+        # Sealed-cache batches carry the estimator's features column.
+        out.append({"features": c})
+    return out
+
+
+def _lda(mesh, **kw):
+    from flinkml_tpu.models.lda import LDA
+
+    return LDA(mesh=mesh, **kw).set_k(2).set_max_iter(8).set_tol(0.0) \
+        .set_seed(0)
+
+
+def test_lda_stream_spilled_matches_in_ram_exactly(tmp_path, mesh):
+    batches = _doc_batches()
+    ram = _lda(mesh).fit(cache_stream(iter(batches)))
+    spill_cache = cache_stream(
+        iter(batches), directory=str(tmp_path / "spill"),
+        memory_budget_bytes=1,
+    )
+    spilled = _lda(mesh).fit(spill_cache)
+    np.testing.assert_array_equal(
+        spilled.topics_matrix, ram.topics_matrix
+    )
+    assert any((tmp_path / "spill").glob("segment-*.bin"))
+
+
+def test_lda_stream_learns_topic_split(tmp_path, mesh):
+    """The streamed fit separates the two vocabulary halves into the two
+    topics (each topic's mass concentrates on one half)."""
+    batches = _doc_batches(n_batches=6)
+    tables = [Table({"features": b["features"]}) for b in batches]
+    model = _lda(
+        mesh, cache_dir=str(tmp_path / "lda"), cache_memory_budget_bytes=1
+    ).fit(iter(tables))
+    tm = model.topics_matrix  # [2, V]
+    v = tm.shape[1]
+    lo_mass = tm[:, : v // 2].sum(axis=1)
+    # One topic mostly low-half, the other mostly high-half.
+    assert abs(lo_mass[0] - lo_mass[1]) > 0.5, lo_mass
+
+
+def test_lda_stream_resume_exact(tmp_path, mesh):
+    cache = cache_stream(iter(_doc_batches()))
+    golden = _lda(mesh).fit(cache)
+
+    mgr = _crash_manager_cls(3)(str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="injected"):
+        _lda(mesh, checkpoint_manager=mgr, checkpoint_interval=3).fit(cache)
+    assert mgr.latest_epoch() == 3
+
+    rec = _lda(mesh, checkpoint_manager=mgr, checkpoint_interval=3,
+               resume=True).fit(cache)
+    np.testing.assert_array_equal(rec.topics_matrix, golden.topics_matrix)
+
+
+# -- Word2Vec ----------------------------------------------------------------
+
+def _sentence_tables(n_batches=3, per_batch=40, seed=0):
+    """Token docs over two disjoint cliques: words co-occur only within
+    their clique."""
+    rng = np.random.default_rng(seed)
+    cliques = [[f"a{i}" for i in range(6)], [f"b{i}" for i in range(6)]]
+    out = []
+    for _ in range(n_batches):
+        docs = []
+        for _ in range(per_batch):
+            words = cliques[rng.integers(0, 2)]
+            docs.append(list(rng.choice(words, size=8)))
+        out.append(Table({"tokens": np.asarray(docs, dtype=object)}))
+    return out
+
+
+def _w2v(mesh, **kw):
+    from flinkml_tpu.models.word2vec import Word2Vec
+
+    return (
+        Word2Vec(mesh=mesh, **kw)
+        .set_input_col("tokens").set_vector_size(16).set_window_size(2)
+        .set_min_count(1).set_max_iter(3).set_seed(0)
+    )
+
+
+def test_w2v_stream_spilled_matches_ram_exactly(tmp_path, mesh):
+    ram = _w2v(mesh).fit(iter(_sentence_tables()))
+    spilled = _w2v(
+        mesh, cache_dir=str(tmp_path / "w2v"), cache_memory_budget_bytes=1
+    ).fit(iter(_sentence_tables()))
+    assert list(ram.vocabulary) == list(spilled.vocabulary)
+    np.testing.assert_array_equal(spilled.vectors, ram.vectors)
+    assert any((tmp_path / "w2v").glob("segment-*.bin"))
+
+
+def test_w2v_stream_separates_cliques(mesh):
+    model = _w2v(mesh).set_max_iter(8).fit(iter(_sentence_tables()))
+    vecs = model.vectors / np.linalg.norm(model.vectors, axis=1,
+                                          keepdims=True)
+    idx = {t: i for i, t in enumerate(model.vocabulary)}
+    same = float(vecs[idx["a0"]] @ vecs[idx["a1"]])
+    cross = float(vecs[idx["a0"]] @ vecs[idx["b0"]])
+    assert same > cross, (same, cross)
+
+
+def test_w2v_stream_resume_exact(tmp_path, mesh):
+    golden = _w2v(mesh).set_max_iter(4).fit(iter(_sentence_tables()))
+
+    mgr = _crash_manager_cls(2)(str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="injected"):
+        _w2v(mesh, checkpoint_manager=mgr,
+             checkpoint_interval=2).set_max_iter(4).fit(
+            iter(_sentence_tables())
+        )
+    assert mgr.latest_epoch() == 2
+
+    rec = _w2v(mesh, checkpoint_manager=mgr, checkpoint_interval=2,
+               resume=True).set_max_iter(4).fit(iter(_sentence_tables()))
+    np.testing.assert_array_equal(rec.vectors, golden.vectors)

@@ -145,16 +145,18 @@ def test_gbt_stream_resume_exact(tmp_path, mesh, subsample):
 
 def test_gbt_estimator_resume_plumbing(tmp_path, mesh):
     """The estimator surface carries the checkpoint knobs into the
-    streamed build (crash → resume through GBTClassifier itself)."""
+    streamed build (crash → resume through GBTClassifier itself).
+    Resume requires the durable DataCache form of the input — a one-shot
+    iterable is rejected (tested below)."""
     from flinkml_tpu.models.gbt import GBTClassifier
-    from flinkml_tpu.table import Table
 
     rng = np.random.default_rng(1)
-    tables = []
+    batches = []
     for _ in range(3):
         x = rng.uniform(-1, 1, size=(64, 4)).astype(np.float32)
-        y = (x[:, 0] > 0).astype(np.float32)
-        tables.append(Table({"features": x, "label": y}))
+        batches.append({"features": x,
+                        "label": (x[:, 0] > 0).astype(np.float32)})
+    cache = cache_stream(iter(batches))
 
     def est(**kw):
         return (
@@ -163,19 +165,51 @@ def test_gbt_estimator_resume_plumbing(tmp_path, mesh):
             .set_seed(0)
         )
 
-    golden = est().fit(iter(tables))
+    golden = est().fit(cache)
 
     mgr = _crash_manager_cls(2)(str(tmp_path / "ckpt"))
     with pytest.raises(RuntimeError, match="injected"):
-        est(checkpoint_manager=mgr, checkpoint_interval=2).fit(iter(tables))
+        est(checkpoint_manager=mgr, checkpoint_interval=2).fit(cache)
 
     recovered = est(checkpoint_manager=mgr, checkpoint_interval=2,
-                    resume=True).fit(iter(tables))
+                    resume=True).fit(cache)
     g = golden.get_model_data()[0]
     r = recovered.get_model_data()[0]
     for col in g.column_names:
         np.testing.assert_array_equal(
             np.asarray(g.column(col)), np.asarray(r.column(col))
+        )
+
+
+def test_streamed_resume_requires_durable_cache(tmp_path, mesh):
+    """resume=True with a one-shot iterable (non-replayable) must be
+    rejected — a partially-consumed generator would silently train the
+    restored state on a truncated dataset."""
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    with pytest.raises(ValueError, match="durable DataCache"):
+        train_kmeans_stream(iter(_blobs()), k=3, mesh=mesh, max_iter=2,
+                            seed=0, column="features",
+                            checkpoint_manager=mgr, resume=True)
+
+
+def test_streamed_fits_reject_multi_process(mesh, monkeypatch):
+    """Streamed fits are single-controller: on a multi-process mesh they
+    must raise the defined error (not die opaquely inside device_put on a
+    non-addressable device)."""
+    import jax
+
+    from flinkml_tpu.models.gmm import GaussianMixture
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(RuntimeError, match="single-controller"):
+        train_kmeans_stream(cache_stream(iter(_blobs())), k=3, mesh=mesh,
+                            max_iter=2, seed=0, column="features")
+    with pytest.raises(RuntimeError, match="single-controller"):
+        GaussianMixture(mesh=mesh).set_k(3).fit(
+            cache_stream(iter(_blobs()))
         )
 
 

@@ -50,10 +50,8 @@ def _inner(spec: str) -> None:
 
         n, k = 65_536, 64
         x = np.zeros((n, d), np.float32)
-        xd, wd, _, use_pallas = prepare_kmeans_data(x, mesh)
-        trainer = _kmeans_trainer(
-            mesh.mesh, k, DeviceMesh.DATA_AXIS, use_pallas
-        )
+        xd, wd, _ = prepare_kmeans_data(x, mesh)
+        trainer = _kmeans_trainer(mesh.mesh, k, DeviceMesh.DATA_AXIS)
         lowered = trainer.lower(
             xd, wd, jnp.zeros((k, d), jnp.float32),
             jnp.asarray(3, jnp.int32),
