@@ -4,6 +4,26 @@ Run: PYTHONPATH=. python examples/gbt_nonlinear.py
 (CPU mesh: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)
 """
 
+# Runnable standalone from any cwd: put the repo root on sys.path when
+# flinkml_tpu isn't already importable (pip-installed or PYTHONPATH set).
+import os as _os
+import sys as _sys
+
+try:
+    import flinkml_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(
+        0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    )
+
+# Honor JAX_PLATFORMS even on images whose TPU plugin overrides it at
+# import time (the documented CPU-mesh invocation must actually run on
+# CPU): re-pin the platform from the env var explicitly.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 import numpy as np
 
 from flinkml_tpu.models import (

@@ -9,6 +9,26 @@ Runs on TPU, or on a virtual CPU mesh with:
         python examples/online_ftrl.py
 """
 
+# Runnable standalone from any cwd: put the repo root on sys.path when
+# flinkml_tpu isn't already importable (pip-installed or PYTHONPATH set).
+import os as _os
+import sys as _sys
+
+try:
+    import flinkml_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(
+        0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    )
+
+# Honor JAX_PLATFORMS even on images whose TPU plugin overrides it at
+# import time (the documented CPU-mesh invocation must actually run on
+# CPU): re-pin the platform from the env var explicitly.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 import numpy as np
 
 from flinkml_tpu.models import LogisticRegression, OnlineLogisticRegression

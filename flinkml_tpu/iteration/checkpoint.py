@@ -48,16 +48,18 @@ def begin_resume(manager: Optional["CheckpointManager"], resume: bool,
 def should_snapshot(manager: Optional["CheckpointManager"], interval: int,
                     step: int, total: int, terminal: bool = False) -> bool:
     """Step 2 of the protocol — the save cadence: snapshot every
-    ``interval`` completed steps and always at the final step (so a
-    finished run resumes as a no-op). ``step`` counts completed units
-    (1-based), ``total`` is the run length in the same units;
-    ``terminal=True`` marks an early stop (tol hit) that must write its
-    terminal snapshot regardless of the cadence."""
-    return (
-        manager is not None
-        and interval > 0
-        and (terminal or step == total or step % interval == 0)
-    )
+    ``interval`` completed steps, and ALWAYS at the run's end (the final
+    step, or a ``terminal=True`` early stop) whenever a manager is
+    configured — even with ``interval=0`` — so a finished run always
+    leaves its terminal snapshot and resumes as a no-op (the linear
+    family's documented contract, now uniform). ``step`` counts
+    completed units (1-based), ``total`` is the run length in the same
+    units."""
+    if manager is None:
+        return False
+    if terminal or step == total:
+        return True
+    return interval > 0 and step % interval == 0
 
 
 class CheckpointManager:

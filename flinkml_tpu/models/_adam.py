@@ -23,6 +23,47 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+def _make_minibatch_step(local_loss, axis: str, local_bs: int,
+                         n_params: int, frozen_tail: int):
+    """ONE Adam minibatch step — the single source of the optimizer math
+    shared by the whole-run and chunked trainers (so the streamed fit's
+    numerics can never drift from the in-RAM fit's).
+
+    Returns ``step_fn(x, y, w, params, m, v, step, lr, key) ->
+    (params, m, v, loss)`` where ``step`` is the GLOBAL 0-based step
+    counter (drives both the minibatch key fold and the bias
+    correction).
+    """
+
+    def step_fn(x, y, w, params, m, v, step, lr, key):
+        n_local = x.shape[0]
+        k = jax.random.fold_in(key, step)
+        idx = jax.random.randint(k, (local_bs,), 0, n_local)
+        xb, yb, wb = x[idx], y[idx], w[idx]
+        loss_sum, grads = jax.value_and_grad(local_loss)(params, xb, yb, wb)
+        total_w = jnp.maximum(jax.lax.psum(jnp.sum(wb), axis), 1e-12)
+        loss = jax.lax.psum(loss_sum, axis) / total_w
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, axis) / total_w, grads
+        )
+        if frozen_tail:
+            grads = tuple(grads[: n_params - frozen_tail]) + tuple(
+                jnp.zeros_like(g) for g in grads[n_params - frozen_tail:]
+            )
+        t = (step + 1).astype(jnp.float32)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m = jax.tree.map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+        v = jax.tree.map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+        params = jax.tree.map(
+            lambda p, mm, vv: p - lr * (mm / (1 - b1 ** t))
+            / (jnp.sqrt(vv / (1 - b2 ** t)) + eps),
+            params, m, v,
+        )
+        return params, m, v, loss
+
+    return step_fn
+
+
 @functools.lru_cache(maxsize=32)
 def make_adam_trainer(mesh, axis: str, local_bs: int, loss_builder,
                       n_params: int, frozen_tail: int = 0):
@@ -36,9 +77,10 @@ def make_adam_trainer(mesh, axis: str, local_bs: int, loss_builder,
     reads); their gradients are zeroed so Adam never touches them.
     """
     local_loss = loss_builder()
+    mb_step = _make_minibatch_step(local_loss, axis, local_bs, n_params,
+                                   frozen_tail)
 
     def local(x, y, w, params, lr, max_iter, tol, key):
-        n_local = x.shape[0]
         m0 = jax.tree.map(jnp.zeros_like, params)
         v0 = jax.tree.map(jnp.zeros_like, params)
 
@@ -48,31 +90,8 @@ def make_adam_trainer(mesh, axis: str, local_bs: int, loss_builder,
 
         def body(state):
             step, params, m, v, _, last = state
-            k = jax.random.fold_in(key, step)
-            idx = jax.random.randint(k, (local_bs,), 0, n_local)
-            xb, yb, wb = x[idx], y[idx], w[idx]
-            loss_sum, grads = jax.value_and_grad(local_loss)(
-                params, xb, yb, wb
-            )
-            total_w = jnp.maximum(jax.lax.psum(jnp.sum(wb), axis), 1e-12)
-            loss = jax.lax.psum(loss_sum, axis) / total_w
-            grads = jax.tree.map(
-                lambda g: jax.lax.psum(g, axis) / total_w, grads
-            )
-            if frozen_tail:
-                grads = tuple(grads[: n_params - frozen_tail]) + tuple(
-                    jnp.zeros_like(g)
-                    for g in grads[n_params - frozen_tail:]
-                )
-            t = (step + 1).astype(jnp.float32)
-            b1, b2, eps = 0.9, 0.999, 1e-8
-            m = jax.tree.map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
-            v = jax.tree.map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
-            params = jax.tree.map(
-                lambda p, mm, vv: p - lr * (mm / (1 - b1 ** t))
-                / (jnp.sqrt(vv / (1 - b2 ** t)) + eps),
-                params, m, v,
-            )
+            params, m, v, loss = mb_step(x, y, w, params, m, v, step, lr,
+                                         key)
             return step + 1, params, m, v, last, loss
 
         inf = jnp.asarray(jnp.inf, jnp.float32)
@@ -87,5 +106,49 @@ def make_adam_trainer(mesh, axis: str, local_bs: int, loss_builder,
             in_specs=(P(axis), P(axis), P(axis), flat_specs,
                       P(), P(), P(), P()),
             out_specs=(flat_specs, P(), P()),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def make_adam_chunk_trainer(mesh, axis: str, local_bs: int, loss_builder,
+                            n_params: int):
+    """Fixed-step sibling of :func:`make_adam_trainer` for streamed
+    out-of-core fits: runs ``n_steps`` Adam minibatch steps over ONE
+    device-resident chunk, carrying the full optimizer state
+    ``(params, m, v, global_step)`` in and out — so the trajectory spans
+    every chunk of a replayed cache as one continuous Adam run, and an
+    epoch-boundary snapshot of that state resumes bit-exactly.
+
+    Minibatch keys fold the GLOBAL step counter (not a per-chunk index),
+    so a resumed run draws exactly the key sequence the uninterrupted
+    run would have — the bit-exact-resume requirement. (The rows a key
+    selects still live in the resident chunk: minibatches sample within
+    the chunk, the classic streamed/sequential-SGD discipline.)
+    """
+    local_loss = loss_builder()
+    mb_step = _make_minibatch_step(local_loss, axis, local_bs, n_params,
+                                   frozen_tail=0)
+
+    def local(x, y, w, params, m, v, step0, lr, n_steps, key):
+        def body(_, state):
+            params, m, v, step, _ = state
+            params, m, v, loss = mb_step(x, y, w, params, m, v, step, lr,
+                                         key)
+            return params, m, v, step + 1, loss
+
+        state = (params, m, v, step0, jnp.asarray(-jnp.inf, jnp.float32))
+        params, m, v, step, loss = jax.lax.fori_loop(
+            0, n_steps, body, state
+        )
+        return params, m, v, step, loss
+
+    flat_specs = tuple(P() for _ in range(n_params))
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), flat_specs, flat_specs,
+                      flat_specs, P(), P(), P(), P()),
+            out_specs=(flat_specs, flat_specs, flat_specs, P(), P()),
         )
     )

@@ -293,9 +293,7 @@ class ALS(_ALSParams, Estimator):
         from flinkml_tpu.parallel.distributed import require_single_controller
 
         require_single_controller("ALS streamed fit")
-        from flinkml_tpu.iteration.datacache import DataCache as _DC
-
-        if self.resume and not isinstance(source, _DC):
+        if self.resume and not isinstance(source, DataCache):
             raise ValueError(
                 "resume=True requires a durable DataCache input: a one-shot "
                 "stream cannot be replayed from the start after a failure"
@@ -361,7 +359,13 @@ class ALS(_ALSParams, Estimator):
         item_ids = np.unique(np.concatenate(item_parts))
         n_users, n_items = len(user_ids), len(item_ids)
 
-        row_tile = mesh.axis_size() * 8
+        # Replayed batches dispatch in FIXED chunk_g-row slices — the same
+        # CHUNK bound the in-RAM path uses to cap the [rows, k, k]
+        # normal-equation intermediate at chunk×k² per device, and a
+        # single compiled shape per target side regardless of how the
+        # cache happens to be batched.
+        chunk = min(self.CHUNK, max(256, -(-nnz // mesh.axis_size())))
+        chunk_g = mesh.axis_size() * chunk
         chunk_fns = {
             True: _normal_eq_chunk_fn(
                 mesh.mesh, DeviceMesh.DATA_AXIS, n_users, implicit
@@ -386,17 +390,24 @@ class ALS(_ALSParams, Estimator):
                 u_idx = np.searchsorted(user_ids, u).astype(np.int32)
                 i_idx = np.searchsorted(item_ids, i).astype(np.int32)
                 seg, idx = (u_idx, i_idx) if by_user else (i_idx, u_idx)
-                seg, idx, r = _pad_coo(seg, idx, r, n_target, row_tile)
-                return (
-                    mesh.shard_batch(seg), mesh.shard_batch(idx),
-                    mesh.shard_batch(r),
-                )
+                seg, idx, r = _pad_coo(seg, idx, r, n_target, chunk_g)
+                return [
+                    (
+                        mesh.shard_batch(seg[sl]), mesh.shard_batch(idx[sl]),
+                        mesh.shard_batch(r[sl]),
+                    )
+                    for sl in (
+                        slice(c * chunk_g, (c + 1) * chunk_g)
+                        for c in range(seg.shape[0] // chunk_g)
+                    )
+                ]
 
             feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
             try:
-                for seg, idx, r in feed:
-                    pa, pb, pc = fn(seg, idx, r, fixed, alpha_j)
-                    a, bvec, cnt = a + pa, bvec + pb, cnt + pc
+                for chunks in feed:
+                    for seg, idx, r in chunks:
+                        pa, pb, pc = fn(seg, idx, r, fixed, alpha_j)
+                        a, bvec, cnt = a + pa, bvec + pb, cnt + pc
             finally:
                 feed.close()
             if implicit:

@@ -366,22 +366,20 @@ def train_kmeans_stream(
     cache (or re-fed identical stream) the crashed run trained from.
     """
     from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
-    from flinkml_tpu.parallel.distributed import require_single_controller
-
-    require_single_controller("train_kmeans_stream")
-    from flinkml_tpu.iteration.datacache import DataCache as _DC
-
-    if resume and not isinstance(batches, _DC):
-        raise ValueError(
-            "resume=True requires a durable DataCache input: a one-shot "
-            "stream cannot be replayed from the start after a failure"
-        )
     from flinkml_tpu.iteration.datacache import (
         DataCache,
         DataCacheWriter,
         PrefetchingDeviceFeed,
     )
+    from flinkml_tpu.parallel.distributed import require_single_controller
     from flinkml_tpu.utils.sampling import RowReservoir
+
+    require_single_controller("train_kmeans_stream")
+    if resume and not isinstance(batches, DataCache):
+        raise ValueError(
+            "resume=True requires a durable DataCache input: a one-shot "
+            "stream cannot be replayed from the start after a failure"
+        )
 
     # Decide the resume target BEFORE pass 0, so a successful restore
     # skips the reservoir pass + seeding whose centroids it would discard

@@ -257,9 +257,7 @@ class LDA(_LDAParams, Estimator):
         from flinkml_tpu.parallel.distributed import require_single_controller
 
         require_single_controller("LDA streamed fit")
-        from flinkml_tpu.iteration.datacache import DataCache as _DC
-
-        if self.resume and not isinstance(source, _DC):
+        if self.resume and not isinstance(source, DataCache):
             raise ValueError(
                 "resume=True requires a durable DataCache input: a one-shot "
                 "stream cannot be replayed from the start after a failure"
@@ -343,7 +341,9 @@ class LDA(_LDAParams, Estimator):
 
             def place(batch):
                 c = to_counts(batch).astype(np.float32)
-                c_pad, n_valid = pad_to_multiple(c, p)
+                # 8p row tile bounds the set of padded shapes -> compiles
+                # (same bucketing as the linear stream path).
+                c_pad, n_valid = pad_to_multiple(c, p * 8)
                 rows_w = np.zeros(c_pad.shape[0], np.float32)
                 rows_w[:n_valid] = 1.0
                 b = counter[0]

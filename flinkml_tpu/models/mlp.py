@@ -100,23 +100,59 @@ def _mlp_squared_loss_builder():
 class _MLPBase(_MLPParams, Estimator):
     """Shared fit scaffold: the subclasses differ only in label
     preparation/validation and the loss builder (same pairing pattern as
-    ``fm._FMBase``)."""
+    ``fm._FMBase``).
+
+    ``fit`` also accepts an iterable of batch Tables or a sealed
+    :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
+    out-of-core path (reference replay parity:
+    ``ReplayOperator.java:62-250``): the stream is cached once, then
+    each epoch replays the cache chunk-by-chunk, running Adam minibatch
+    steps within the resident chunk with the optimizer state carried
+    across chunks as one continuous run. ``checkpoint_manager`` +
+    ``checkpoint_interval`` snapshot the full Adam state every N epochs;
+    ``resume=True`` (durable DataCache input required) continues
+    bit-exactly.
+    """
 
     _MODEL_CLS = None
     _LOSS_BUILDER = None
 
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
     def _prepare_labels(self, y: np.ndarray, layers) -> np.ndarray:
         raise NotImplementedError
 
-    def fit(self, *inputs: Table):
-        (table,) = inputs
+    def _check_layers(self):
         layers = self.get(self.LAYERS)
         if layers is None or len(layers) < 2:
             raise ValueError("layers must list at least [inputDim, outputDim]")
+        return layers
+
+    def fit(self, *inputs):
+        (table,) = inputs
+        if not isinstance(table, Table):
+            return self._fit_stream(table)
+        if self.checkpoint_manager is not None or self.resume:
+            raise ValueError(
+                "checkpointing is supported for streamed fits only "
+                "(pass an iterable of batch Tables or a DataCache)"
+            )
+        layers = self._check_layers()
         x, y, w = labeled_data(
             table, self.get(self.FEATURES_COL), self.get(self.LABEL_COL)
         )
@@ -148,6 +184,169 @@ class _MLPBase(_MLPParams, Estimator):
             f32(self.get(self.TOL)),
             jax.random.fold_in(key, 123),
         )
+        model = self._MODEL_CLS()
+        model.copy_params_from(self)
+        model._weights = [np.asarray(t, np.float64) for t in flat]
+        return model
+
+    def _fit_stream(self, source):
+        """Out-of-core Adam (see class docstring): the optimizer state
+        (params, m, v, global step) rides across the replayed chunks as
+        one continuous run; minibatch keys fold the global step, so a
+        resumed run draws exactly the uninterrupted run's key sequence
+        (minibatches sample within the resident chunk — streamed SGD)."""
+        from flinkml_tpu.iteration.checkpoint import (
+            begin_resume,
+            should_snapshot,
+        )
+        from flinkml_tpu.iteration.datacache import (
+            DataCache,
+            DataCacheWriter,
+            PrefetchingDeviceFeed,
+        )
+        from flinkml_tpu.models._adam import make_adam_chunk_trainer
+        from flinkml_tpu.parallel.distributed import require_single_controller
+
+        require_single_controller("MLP streamed fit")
+        if self.resume and not isinstance(source, DataCache):
+            raise ValueError(
+                "resume=True requires a durable DataCache input: a one-shot "
+                "stream cannot be replayed from the start after a failure"
+            )
+        layers = self._check_layers()
+        features_col = self.get(self.FEATURES_COL)
+        label_col = self.get(self.LABEL_COL)
+        mesh = self.mesh or DeviceMesh()
+        p = mesh.axis_size()
+        resume_epoch = begin_resume(
+            self.checkpoint_manager, self.resume, mesh.mesh.size
+        )
+
+        # -- pass 0: cache (labels validated/prepared per batch) -----------
+        n_rows = 0
+        if isinstance(source, DataCache):
+            cache = source
+        else:
+            writer = DataCacheWriter(
+                self.cache_dir, self.cache_memory_budget_bytes
+            )
+            for t in source:
+                x, y, w = labeled_data(t, features_col, label_col)
+                if x.shape[0] == 0:
+                    raise ValueError(
+                        "stream batch has zero rows; drop empty batches"
+                    )
+                if x.shape[1] != layers[0]:
+                    raise ValueError(
+                        f"layers[0]={layers[0]} != feature dim {x.shape[1]}"
+                    )
+                writer.append({
+                    "x": x.astype(np.float32),
+                    "y": self._prepare_labels(y, layers),
+                    "w": w.astype(np.float32),
+                })
+                n_rows += x.shape[0]
+            cache = writer.finish()
+        if cache.num_rows == 0:
+            raise ValueError("training stream is empty")
+
+        def place(batch):
+            x = np.asarray(batch["x"], np.float32)
+            if x.shape[1] != layers[0]:
+                raise ValueError(
+                    f"layers[0]={layers[0]} != feature dim {x.shape[1]}"
+                )
+            y = self._prepare_labels(
+                np.asarray(batch["y"]), layers
+            ) if isinstance(source, DataCache) else np.asarray(batch["y"])
+            w = (
+                np.asarray(batch["w"], np.float32)
+                if "w" in batch else np.ones(x.shape[0], np.float32)
+            )
+            x_pad, n_valid = pad_to_multiple(x, p)
+            y_pad, _ = pad_to_multiple(y, p)
+            w_pad = np.zeros(x_pad.shape[0], np.float32)
+            w_pad[:n_valid] = w[:n_valid]
+            return (
+                mesh.shard_batch(x_pad), mesh.shard_batch(y_pad),
+                mesh.shard_batch(w_pad), x.shape[0],
+            )
+
+        global_bs = self.get(self.GLOBAL_BATCH_SIZE)
+        local_bs = max(1, global_bs // p)
+        trainer = make_adam_chunk_trainer(
+            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
+            type(self)._LOSS_BUILDER, 2 * (len(layers) - 1),
+        )
+        key = jax.random.PRNGKey(self.get_seed())
+        init = _init_params(list(layers), key)
+        flat = tuple(t for wb in init for t in wb)
+        m = tuple(jnp.zeros_like(t) for t in flat)
+        v = tuple(jnp.zeros_like(t) for t in flat)
+        step = jnp.asarray(0, jnp.int32)
+        sample_key = jax.random.fold_in(key, 123)
+        f32 = lambda a: jnp.asarray(a, jnp.float32)
+        lr = f32(self.get(self.LEARNING_RATE))
+
+        prev_loss = np.inf
+        start_epoch = 0
+        terminated = False
+        mgr = self.checkpoint_manager
+        if resume_epoch is not None:
+            like = (
+                tuple(np.zeros(t.shape, np.float32) for t in flat),
+                tuple(np.zeros(t.shape, np.float32) for t in flat),
+                tuple(np.zeros(t.shape, np.float32) for t in flat),
+                np.int32(0), np.float64(0.0), np.asarray(False),
+            )
+            (flat_h, m_h, v_h, step_h, prev_h, term), start_epoch = (
+                mgr.restore(resume_epoch, like)
+            )
+            flat = tuple(jnp.asarray(t) for t in flat_h)
+            m = tuple(jnp.asarray(t) for t in m_h)
+            v = tuple(jnp.asarray(t) for t in v_h)
+            step = jnp.asarray(int(step_h), jnp.int32)
+            prev_loss = float(prev_h)
+            terminated = bool(term)
+
+        # max_iter counts EPOCHS here (one replay pass each); within an
+        # epoch every chunk contributes ceil(rows/global_bs) Adam steps.
+        max_iter = self.get(self.MAX_ITER)
+        tol = self.get(self.TOL)
+        for epoch in range(start_epoch, max_iter):
+            if terminated:
+                break
+            last_loss = None
+            feed = PrefetchingDeviceFeed(cache.reader(), place=place,
+                                         depth=2)
+            try:
+                for xb, yb, wb, rows in feed:
+                    n_steps = max(1, rows // global_bs)
+                    flat, m, v, step, loss = trainer(
+                        xb, yb, wb, flat, m, v, step, lr,
+                        jnp.asarray(n_steps, jnp.int32), sample_key,
+                    )
+                    last_loss = loss
+            finally:
+                feed.close()
+            cur = float(last_loss)
+            terminated = abs(prev_loss - cur) <= tol
+            prev_loss = cur
+            if should_snapshot(mgr, self.checkpoint_interval, epoch + 1,
+                               max_iter, terminal=terminated):
+                mgr.save(
+                    (
+                        tuple(np.asarray(t) for t in flat),
+                        tuple(np.asarray(t) for t in m),
+                        tuple(np.asarray(t) for t in v),
+                        np.int32(int(step)), np.float64(prev_loss),
+                        np.asarray(terminated),
+                    ),
+                    epoch + 1,
+                )
+            if terminated:
+                break
+
         model = self._MODEL_CLS()
         model.copy_params_from(self)
         model._weights = [np.asarray(t, np.float64) for t in flat]

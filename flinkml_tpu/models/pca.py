@@ -64,12 +64,22 @@ class _PCAParams(HasInputCol, HasOutputCol):
 
 
 class PCA(_PCAParams, Estimator):
+    """``fit`` also accepts an iterable of batch Tables or a sealed
+    :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
+    out-of-core path: PCA is a SINGLE accumulation pass (count, centered
+    sum, centered gram per batch, summed on device), so no cache replay
+    is needed and the only resident state is the [d, d] gram. No
+    checkpoint knobs: a single cheap pass restarts, it doesn't resume
+    (checkpointing targets multi-pass iteration)."""
+
     def __init__(self, mesh: Optional[DeviceMesh] = None):
         super().__init__()
         self.mesh = mesh
 
-    def fit(self, *inputs: Table) -> "PCAModel":
+    def fit(self, *inputs) -> "PCAModel":
         (table,) = inputs
+        if not isinstance(table, Table):
+            return self._fit_stream(table)
         x = features_matrix(table, self.get(self.INPUT_COL))
         n, d = x.shape
         k = self.get(self.K)
@@ -81,11 +91,67 @@ class PCA(_PCAParams, Estimator):
         cnt, s, g = _mean_and_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)(
             xd, wd, jnp.asarray(shift)
         )
-        cnt = float(cnt)
-        mean_c = np.asarray(s, np.float64) / cnt          # mean of (x - shift)
-        gram = np.asarray(g, np.float64)
+        return self._finish(float(cnt), np.asarray(s, np.float64),
+                            np.asarray(g, np.float64), shift, k)
+
+    def _fit_stream(self, source) -> "PCAModel":
+        """Out-of-core single-pass PCA (see class docstring)."""
+        from flinkml_tpu.iteration.datacache import DataCache
+        from flinkml_tpu.parallel.distributed import require_single_controller
+
+        require_single_controller("PCA streamed fit")
+        input_col = self.get(self.INPUT_COL)
+        k = self.get(self.K)
+        mesh = self.mesh or DeviceMesh()
+        fn = _mean_and_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)
+
+        column = input_col if isinstance(source, DataCache) else None
+        batches = source.reader() if isinstance(source, DataCache) else source
+
+        cnt = 0.0
+        s = g = None
+        shift = None
+        d = None
+        for b in batches:
+            if column is not None:
+                x = np.asarray(b[column], np.float32)
+            else:
+                x = features_matrix(b, input_col).astype(np.float32)
+            if x.ndim != 2 or x.shape[0] == 0:
+                raise ValueError(
+                    f"stream batches must be non-empty [n, d], got {x.shape}"
+                )
+            if d is None:
+                d = x.shape[1]
+                shift = np.array(x[0])  # first row of the stream
+            elif x.shape[1] != d:
+                raise ValueError(
+                    f"batch feature dim {x.shape[1]} != first batch's {d}"
+                )
+            xd, wd = _shard_with_mask(x, mesh)
+            cb, sb, gb = fn(xd, wd, jnp.asarray(shift))
+            cnt += float(cb)
+            s = np.asarray(sb, np.float64) if s is None else (
+                s + np.asarray(sb, np.float64)
+            )
+            g = np.asarray(gb, np.float64) if g is None else (
+                g + np.asarray(gb, np.float64)
+            )
+        if d is None:
+            raise ValueError("training stream is empty")
+        if k > min(int(cnt), d):
+            raise ValueError(
+                f"k={k} must be <= min(n_rows, dim) = {min(int(cnt), d)}"
+            )
+        return self._finish(cnt, s, g, shift, k)
+
+    def _finish(self, cnt: float, s: np.ndarray, g: np.ndarray,
+                shift: np.ndarray, k: int) -> "PCAModel":
+        """Host f64 eigensolve from the accumulated (count, sum, gram) —
+        shared by the in-RAM single pass and the streamed accumulation."""
+        mean_c = s / cnt                                  # mean of (x - shift)
         # cov of x = E[(x-shift)(x-shift)ᵀ] - mean_c mean_cᵀ, over n-1.
-        cov = (gram / cnt - np.outer(mean_c, mean_c)) * (cnt / max(cnt - 1, 1))
+        cov = (g / cnt - np.outer(mean_c, mean_c)) * (cnt / max(cnt - 1, 1))
         eigvals, eigvecs = np.linalg.eigh(cov)
         idx = np.argsort(eigvals)[::-1][:k]
         components = eigvecs[:, idx].T                     # [k, d]
