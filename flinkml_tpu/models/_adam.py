@@ -112,7 +112,7 @@ def make_adam_trainer(mesh, axis: str, local_bs: int, loss_builder,
 
 @functools.lru_cache(maxsize=32)
 def make_adam_chunk_trainer(mesh, axis: str, local_bs: int, loss_builder,
-                            n_params: int):
+                            n_params: int, frozen_tail: int = 0):
     """Fixed-step sibling of :func:`make_adam_trainer` for streamed
     out-of-core fits: runs ``n_steps`` Adam minibatch steps over ONE
     device-resident chunk, carrying the full optimizer state
@@ -128,7 +128,7 @@ def make_adam_chunk_trainer(mesh, axis: str, local_bs: int, loss_builder,
     """
     local_loss = loss_builder()
     mb_step = _make_minibatch_step(local_loss, axis, local_bs, n_params,
-                                   frozen_tail=0)
+                                   frozen_tail)
 
     def local(x, y, w, params, m, v, step0, lr, n_steps, key):
         def body(_, state):
@@ -152,3 +152,185 @@ def make_adam_chunk_trainer(mesh, axis: str, local_bs: int, loss_builder,
             out_specs=(flat_specs, flat_specs, flat_specs, P(), P()),
         )
     )
+
+
+def run_streamed_adam(
+    source,
+    *,
+    what: str,
+    mesh,
+    cache_dir,
+    cache_memory_budget_bytes,
+    ingest,
+    place_y,
+    loss_builder,
+    n_params: int,
+    params0_fn,
+    lr: float,
+    global_bs: int,
+    max_iter: int,
+    tol: float,
+    seed: int,
+    frozen_tail: int = 0,
+    checkpoint_manager=None,
+    checkpoint_interval: int = 0,
+    resume: bool = False,
+):
+    """The shared out-of-core Adam fit loop (MLP, FM — any
+    ``make_adam_trainer`` family member): cache the stream once, then
+    each epoch replays the cache chunk-by-chunk through
+    :func:`make_adam_chunk_trainer`, with the optimizer state carried
+    across chunks as one continuous run and snapshotted at epoch
+    boundaries (``begin_resume``/``should_snapshot`` protocol; resume
+    requires a durable DataCache input).
+
+    - ``ingest(table) -> {"x", "y", "w"}``: per-batch extraction +
+      validation for the caching pass (one-shot stream sources).
+    - ``place_y(y_raw) -> y``: label preparation/validation applied at
+      replay time (covers sealed-DataCache sources too).
+    - ``params0_fn(d) -> flat params tuple``: initial parameters, given
+      the feature dim discovered from the cache.
+
+    Returns the final flat params tuple (device arrays).
+
+    Reference parity: ``ReplayOperator.java:62-250`` (replayed cached
+    partitions); ``Checkpoints.java:43-211`` (exact-resume contract).
+    """
+    import numpy as np
+
+    from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
+    from flinkml_tpu.iteration.datacache import (
+        DataCache,
+        DataCacheWriter,
+        PrefetchingDeviceFeed,
+    )
+    from flinkml_tpu.parallel import pad_to_multiple
+    from flinkml_tpu.parallel.distributed import require_single_controller
+    from flinkml_tpu.parallel.mesh import DeviceMesh
+
+    require_single_controller(what)
+    if resume and not isinstance(source, DataCache):
+        raise ValueError(
+            "resume=True requires a durable DataCache input: a one-shot "
+            "stream cannot be replayed from the start after a failure"
+        )
+    p = mesh.axis_size()
+    resume_epoch = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
+
+    # -- pass 0: cache --------------------------------------------------
+    if isinstance(source, DataCache):
+        cache = source
+    else:
+        writer = DataCacheWriter(cache_dir, cache_memory_budget_bytes)
+        for t in source:
+            b = ingest(t)
+            if b["x"].shape[0] == 0:
+                raise ValueError(
+                    "stream batch has zero rows; drop empty batches"
+                )
+            writer.append(b)
+        cache = writer.finish()
+    if cache.num_rows == 0:
+        raise ValueError("training stream is empty")
+    reader = cache.reader()
+    d = np.asarray(next(iter(reader))["x"]).shape[1]
+    if hasattr(reader, "close"):
+        reader.close()
+
+    # Labels in a cache the runner built itself were already prepared/
+    # validated at ingest; re-running place_y per chunk per epoch would
+    # put O(rows log rows) redundant host validation on the prefetch
+    # thread. Only user-supplied sealed caches need replay-time prep.
+    labels_prepared = not isinstance(source, DataCache)
+
+    def place(batch):
+        x = np.asarray(batch["x"], np.float32)
+        if x.shape[1] != d:
+            raise ValueError(
+                f"batch feature dim {x.shape[1]} != first batch's {d}"
+            )
+        y = np.asarray(batch["y"])
+        if not labels_prepared:
+            y = place_y(y)
+        w = (
+            np.asarray(batch["w"], np.float32)
+            if "w" in batch else np.ones(x.shape[0], np.float32)
+        )
+        # 8p row tile bounds the set of padded shapes -> compiles.
+        x_pad, n_valid = pad_to_multiple(x, p * 8)
+        y_pad, _ = pad_to_multiple(y, p * 8)
+        w_pad = np.zeros(x_pad.shape[0], np.float32)
+        w_pad[:n_valid] = w[:n_valid]
+        return (
+            mesh.shard_batch(x_pad), mesh.shard_batch(y_pad),
+            mesh.shard_batch(w_pad), x.shape[0],
+        )
+
+    local_bs = max(1, global_bs // p)
+    trainer = make_adam_chunk_trainer(
+        mesh.mesh, DeviceMesh.DATA_AXIS, local_bs, loss_builder, n_params,
+        frozen_tail,
+    )
+    flat = tuple(params0_fn(d))
+    m = tuple(jnp.zeros_like(t) for t in flat)
+    v = tuple(jnp.zeros_like(t) for t in flat)
+    step = jnp.asarray(0, jnp.int32)
+    sample_key = jax.random.fold_in(jax.random.PRNGKey(seed), 123)
+    lr_dev = jnp.asarray(lr, jnp.float32)
+
+    prev_loss = np.inf
+    start_epoch = 0
+    terminated = False
+    mgr = checkpoint_manager
+    if resume_epoch is not None:
+        like = (
+            tuple(np.zeros(t.shape, np.float32) for t in flat),
+            tuple(np.zeros(t.shape, np.float32) for t in flat),
+            tuple(np.zeros(t.shape, np.float32) for t in flat),
+            np.int32(0), np.float64(0.0), np.asarray(False),
+        )
+        (flat_h, m_h, v_h, step_h, prev_h, term), start_epoch = (
+            mgr.restore(resume_epoch, like)
+        )
+        flat = tuple(jnp.asarray(t) for t in flat_h)
+        m = tuple(jnp.asarray(t) for t in m_h)
+        v = tuple(jnp.asarray(t) for t in v_h)
+        step = jnp.asarray(int(step_h), jnp.int32)
+        prev_loss = float(prev_h)
+        terminated = bool(term)
+
+    # max_iter counts EPOCHS (one replay pass each); within an epoch
+    # every chunk contributes ceil(rows / global_bs) Adam steps.
+    for epoch in range(start_epoch, max_iter):
+        if terminated:
+            break
+        last_loss = None
+        feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
+        try:
+            for xb, yb, wb, rows in feed:
+                n_steps = max(1, -(-rows // global_bs))  # ceil
+                flat, m, v, step, loss = trainer(
+                    xb, yb, wb, flat, m, v, step, lr_dev,
+                    jnp.asarray(n_steps, jnp.int32), sample_key,
+                )
+                last_loss = loss
+        finally:
+            feed.close()
+        cur = float(last_loss)
+        terminated = abs(prev_loss - cur) <= tol
+        prev_loss = cur
+        if should_snapshot(mgr, checkpoint_interval, epoch + 1, max_iter,
+                           terminal=terminated):
+            mgr.save(
+                (
+                    tuple(np.asarray(t) for t in flat),
+                    tuple(np.asarray(t) for t in m),
+                    tuple(np.asarray(t) for t in v),
+                    np.int32(int(step)), np.float64(prev_loss),
+                    np.asarray(terminated),
+                ),
+                epoch + 1,
+            )
+        if terminated:
+            break
+    return flat

@@ -89,14 +89,119 @@ def _fm_squared_loss_builder():
 
 
 class _FMBase(_FMParams, Estimator):
+    """``fit`` also accepts an iterable of batch Tables or a sealed
+    :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
+    out-of-core path (the shared streamed-Adam runner,
+    :func:`flinkml_tpu.models._adam.run_streamed_adam`; reference replay
+    parity ``ReplayOperator.java:62-250``). ``checkpoint_manager`` +
+    ``checkpoint_interval`` snapshot the full Adam state every N epochs;
+    ``resume=True`` (durable DataCache input required) continues
+    bit-exactly."""
+
     _LOGISTIC = True
 
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
+    def __init__(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+    ):
         super().__init__()
         self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
 
-    def fit(self, *inputs: Table):
+    def _loss_builder(self):
+        return (
+            _fm_logistic_loss_builder if self._LOGISTIC
+            else _fm_squared_loss_builder
+        )
+
+    def _params0(self, d: int):
+        """Initial flat params tuple (bias, w, V, frozen reg tail) — the
+        single source for the in-RAM and streamed paths."""
+        k = self.get(self.FACTOR_SIZE)
+        v0 = jax.random.normal(
+            jax.random.PRNGKey(self.get_seed()), (d, k), jnp.float32
+        ) * 0.01
+        return (
+            jnp.zeros(1, jnp.float32),
+            jnp.zeros(d, jnp.float32),
+            v0,
+            jnp.asarray([self.get(self.REG)], jnp.float32),
+        )
+
+    def _make_model(self, params):
+        model = (FMClassifierModel if self._LOGISTIC else FMRegressorModel)()
+        model.copy_params_from(self)
+        model._set(np.asarray(params[0], np.float64)[0],
+                   np.asarray(params[1], np.float64),
+                   np.asarray(params[2], np.float64))
+        return model
+
+    def _fit_stream(self, source):
+        """Out-of-core FM via the shared streamed-Adam runner; the reg
+        strength rides as the frozen params-tuple tail, exactly as in
+        the in-RAM path."""
+        from flinkml_tpu.models._adam import run_streamed_adam
+
+        features_col = self.get(self.FEATURES_COL)
+        label_col = self.get(self.LABEL_COL)
+        weight_col = self.get(self.WEIGHT_COL)
+        mesh = self.mesh or DeviceMesh()
+
+        def prepare_y(y):
+            y = np.asarray(y, np.float32)
+            if self._LOGISTIC:
+                check_binary_labels(y, type(self).__name__)
+            return y
+
+        def ingest(t):
+            x, y, w = labeled_data(t, features_col, label_col, weight_col)
+            return {
+                "x": x.astype(np.float32),
+                "y": prepare_y(y),
+                "w": w.astype(np.float32),
+            }
+
+        params = run_streamed_adam(
+            source,
+            what="FM streamed fit",
+            mesh=mesh,
+            cache_dir=self.cache_dir,
+            cache_memory_budget_bytes=self.cache_memory_budget_bytes,
+            ingest=ingest,
+            place_y=prepare_y,
+            loss_builder=self._loss_builder(),
+            n_params=4,
+            params0_fn=self._params0,
+            lr=self.get(self.LEARNING_RATE),
+            global_bs=self.get(self.GLOBAL_BATCH_SIZE),
+            max_iter=self.get(self.MAX_ITER),
+            tol=self.get(self.TOL),
+            seed=self.get_seed(),
+            frozen_tail=1,
+            checkpoint_manager=self.checkpoint_manager,
+            checkpoint_interval=self.checkpoint_interval,
+            resume=self.resume,
+        )
+        return self._make_model(params)
+
+    def fit(self, *inputs):
         (table,) = inputs
+        if not isinstance(table, Table):
+            return self._fit_stream(table)
+        if self.checkpoint_manager is not None or self.resume:
+            raise ValueError(
+                "checkpointing is supported for streamed fits only "
+                "(pass an iterable of batch Tables or a DataCache)"
+            )
         x, y, w = labeled_data(
             table, self.get(self.FEATURES_COL), self.get(self.LABEL_COL),
             self.get(self.WEIGHT_COL),
@@ -104,7 +209,6 @@ class _FMBase(_FMParams, Estimator):
         if self._LOGISTIC:
             check_binary_labels(y, type(self).__name__)
         d = x.shape[1]
-        k = self.get(self.FACTOR_SIZE)
         mesh = self.mesh or DeviceMesh()
         p = mesh.axis_size()
         x_pad, n_valid = pad_to_multiple(x.astype(np.float32), p)
@@ -112,37 +216,20 @@ class _FMBase(_FMParams, Estimator):
         w_pad = np.zeros(x_pad.shape[0], np.float32)
         w_pad[:n_valid] = w[:n_valid].astype(np.float32)
         local_bs = max(1, self.get(self.GLOBAL_BATCH_SIZE) // p)
-        builder = (
-            _fm_logistic_loss_builder if self._LOGISTIC
-            else _fm_squared_loss_builder
-        )
         trainer = make_adam_trainer(
-            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs, builder, 4,
-            frozen_tail=1,
-        )
-        key = jax.random.PRNGKey(self.get_seed())
-        v0 = jax.random.normal(key, (d, k), jnp.float32) * 0.01
-        params0 = (
-            jnp.zeros(1, jnp.float32),
-            jnp.zeros(d, jnp.float32),
-            v0,
-            jnp.asarray([self.get(self.REG)], jnp.float32),
+            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs, self._loss_builder(),
+            4, frozen_tail=1,
         )
         f32 = lambda val: jnp.asarray(val, jnp.float32)
         params, steps, loss = trainer(
             mesh.shard_batch(x_pad), mesh.shard_batch(y_pad),
-            mesh.shard_batch(w_pad), params0,
+            mesh.shard_batch(w_pad), self._params0(d),
             f32(self.get(self.LEARNING_RATE)),
             jnp.asarray(self.get(self.MAX_ITER), jnp.int32),
             f32(self.get(self.TOL)),
-            jax.random.fold_in(key, 321),
+            jax.random.fold_in(jax.random.PRNGKey(self.get_seed()), 321),
         )
-        model = (FMClassifierModel if self._LOGISTIC else FMRegressorModel)()
-        model.copy_params_from(self)
-        model._set(np.asarray(params[0], np.float64)[0],
-                   np.asarray(params[1], np.float64),
-                   np.asarray(params[2], np.float64))
-        return model
+        return self._make_model(params)
 
 
 class _FMModelBase(_FMParams, Model):

@@ -190,163 +190,59 @@ class _MLPBase(_MLPParams, Estimator):
         return model
 
     def _fit_stream(self, source):
-        """Out-of-core Adam (see class docstring): the optimizer state
-        (params, m, v, global step) rides across the replayed chunks as
-        one continuous run; minibatch keys fold the global step, so a
-        resumed run draws exactly the uninterrupted run's key sequence
-        (minibatches sample within the resident chunk — streamed SGD)."""
-        from flinkml_tpu.iteration.checkpoint import (
-            begin_resume,
-            should_snapshot,
-        )
-        from flinkml_tpu.iteration.datacache import (
-            DataCache,
-            DataCacheWriter,
-            PrefetchingDeviceFeed,
-        )
-        from flinkml_tpu.models._adam import make_adam_chunk_trainer
-        from flinkml_tpu.parallel.distributed import require_single_controller
+        """Out-of-core Adam via the shared runner
+        (:func:`flinkml_tpu.models._adam.run_streamed_adam`): the
+        optimizer state rides across the replayed chunks as one
+        continuous run, snapshotted at epoch boundaries."""
+        from flinkml_tpu.models._adam import run_streamed_adam
 
-        require_single_controller("MLP streamed fit")
-        if self.resume and not isinstance(source, DataCache):
-            raise ValueError(
-                "resume=True requires a durable DataCache input: a one-shot "
-                "stream cannot be replayed from the start after a failure"
-            )
         layers = self._check_layers()
         features_col = self.get(self.FEATURES_COL)
         label_col = self.get(self.LABEL_COL)
         mesh = self.mesh or DeviceMesh()
-        p = mesh.axis_size()
-        resume_epoch = begin_resume(
-            self.checkpoint_manager, self.resume, mesh.mesh.size
-        )
 
-        # -- pass 0: cache (labels validated/prepared per batch) -----------
-        n_rows = 0
-        if isinstance(source, DataCache):
-            cache = source
-        else:
-            writer = DataCacheWriter(
-                self.cache_dir, self.cache_memory_budget_bytes
-            )
-            for t in source:
-                x, y, w = labeled_data(t, features_col, label_col)
-                if x.shape[0] == 0:
-                    raise ValueError(
-                        "stream batch has zero rows; drop empty batches"
-                    )
-                if x.shape[1] != layers[0]:
-                    raise ValueError(
-                        f"layers[0]={layers[0]} != feature dim {x.shape[1]}"
-                    )
-                writer.append({
-                    "x": x.astype(np.float32),
-                    "y": self._prepare_labels(y, layers),
-                    "w": w.astype(np.float32),
-                })
-                n_rows += x.shape[0]
-            cache = writer.finish()
-        if cache.num_rows == 0:
-            raise ValueError("training stream is empty")
-
-        def place(batch):
-            x = np.asarray(batch["x"], np.float32)
+        def ingest(t):
+            x, y, w = labeled_data(t, features_col, label_col)
             if x.shape[1] != layers[0]:
                 raise ValueError(
                     f"layers[0]={layers[0]} != feature dim {x.shape[1]}"
                 )
-            y = self._prepare_labels(
-                np.asarray(batch["y"]), layers
-            ) if isinstance(source, DataCache) else np.asarray(batch["y"])
-            w = (
-                np.asarray(batch["w"], np.float32)
-                if "w" in batch else np.ones(x.shape[0], np.float32)
-            )
-            x_pad, n_valid = pad_to_multiple(x, p)
-            y_pad, _ = pad_to_multiple(y, p)
-            w_pad = np.zeros(x_pad.shape[0], np.float32)
-            w_pad[:n_valid] = w[:n_valid]
-            return (
-                mesh.shard_batch(x_pad), mesh.shard_batch(y_pad),
-                mesh.shard_batch(w_pad), x.shape[0],
-            )
+            return {
+                "x": x.astype(np.float32),
+                "y": self._prepare_labels(y, layers),
+                "w": w.astype(np.float32),
+            }
 
-        global_bs = self.get(self.GLOBAL_BATCH_SIZE)
-        local_bs = max(1, global_bs // p)
-        trainer = make_adam_chunk_trainer(
-            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
-            type(self)._LOSS_BUILDER, 2 * (len(layers) - 1),
-        )
-        key = jax.random.PRNGKey(self.get_seed())
-        init = _init_params(list(layers), key)
-        flat = tuple(t for wb in init for t in wb)
-        m = tuple(jnp.zeros_like(t) for t in flat)
-        v = tuple(jnp.zeros_like(t) for t in flat)
-        step = jnp.asarray(0, jnp.int32)
-        sample_key = jax.random.fold_in(key, 123)
-        f32 = lambda a: jnp.asarray(a, jnp.float32)
-        lr = f32(self.get(self.LEARNING_RATE))
-
-        prev_loss = np.inf
-        start_epoch = 0
-        terminated = False
-        mgr = self.checkpoint_manager
-        if resume_epoch is not None:
-            like = (
-                tuple(np.zeros(t.shape, np.float32) for t in flat),
-                tuple(np.zeros(t.shape, np.float32) for t in flat),
-                tuple(np.zeros(t.shape, np.float32) for t in flat),
-                np.int32(0), np.float64(0.0), np.asarray(False),
-            )
-            (flat_h, m_h, v_h, step_h, prev_h, term), start_epoch = (
-                mgr.restore(resume_epoch, like)
-            )
-            flat = tuple(jnp.asarray(t) for t in flat_h)
-            m = tuple(jnp.asarray(t) for t in m_h)
-            v = tuple(jnp.asarray(t) for t in v_h)
-            step = jnp.asarray(int(step_h), jnp.int32)
-            prev_loss = float(prev_h)
-            terminated = bool(term)
-
-        # max_iter counts EPOCHS here (one replay pass each); within an
-        # epoch every chunk contributes ceil(rows/global_bs) Adam steps.
-        max_iter = self.get(self.MAX_ITER)
-        tol = self.get(self.TOL)
-        for epoch in range(start_epoch, max_iter):
-            if terminated:
-                break
-            last_loss = None
-            feed = PrefetchingDeviceFeed(cache.reader(), place=place,
-                                         depth=2)
-            try:
-                for xb, yb, wb, rows in feed:
-                    n_steps = max(1, rows // global_bs)
-                    flat, m, v, step, loss = trainer(
-                        xb, yb, wb, flat, m, v, step, lr,
-                        jnp.asarray(n_steps, jnp.int32), sample_key,
-                    )
-                    last_loss = loss
-            finally:
-                feed.close()
-            cur = float(last_loss)
-            terminated = abs(prev_loss - cur) <= tol
-            prev_loss = cur
-            if should_snapshot(mgr, self.checkpoint_interval, epoch + 1,
-                               max_iter, terminal=terminated):
-                mgr.save(
-                    (
-                        tuple(np.asarray(t) for t in flat),
-                        tuple(np.asarray(t) for t in m),
-                        tuple(np.asarray(t) for t in v),
-                        np.int32(int(step)), np.float64(prev_loss),
-                        np.asarray(terminated),
-                    ),
-                    epoch + 1,
+        def params0_fn(d):
+            if d != layers[0]:
+                raise ValueError(
+                    f"layers[0]={layers[0]} != feature dim {d}"
                 )
-            if terminated:
-                break
+            init = _init_params(
+                list(layers), jax.random.PRNGKey(self.get_seed())
+            )
+            return tuple(t for wb in init for t in wb)
 
+        flat = run_streamed_adam(
+            source,
+            what="MLP streamed fit",
+            mesh=mesh,
+            cache_dir=self.cache_dir,
+            cache_memory_budget_bytes=self.cache_memory_budget_bytes,
+            ingest=ingest,
+            place_y=lambda y: self._prepare_labels(y, layers),
+            loss_builder=type(self)._LOSS_BUILDER,
+            n_params=2 * (len(layers) - 1),
+            params0_fn=params0_fn,
+            lr=self.get(self.LEARNING_RATE),
+            global_bs=self.get(self.GLOBAL_BATCH_SIZE),
+            max_iter=self.get(self.MAX_ITER),
+            tol=self.get(self.TOL),
+            seed=self.get_seed(),
+            checkpoint_manager=self.checkpoint_manager,
+            checkpoint_interval=self.checkpoint_interval,
+            resume=self.resume,
+        )
         model = self._MODEL_CLS()
         model.copy_params_from(self)
         model._weights = [np.asarray(t, np.float64) for t in flat]

@@ -151,6 +151,64 @@ def test_mlp_stream_resume_requires_durable_cache(tmp_path, mesh):
         )
 
 
+def _fm(mesh, **kw):
+    from flinkml_tpu.models.fm import FMClassifier
+
+    return (
+        FMClassifier(mesh=mesh, **kw)
+        .set_factor_size(4).set_max_iter(6).set_global_batch_size(64)
+        .set_learning_rate(0.05).set_reg(0.001).set_tol(0.0).set_seed(0)
+    )
+
+
+def test_fm_stream_spilled_matches_ram_exactly(tmp_path, mesh):
+    batches = _mlp_batches()
+    tables = lambda: iter(Table(b) for b in batches)
+    ram = _fm(mesh).fit(tables())
+    spilled = _fm(
+        mesh, cache_dir=str(tmp_path / "fm"), cache_memory_budget_bytes=1
+    ).fit(tables())
+    g, r = ram.get_model_data()[0], spilled.get_model_data()[0]
+    for col in g.column_names:
+        np.testing.assert_array_equal(
+            np.asarray(g.column(col)), np.asarray(r.column(col))
+        )
+    assert any((tmp_path / "fm").glob("segment-*.bin"))
+
+
+def test_fm_stream_resume_exact(tmp_path, mesh):
+    batches = _mlp_batches()
+    cache = cache_stream(
+        {"x": b["features"], "y": b["label"].astype(np.float32),
+         "w": np.ones(len(b["label"]), np.float32)}
+        for b in batches
+    )
+    golden = _fm(mesh).fit(cache)
+
+    mgr = _crash_manager_cls(2)(str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="injected"):
+        _fm(mesh, checkpoint_manager=mgr, checkpoint_interval=2).fit(cache)
+    assert mgr.latest_epoch() == 2
+
+    rec = _fm(mesh, checkpoint_manager=mgr, checkpoint_interval=2,
+              resume=True).fit(cache)
+    g, r = golden.get_model_data()[0], rec.get_model_data()[0]
+    for col in g.column_names:
+        np.testing.assert_array_equal(
+            np.asarray(g.column(col)), np.asarray(r.column(col))
+        )
+
+
+def test_fm_stream_learns(mesh):
+    batches = _mlp_batches(n_batches=6)
+    model = _fm(mesh).set_max_iter(25).fit(iter(Table(b) for b in batches))
+    big_x = np.concatenate([b["features"] for b in batches])
+    big_y = np.concatenate([b["label"] for b in batches])
+    (out,) = model.transform(Table({"features": big_x}))
+    acc = float((out.column("prediction") == big_y).mean())
+    assert acc > 0.85, acc
+
+
 def test_mlp_in_ram_rejects_checkpoint_knobs(mesh):
     b = _mlp_batches(n_batches=1)[0]
     with pytest.raises(ValueError, match="streamed fits only"):
