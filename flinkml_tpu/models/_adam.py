@@ -191,6 +191,12 @@ def run_streamed_adam(
     - ``params0_fn(d) -> flat params tuple``: initial parameters, given
       the feature dim discovered from the cache.
 
+    Chunk policy (the defined contract, not an accident): each resident
+    chunk contributes ``ceil(rows / global_bs)`` Adam steps per epoch,
+    and chunks pad to the 8p row tile (bounding the set of compiled
+    shapes) — so step counts and padded shapes are functions of the
+    cache's batch sizes, identical between a fresh run and a resume.
+
     Returns the final flat params tuple (device arrays).
 
     Reference parity: ``ReplayOperator.java:62-250`` (replayed cached
@@ -245,6 +251,10 @@ def run_streamed_adam(
 
     def place(batch):
         x = np.asarray(batch["x"], np.float32)
+        if x.shape[0] == 0:
+            raise ValueError(
+                "stream batch has zero rows; drop empty batches"
+            )
         if x.shape[1] != d:
             raise ValueError(
                 f"batch feature dim {x.shape[1]} != first batch's {d}"
@@ -256,6 +266,14 @@ def run_streamed_adam(
             np.asarray(batch["w"], np.float32)
             if "w" in batch else np.ones(x.shape[0], np.float32)
         )
+        if float(w.sum()) == 0.0:
+            # The step normalizes by the batch weight sum; an all-zero
+            # chunk would silently train on nothing. Fail loudly (same
+            # contract as the linear stream trainer).
+            raise ValueError(
+                "stream batch has zero total weight (empty batch or all "
+                "weights 0); drop such batches before training"
+            )
         # 8p row tile bounds the set of padded shapes -> compiles.
         x_pad, n_valid = pad_to_multiple(x, p * 8)
         y_pad, _ = pad_to_multiple(y, p * 8)

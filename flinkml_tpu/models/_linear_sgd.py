@@ -806,6 +806,45 @@ def train_softmax_model(
     )
 
 
+def streamed_linear_fit(
+    source,
+    *,
+    features_col: str,
+    label_col: str,
+    weight_col: Optional[str],
+    label_check=None,
+    **kwargs,
+) -> np.ndarray:
+    """Estimator-facing wrapper over :func:`train_linear_model_stream` —
+    the one streamed dispatch for every linear estimator (LR binomial,
+    LinearSVC, LinearRegression): accepts an iterable of batch Tables or
+    a sealed DataCache carrying the given columns, applying
+    ``label_check`` on either branch. ``kwargs`` pass straight through
+    (loss, mesh, cache_dir, checkpoint_manager, ...)."""
+    from flinkml_tpu.iteration.datacache import DataCache
+    from flinkml_tpu.models._data import labeled_data
+
+    if isinstance(source, DataCache):
+        validate = None
+        if label_check is not None:
+            def validate(batch):
+                label_check(np.asarray(batch[label_col]))
+
+        return train_linear_model_stream(
+            source, columns=(features_col, label_col, weight_col),
+            validate=validate, **kwargs,
+        )
+
+    def batches():
+        for t in source:
+            x, y, w = labeled_data(t, features_col, label_col, weight_col)
+            if label_check is not None:
+                label_check(y)
+            yield {"x": x, "y": y, "w": w}
+
+    return train_linear_model_stream(batches(), **kwargs)
+
+
 def train_linear_model_from_table(
     table,
     features_col: str,

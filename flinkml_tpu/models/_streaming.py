@@ -1,0 +1,55 @@
+"""The shared constructor/knob surface of every streamed-capable
+estimator (round 4): one definition of the cache + checkpoint knobs, so
+adding or renaming a streaming knob is a one-site change instead of a
+per-estimator copy-paste.
+
+Estimators inherit this FIRST (``class KMeans(StreamingEstimatorMixin,
+_KMeansParams, Estimator)``); the mixin's ``__init__`` stores the knobs
+and chains ``super().__init__()`` into the params machinery. Estimators
+with extra knobs (GBT's ``stream_reservoir_capacity``) override
+``__init__`` and delegate here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class StreamingEstimatorMixin:
+    """Cache + checkpoint knobs shared by every streamed-capable
+    estimator; see ``docs/development/iteration.md`` ("Out-of-core
+    training") for the capacity model and the checkpoint protocol."""
+
+    def __init__(
+        self,
+        mesh=None,
+        cache_dir: Optional[str] = None,
+        cache_memory_budget_bytes: Optional[int] = None,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+    ):
+        super().__init__()
+        self.mesh = mesh
+        self.cache_dir = cache_dir
+        self.cache_memory_budget_bytes = cache_memory_budget_bytes
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_interval = checkpoint_interval
+        self.resume = resume
+
+    def _checkpoint_kwargs(self) -> dict:
+        return dict(
+            checkpoint_manager=self.checkpoint_manager,
+            checkpoint_interval=self.checkpoint_interval,
+            resume=self.resume,
+        )
+
+    def _reject_in_ram_checkpointing(self, detail: str = "") -> None:
+        """In-RAM fits that cannot checkpoint raise loudly instead of
+        silently dropping the knobs."""
+        if self.checkpoint_manager is not None or self.resume:
+            raise ValueError(
+                "checkpointing is supported for streamed fits only "
+                "(pass an iterable of batch Tables or a DataCache)"
+                + (f"; {detail}" if detail else "")
+            )

@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import HasMaxIter, HasPredictionCol, HasSeed
 from flinkml_tpu.params import (
     BoolParam,
@@ -178,7 +179,7 @@ def _half_step(
     return _solve_factors(a, b, gram, jnp.asarray(reg, jnp.float32), cnt)
 
 
-class ALS(_ALSParams, Estimator):
+class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
     """Alternating least squares over (user, item, rating) tables.
 
     ``fit`` accepts, besides a single in-RAM :class:`Table`:
@@ -202,32 +203,12 @@ class ALS(_ALSParams, Estimator):
     # nnz×k² intermediate to chunk×k² per device.
     CHUNK = 1 << 16
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
 
     def fit(self, *inputs) -> "ALSModel":
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
-        if self.checkpoint_manager is not None or self.resume:
-            raise ValueError(
-                "checkpointing is supported for streamed fits only "
-                "(pass an iterable of batch Tables or a DataCache)"
-            )
+        self._reject_in_ram_checkpointing()
         users_raw = np.asarray(table.column(self.get(self.USER_COL)))
         items_raw = np.asarray(table.column(self.get(self.ITEM_COL)))
         ratings = np.asarray(

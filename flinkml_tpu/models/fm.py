@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasFeaturesCol,
     HasGlobalBatchSize,
@@ -88,7 +89,7 @@ def _fm_squared_loss_builder():
     return local_loss
 
 
-class _FMBase(_FMParams, Estimator):
+class _FMBase(StreamingEstimatorMixin, _FMParams, Estimator):
     """``fit`` also accepts an iterable of batch Tables or a sealed
     :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
     out-of-core path (the shared streamed-Adam runner,
@@ -100,22 +101,6 @@ class _FMBase(_FMParams, Estimator):
 
     _LOGISTIC = True
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
 
     def _loss_builder(self):
         return (
@@ -187,9 +172,7 @@ class _FMBase(_FMParams, Estimator):
             tol=self.get(self.TOL),
             seed=self.get_seed(),
             frozen_tail=1,
-            checkpoint_manager=self.checkpoint_manager,
-            checkpoint_interval=self.checkpoint_interval,
-            resume=self.resume,
+            **self._checkpoint_kwargs(),
         )
         return self._make_model(params)
 
@@ -197,11 +180,7 @@ class _FMBase(_FMParams, Estimator):
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
-        if self.checkpoint_manager is not None or self.resume:
-            raise ValueError(
-                "checkpointing is supported for streamed fits only "
-                "(pass an iterable of batch Tables or a DataCache)"
-            )
+        self._reject_in_ram_checkpointing()
         x, y, w = labeled_data(
             table, self.get(self.FEATURES_COL), self.get(self.LABEL_COL),
             self.get(self.WEIGHT_COL),

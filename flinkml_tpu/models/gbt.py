@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasFeaturesCol,
     HasLabelCol,
@@ -296,7 +297,7 @@ def _walk_forest(x: np.ndarray, feats, thrs, leaves, depth: int) -> np.ndarray:
     return total
 
 
-class _GBTBase(_GBTParams, Estimator):
+class _GBTBase(StreamingEstimatorMixin, _GBTParams, Estimator):
     """``fit`` accepts, besides a single in-RAM :class:`Table`:
 
       - an **iterable of batch Tables** — the out-of-core path: the
@@ -315,23 +316,8 @@ class _GBTBase(_GBTParams, Estimator):
     _LOGISTIC = True
     _BOOSTING = True
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-        stream_reservoir_capacity: int = 65_536,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
+    def __init__(self, stream_reservoir_capacity: int = 65_536, **knobs):
+        super().__init__(**knobs)
         # Streamed-fit bin-edge sample size (see _gbt_stream: edges come
         # from a seeded uniform row reservoir; capacity >= n gives exact
         # edges, smaller capacities trade accuracy for a bounded sample —
@@ -535,9 +521,7 @@ class _GBTBase(_GBTParams, Estimator):
             columns=columns,
             label_check=label_check,
             reservoir_capacity=self.stream_reservoir_capacity,
-            checkpoint_manager=self.checkpoint_manager,
-            checkpoint_interval=self.checkpoint_interval,
-            resume=self.resume,
+            **self._checkpoint_kwargs(),
         )
         edges_inf = np.concatenate(
             [edges, np.full((edges.shape[0], 1), np.inf)], axis=1
@@ -554,13 +538,10 @@ class _GBTBase(_GBTParams, Estimator):
     def fit(self, *inputs):
         (table,) = inputs
         if isinstance(table, Table):
-            if self.checkpoint_manager is not None or self.resume:
-                raise ValueError(
-                    "checkpointing is supported for streamed fits only "
-                    "(pass an iterable of batch Tables or a DataCache); "
-                    "the in-RAM fit builds the whole forest in one device "
-                    "program"
-                )
+            self._reject_in_ram_checkpointing(
+                "the in-RAM fit builds the whole forest in one device "
+                "program"
+            )
             forest = self._fit_forest(table)
         else:
             forest = self._fit_stream_forest(table)

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasFeaturesCol,
     HasMaxIter,
@@ -127,7 +128,7 @@ def _m_step(r_k, r_x, r_xx, cov_type: str):
     return weights, means, covs
 
 
-class GaussianMixture(_GMMParams, Estimator):
+class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
     """``fit`` accepts, besides a single in-RAM :class:`Table`, an
     iterable of batch Tables or a sealed
     :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
@@ -135,32 +136,12 @@ class GaussianMixture(_GMMParams, Estimator):
     accumulating the psum'd sufficient statistics batch-by-batch with
     bounded HBM residency (reference: ``ReplayOperator.java:62-250``)."""
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
 
     def fit(self, *inputs) -> "GaussianMixtureModel":
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
-        if self.checkpoint_manager is not None or self.resume:
-            raise ValueError(
-                "checkpointing is supported for streamed fits only "
-                "(pass an iterable of batch Tables or a DataCache)"
-            )
+        self._reject_in_ram_checkpointing()
         x = features_matrix(table, self.get(self.FEATURES_COL))
         n, d = x.shape
         k = self.get(self.K)

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasDistanceMeasure,
     HasFeaturesCol,
@@ -67,7 +68,7 @@ class _KMeansParams(
     )
 
 
-class KMeans(_KMeansParams, Estimator):
+class KMeans(StreamingEstimatorMixin, _KMeansParams, Estimator):
     """``fit`` accepts, besides a single in-RAM :class:`Table`:
 
       - an **iterable of batch Tables** — the out-of-core path: epoch 0
@@ -82,22 +83,6 @@ class KMeans(_KMeansParams, Estimator):
         whose batches carry this estimator's features column.
     """
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
 
     def fit(self, *inputs) -> "KMeansModel":
         (table,) = inputs
@@ -109,12 +94,9 @@ class KMeans(_KMeansParams, Estimator):
                 f"(parity with the reference), got {measure!r}"
             )
         if isinstance(table, Table):
-            if self.checkpoint_manager is not None or self.resume:
-                raise ValueError(
-                    "checkpointing is supported for streamed fits only "
-                    "(pass an iterable of batch Tables or a DataCache); "
-                    "the in-RAM fit runs as one whole-loop device program"
-                )
+            self._reject_in_ram_checkpointing(
+                "the in-RAM fit runs as one whole-loop device program"
+            )
             x = features_matrix(table, self.get(_KMeansParams.FEATURES_COL))
             if x.shape[0] < k:
                 raise ValueError(
@@ -161,9 +143,7 @@ class KMeans(_KMeansParams, Estimator):
             column=(
                 features_col if isinstance(source, DataCache) else "x"
             ),
-            checkpoint_manager=self.checkpoint_manager,
-            checkpoint_interval=self.checkpoint_interval,
-            resume=self.resume,
+            **self._checkpoint_kwargs(),
         )
 
 

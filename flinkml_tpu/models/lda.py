@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasFeaturesCol,
     HasMaxIter,
@@ -160,7 +161,7 @@ def _vb_pass_fn(mesh, axis: str, k: int):
     )
 
 
-class LDA(_LDAParams, Estimator):
+class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
     """``fit`` accepts, besides a single in-RAM :class:`Table`, an
     iterable of batch Tables or a sealed
     :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
@@ -171,32 +172,12 @@ class LDA(_LDAParams, Estimator):
     ``checkpoint_interval`` snapshot ``(lambda, prev_ll)`` every N outer
     passes of the streamed fit; ``resume=True`` continues bit-exactly."""
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
 
     def fit(self, *inputs) -> "LDAModel":
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
-        if self.checkpoint_manager is not None or self.resume:
-            raise ValueError(
-                "checkpointing is supported for streamed fits only "
-                "(pass an iterable of batch Tables or a DataCache)"
-            )
+        self._reject_in_ram_checkpointing()
         counts = _counts_matrix(table, self.get(self.FEATURES_COL))
         if (counts < 0).any():
             raise ValueError("token counts must be non-negative")

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasElasticNet,
     HasFeaturesCol,
@@ -116,15 +117,55 @@ def _fit_normal_equations(table, features_col, label_col, weight_col,
     return coef
 
 
-class LinearRegression(_LinearRegressionParams, Estimator):
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
-        super().__init__()
-        self.mesh = mesh
+class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimator):
+    """``fit`` also accepts an iterable of batch Tables or a sealed
+    :class:`~flinkml_tpu.iteration.datacache.DataCache` — the streamed
+    out-of-core path (squared loss through the shared linear stream
+    trainer, ``solver='sgd'`` only; ``ReplayOperator.java:62-250``
+    parity), checkpointable via ``checkpoint_manager``/
+    ``checkpoint_interval``/``resume``."""
 
-    def fit(self, *inputs: Table) -> "LinearRegressionModel":
+
+    def _make_model(self, coef) -> "LinearRegressionModel":
+        model = LinearRegressionModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"coefficient": coef[None, :]}))
+        return model
+
+    def fit(self, *inputs) -> "LinearRegressionModel":
         (table,) = inputs
         features_col = self.get(_LinearRegressionParams.FEATURES_COL)
+        if not isinstance(table, Table):
+            if self.get(self.SOLVER) == "normal":
+                raise ValueError(
+                    "solver='normal' does not support streamed fits (the "
+                    "closed form needs the full gram); use solver='sgd'"
+                )
+            coef = _linear_sgd.streamed_linear_fit(
+                table,
+                features_col=features_col,
+                label_col=self.get(_LinearRegressionParams.LABEL_COL),
+                weight_col=self.get(_LinearRegressionParams.WEIGHT_COL),
+                loss="squared",
+                mesh=self.mesh or DeviceMesh(),
+                max_iter=self.get(_LinearRegressionParams.MAX_ITER),
+                learning_rate=self.get(
+                    _LinearRegressionParams.LEARNING_RATE
+                ),
+                reg=self.get(_LinearRegressionParams.REG),
+                elastic_net=self.get(_LinearRegressionParams.ELASTIC_NET),
+                tol=self.get(_LinearRegressionParams.TOL),
+                cache_dir=self.cache_dir,
+                memory_budget_bytes=self.cache_memory_budget_bytes,
+                **self._checkpoint_kwargs(),
+            )
+            return self._make_model(coef)
         if self.get(self.SOLVER) == "normal":
+            if self.checkpoint_manager is not None or self.resume:
+                raise ValueError(
+                    "solver='normal' is a one-shot closed form; "
+                    "checkpointing applies to solver='sgd'"
+                )
             if self.get(self.ELASTIC_NET) > 0:
                 raise ValueError(
                     "solver='normal' has no closed form for elasticNet > 0; "
@@ -161,12 +202,10 @@ class LinearRegression(_LinearRegressionParams, Estimator):
             table, features_col,
             self.get(_LinearRegressionParams.LABEL_COL),
             self.get(_LinearRegressionParams.WEIGHT_COL),
+            **self._checkpoint_kwargs(),
             **hyper,
         )
-        model = LinearRegressionModel()
-        model.copy_params_from(self)
-        model.set_model_data(Table({"coefficient": coef[None, :]}))
-        return model
+        return self._make_model(coef)
 
 
 class LinearRegressionModel(CoefficientModelMixin, _LinearRegressionParams, Model):

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasElasticNet,
     HasFeaturesCol,
@@ -66,15 +67,44 @@ class _LinearSVCParams(
     )
 
 
-class LinearSVC(_LinearSVCParams, Estimator):
-    def __init__(self, mesh: Optional[DeviceMesh] = None):
-        super().__init__()
-        self.mesh = mesh
+class LinearSVC(StreamingEstimatorMixin, _LinearSVCParams, Estimator):
+    """``fit`` also accepts an iterable of batch Tables or a sealed
+    :class:`~flinkml_tpu.iteration.datacache.DataCache` — the streamed
+    out-of-core path (hinge loss through the shared linear stream
+    trainer; ``ReplayOperator.java:62-250`` parity), checkpointable via
+    ``checkpoint_manager``/``checkpoint_interval``/``resume``."""
 
-    def fit(self, *inputs: Table) -> "LinearSVCModel":
+
+    def _make_model(self, coef) -> "LinearSVCModel":
+        model = LinearSVCModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"coefficient": coef[None, :]}))
+        return model
+
+    def fit(self, *inputs) -> "LinearSVCModel":
         (table,) = inputs
         features_col = self.get(_LinearSVCParams.FEATURES_COL)
+        if not isinstance(table, Table):
+            coef = _linear_sgd.streamed_linear_fit(
+                table,
+                features_col=features_col,
+                label_col=self.get(_LinearSVCParams.LABEL_COL),
+                weight_col=self.get(_LinearSVCParams.WEIGHT_COL),
+                label_check=lambda y: check_binary_labels(y, "LinearSVC"),
+                loss="hinge",
+                mesh=self.mesh or DeviceMesh(),
+                max_iter=self.get(_LinearSVCParams.MAX_ITER),
+                learning_rate=self.get(_LinearSVCParams.LEARNING_RATE),
+                reg=self.get(_LinearSVCParams.REG),
+                elastic_net=self.get(_LinearSVCParams.ELASTIC_NET),
+                tol=self.get(_LinearSVCParams.TOL),
+                cache_dir=self.cache_dir,
+                memory_budget_bytes=self.cache_memory_budget_bytes,
+                **self._checkpoint_kwargs(),
+            )
+            return self._make_model(coef)
         hyper = dict(
+            **self._checkpoint_kwargs(),
             loss="hinge",
             mesh=self.mesh or DeviceMesh(),
             max_iter=self.get(_LinearSVCParams.MAX_ITER),
@@ -92,10 +122,7 @@ class LinearSVC(_LinearSVCParams, Estimator):
             label_check=lambda y: check_binary_labels(y, "LinearSVC"),
             **hyper,
         )
-        model = LinearSVCModel()
-        model.copy_params_from(self)
-        model.set_model_data(Table({"coefficient": coef[None, :]}))
-        return model
+        return self._make_model(coef)
 
 
 class LinearSVCModel(CoefficientModelMixin, _LinearSVCParams, Model):

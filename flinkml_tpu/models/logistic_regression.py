@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasFeaturesCol,
     HasGlobalBatchSize,
@@ -83,7 +84,7 @@ class _LogisticRegressionParams(
     LogisticRegressionParams / LogisticRegressionModelParams)."""
 
 
-class LogisticRegression(_LogisticRegressionParams, Estimator):
+class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Estimator):
     """Fits binomial LR by epoch-synchronized distributed SGD.
 
     ``fit`` accepts, besides a single in-RAM :class:`Table`:
@@ -98,16 +99,6 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
         replayed every epoch, no caching pass needed.
     """
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
 
     def fit(self, *inputs) -> "LogisticRegressionModel":
         (table,) = inputs
@@ -123,6 +114,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
             reg=self.get(_LogisticRegressionParams.REG),
             tol=self.get(_LogisticRegressionParams.TOL),
             seed=self.get_seed(),
+            **self._checkpoint_kwargs(),
         )
 
         if sparse_features(table, features_col) is not None:
@@ -178,8 +170,6 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
     def _fit_stream(self, source) -> "LogisticRegressionModel":
         """Out-of-core fit from an iterable of batch Tables or a DataCache
         (see class docstring; ReplayOperator.java:62-250 parity)."""
-        from flinkml_tpu.iteration.datacache import DataCache
-
         if self.get(_LogisticRegressionParams.MULTI_CLASS) == "multinomial":
             raise ValueError(
                 "multinomial logistic regression does not support "
@@ -189,7 +179,12 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
         features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
         label_col = self.get(_LogisticRegressionParams.LABEL_COL)
         weight_col = self.get(_LogisticRegressionParams.WEIGHT_COL)
-        kwargs = dict(
+        coef = _linear_sgd.streamed_linear_fit(
+            source,
+            features_col=features_col,
+            label_col=label_col,
+            weight_col=weight_col,
+            label_check=_check_stream_labels,
             loss="logistic",
             mesh=self.mesh or DeviceMesh(),
             max_iter=self.get(_LogisticRegressionParams.MAX_ITER),
@@ -199,23 +194,8 @@ class LogisticRegression(_LogisticRegressionParams, Estimator):
             tol=self.get(_LogisticRegressionParams.TOL),
             cache_dir=self.cache_dir,
             memory_budget_bytes=self.cache_memory_budget_bytes,
+            **self._checkpoint_kwargs(),
         )
-        if isinstance(source, DataCache):
-            def validate(batch):
-                _check_stream_labels(np.asarray(batch[label_col]))
-
-            coef = _linear_sgd.train_linear_model_stream(
-                source, columns=(features_col, label_col, weight_col),
-                validate=validate, **kwargs
-            )
-        else:
-            def batches():
-                for t in source:
-                    x, y, w = labeled_data(t, features_col, label_col, weight_col)
-                    _check_stream_labels(y)
-                    yield {"x": x, "y": y, "w": w}
-
-            coef = _linear_sgd.train_linear_model_stream(batches(), **kwargs)
 
         model = LogisticRegressionModel(mesh=self.mesh)
         model.copy_params_from(self)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.models._adam import make_adam_trainer
 from flinkml_tpu.common_params import (
     HasFeaturesCol,
@@ -97,7 +98,7 @@ def _mlp_squared_loss_builder():
     return local_loss
 
 
-class _MLPBase(_MLPParams, Estimator):
+class _MLPBase(StreamingEstimatorMixin, _MLPParams, Estimator):
     """Shared fit scaffold: the subclasses differ only in label
     preparation/validation and the loss builder (same pairing pattern as
     ``fm._FMBase``).
@@ -117,22 +118,6 @@ class _MLPBase(_MLPParams, Estimator):
     _MODEL_CLS = None
     _LOSS_BUILDER = None
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
 
     def _prepare_labels(self, y: np.ndarray, layers) -> np.ndarray:
         raise NotImplementedError
@@ -147,11 +132,7 @@ class _MLPBase(_MLPParams, Estimator):
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
-        if self.checkpoint_manager is not None or self.resume:
-            raise ValueError(
-                "checkpointing is supported for streamed fits only "
-                "(pass an iterable of batch Tables or a DataCache)"
-            )
+        self._reject_in_ram_checkpointing()
         layers = self._check_layers()
         x, y, w = labeled_data(
             table, self.get(self.FEATURES_COL), self.get(self.LABEL_COL)
@@ -239,9 +220,7 @@ class _MLPBase(_MLPParams, Estimator):
             max_iter=self.get(self.MAX_ITER),
             tol=self.get(self.TOL),
             seed=self.get_seed(),
-            checkpoint_manager=self.checkpoint_manager,
-            checkpoint_interval=self.checkpoint_interval,
-            resume=self.resume,
+            **self._checkpoint_kwargs(),
         )
         model = self._MODEL_CLS()
         model.copy_params_from(self)

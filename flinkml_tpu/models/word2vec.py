@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.models._streaming import StreamingEstimatorMixin
 from flinkml_tpu.common_params import (
     HasInputCol,
     HasLearningRate,
@@ -145,7 +146,7 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
     )
 
 
-class Word2Vec(_Word2VecParams, Estimator):
+class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
     """``fit`` accepts, besides a single in-RAM :class:`Table`, an
     **iterable of batch Tables** — the out-of-core path: pass A encodes
     the token stream to an int-coded doc cache (strings never spill; the
@@ -162,32 +163,12 @@ class Word2Vec(_Word2VecParams, Estimator):
     enforce cannot apply here; passes A/B re-run deterministically from
     the same seed over the re-fed stream."""
 
-    def __init__(
-        self,
-        mesh: Optional[DeviceMesh] = None,
-        cache_dir: Optional[str] = None,
-        cache_memory_budget_bytes: Optional[int] = None,
-        checkpoint_manager=None,
-        checkpoint_interval: int = 0,
-        resume: bool = False,
-    ):
-        super().__init__()
-        self.mesh = mesh
-        self.cache_dir = cache_dir
-        self.cache_memory_budget_bytes = cache_memory_budget_bytes
-        self.checkpoint_manager = checkpoint_manager
-        self.checkpoint_interval = checkpoint_interval
-        self.resume = resume
 
     def fit(self, *inputs) -> "Word2VecModel":
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
-        if self.checkpoint_manager is not None or self.resume:
-            raise ValueError(
-                "checkpointing is supported for streamed fits only "
-                "(pass an iterable of batch Tables)"
-            )
+        self._reject_in_ram_checkpointing()
         docs = _token_column(table, self.get(self.INPUT_COL))
         min_count = self.get(self.MIN_COUNT)
         counts: Dict[str, int] = {}

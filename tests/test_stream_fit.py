@@ -109,6 +109,67 @@ def test_estimator_fit_from_table_stream(mesh):
     assert acc > 0.9
 
 
+def test_linear_svc_and_regression_streamed_fit(tmp_path, mesh):
+    """Round 4: every linear estimator exposes the streamed path (the
+    loss-generic stream trainer was previously reachable only through
+    LogisticRegression). Spilled estimator fit == the low-level stream
+    trainer with the matching loss, exactly."""
+    from flinkml_tpu.models.linear_regression import LinearRegression
+    from flinkml_tpu.models.linear_svc import LinearSVC
+
+    batches = _make_batches(seed=21)
+    tables = lambda: iter(
+        Table({"features": b["x"], "label": b["y"], "weight": b["w"]})
+        for b in batches
+    )
+
+    svc = (
+        LinearSVC(mesh=mesh, cache_dir=str(tmp_path / "svc"),
+                  cache_memory_budget_bytes=1)
+        .set_weight_col("weight").set_max_iter(8).set_learning_rate(0.5)
+        .set_reg(0.01).set_tol(0.0)
+    ).fit(tables())
+    direct = _train(iter(batches), mesh, loss="hinge")
+    np.testing.assert_array_equal(
+        np.asarray(svc.get_model_data()[0].column("coefficient")[0]), direct
+    )
+    assert any((tmp_path / "svc").glob("segment-*.bin"))
+
+    # Regression: continuous labels through the squared-loss stream path.
+    reg_batches = []
+    rng = np.random.default_rng(8)
+    true = rng.normal(size=10)
+    for _ in range(4):
+        x = rng.normal(size=(64, 10)).astype(np.float32)
+        reg_batches.append({
+            "x": x, "y": (x @ true).astype(np.float32),
+            "w": np.ones(64, np.float32),
+        })
+    lin = (
+        LinearRegression(mesh=mesh)
+        .set_weight_col("weight").set_max_iter(8).set_learning_rate(0.1)
+        .set_reg(0.0).set_tol(0.0)
+    ).fit(iter(
+        Table({"features": b["x"], "label": b["y"], "weight": b["w"]})
+        for b in reg_batches
+    ))
+    direct_reg = _train(iter(reg_batches), mesh, loss="squared",
+                        learning_rate=0.1, reg=0.0)
+    np.testing.assert_array_equal(
+        np.asarray(lin.get_model_data()[0].column("coefficient")[0]),
+        direct_reg,
+    )
+
+
+def test_linear_regression_normal_solver_rejects_stream(mesh):
+    from flinkml_tpu.models.linear_regression import LinearRegression
+
+    with pytest.raises(ValueError, match="solver='sgd'"):
+        LinearRegression(mesh=mesh).set_solver("normal").fit(
+            iter(_make_batches())
+        )
+
+
 def test_fit_from_sealed_datacache(mesh):
     """A sealed DataCache input replays every epoch (no caching pass) and
     matches the one-shot stream result."""
