@@ -248,17 +248,26 @@ def run_streamed_adam(
     # put O(rows log rows) redundant host validation on the prefetch
     # thread. Only user-supplied sealed caches need replay-time prep.
     labels_prepared = not isinstance(source, DataCache)
+    # Cached batches are immutable, so validation (zero rows/weight,
+    # label prep for sealed caches) only needs the FIRST replay pass —
+    # not max_iter re-scans on the prefetch thread (the linear stream
+    # trainer's first_pass_done discipline).
+    first_pass_done = [False]
 
     def place(batch):
         x = np.asarray(batch["x"], np.float32)
-        if x.shape[0] == 0:
+        validate = not first_pass_done[0]
+        if validate and x.shape[0] == 0:
             raise ValueError(
                 "stream batch has zero rows; drop empty batches"
             )
-        if x.shape[1] != d:
+        if validate and x.shape[1] != d:
             raise ValueError(
                 f"batch feature dim {x.shape[1]} != first batch's {d}"
             )
+        # Sealed-cache labels need CONVERSION every pass (the cache is
+        # re-read from disk each epoch); place_y fuses that with the
+        # validation, which is cheap next to the device step.
         y = np.asarray(batch["y"])
         if not labels_prepared:
             y = place_y(y)
@@ -266,7 +275,7 @@ def run_streamed_adam(
             np.asarray(batch["w"], np.float32)
             if "w" in batch else np.ones(x.shape[0], np.float32)
         )
-        if float(w.sum()) == 0.0:
+        if validate and float(w.sum()) == 0.0:
             # The step normalizes by the batch weight sum; an all-zero
             # chunk would silently train on nothing. Fail loudly (same
             # contract as the linear stream trainer).
@@ -334,6 +343,7 @@ def run_streamed_adam(
                 last_loss = loss
         finally:
             feed.close()
+        first_pass_done[0] = True  # batches are immutable: validate once
         cur = float(last_loss)
         terminated = abs(prev_loss - cur) <= tol
         prev_loss = cur

@@ -316,8 +316,9 @@ class _GBTBase(StreamingEstimatorMixin, _GBTParams, Estimator):
     _LOGISTIC = True
     _BOOSTING = True
 
-    def __init__(self, stream_reservoir_capacity: int = 65_536, **knobs):
-        super().__init__(**knobs)
+    def __init__(self, mesh=None, *, stream_reservoir_capacity: int = 65_536,
+                 **knobs):
+        super().__init__(mesh=mesh, **knobs)
         # Streamed-fit bin-edge sample size (see _gbt_stream: edges come
         # from a seeded uniform row reservoir; capacity >= n gives exact
         # edges, smaller capacities trade accuracy for a bounded sample —

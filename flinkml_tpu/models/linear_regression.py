@@ -183,10 +183,7 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
                 self.get(_LinearRegressionParams.WEIGHT_COL),
                 self.mesh or DeviceMesh(), self.get(self.REG),
             )
-            model = LinearRegressionModel()
-            model.copy_params_from(self)
-            model.set_model_data(Table({"coefficient": coef[None, :]}))
-            return model
+            return self._make_model(coef)
         hyper = dict(
             loss="squared",
             mesh=self.mesh or DeviceMesh(),
