@@ -194,6 +194,63 @@ def test_streamed_resume_requires_durable_cache(tmp_path, mesh):
                             checkpoint_manager=mgr, resume=True)
 
 
+def test_quickstart_crash_recovery_recipe(tmp_path, mesh):
+    """The documented cross-process recovery flow (quickstart
+    'Datasets bigger than memory'): persist the sealed cache, crash,
+    recover BOTH halves (DataCacheSnapshot + CheckpointManager) in a
+    'fresh process', resume — exact."""
+    from flinkml_tpu.iteration.datacache import DataCacheSnapshot
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    cache = cache_stream(iter(_blobs(seed=17)),
+                         directory=str(tmp_path / "cache"),
+                         memory_budget_bytes=1)
+    DataCacheSnapshot.persist(cache, str(tmp_path / "snap"))
+    args = dict(k=3, mesh=mesh, max_iter=8, seed=3, column="features")
+    golden = train_kmeans_stream(cache, **args)
+
+    mgr = _crash_manager_cls(3)(str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="injected"):
+        train_kmeans_stream(cache, checkpoint_manager=mgr,
+                            checkpoint_interval=3, **args)
+
+    # "Fresh process": everything reconstructed from disk paths only.
+    recovered_cache = DataCacheSnapshot.recover(str(tmp_path / "snap"))
+    final = train_kmeans_stream(
+        recovered_cache, checkpoint_manager=CheckpointManager(
+            str(tmp_path / "ckpt")
+        ), checkpoint_interval=3, resume=True, **args,
+    )
+    np.testing.assert_array_equal(final, golden)
+
+
+@pytest.mark.parametrize("crash_epochs", [(2, 5), (3, 6)])
+def test_kmeans_stream_double_failure_recovery(tmp_path, mesh, crash_epochs):
+    """Mirror of the reference's failoverCount-parameterized checkpoint
+    ITCases (``BoundedAllRoundCheckpointITCase.java:75-103``): the fit
+    crashes TWICE at different epochs, resumes each time, and the final
+    model still matches the uninterrupted run exactly."""
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    cache = cache_stream(iter(_blobs(seed=13)))
+    args = dict(k=3, mesh=mesh, max_iter=8, seed=5, column="features")
+    golden = train_kmeans_stream(cache, **args)
+
+    mgr_dir = str(tmp_path / "ckpt")
+    for crash_at in crash_epochs:
+        mgr = _crash_manager_cls(crash_at)(mgr_dir)
+        with pytest.raises(RuntimeError, match="injected"):
+            train_kmeans_stream(cache, checkpoint_manager=mgr,
+                                checkpoint_interval=1, resume=True, **args)
+        assert mgr.latest_epoch() == crash_at
+
+    final = train_kmeans_stream(
+        cache, checkpoint_manager=CheckpointManager(mgr_dir),
+        checkpoint_interval=1, resume=True, **args,
+    )
+    np.testing.assert_array_equal(final, golden)
+
+
 def test_streamed_fits_reject_multi_process(mesh, monkeypatch):
     """Streamed fits are single-controller: on a multi-process mesh they
     must raise the defined error (not die opaquely inside device_put on a
