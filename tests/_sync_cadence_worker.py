@@ -1,11 +1,11 @@
-"""Two-process worker: sustained cross-process collective dispatch.
+"""Multi-process worker: sustained cross-process collective dispatch.
 
 Regression for the multi-process in-flight-dispatch deadlock: a bare host
 loop enqueueing 60 ``psum`` steps with no synchronization wedges a
 2-process Gloo mesh permanently (threshold between 20 and 60 in-flight).
 ``synced_loop`` is the framework's backpressure policy (the role Flink's
 credit-based flow control plays under ``AllReduceImpl.java:52-299``);
-this worker drives 80 sustained steps through it — more than the wedge
+this worker (launched as an N-process pod) drives 80 sustained steps through it — more than the wedge
 trigger — and checks the numeric result.
 
 Usage: python _sync_cadence_worker.py <port> <process_id> <num_processes>

@@ -1,5 +1,5 @@
 """Unit tests for the bounded in-flight dispatch policy (single-process
-half; the 2-process sustained-dispatch IT lives in test_distributed.py).
+half; the multi-process sustained-dispatch ITs live in test_distributed.py).
 """
 
 import jax.numpy as jnp

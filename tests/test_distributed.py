@@ -68,7 +68,7 @@ def test_two_process_control_plane(tmp_path):
     """
     # One local device per process: the mesh must span processes, not be
     # satisfiable host-locally.
-    _launch_two_process_workers(tmp_path, local_devices=1)
+    _launch_multiprocess_workers(tmp_path, local_devices=1)
 
 
 def test_two_process_multi_device_data_plane(tmp_path):
@@ -76,27 +76,29 @@ def test_two_process_multi_device_data_plane(tmp_path):
     mixed addressable/non-addressable shards per process — the layout a
     real multi-host pod has. Exercises all_reduce_sum, keyed_aggregate,
     and map_partition across the process boundary."""
-    _launch_two_process_workers(tmp_path, local_devices=2)
+    _launch_multiprocess_workers(tmp_path, local_devices=2)
 
 
-def test_sustained_cross_process_dispatch(tmp_path):
-    """Regression: ≥60 sustained collective steps on a 2-process mesh.
+@pytest.mark.parametrize("n_procs", [2, 4])
+def test_sustained_cross_process_dispatch(tmp_path, n_procs):
+    """Regression: ≥60 sustained collective steps on a multi-process mesh.
 
     An unsynchronized host loop deadlocks the Gloo backend between 20 and
     60 in-flight ``psum`` dispatches; ``synced_loop`` (the framework's
-    bounded-dispatch policy) must sustain 80. See
+    bounded-dispatch policy) must sustain 80 — on 2 processes AND on a
+    4-process pod (the control plane is not a pairwise special case). See
     tests/_sync_cadence_worker.py for the worker body.
     """
-    _launch_two_process_workers(
+    _launch_multiprocess_workers(
         tmp_path, local_devices=1,
         worker_script="_sync_cadence_worker.py",
-        ok_token="CADENCE_OK", check_artifacts=False,
+        ok_token="CADENCE_OK", check_artifacts=False, n_procs=n_procs,
     )
 
 
-def _launch_two_process_workers(
+def _launch_multiprocess_workers(
     tmp_path, local_devices, worker_script="_dist_worker.py",
-    ok_token="WORKER_OK", check_artifacts=True,
+    ok_token="WORKER_OK", check_artifacts=True, n_procs=2,
 ):
     import shutil
     import socket
@@ -124,13 +126,14 @@ def _launch_two_process_workers(
             port = s.getsockname()[1]
         procs = [
             subprocess.Popen(
-                [sys.executable, worker, str(port), str(p), "2", workdir],
+                [sys.executable, worker, str(port), str(p), str(n_procs),
+                 workdir],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,
                 env=env,
             )
-            for p in range(2)
+            for p in range(n_procs)
         ]
         outputs = []
         try:
@@ -138,7 +141,9 @@ def _launch_two_process_workers(
                 out, _ = p.communicate(timeout=180)
                 outputs.append(out)
         except subprocess.TimeoutExpired:
-            outputs = ["<timeout>"] * 2
+            # Keep what the finished ranks printed — that is the evidence
+            # for diagnosing which rank wedged.
+            outputs += ["<timeout>"] * (n_procs - len(outputs))
         finally:
             for p in procs:
                 if p.poll() is None:
