@@ -229,3 +229,80 @@ def test_prefetching_device_feed_propagates_errors():
     next(feed)
     with pytest.raises(ValueError, match="boom"):
         next(feed)
+
+
+def test_concurrent_readers_are_independent(tmp_path):
+    """Two readers iterating the SAME sealed (spilled) cache concurrently
+    each see every batch exactly once, in order — reader position is
+    per-reader state, not cache state (``DataCacheReader.java:35-135``:
+    the reference's cache serves multiple consumers)."""
+    import threading
+
+    writer = DataCacheWriter(str(tmp_path / "c"), memory_budget_bytes=1)
+    batches = []
+    for i in range(8):
+        b = {"x": np.full((16, 3), float(i), np.float32)}
+        batches.append(b)
+        writer.append(b)
+    cache = writer.finish()
+
+    seen = [[], []]
+    errs = []
+
+    def consume(slot):
+        try:
+            for batch in cache.reader():
+                seen[slot].append(float(batch["x"][0, 0]))
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=consume, args=(s,)) for s in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    expected = [float(i) for i in range(8)]
+    assert seen[0] == expected and seen[1] == expected
+
+
+def test_concurrent_streamed_fits_from_one_cache(tmp_path, mesh):
+    """Two streamed KMeans fits replaying ONE sealed cache from separate
+    threads produce exactly the sequential result — the cache is safely
+    shareable across concurrent training jobs (prefetch threads, segment
+    reads, device dispatch)."""
+    import threading
+
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    rng = np.random.default_rng(0)
+    centers = rng.uniform(-10, 10, size=(3, 4)).astype(np.float32)
+    writer = DataCacheWriter(str(tmp_path / "c"), memory_budget_bytes=1)
+    for _ in range(4):
+        a = rng.integers(0, 3, size=48)
+        writer.append({
+            "x": (centers[a] + rng.normal(scale=0.3, size=(48, 4)))
+            .astype(np.float32)
+        })
+    cache = writer.finish()
+
+    args = dict(k=3, mesh=mesh, max_iter=5, seed=2, column="x")
+    golden = train_kmeans_stream(cache, **args)
+
+    results = [None, None]
+    errs = []
+
+    def fit(slot):
+        try:
+            results[slot] = train_kmeans_stream(cache, **args)
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=fit, args=(s,)) for s in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    np.testing.assert_array_equal(results[0], golden)
+    np.testing.assert_array_equal(results[1], golden)
