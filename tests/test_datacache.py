@@ -239,11 +239,8 @@ def test_concurrent_readers_are_independent(tmp_path):
     import threading
 
     writer = DataCacheWriter(str(tmp_path / "c"), memory_budget_bytes=1)
-    batches = []
     for i in range(8):
-        b = {"x": np.full((16, 3), float(i), np.float32)}
-        batches.append(b)
-        writer.append(b)
+        writer.append({"x": np.full((16, 3), float(i), np.float32)})
     cache = writer.finish()
 
     seen = [[], []]

@@ -109,6 +109,38 @@ def test_estimator_fit_from_table_stream(mesh):
     assert acc > 0.9
 
 
+def test_kmeans_stream_batching_invariance(mesh):
+    """The streamed result is a property of the DATA, not of how the
+    stream happened to be batched: any split of the same rows gives the
+    same centroids up to f32 summation order (per-batch partials sum to
+    the same totals)."""
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    rng = np.random.default_rng(9)
+    centers = rng.uniform(-10, 10, size=(3, 5)).astype(np.float32)
+    a = rng.integers(0, 3, size=384)
+    x = (centers[a] + rng.normal(scale=0.4, size=(384, 5))).astype(
+        np.float32
+    )
+    init = np.ascontiguousarray(x[:3])
+
+    def batches(sizes):
+        off = 0
+        for s in sizes:
+            yield {"x": x[off:off + s]}
+            off += s
+
+    base = train_kmeans_stream(iter(batches((64,) * 6)), k=3, mesh=mesh,
+                               max_iter=5, seed=0, initial_centroids=init)
+    for split in ((37, 91, 128, 40, 64, 24), (200, 184)):
+        assert sum(split) == 384
+        other = train_kmeans_stream(
+            iter(batches(split)), k=3, mesh=mesh, max_iter=5, seed=0,
+            initial_centroids=init,
+        )
+        np.testing.assert_allclose(other, base, rtol=1e-4, atol=1e-5)
+
+
 def test_linear_svc_and_regression_streamed_fit(tmp_path, mesh):
     """Round 4: every linear estimator exposes the streamed path (the
     loss-generic stream trainer was previously reachable only through
