@@ -1,0 +1,38 @@
+#!/bin/bash
+# One-shot device-evidence capture for the moment the tunnel heals.
+# Runs, in order, with generous but bounded timeouts and full logging:
+#   1. health probe (aborts early if the tunnel is still wedged)
+#   2. sorted-scatter A/B at Criteo shapes (VERDICT r3 item 4a)
+#   3. compile-ceiling sweep, device half   (VERDICT r3 item 4b)
+#   4. full staged bench -> one JSON line   (the round's headline number)
+# All output lands in tools/device_evidence_<UTC>.log; append the numbers
+# to BASELINE.md afterwards. Never run concurrently with another device
+# client (each step takes the single-tenant device lock itself).
+set -u
+cd "$(dirname "$0")/.."
+# Tools import flinkml_tpu; keep the axon site dir so device access works.
+export PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+LOG="tools/device_evidence_${STAMP}.log"
+exec > >(tee "$LOG") 2>&1
+
+echo "=== device evidence run ${STAMP} ==="
+
+echo "--- 1. health probe (90 s cap) ---"
+if ! timeout 90 python tools/device_probe.py; then
+    echo "PROBE FAILED: tunnel still wedged; aborting (log: $LOG)"
+    exit 1
+fi
+
+echo "--- 2. sorted-scatter A/B (600 s cap) ---"
+timeout 600 python tools/sorted_scatter_probe.py \
+    || echo "sorted_scatter_probe FAILED rc=$?"
+
+echo "--- 3. compile-ceiling sweep, device half (1800 s cap) ---"
+timeout 1800 python tools/compile_ceiling_probe.py \
+    || echo "compile_ceiling_probe FAILED rc=$?"
+
+echo "--- 4. full staged bench (FLINKML_BENCH_TIMEOUT=${FLINKML_BENCH_TIMEOUT:-2100} s) ---"
+timeout 2700 python bench.py || echo "bench FAILED rc=$?"
+
+echo "=== done; transcribe results into BASELINE.md (log: $LOG) ==="
