@@ -45,6 +45,43 @@ def begin_resume(manager: Optional["CheckpointManager"], resume: bool,
     return manager.latest_epoch() if resume else None
 
 
+def save_replicated(manager: "CheckpointManager", state: Any, epoch: int,
+                    mesh=None, extra: Optional[dict] = None) -> None:
+    """Multi-process-safe save of a REPLICATED state: rank 0 writes to the
+    shared checkpoint directory, every process barriers on the commit.
+
+    The streamed trainers' carry (coefficients, centroids, EM stats…) is
+    identical on every host — having each rank write its own copy would
+    race on the shared directory's atomic rename, and skipping the
+    barrier would let fast ranks train past an uncommitted snapshot (the
+    crash-resume contract requires the snapshot durable before anyone
+    proceeds — the role of the reference's two-phase checkpoint commit,
+    ``Checkpoints.java:43-211``). Single-process this is exactly
+    ``manager.save`` (async write preserved; no barrier cost).
+    """
+    if jax.process_count() == 1:
+        manager.save(state, epoch, extra=extra)
+        return
+    from flinkml_tpu.iteration.stream_sync import agree_all_ok
+
+    err = None
+    if jax.process_index() == 0:
+        try:
+            manager.save(state, epoch, extra=extra)
+            manager.wait()  # durable before anyone trains past it
+        except Exception as e:  # noqa: BLE001 — agreed below
+            err = e
+    # The agreement doubles as the commit barrier; a rank-0 write failure
+    # aborts EVERY rank (a bare barrier would strand ranks 1..N-1 when
+    # rank 0 raises before reaching it).
+    try:
+        agree_all_ok(err is None, mesh, "checkpoint commit")
+    except ValueError:
+        if err is not None:
+            raise err
+        raise
+
+
 def should_snapshot(manager: Optional["CheckpointManager"], interval: int,
                     step: int, total: int, terminal: bool = False) -> bool:
     """Step 2 of the protocol — the save cadence: snapshot every

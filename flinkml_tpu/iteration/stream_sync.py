@@ -1,0 +1,425 @@
+"""Multi-process streamed (out-of-core) training: the agreement layer.
+
+The streamed fits replay a host-side :class:`~flinkml_tpu.iteration.
+datacache.DataCache` through per-batch SPMD steps (``shard_map`` +
+``psum`` over the full mesh). On a single process the host is free to
+dispatch whatever batch shapes and step counts it likes; on a
+multi-process mesh SPMD imposes two global invariants the reference got
+for free from Flink's partitioned-stream runtime (every subtask of an
+operator runs the same dataflow over its own partition,
+``AllReduceImpl.java:52-299`` aligns per-chunk contributions):
+
+1. **Same program, same shapes** — every process must dispatch the same
+   compiled step at every loop index, so the per-process batch height
+   must be one agreed constant (padded, zero-weighted rows are exact
+   no-ops).
+2. **Same step count** — a process whose local cache is shorter must keep
+   dispatching (zero-weight "dummy" steps) until the longest process has
+   drained, or the collective wedges.
+
+This module provides those agreements: a device-mediated scalar max
+(:func:`agree_max` — rides the same ICI/DCN fabric as the data plane,
+like :func:`~flinkml_tpu.parallel.distributed.host_barrier`), the
+per-epoch :class:`SyncedReplayPlan` that wraps a local cache reader into
+an agreed-length, fixed-shape batch sequence, and a pooled reservoir
+sample (:func:`pooled_sample`) for trainers whose initialization draws
+rows from the global dataset (KMeans, GMM).
+
+Convention (documented in ``docs/development/parallelism.md``): on a
+multi-process mesh each process feeds its OWN partition of the stream —
+the reference's per-subtask stream partitions — typically its
+:func:`~flinkml_tpu.parallel.process_slice` of a global dataset. The
+fitted model is identical on every process (replicated outputs, host
+updates applied to identical values).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flinkml_tpu.iteration.datacache import DataCache, Segment
+from flinkml_tpu.parallel.mesh import DeviceMesh
+
+
+@functools.lru_cache(maxsize=128)
+def _agree_fn(mesh, axis: str, op: str):
+    """Compiled collective for :func:`_device_agree`, cached per
+    (mesh, op) — a fresh closure per call would defeat the jit cache and
+    recompile every agreement (a streamed fit performs ~10 of them)."""
+    red = {"max": jax.lax.pmax, "sum": jax.lax.psum}[op]
+
+    def _one(x):
+        return red(x, axis)
+
+    return jax.jit(
+        jax.shard_map(_one, mesh=mesh, in_specs=P(axis), out_specs=P(None))
+    )
+
+
+def _device_agree(value: int, mesh: Optional[DeviceMesh], op: str) -> int:
+    """Device-mediated int32 reduction of a per-process scalar across all
+    processes (``op`` in {"max", "sum"}). Single-process: returns ``value``.
+
+    Construction mirrors ``parallel.distributed.host_barrier``: each
+    process fills only its addressable shards of a data-axis-sharded
+    vector with its value; one collective makes the reduction visible to
+    every host. No side channel, no extra service.
+    """
+    if jax.process_count() == 1:
+        return int(value)
+    dm = mesh if mesh is not None else DeviceMesh()
+    axis = dm.axis_names[0]
+    sharding = jax.sharding.NamedSharding(dm.mesh, P(axis))
+    global_shape = (dm.axis_size(),)
+    full = np.full(global_shape, int(value), dtype=np.int32)
+    arr = jax.make_array_from_callback(
+        global_shape, sharding, lambda idx: full[idx]
+    )
+    reduced = _agree_fn(dm.mesh, axis, op)(arr)
+    return int(np.asarray(reduced.addressable_shards[0].data)[0])
+
+
+def agree_max(value: int, mesh: Optional[DeviceMesh] = None) -> int:
+    """Max of a per-process int across all processes (see module doc).
+
+    Values must fit int32 (schedule lengths, batch heights, dtype codes —
+    all small by construction). For unbounded quantities like global row
+    counts, use :func:`gather_vectors` (f64-exact transport) instead.
+    """
+    return _device_agree(value, mesh, "max")
+
+
+def agree_all_ok(ok: bool, mesh: Optional[DeviceMesh], what: str) -> None:
+    """Agreed validation barrier: raise on EVERY process when any process
+    failed a local check.
+
+    A rank-local ``raise`` in a multi-process code path is a distributed
+    hang, not an error: the raising rank exits while its peers block
+    forever in their next collective (the Gloo backend wedges
+    permanently). So every local validation that can fail on one rank
+    but not another must funnel through this rendezvous before any rank
+    proceeds — all ranks call it at the same point, and all ranks raise
+    together. Single-process: raises immediately when not ``ok``.
+    """
+    if jax.process_count() == 1:
+        failed = not ok
+    else:
+        failed = _device_agree(0 if ok else 1, mesh, "max") != 0
+    if failed:
+        suffix = "" if ok else " (failed on this process)"
+        raise ValueError(
+            f"{what} failed on at least one process{suffix}; "
+            "all ranks abort together to avoid a distributed hang"
+        )
+
+
+class DeferredValidation:
+    """Collect local ingest-time errors, then rendezvous.
+
+    Ingest validation (batch shapes, zero weights, label domains) fails
+    on ONE rank's data — raising there immediately would strand the
+    peers in their next collective (see :func:`agree_all_ok`). Instead
+    the caching loop records the first failure and keeps sealing the
+    cache (metadata-only planning tolerates a partial cache); after the
+    plan's collectives, :meth:`rendezvous` agrees the outcome across all
+    ranks — re-raising the ORIGINAL error on the failing rank and the
+    generic agreement error elsewhere.
+    """
+
+    def __init__(self):
+        self.err: Optional[Exception] = None
+
+    def run(self, fn, *args) -> None:
+        """Run a validation step; hold its first failure for the
+        rendezvous instead of raising."""
+        if self.err is None:
+            try:
+                fn(*args)
+            except Exception as e:  # noqa: BLE001 — held, re-raised later
+                self.err = e
+
+    def rendezvous(self, mesh: Optional[DeviceMesh], what: str) -> None:
+        try:
+            agree_all_ok(self.err is None, mesh, what)
+        except ValueError:
+            if self.err is not None:
+                raise self.err
+            raise
+
+
+def agree_feature_dim(
+    cache: DataCache,
+    column: str,
+    mesh: Optional[DeviceMesh],
+    local_dim: int = 0,
+) -> int:
+    """Discover + agree the feature dim of a cached stream across
+    processes (one definition for every streamed trainer).
+
+    ``local_dim`` short-circuits discovery when the trainer already knows
+    it; otherwise the first cached batch's ``column`` is read. An empty
+    local cache contributes 0 and adopts the agreed dim. A mismatch
+    raises on EVERY rank (see :func:`agree_all_ok`).
+    """
+    if not local_dim and cache.num_batches:
+        reader = cache.reader()
+        local_dim = int(np.asarray(next(iter(reader))[column]).shape[1])
+        if hasattr(reader, "close"):
+            reader.close()
+    dim = agree_max(local_dim, mesh)
+    agree_all_ok(
+        not (local_dim and local_dim != dim), mesh,
+        f"feature-dim agreement (local {local_dim}, global {dim})",
+    )
+    return dim
+
+
+def _entry_rows(entry: Any) -> int:
+    if isinstance(entry, Segment):
+        return entry.num_rows
+    return next(iter(entry.values())).shape[0] if entry else 0
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+@dataclasses.dataclass
+class SyncedReplayPlan:
+    """The agreed per-epoch replay schedule for one sealed local cache.
+
+    ``global_steps`` — dispatches every process performs per epoch;
+    ``local_height`` — fixed padded row count each process contributes per
+    step (the global batch is ``local_height × process_count`` rows).
+    """
+
+    global_steps: int
+    local_height: int
+    mesh: DeviceMesh
+
+    @staticmethod
+    def create(
+        cache: DataCache, mesh: DeviceMesh, row_tile: int
+    ) -> "SyncedReplayPlan":
+        """Agree the schedule for ``cache`` (this process's partition).
+
+        ``row_tile`` is the divisibility unit for the local height
+        (usually ``mesh.axis_size() * 8`` — also divisible by the local
+        device count, so :meth:`DeviceMesh.global_batch` placement works).
+        An empty local cache is legal (that process only feeds dummy
+        steps); an empty GLOBAL cache raises.
+        """
+        local_max = max(
+            (_entry_rows(e) for e in cache.entries), default=0
+        )
+        steps = agree_max(cache.num_batches, mesh)
+        height = agree_max(_round_up(max(local_max, 1), row_tile), mesh)
+        if steps == 0:
+            raise ValueError("training stream is empty on every process")
+        return SyncedReplayPlan(
+            global_steps=steps, local_height=height, mesh=mesh
+        )
+
+    def epoch_batches(
+        self,
+        reader: Iterator[Dict[str, np.ndarray]],
+        dummy: Callable[[], Any],
+    ) -> Iterator[Any]:
+        """Yield exactly ``global_steps`` items: the local reader's batches
+        (to be padded to ``local_height`` by the caller's ``place``),
+        then ``dummy()`` fillers once the local cache is drained.
+
+        The caller's placement must pad every real batch to
+        ``local_height`` rows with zero-weight padding, and ``dummy()``
+        must produce a zero-weight batch of the same shape — both are
+        exact no-ops in every weighted reduction, so a short process
+        contributes nothing past its own data while keeping the SPMD
+        step count aligned.
+        """
+        steps = 0
+        for batch in reader:
+            if steps >= self.global_steps:
+                raise RuntimeError(
+                    "local cache yielded more batches than the agreed "
+                    "schedule — caches must be sealed before planning"
+                )
+            yield batch
+            steps += 1
+        while steps < self.global_steps:
+            yield dummy()
+            steps += 1
+
+
+def pad_rows_to(arr: np.ndarray, height: int, dtype=None) -> np.ndarray:
+    """Zero-pad ``arr`` along axis 0 to exactly ``height`` rows — the
+    fixed-shape placement contract of :class:`SyncedReplayPlan` (padded
+    rows must carry zero weight, making them exact no-ops). One shared
+    definition so the per-trainer ``place`` functions cannot drift."""
+    arr = np.asarray(arr, dtype)
+    out = np.zeros((height,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+@functools.lru_cache(maxsize=64)
+def _slot_gather_fn(mesh, axis: str, p_size: int, item_shape: tuple):
+    """Compiled one-slot-scatter ``psum`` (== all_gather, but with
+    replication the shard_map output checker can infer), cached per
+    (mesh, item shape). Each device's ``[1, *item_shape]`` shard lands in
+    its own slot of a ``[p_size, *item_shape]`` buffer; the psum makes
+    every slot visible everywhere without cross-addition."""
+
+    def _gather(shard):
+        i = jax.lax.axis_index(axis)
+        buf = jnp.zeros(
+            (p_size,) + item_shape, jnp.float32
+        ).at[i].set(shard[0])
+        return jax.lax.psum(buf, axis)
+
+    return jax.jit(
+        jax.shard_map(_gather, mesh=mesh, in_specs=P(axis), out_specs=P(None))
+    )
+
+
+def gather_vectors(local_vec: np.ndarray, mesh: DeviceMesh) -> np.ndarray:
+    """Gather one flat float64 vector per process; returns ``[P, len]``
+    (process-indexed, every process sees all rows).
+
+    Used to reduce host-side pass-0 statistics (GMM moments, PCA sums)
+    across processes without losing f64 precision to the f32 data plane:
+    each value rides as an (hi, lo) f32 pair — ``hi = f32(v)``,
+    ``lo = f32(v - hi)`` — and is reassembled on the host, exact to
+    ~2^-48 relative. The transport is the same one-slot-scatter ``psum``
+    as :func:`pooled_sample` (no cross-process addition touches the
+    split values, so reassembly is deterministic and identical on every
+    host). Single-process: returns ``local_vec[None, :]``.
+    """
+    local_vec = np.asarray(local_vec, np.float64).ravel()
+    if jax.process_count() == 1:
+        return local_vec[None, :]
+    dm = mesh if mesh is not None else DeviceMesh()
+    axis = dm.axis_names[0]
+    p_size = dm.axis_size()
+    m = local_vec.shape[0]
+    hi = local_vec.astype(np.float32)
+    lo = (local_vec - hi.astype(np.float64)).astype(np.float32)
+    pair = np.stack([hi, lo])  # [2, m]
+
+    sharding = jax.sharding.NamedSharding(dm.mesh, P(axis))
+    arr = jax.make_array_from_callback(
+        (p_size, 2, m), sharding, lambda idx: pair[None]
+    )
+    out = _slot_gather_fn(dm.mesh, axis, p_size, (2, m))(arr)
+    per_dev = np.asarray(out.addressable_shards[0].data, np.float64)
+    # One representative device per process; devices group by process.
+    devices = list(dm.mesh.devices.flat)
+    rows, seen = [], set()
+    for i, dev in enumerate(devices):
+        if dev.process_index in seen:
+            continue
+        seen.add(dev.process_index)
+        rows.append(per_dev[i, 0] + per_dev[i, 1])
+    return np.stack(rows)
+
+
+def pooled_sample(
+    local_sample: np.ndarray,
+    local_rows: int,
+    cap: int,
+    seed: int,
+    mesh: DeviceMesh,
+) -> np.ndarray:
+    """Combine per-process uniform row samples into one global sample.
+
+    Each process passes its local reservoir sample (``<= cap`` rows,
+    uniform over its ``local_rows``-row partition). The samples are
+    gathered through the device fabric (an ``all_gather`` over the data
+    axis — no host side channel), then ``cap`` rows are drawn on every
+    host identically (same seed ⇒ same result) by Efraimidis–Spirakis
+    weighted sampling without replacement, each pooled row weighted
+    ``local_rows / sample_rows`` of its home process so the draw matches
+    uniform-over-the-global-dataset in expectation.
+
+    Single-process this is the identity (the local sample IS the global
+    sample). Returns ``min(cap, total pooled rows)`` rows.
+    """
+    local_sample = np.asarray(local_sample, np.float32)
+    if jax.process_count() == 1:
+        return local_sample
+    if local_sample.size == 0:
+        # An empty partition is legal (the process feeds only dummy
+        # steps); normalize the empty reservoir's 1-D shape so the
+        # feature dim comes from the agreement below.
+        local_sample = local_sample.reshape(0, 0)
+    if local_sample.ndim != 2:
+        raise ValueError(f"sample must be [n, d], got {local_sample.shape}")
+    d = agree_max(local_sample.shape[1], mesh)
+    if local_sample.shape[0] and local_sample.shape[1] != d:
+        raise ValueError(
+            f"sample feature dim {local_sample.shape[1]} != global dim {d}"
+        )
+    s_p = local_sample.shape[0]
+    # Gather buffers sized by the agreed ACTUAL max sample size, not the
+    # nominal cap (GMM's cap is 65,536 — padding every device's slot to
+    # it would burn ~cap*d*4 B per device for a few hundred real rows).
+    cap_eff = max(1, agree_max(s_p, mesh))
+    padded = np.zeros((cap_eff, d), np.float32)
+    if s_p:
+        padded[:s_p] = local_sample
+
+    axis = mesh.axis_names[0]
+    p_size = mesh.axis_size()
+    # Row 0 of each device's shard block carries (sample_rows, local_rows);
+    # the gathered copy is deduplicated per process on the host below.
+    meta = np.array([[float(s_p), float(local_rows)]], np.float32)
+
+    # Each device's shard is this process's whole padded sample / meta row
+    # (the callback is only invoked for addressable shards).
+    sharding3 = jax.sharding.NamedSharding(mesh.mesh, P(axis))
+    sample_g = jax.make_array_from_callback(
+        (p_size, cap_eff, d), sharding3, lambda idx: padded[None]
+    )
+    meta_g = jax.make_array_from_callback(
+        (p_size, 2), sharding3, lambda idx: meta
+    )
+    gathered = _slot_gather_fn(mesh.mesh, axis, p_size, (cap_eff, d))(
+        sample_g
+    )
+    metas = _slot_gather_fn(mesh.mesh, axis, p_size, (2,))(meta_g)
+    gathered = np.asarray(gathered.addressable_shards[0].data)
+    metas = np.asarray(metas.addressable_shards[0].data)
+
+    # One representative device per process (devices of a process hold
+    # identical copies; mesh device order groups by process).
+    devices = list(mesh.mesh.devices.flat)
+    rows, weights = [], []
+    seen = set()
+    for i, dev in enumerate(devices):
+        p = dev.process_index
+        if p in seen:
+            continue
+        seen.add(p)
+        s_rows = int(metas[i, 0])
+        n_rows = float(metas[i, 1])
+        if s_rows == 0:
+            continue
+        rows.append(gathered[i, :s_rows])
+        weights.append(np.full(s_rows, n_rows / s_rows, np.float64))
+    if not rows:
+        raise ValueError("pooled sample is empty on every process")
+    pool = np.concatenate(rows, axis=0)
+    w = np.concatenate(weights)
+    take = min(cap, pool.shape[0])
+    rng = np.random.default_rng(seed)
+    # Efraimidis–Spirakis: top-k of u^(1/w) is a weighted sample without
+    # replacement; identical seed on every host ⇒ identical selection.
+    keys = rng.random(pool.shape[0]) ** (1.0 / np.maximum(w, 1e-12))
+    order = np.argsort(keys)[::-1][:take]
+    return pool[order]

@@ -211,10 +211,15 @@ def run_streamed_adam(
         PrefetchingDeviceFeed,
     )
     from flinkml_tpu.parallel import pad_to_multiple
-    from flinkml_tpu.parallel.distributed import require_single_controller
     from flinkml_tpu.parallel.mesh import DeviceMesh
 
-    require_single_controller(what)
+    # Multi-process: per-process stream partitions + an agreed SPMD
+    # schedule. The extra agreement here (vs the linear/KMeans streamed
+    # fits) is the per-chunk Adam step count: ``n_steps`` is a traced
+    # operand of the chunk trainer and must be identical on every process
+    # at every dispatch, so the schedule is derived from the GLOBAL row
+    # count of each chunk index (gathered once; the cache is sealed).
+    multi = jax.process_count() > 1
     if resume and not isinstance(source, DataCache):
         raise ValueError(
             "resume=True requires a durable DataCache input: a one-shot "
@@ -224,24 +229,109 @@ def run_streamed_adam(
     resume_epoch = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
 
     # -- pass 0: cache --------------------------------------------------
+    from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+    dv = DeferredValidation()
+
+    first_dim = [None]
+
+    def checked_ingest(t):
+        """Full ingest-time validation (zero rows, ragged dims, zero
+        total weight) — everything place-time validation would catch,
+        because on a multi-process mesh a place-time raise is a
+        rank-local abort mid-collective (the hang class
+        stream_sync.DeferredValidation exists to prevent)."""
+        b = ingest(t)
+        x = b["x"]
+        if x.shape[0] == 0:
+            raise ValueError(
+                "stream batch has zero rows; drop empty batches"
+            )
+        if first_dim[0] is None:
+            first_dim[0] = x.shape[1]
+        elif x.shape[1] != first_dim[0]:
+            raise ValueError(
+                f"batch feature dim {x.shape[1]} != first batch's "
+                f"{first_dim[0]}"
+            )
+        if "w" in b and float(np.sum(b["w"])) == 0.0:
+            raise ValueError(
+                "stream batch has zero total weight (all weights 0); "
+                "drop such batches before training"
+            )
+        return b
+
     if isinstance(source, DataCache):
         cache = source
     else:
         writer = DataCacheWriter(cache_dir, cache_memory_budget_bytes)
         for t in source:
-            b = ingest(t)
-            if b["x"].shape[0] == 0:
-                raise ValueError(
-                    "stream batch has zero rows; drop empty batches"
-                )
-            writer.append(b)
+            if multi:
+                # Held for the post-plan rendezvous: a rank-local raise
+                # here would strand the peers in the plan's collectives.
+                if dv.err is None:
+                    try:
+                        writer.append(checked_ingest(t))
+                    except Exception as e:  # noqa: BLE001
+                        dv.err = e
+            else:
+                writer.append(checked_ingest(t))
         cache = writer.finish()
-    if cache.num_rows == 0:
+    if not multi and cache.num_rows == 0:
         raise ValueError("training stream is empty")
-    reader = cache.reader()
-    d = np.asarray(next(iter(reader))["x"]).shape[1]
-    if hasattr(reader, "close"):
-        reader.close()
+    d = 0
+    if cache.num_batches:
+        reader = cache.reader()
+        d = np.asarray(next(iter(reader))["x"]).shape[1]
+        if hasattr(reader, "close"):
+            reader.close()
+
+    plan = None
+    nsteps_sched = None
+    if multi:
+        from flinkml_tpu.iteration.stream_sync import (
+            SyncedReplayPlan,
+            _entry_rows,
+            agree_all_ok,
+            agree_feature_dim,
+            agree_max,
+            gather_vectors,
+        )
+
+        plan = SyncedReplayPlan.create(cache, mesh, p * 8)
+        dv.rendezvous(mesh, "stream ingest validation")
+        d = agree_feature_dim(cache, "x", mesh, local_dim=d)
+        # Global per-chunk row counts → agreed Adam step schedule.
+        local_rows = np.zeros(plan.global_steps)
+        for t, entry in enumerate(cache.entries):
+            local_rows[t] = _entry_rows(entry)
+        rows_global = gather_vectors(local_rows, mesh).sum(axis=0)
+        nsteps_sched = np.maximum(
+            1, -(-rows_global.astype(np.int64) // global_bs)
+        )
+        # Agreed label dtype: dummy chunks must dispatch the exact program
+        # real chunks do, so their y placeholder needs the real dtype even
+        # on a process whose local cache is empty.
+        _DTYPE_CODES = {
+            np.dtype(np.float32): 1, np.dtype(np.int32): 2,
+            np.dtype(np.int64): 3, np.dtype(np.float64): 4,
+        }
+        _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+        local_code = 0
+        if cache.num_batches:
+            reader = cache.reader()
+            y0 = np.asarray(next(iter(reader))["y"])
+            if hasattr(reader, "close"):
+                reader.close()
+            if isinstance(source, DataCache):  # sealed caches: raw labels
+                y0 = place_y(y0)
+            local_code = _DTYPE_CODES[np.dtype(np.asarray(y0).dtype)]
+        code = agree_max(local_code, mesh)
+        agree_all_ok(
+            not (local_code and local_code != code), mesh,
+            "label-dtype agreement",
+        )
+        y_dtype = _CODE_DTYPES[code]
 
     # Labels in a cache the runner built itself were already prepared/
     # validated at ingest; re-running place_y per chunk per epoch would
@@ -293,6 +383,42 @@ def run_streamed_adam(
             mesh.shard_batch(w_pad), x.shape[0],
         )
 
+    def place_multi(batch):
+        """Fixed-shape multi-process placement (agreed height; dummy
+        chunks are zero-weight no-op contributions to the global step)."""
+        height = plan.local_height
+        if "_dummy" in batch:
+            x_pad = np.zeros((height, d), np.float32)
+            y_pad = np.zeros(height, y_dtype)
+            w_pad = np.zeros(height, np.float32)
+        else:
+            x = np.asarray(batch["x"], np.float32)
+            if not first_pass_done[0] and x.shape[1] != d:
+                raise ValueError(
+                    f"batch feature dim {x.shape[1]} != global dim {d}"
+                )
+            y = np.asarray(batch["y"])
+            if not labels_prepared:
+                y = place_y(y)
+            w = (
+                np.asarray(batch["w"], np.float32)
+                if "w" in batch else np.ones(x.shape[0], np.float32)
+            )
+            if not first_pass_done[0] and float(w.sum()) == 0.0:
+                raise ValueError(
+                    "stream batch has zero total weight (empty batch or "
+                    "all weights 0); drop such batches before training"
+                )
+            from flinkml_tpu.iteration.stream_sync import pad_rows_to
+
+            x_pad = pad_rows_to(x, height, np.float32)
+            y_pad = pad_rows_to(np.asarray(y, y_dtype), height)
+            w_pad = pad_rows_to(np.asarray(w, np.float32), height)
+        return (
+            mesh.global_batch(x_pad), mesh.global_batch(y_pad),
+            mesh.global_batch(w_pad), 0,
+        )
+
     local_bs = max(1, global_bs // p)
     trainer = make_adam_chunk_trainer(
         mesh.mesh, DeviceMesh.DATA_AXIS, local_bs, loss_builder, n_params,
@@ -328,37 +454,52 @@ def run_streamed_adam(
 
     # max_iter counts EPOCHS (one replay pass each); within an epoch
     # every chunk contributes ceil(rows / global_bs) Adam steps.
+    from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+    guard = DispatchGuard()  # multi-process backpressure (no-op single)
     for epoch in range(start_epoch, max_iter):
         if terminated:
             break
         last_loss = None
-        feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
+        if multi:
+            src = plan.epoch_batches(cache.reader(), lambda: {"_dummy": True})
+            feed = PrefetchingDeviceFeed(src, place=place_multi, depth=2)
+        else:
+            feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
         try:
-            for xb, yb, wb, rows in feed:
-                n_steps = max(1, -(-rows // global_bs))  # ceil
+            for t, (xb, yb, wb, rows) in enumerate(feed):
+                n_steps = (
+                    int(nsteps_sched[t]) if multi
+                    else max(1, -(-rows // global_bs))  # ceil
+                )
                 flat, m, v, step, loss = trainer(
                     xb, yb, wb, flat, m, v, step, lr_dev,
                     jnp.asarray(n_steps, jnp.int32), sample_key,
                 )
                 last_loss = loss
+                step = guard.after_dispatch(step)
         finally:
             feed.close()
+        guard.flush(step)
         first_pass_done[0] = True  # batches are immutable: validate once
         cur = float(last_loss)
         terminated = abs(prev_loss - cur) <= tol
         prev_loss = cur
         if should_snapshot(mgr, checkpoint_interval, epoch + 1, max_iter,
                            terminal=terminated):
-            mgr.save(
-                (
-                    tuple(np.asarray(t) for t in flat),
-                    tuple(np.asarray(t) for t in m),
-                    tuple(np.asarray(t) for t in v),
-                    np.int32(int(step)), np.float64(prev_loss),
-                    np.asarray(terminated),
-                ),
-                epoch + 1,
+            state = (
+                tuple(np.asarray(t) for t in flat),
+                tuple(np.asarray(t) for t in m),
+                tuple(np.asarray(t) for t in v),
+                np.int32(int(step)), np.float64(prev_loss),
+                np.asarray(terminated),
             )
+            if multi:
+                from flinkml_tpu.iteration.checkpoint import save_replicated
+
+                save_replicated(mgr, state, epoch + 1, mesh)
+            else:
+                mgr.save(state, epoch + 1)
         if terminated:
             break
     return flat

@@ -925,6 +925,218 @@ def _pad_rows(arr: np.ndarray, rows: int) -> np.ndarray:
     return np.pad(arr, pad)
 
 
+_DUMMY_BATCH = {"_dummy": True}
+
+
+def _train_linear_stream_multiprocess(
+    batches,
+    loss: str,
+    mesh: DeviceMesh,
+    max_iter: int,
+    learning_rate: float,
+    reg: float,
+    elastic_net: float,
+    tol: float,
+    cache_dir: Optional[str],
+    memory_budget_bytes: Optional[int],
+    checkpoint_manager,
+    checkpoint_interval: int,
+    resume: bool,
+    listeners,
+    prefetch_depth: int,
+    dtype,
+    columns: Tuple[str, str, Optional[str]],
+    validate,
+) -> np.ndarray:
+    """The multi-process body of :func:`train_linear_model_stream`.
+
+    Each process feeds its OWN partition of the stream (the reference's
+    per-subtask stream partitions); the SPMD invariants — one agreed
+    padded batch height, one agreed step count per epoch, zero-weight
+    dummy steps for short processes — come from
+    :class:`~flinkml_tpu.iteration.stream_sync.SyncedReplayPlan`.
+    Differences from the single-process path, all forced by SPMD:
+
+      - pass 0 caches WITHOUT training (the step count must be agreed
+        before the first collective dispatch), so one extra replay pass;
+      - every step has one fixed global shape (bounds compilations to 1);
+      - in-flight dispatches are bounded by
+        :class:`~flinkml_tpu.parallel.dispatch.DispatchGuard` (the
+        multi-process backpressure policy);
+      - checkpoints commit rank-0-writes + global barrier
+        (:func:`~flinkml_tpu.iteration.checkpoint.save_replicated`)
+        against a SHARED checkpoint directory.
+
+    Numerics match a single-process run whose step-t batch is the
+    concatenation of every process's step-t batch (up to float reduction
+    order); the fitted coefficient is replicated and identical on every
+    process.
+    """
+    from flinkml_tpu.iteration.checkpoint import begin_resume, save_replicated
+    from flinkml_tpu.iteration.datacache import (
+        DataCache,
+        DataCacheWriter,
+        PrefetchingDeviceFeed,
+    )
+    from flinkml_tpu.iteration.runtime import TerminateOnMaxIterOrTol
+    from flinkml_tpu.iteration.stream_sync import (
+        DeferredValidation,
+        SyncedReplayPlan,
+        agree_feature_dim,
+    )
+    from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+    # loss/resume-durability already validated by the dispatching caller
+    # (train_linear_model_stream).
+    is_cache = isinstance(batches, DataCache)
+    resume_epoch = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
+
+    p_size = mesh.axis_size()
+    row_tile = p_size * 8
+    axis = DeviceMesh.DATA_AXIS
+    stepper = _stream_stepper(mesh.mesh, loss, axis)
+    l2 = reg * (1.0 - elastic_net)
+    l1 = reg * elastic_net
+    x_key, y_key, w_key = columns
+
+    # -- pass 0: cache only (step counts must be agreed before training) --
+    dv = DeferredValidation()
+
+    def check_ingest(b):
+        x = np.asarray(b[x_key], dtype=dtype)
+        if validate is not None:
+            validate(b)
+        w = (
+            np.asarray(b[w_key], dtype=dtype)
+            if w_key is not None and w_key in b
+            else np.ones(x.shape[0], dtype=dtype)
+        )
+        if x.shape[0] == 0 or float(w.sum()) == 0.0:
+            raise ValueError(
+                "stream batch has zero total weight (empty batch or all "
+                "weights 0); drop such batches before training"
+            )
+
+    if is_cache:
+        cache = batches
+    else:
+        writer = DataCacheWriter(cache_dir, memory_budget_bytes)
+        for b in batches:
+            dv.run(check_ingest, b)
+            writer.append({k: np.array(v) for k, v in b.items()})
+        cache = writer.finish()
+
+    plan = SyncedReplayPlan.create(cache, mesh, row_tile)
+    dv.rendezvous(mesh, "stream ingest validation")
+    height = plan.local_height
+    dim = agree_feature_dim(cache, x_key, mesh)
+
+    # Iterable sources were fully validated at ingest (above, before the
+    # rendezvous); only sealed caches still validate at first replay —
+    # those raises are rank-local on the feed thread, the documented
+    # residual (stream_sync.DeferredValidation).
+    first_pass_done = [not is_cache]
+
+    def place(batch):
+        if "_dummy" in batch:
+            x = np.zeros((height, dim), dtype)
+            y = np.zeros(height, dtype)
+            w = np.zeros(height, dtype)
+        else:
+            x = np.asarray(batch[x_key], dtype=dtype)
+            y = np.asarray(batch[y_key], dtype=dtype)
+            w = (
+                np.asarray(batch[w_key], dtype=dtype)
+                if w_key is not None and w_key in batch
+                else np.ones(x.shape[0], dtype=dtype)
+            )
+            if not first_pass_done[0]:
+                if validate is not None:
+                    validate(batch)
+                if x.shape[0] == 0 or float(w.sum()) == 0.0:
+                    raise ValueError(
+                        "stream batch has zero total weight (empty batch or "
+                        "all weights 0); drop such batches before training"
+                    )
+            from flinkml_tpu.iteration.stream_sync import pad_rows_to
+
+            x, y, w = (
+                pad_rows_to(x, height),
+                pad_rows_to(y, height),
+                pad_rows_to(w, height),
+            )
+        return (
+            mesh.global_batch(x),
+            mesh.global_batch(y),
+            mesh.global_batch(w),
+        )
+
+    dt = jnp.dtype(dtype)
+    hy = (
+        jnp.asarray(learning_rate, dt),
+        jnp.asarray(l2, dt),
+        jnp.asarray(l1, dt),
+    )
+    criterion = TerminateOnMaxIterOrTol(max_iter, tol)
+    guard = DispatchGuard()
+
+    coef = None
+    epoch = 0
+    cur_loss = math.inf
+    if resume_epoch is not None:
+        restored = _restore_carry(checkpoint_manager, dim, dtype)
+        if restored is not None:
+            coef_h, epoch, cur_loss = restored
+            coef = jnp.asarray(coef_h, dt)
+
+    def run_epoch(coef):
+        loss_acc = jnp.zeros((), dt)
+        wsum_acc = jnp.zeros((), dt)
+        feed = PrefetchingDeviceFeed(
+            plan.epoch_batches(cache.reader(), lambda: _DUMMY_BATCH),
+            place=place,
+            depth=prefetch_depth,
+        )
+        try:
+            for xb, yb, wb in feed:
+                if coef is None:
+                    coef = jnp.zeros(dim, dt)
+                coef, ls, ws = stepper(coef, xb, yb, wb, *hy)
+                loss_acc = loss_acc + ls
+                wsum_acc = wsum_acc + ws
+                coef = guard.after_dispatch(coef)
+        finally:
+            feed.close()
+        coef = guard.flush(coef)
+        return coef, float(loss_acc) / float(wsum_acc)
+
+    while not (epoch > 0 and criterion.should_terminate(epoch - 1, cur_loss)):
+        coef, cur_loss = run_epoch(coef)
+        epoch += 1
+        first_pass_done[0] = True
+        coef_host = np.asarray(coef)
+        for listener in listeners:
+            listener.on_epoch_watermark_incremented(epoch - 1, coef_host)
+        terminated = criterion.should_terminate(epoch - 1, cur_loss)
+        if checkpoint_manager is not None and (
+            terminated
+            or (checkpoint_interval > 0 and epoch % checkpoint_interval == 0)
+        ):
+            save_replicated(
+                checkpoint_manager,
+                (coef_host, np.float64(cur_loss)),
+                epoch,
+                mesh,
+            )
+
+    result = np.asarray(coef)
+    if checkpoint_manager is not None:
+        checkpoint_manager.wait()
+    for listener in listeners:
+        listener.on_iteration_terminated(result)
+    return result
+
+
 def train_linear_model_stream(
     batches,
     loss: str,
@@ -997,10 +1209,17 @@ def train_linear_model_stream(
             "resume=True requires a durable DataCache input: a one-shot "
             "stream cannot be replayed from the start after a failure"
         )
+    if jax.process_count() > 1:
+        # Per-process stream partitions + agreed SPMD schedule; see
+        # _train_linear_stream_multiprocess for the invariants.
+        return _train_linear_stream_multiprocess(
+            batches, loss, mesh, max_iter, learning_rate, reg, elastic_net,
+            tol, cache_dir, memory_budget_bytes, checkpoint_manager,
+            checkpoint_interval, resume, listeners, prefetch_depth, dtype,
+            columns, validate,
+        )
     from flinkml_tpu.iteration.checkpoint import begin_resume
-    from flinkml_tpu.parallel.distributed import require_single_controller
 
-    require_single_controller("train_linear_model_stream")
     begin_resume(checkpoint_manager, resume, mesh.mesh.size)
 
     p_size = mesh.axis_size()

@@ -214,9 +214,11 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
             begin_resume,
             should_snapshot,
         )
-        from flinkml_tpu.parallel.distributed import require_single_controller
-
-        require_single_controller("GaussianMixture streamed fit")
+        # Multi-process: per-process stream partitions + the agreed SPMD
+        # replay schedule; pass-0 moments and the init reservoir are
+        # combined across processes through the device fabric
+        # (iteration/stream_sync.py).
+        multi = jax.process_count() > 1
         if self.resume and not isinstance(source, DataCache):
             raise ValueError(
                 "resume=True requires a durable DataCache input: a one-shot "
@@ -263,19 +265,47 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
             sum_xx = sq if sum_xx is None else sum_xx + sq
             count += x.shape[0]
 
+        from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+        dv = DeferredValidation()
+        take_in = ingest if not multi else (lambda x: dv.run(ingest, x))
         if isinstance(source, DataCache):
             cache = source
             for batch in cache.reader():
-                ingest(np.asarray(batch[column], np.float32))
+                take_in(np.asarray(batch[column], np.float32))
         else:
             writer = DataCacheWriter(
                 self.cache_dir, self.cache_memory_budget_bytes
             )
             for t in source:
                 x = features_matrix(t, features_col).astype(np.float32)
-                ingest(x)
+                take_in(x)
                 writer.append({column: np.array(x)})
             cache = writer.finish()
+        plan = None
+        if multi:
+            from flinkml_tpu.iteration.stream_sync import (
+                SyncedReplayPlan,
+                agree_feature_dim,
+                gather_vectors,
+                pooled_sample,
+            )
+
+            plan = SyncedReplayPlan.create(cache, mesh, row_tile)
+            dv.rendezvous(mesh, "stream ingest validation")
+            d = agree_feature_dim(
+                cache, column, mesh, local_dim=0 if d is None else d
+            )
+            # Combine pass-0 moments exactly (f64 via hi/lo f32 pairs).
+            local_stats = np.concatenate([
+                np.zeros(2 * d) if sum_x is None
+                else np.concatenate([sum_x, sum_xx]),
+                [float(count)],
+            ])
+            stats = gather_vectors(local_stats, mesh).sum(axis=0)
+            sum_x, sum_xx = stats[:d], stats[d : 2 * d]
+            local_count = count
+            count = int(round(stats[2 * d]))
         if count < k:
             raise ValueError(f"n_rows={count} < k={k}")
 
@@ -290,7 +320,14 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
         weights = np.full(k, 1.0 / k)
         if resume_epoch is None:
             rng = np.random.default_rng(self.get_seed())
-            sample = reservoir.sample().astype(np.float64) - shift[None, :]
+            sample = reservoir.sample()
+            if multi:
+                # pooled_sample tolerates an empty local partition.
+                sample = pooled_sample(
+                    sample.astype(np.float32), local_count,
+                    65_536, self.get_seed(), mesh,
+                )
+            sample = sample.astype(np.float64) - shift[None, :]
             means = np.asarray(_kmeans_pp_init(sample, k, rng), np.float64)
         else:
             means = np.zeros((k, d))  # placeholder; restored below
@@ -298,14 +335,34 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
         step = _em_step_fn(mesh.mesh, DeviceMesh.DATA_AXIS, k, cov_type)
         f32 = lambda a: jnp.asarray(a, jnp.float32)
 
-        def place(batch):
-            x = np.asarray(batch[column], np.float32) - shift.astype(
-                np.float32
-            )[None, :]
-            x_pad, n_valid = pad_to_multiple(x, row_tile)
-            wl = np.zeros(x_pad.shape[0], np.float32)
-            wl[:n_valid] = 1.0
-            return mesh.shard_batch(x_pad), mesh.shard_batch(wl)
+        if multi:
+            from flinkml_tpu.iteration.stream_sync import pad_rows_to
+
+            height = plan.local_height
+
+            def place(batch):
+                if "_dummy" in batch:
+                    return (
+                        mesh.global_batch(np.zeros((height, d), np.float32)),
+                        mesh.global_batch(np.zeros(height, np.float32)),
+                    )
+                x = np.asarray(batch[column], np.float32) - shift.astype(
+                    np.float32
+                )[None, :]
+                x_pad = pad_rows_to(x, height)
+                wl = pad_rows_to(np.ones(x.shape[0], np.float32), height)
+                return mesh.global_batch(x_pad), mesh.global_batch(wl)
+
+        else:
+
+            def place(batch):
+                x = np.asarray(batch[column], np.float32) - shift.astype(
+                    np.float32
+                )[None, :]
+                x_pad, n_valid = pad_to_multiple(x, row_tile)
+                wl = np.zeros(x_pad.shape[0], np.float32)
+                wl[:n_valid] = 1.0
+                return mesh.shard_batch(x_pad), mesh.shard_batch(wl)
 
         # -- checkpoint/resume: state = (weights, means, covs, prev_ll,
         # terminated) -- each EM epoch is a pure function of (state, cache),
@@ -324,18 +381,31 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
             terminated = bool(term)
 
         def snapshot(epoch):
+            state = (weights, means, covs, np.float64(prev_ll),
+                     np.asarray(terminated))
+            if multi:
+                from flinkml_tpu.iteration.checkpoint import save_replicated
+
+                save_replicated(mgr, state, epoch, mesh)
+                return
             mgr.save(
-                (weights, means, covs, np.float64(prev_ll),
-                 np.asarray(terminated)),
+                state,
                 epoch,
             )
 
+        from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+        guard = DispatchGuard()  # multi-process backpressure (no-op single)
         max_iter = self.get(self.MAX_ITER)
         for epoch in range(start_epoch, max_iter):
             if terminated:
                 break  # restored from a tol-terminated run: no-op resume
             acc = None
-            feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
+            src = (
+                plan.epoch_batches(cache.reader(), lambda: {"_dummy": True})
+                if multi else cache.reader()
+            )
+            feed = PrefetchingDeviceFeed(src, place=place, depth=2)
             try:
                 for xb, wl in feed:
                     out = step(xb, wl, f32(weights), f32(means), f32(covs))
@@ -343,8 +413,10 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
                         out if acc is None
                         else tuple(a + b for a, b in zip(acc, out))
                     )
+                    guard.after_dispatch(acc[0])
             finally:
                 feed.close()
+            guard.flush(acc[0])
             r_k, r_x, r_xx, ll, n_tot = acc
             weights, means, covs = _m_step(
                 np.asarray(r_k, np.float64), np.asarray(r_x, np.float64),

@@ -351,10 +351,15 @@ def train_kmeans_stream(
         DataCacheWriter,
         PrefetchingDeviceFeed,
     )
-    from flinkml_tpu.parallel.distributed import require_single_controller
     from flinkml_tpu.utils.sampling import RowReservoir
 
-    require_single_controller("train_kmeans_stream")
+    # Multi-process: each process feeds its own stream partition; the SPMD
+    # schedule (fixed batch height, agreed step count, zero-weight dummy
+    # steps) comes from SyncedReplayPlan, init samples are pooled across
+    # processes, checkpoints commit rank-0-write + barrier. See
+    # iteration/stream_sync.py and _train_linear_stream_multiprocess for
+    # the invariants.
+    multi = jax.process_count() > 1
     if resume and not isinstance(batches, DataCache):
         raise ValueError(
             "resume=True requires a durable DataCache input: a one-shot "
@@ -392,12 +397,34 @@ def train_kmeans_stream(
         w[:n_valid] = 1.0  # padded rows never influence centroids
         return mesh.shard_batch(x_pad), mesh.shard_batch(w)
 
+    def make_multi_place(height: int, dim: int):
+        """Fixed-shape multi-process placement: every step contributes
+        exactly ``height`` local rows (zero-weight padding / dummies)."""
+
+        from flinkml_tpu.iteration.stream_sync import pad_rows_to
+
+        def place_multi(batch):
+            if "_dummy" in batch:
+                x_pad = np.zeros((height, dim), np.float32)
+                w = np.zeros(height, np.float32)
+            else:
+                x = np.asarray(batch[column], dtype=np.float32)
+                check_dims(x)
+                x_pad = pad_rows_to(x, height)
+                w = pad_rows_to(np.ones(x.shape[0], np.float32), height)
+            return mesh.global_batch(x_pad), mesh.global_batch(w)
+
+        return place_multi
+
     # -- pass 0: cache (if needed) + reservoir sample for init -------------
     reservoir_cap = (
         k if init_mode == "random" else max(k, init_sample_size)
     )
     need_init = initial_centroids is None and resume_epoch is None
     reservoir = RowReservoir(reservoir_cap, seed=seed)
+    from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+    dv = DeferredValidation()
     if isinstance(batches, DataCache):
         cache = batches
         if need_init:
@@ -407,22 +434,50 @@ def train_kmeans_stream(
         writer = DataCacheWriter(cache_dir, memory_budget_bytes)
         for b in batches:
             x = np.asarray(b[column], np.float32)
-            check_dims(x)
+            if multi:
+                # Held for the post-plan rendezvous: a rank-local raise
+                # here would strand the peers in plan.create's collective.
+                dv.run(check_dims, x)
+            else:
+                check_dims(x)
             writer.append({column: np.array(x)})
             if need_init:
                 reservoir.add(x)
         cache = writer.finish()
-    if cache.num_rows < k:
+    plan = None
+    dim = n_feat[0] or 0
+    if multi:
+        from flinkml_tpu.iteration.stream_sync import (
+            SyncedReplayPlan,
+            agree_feature_dim,
+            gather_vectors,
+            pooled_sample,
+        )
+
+        plan = SyncedReplayPlan.create(cache, mesh, row_tile)
+        dv.rendezvous(mesh, "stream ingest validation")
+        dim = agree_feature_dim(cache, column, mesh, local_dim=dim)
+        # f64 transport: global row counts can exceed int32.
+        total_rows = int(
+            gather_vectors(np.asarray([cache.num_rows], np.float64), mesh)
+            .sum()
+        )
+        if total_rows < k:  # replicated value: every rank raises together
+            raise ValueError(f"k={k} exceeds number of points {total_rows}")
+    elif cache.num_rows < k:
         raise ValueError(f"k={k} exceeds number of points {cache.num_rows}")
 
     rng = np.random.default_rng(seed)
     start_epoch = 0
     if resume_epoch is not None:
-        # Shape discovery without a full pass: one cached batch gives d.
-        reader = cache.reader()
-        d_feat = np.asarray(next(iter(reader))[column]).shape[1]
-        if hasattr(reader, "close"):
-            reader.close()
+        if multi:
+            d_feat = dim
+        else:
+            # Shape discovery without a full pass: one cached batch gives d.
+            reader = cache.reader()
+            d_feat = np.asarray(next(iter(reader))[column]).shape[1]
+            if hasattr(reader, "close"):
+                reader.close()
         centroids, start_epoch = checkpoint_manager.restore(
             resume_epoch, like=np.zeros((k, d_feat), np.float32)
         )
@@ -434,6 +489,12 @@ def train_kmeans_stream(
             )
     else:
         sample = reservoir.sample()
+        if multi:
+            # Pool the per-process uniform samples into one global sample
+            # (identical on every host), then seed from it.
+            sample = pooled_sample(
+                sample, cache.num_rows, reservoir_cap, seed, mesh
+            )
         if init_mode == "k-means++":
             centroids = _kmeans_pp_init(sample, k, rng).astype(np.float32)
         else:
@@ -442,27 +503,43 @@ def train_kmeans_stream(
             # reference's shuffled selection (KMeans.java:314-335).
             centroids = sample[rng.permutation(sample.shape[0])[:k]]
 
+    from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+    guard = DispatchGuard()  # multi-process backpressure (no-op single)
     cent_dev = jnp.asarray(centroids)
     for epoch in range(start_epoch, max_iter):
         sums = None
         counts = None
-        feed = PrefetchingDeviceFeed(
-            cache.reader(), place=place, depth=prefetch_depth
-        )
+        if multi:
+            src = plan.epoch_batches(cache.reader(), lambda: {"_dummy": True})
+            place_fn = make_multi_place(plan.local_height, dim)
+        else:
+            src = cache.reader()
+            place_fn = place
+        feed = PrefetchingDeviceFeed(src, place=place_fn, depth=prefetch_depth)
         try:
             for xb, wb in feed:
                 s, c = fn(xb, wb, cent_dev)
                 sums = s if sums is None else sums + s
                 counts = c if counts is None else counts + c
+                counts = guard.after_dispatch(counts)
         finally:
             feed.close()
         if sums is None:
             raise ValueError("training stream is empty")
+        counts = guard.flush(counts)
         safe = jnp.maximum(counts, 1.0)[:, None]
         cent_dev = jnp.where(counts[:, None] > 0, sums / safe, cent_dev)
         if should_snapshot(checkpoint_manager, checkpoint_interval,
                            epoch + 1, max_iter):
-            checkpoint_manager.save(np.asarray(cent_dev), epoch + 1)
+            if multi:
+                from flinkml_tpu.iteration.checkpoint import save_replicated
+
+                save_replicated(
+                    checkpoint_manager, np.asarray(cent_dev), epoch + 1, mesh
+                )
+            else:
+                checkpoint_manager.save(np.asarray(cent_dev), epoch + 1)
     return np.asarray(cent_dev)
 
 

@@ -261,7 +261,10 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
             xd = self.mesh.shard_batch(x_pad)
             coef = self.mesh.replicate(jnp.asarray(self._coefficient, xd.dtype))
             pred, raw = predict(xd, coef)
-            pred, raw = np.asarray(pred)[:n_valid], np.asarray(raw)[:n_valid]
+            # to_host: data-sharded outputs span non-addressable devices
+            # on a multi-process mesh; every rank gathers the full result.
+            pred = self.mesh.to_host(pred)[:n_valid]
+            raw = self.mesh.to_host(raw)[:n_valid]
         else:
             pred, raw = predict(jnp.asarray(x), jnp.asarray(self._coefficient))
         out = table.with_column(

@@ -114,13 +114,18 @@ def host_barrier(mesh=None, tag: int = 0) -> int:
 def require_single_controller(what: str) -> None:
     """Raise a clear error when ``what`` runs under a multi-process mesh.
 
-    The streamed out-of-core fits keep per-row state host-resident and
-    place full global batches from one host — on a multi-process mesh
-    that would die opaquely inside ``device_put`` (non-addressable
-    devices). Until streams are ``process_slice``-sharded, the defined
-    behavior is this explicit rejection; multi-host training uses the
-    in-RAM paths with ``mesh.global_batch`` per-host ingest
-    (``examples/multihost_pod.py``).
+    Most streamed out-of-core fits ARE multi-process-capable (round 4:
+    the linear family, KMeans, GMM, and the streamed-Adam runner behind
+    MLP/FM train from per-process stream partitions via
+    ``iteration/stream_sync.py``). The families still guarded here keep
+    per-row or per-block state host-resident in layouts that are not yet
+    process-partitioned (GBT's per-row gradients/predictions, ALS's
+    factor blocks, LDA's document statistics, Word2Vec's pair cache,
+    PCA's single accumulation pass) — on a multi-process mesh they would
+    die opaquely inside ``device_put`` (non-addressable devices), so the
+    defined behavior is this explicit rejection; multi-host training for
+    them uses the in-RAM paths with ``mesh.global_batch`` per-host
+    ingest (``examples/multihost_pod.py``).
     """
     if jax.process_count() > 1:
         raise RuntimeError(
@@ -128,7 +133,9 @@ def require_single_controller(what: str) -> None:
             "from one process, which cannot address a multi-process "
             "mesh's remote devices. Run it single-process, or use the "
             "in-RAM fit with per-host `mesh.global_batch` ingest "
-            "(docs/development/parallelism.md, examples/multihost_pod.py)."
+            "(docs/development/parallelism.md, examples/multihost_pod.py). "
+            "Multi-process streamed fits are available for the linear "
+            "family, KMeans, GaussianMixture, and MLP/FM."
         )
 
 

@@ -99,6 +99,23 @@ class DeviceMesh:
             lambda a: jax.device_put(a, sharding), tree
         )
 
+    def to_host(self, arr) -> np.ndarray:
+        """Fetch a device array to host, multi-process-safe.
+
+        Fully-addressable arrays (single-process, or replicated outputs)
+        fetch directly. A data-sharded array on a multi-process mesh
+        spans non-addressable devices, so it is all-gathered across
+        processes first — in that case this is a COLLECTIVE: every
+        process must call it, in the same order (the SPMD transform
+        convention: all ranks run the same inference over the same
+        global table and all receive the full result).
+        """
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
     def global_batch(self, local_rows) -> jax.Array:
         """Assemble a globally-sharded batch from THIS PROCESS's rows.
 

@@ -1,0 +1,102 @@
+"""Shared fixtures for the multi-process streamed-fit IT.
+
+Both the pytest parent (which computes the single-process expected
+models) and the spawned workers (which train multi-process) import from
+here, so the data and hyperparameters can never drift apart.
+
+The equivalence contract under test: a multi-process streamed fit over
+per-process stream partitions must match a single-process streamed fit
+whose step-t batch is the concatenation of every process's step-t batch
+(padded dummy rows are zero-weight no-ops), up to float reduction order.
+"""
+
+import numpy as np
+
+N_ROWS = 600
+N_FEATURES = 6
+K_CLUSTERS = 4
+DATA_SEED = 7
+
+LINEAR_HP = dict(
+    loss="logistic",
+    max_iter=5,
+    learning_rate=0.5,
+    reg=0.01,
+    elastic_net=0.0,
+    tol=0.0,
+)
+KMEANS_HP = dict(max_iter=5, seed=3)
+
+# Different per-process batch sizes on purpose: unequal batch heights AND
+# unequal batch counts force the agreed-height padding and the dummy-step
+# tail of the SPMD schedule.
+BATCH_SIZES = {0: 17, 1: 29, 2: 23, 3: 13}
+
+
+def global_data():
+    rng = np.random.default_rng(DATA_SEED)
+    x = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    w_true = rng.normal(size=N_FEATURES).astype(np.float32)
+    logits = x @ w_true
+    y = (logits + rng.normal(scale=0.3, size=N_ROWS) > 0).astype(np.float32)
+    return x, y
+
+
+def slice_for(pid: int, nproc: int) -> slice:
+    base, rem = divmod(N_ROWS, nproc)
+    start = pid * base + min(pid, rem)
+    return slice(start, start + base + (1 if pid < rem else 0))
+
+
+def local_batches(pid: int, nproc: int):
+    """This process's stream partition, in uneven batch sizes."""
+    x, y = global_data()
+    sl = slice_for(pid, nproc)
+    xs, ys = x[sl], y[sl]
+    bs = BATCH_SIZES[pid]
+    return [
+        {"x": xs[i : i + bs], "y": ys[i : i + bs]}
+        for i in range(0, xs.shape[0], bs)
+    ]
+
+
+def combined_batches(nproc: int):
+    """The single-process equivalent stream: step t concatenates every
+    process's batch t (processes already exhausted contribute nothing)."""
+    per_proc = [local_batches(p, nproc) for p in range(nproc)]
+    steps = max(len(b) for b in per_proc)
+    out = []
+    for t in range(steps):
+        parts = [b[t] for b in per_proc if t < len(b)]
+        out.append(
+            {
+                "x": np.concatenate([p["x"] for p in parts]),
+                "y": np.concatenate([p["y"] for p in parts]),
+            }
+        )
+    return out
+
+
+def initial_centroids():
+    x, _ = global_data()
+    return np.ascontiguousarray(x[:K_CLUSTERS])
+
+
+GMM_MEANS = np.asarray([[-4.0, -4.0], [4.0, 4.0]])
+
+
+def gmm_global_data(n=400):
+    rng = np.random.default_rng(DATA_SEED + 1)
+    a = rng.integers(0, 2, n)
+    return (
+        GMM_MEANS[a] + rng.normal(scale=0.5, size=(n, 2))
+    ).astype(np.float32)
+
+
+def gmm_local_batches(pid: int, nproc: int):
+    x = gmm_global_data()
+    base, rem = divmod(x.shape[0], nproc)
+    start = pid * base + min(pid, rem)
+    xs = x[start : start + base + (1 if pid < rem else 0)]
+    bs = BATCH_SIZES[pid]
+    return [xs[i : i + bs] for i in range(0, xs.shape[0], bs)]

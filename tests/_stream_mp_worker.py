@@ -1,0 +1,141 @@
+"""Multi-process streamed-fit worker, launched by test_distributed.py.
+
+Exercises the round-4 multi-process out-of-core path end to end on a real
+jax.distributed (Gloo) mesh: per-process stream partitions, the agreed
+SPMD replay schedule (fixed height + dummy steps), pooled init sampling,
+bounded in-flight dispatch, and rank-0-write + barrier checkpointing —
+the reference's partitioned-stream training (`ReplayOperator.java:62-250`
+over per-subtask partitions) without a single-controller restriction.
+
+Usage: python _stream_mp_worker.py <port> <process_id> <num_processes> <workdir>
+Prints ``STREAM_OK <pid>`` on success. Writes ``result_<pid>.npz`` with
+the fitted models for the parent to cross-check.
+"""
+
+import os
+import sys
+
+port, pid, nproc, workdir = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _stream_mp_common as C  # noqa: E402
+
+from flinkml_tpu.iteration.checkpoint import CheckpointManager  # noqa: E402
+from flinkml_tpu.iteration.datacache import cache_stream  # noqa: E402
+from flinkml_tpu.models._linear_sgd import (  # noqa: E402
+    train_linear_model_stream,
+)
+from flinkml_tpu.models.kmeans import train_kmeans_stream  # noqa: E402
+from flinkml_tpu.parallel import DeviceMesh, init_distributed  # noqa: E402
+
+idx, count = init_distributed(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nproc,
+    process_id=pid,
+)
+assert (idx, count) == (pid, nproc), (idx, count)
+
+mesh = DeviceMesh()
+batches = C.local_batches(pid, nproc)
+
+# --- 1. linear streamed fit from a durable local cache + checkpointing
+# into the SHARED directory (rank 0 writes, everyone barriers).
+cache = cache_stream(iter(batches))
+ckpt_dir = os.path.join(workdir, "ckpt_linear")
+os.makedirs(ckpt_dir, exist_ok=True)
+manager = CheckpointManager(ckpt_dir)
+coef = train_linear_model_stream(
+    cache, mesh=mesh, checkpoint_manager=manager, checkpoint_interval=2,
+    **C.LINEAR_HP,
+)
+manager.close()
+assert np.all(np.isfinite(coef)), coef
+
+# --- 2. resume from the shared checkpoint: the run is already terminal,
+# so a resumed fit must return the identical coefficient without
+# retraining (exact-resume contract on a multi-process mesh).
+manager2 = CheckpointManager(ckpt_dir)
+coef_resumed = train_linear_model_stream(
+    cache, mesh=mesh, checkpoint_manager=manager2, resume=True,
+    **C.LINEAR_HP,
+)
+manager2.close()
+assert np.array_equal(coef, coef_resumed), (coef, coef_resumed)
+
+# --- 3. KMeans streamed fit, fixed init (cross-checked vs single-process
+# by the parent) and pooled random init (must agree across ranks).
+x_batches = [{"x": b["x"]} for b in batches]
+cents = train_kmeans_stream(
+    iter(x_batches), k=C.K_CLUSTERS, mesh=mesh,
+    initial_centroids=C.initial_centroids(), **C.KMEANS_HP,
+)
+cents_rand = train_kmeans_stream(
+    iter(x_batches), k=C.K_CLUSTERS, mesh=mesh, **C.KMEANS_HP,
+)
+assert np.all(np.isfinite(cents)) and np.all(np.isfinite(cents_rand))
+
+# --- 3b. an EMPTY local partition is legal (that rank feeds only dummy
+# steps; pooled init draws entirely from the other rank's reservoir).
+cents_empty = train_kmeans_stream(
+    iter(x_batches if pid == 0 else []),
+    k=C.K_CLUSTERS, mesh=mesh, **C.KMEANS_HP,
+)
+assert np.all(np.isfinite(cents_empty))
+
+# --- 4. GMM streamed fit: pooled moments + pooled init reservoir; must
+# agree across ranks and recover the synthetic components (checked by
+# the parent).
+from flinkml_tpu.models import GaussianMixture  # noqa: E402
+from flinkml_tpu.table import Table  # noqa: E402
+
+gm_tables = [Table({"features": b}) for b in C.gmm_local_batches(pid, nproc)]
+gm = (
+    GaussianMixture(mesh=mesh).set_k(2).set_max_iter(20).set_tol(0.0)
+    .set_seed(5).set_covariance_type("diag").fit(iter(gm_tables))
+)
+
+# --- 5. streamed-Adam runner (MLP): agreed per-chunk step schedule +
+# agreed label dtype; ranks must agree bit-exactly and the model must
+# learn the separable target (checked by the parent).
+from flinkml_tpu.models.mlp import MLPClassifier  # noqa: E402
+
+x_all, y_all = C.global_data()
+sl = C.slice_for(pid, nproc)
+bs = C.BATCH_SIZES[pid]
+mlp_tables = [
+    Table({
+        "features": x_all[sl][i : i + bs],
+        "label": (x_all[sl][i : i + bs, 0]
+                  + x_all[sl][i : i + bs, 1] > 0).astype(np.float64),
+    })
+    for i in range(0, x_all[sl].shape[0], bs)
+]
+mlp = (
+    MLPClassifier(mesh=mesh)
+    .set_layers([C.N_FEATURES, 8, 2]).set_max_iter(8)
+    .set_global_batch_size(64).set_learning_rate(0.05)
+    .set_tol(0.0).set_seed(0)
+    .fit(iter(mlp_tables))
+)
+(mlp_out,) = mlp.transform(Table({"features": x_all}))
+mlp_acc = float(
+    (mlp_out.column("prediction") == (x_all[:, 0] + x_all[:, 1] > 0)).mean()
+)
+
+np.savez(
+    os.path.join(workdir, f"result_{pid}.npz"),
+    coef=coef, cents=cents, cents_rand=cents_rand,
+    cents_empty=cents_empty,
+    gmm_means=gm.means, gmm_weights=gm.weights,
+    mlp_w0=np.asarray(mlp._weights[0]), mlp_acc=np.float64(mlp_acc),
+)
+print(f"STREAM_OK {pid}")

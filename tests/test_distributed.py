@@ -96,6 +96,63 @@ def test_sustained_cross_process_dispatch(tmp_path, n_procs):
     )
 
 
+def test_two_process_streamed_fit(tmp_path):
+    """Streamed out-of-core training across 2 real processes (× 2 local
+    devices): per-process stream partitions, agreed SPMD schedule with
+    unequal batch counts/heights, pooled init sampling, shared-directory
+    checkpoint + exact resume. The fitted models must (a) be identical
+    on every rank (replicated training state), and (b) match the
+    single-process fit over the concatenated per-step batches — the
+    equivalence contract of `iteration/stream_sync.py`. Reference: the
+    partitioned-stream training the reference runs across TaskManagers
+    (`ReplayOperator.java:62-250`, `LogisticRegression.java:334-386`)."""
+    import sys
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _stream_mp_common as C
+    from flinkml_tpu.models._linear_sgd import train_linear_model_stream
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+
+    workdir = _launch_multiprocess_workers(
+        tmp_path, local_devices=2,
+        worker_script="_stream_mp_worker.py",
+        ok_token="STREAM_OK", check_artifacts=False,
+    )
+
+    results = [
+        np.load(workdir / f"result_{p}.npz") for p in range(2)
+    ]
+    # (a) replicated training state: every rank fitted the same model.
+    for key in ("coef", "cents", "cents_rand", "cents_empty", "gmm_means",
+                "gmm_weights", "mlp_w0"):
+        assert np.array_equal(results[0][key], results[1][key]), key
+
+    # GMM: pooled moments + pooled init recover the planted components.
+    got = np.sort(results[0]["gmm_means"], axis=0)
+    np.testing.assert_allclose(got, C.GMM_MEANS, atol=0.3)
+    # MLP (streamed-Adam runner): learns the separable target.
+    assert float(results[0]["mlp_acc"]) > 0.9, results[0]["mlp_acc"]
+
+    # (b) single-process equivalence on the concatenated-step stream.
+    mesh = DeviceMesh()
+    exp_coef = train_linear_model_stream(
+        iter(C.combined_batches(2)), mesh=mesh, **C.LINEAR_HP
+    )
+    np.testing.assert_allclose(
+        results[0]["coef"], exp_coef, rtol=2e-4, atol=2e-5
+    )
+    exp_cents = train_kmeans_stream(
+        iter({"x": b["x"]} for b in C.combined_batches(2)),
+        k=C.K_CLUSTERS, mesh=mesh,
+        initial_centroids=C.initial_centroids(), **C.KMEANS_HP,
+    )
+    np.testing.assert_allclose(
+        results[0]["cents"], exp_cents, rtol=2e-4, atol=2e-4
+    )
+
+
 def _launch_multiprocess_workers(
     tmp_path, local_devices, worker_script="_dist_worker.py",
     ok_token="WORKER_OK", check_artifacts=True, n_procs=2,
@@ -166,3 +223,4 @@ def _launch_multiprocess_workers(
         # The committed artifacts exist on the shared filesystem.
         assert (workdir / "manifest.json").exists()
         assert (workdir / "ckpt").is_dir()
+    return workdir

@@ -11,15 +11,25 @@ import subprocess
 import sys
 
 
-def test_multihost_pod_example_local_demo():
+def _run_example(name, args, token):
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    example = os.path.join(repo_root, "examples", "multihost_pod.py")
+    example = os.path.join(repo_root, "examples", name)
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
-        [sys.executable, example, "--local-demo"],
+        [sys.executable, example, *args],
         env=env, capture_output=True, text=True, timeout=900,
     )
     assert out.returncode == 0, (out.stdout, out.stderr)
-    assert "LOCAL DEMO OK" in out.stdout, out.stdout
+    assert token in out.stdout, out.stdout
+
+
+def test_multihost_pod_example_local_demo():
+    _run_example("multihost_pod.py", ["--local-demo"], "LOCAL DEMO OK")
+
+
+def test_multihost_streamed_fit_example_local_demo():
+    """The round-4 multi-process streamed-fit recipe: 2 hosts, disjoint
+    stream partitions, identical fitted models."""
+    _run_example("multihost_streamed_fit.py", [], "local demo OK")

@@ -1,0 +1,73 @@
+"""Unit tests for the multi-process streamed-fit agreement layer
+(`iteration/stream_sync.py`). Single-process semantics here; the real
+2-process behavior is exercised by
+tests/test_distributed.py::test_two_process_streamed_fit."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.iteration.datacache import cache_stream
+from flinkml_tpu.iteration.stream_sync import (
+    SyncedReplayPlan,
+    agree_max,
+    gather_vectors,
+    pooled_sample,
+)
+from flinkml_tpu.parallel import DeviceMesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return DeviceMesh()
+
+
+def test_agree_max_single_process_identity(mesh):
+    assert agree_max(7, mesh) == 7
+    assert agree_max(0, mesh) == 0
+
+
+def test_gather_vectors_single_process_identity(mesh):
+    v = np.asarray([1.5, -2.25, 1e12 + 0.125])
+    out = gather_vectors(v, mesh)
+    assert out.shape == (1, 3)
+    np.testing.assert_array_equal(out[0], v)
+
+
+def test_pooled_sample_single_process_identity(mesh):
+    s = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+    np.testing.assert_array_equal(pooled_sample(s, 100, 5, 0, mesh), s)
+
+
+def test_plan_schedule_from_cache(mesh):
+    batches = [{"x": np.zeros((n, 2), np.float32)} for n in (5, 17, 3)]
+    cache = cache_stream(iter(batches))
+    plan = SyncedReplayPlan.create(cache, mesh, row_tile=8)
+    assert plan.global_steps == 3
+    # height = max batch rows (17) rounded up to the tile
+    assert plan.local_height == 24
+
+
+def test_plan_epoch_batches_pads_with_dummies(mesh):
+    batches = [{"x": np.zeros((4, 2), np.float32)} for _ in range(2)]
+    cache = cache_stream(iter(batches))
+    plan = SyncedReplayPlan.create(cache, mesh, row_tile=8)
+    plan.global_steps = 5  # pretend another process has 5 batches
+    out = list(plan.epoch_batches(cache.reader(), lambda: {"_dummy": True}))
+    assert len(out) == 5
+    assert sum("_dummy" in b for b in out) == 3
+    assert all("_dummy" not in b for b in out[:2])
+
+
+def test_plan_rejects_unsealed_overrun(mesh):
+    batches = [{"x": np.zeros((4, 2), np.float32)} for _ in range(3)]
+    cache = cache_stream(iter(batches))
+    plan = SyncedReplayPlan.create(cache, mesh, row_tile=8)
+    plan.global_steps = 2  # an impossible agreement for this cache
+    with pytest.raises(RuntimeError, match="more batches than the agreed"):
+        list(plan.epoch_batches(cache.reader(), lambda: {"_dummy": True}))
+
+
+def test_plan_empty_cache_raises(mesh):
+    cache = cache_stream(iter([]))
+    with pytest.raises(ValueError, match="empty on every process"):
+        SyncedReplayPlan.create(cache, mesh, row_tile=8)

@@ -33,6 +33,9 @@ timeout 1800 python tools/compile_ceiling_probe.py \
     || echo "compile_ceiling_probe FAILED rc=$?"
 
 echo "--- 4. full staged bench (FLINKML_BENCH_TIMEOUT=${FLINKML_BENCH_TIMEOUT:-2100} s) ---"
-timeout 2700 python bench.py || echo "bench FAILED rc=$?"
+# Outer kill-cap tracks the bench's own budget (+10 min of slack) so an
+# operator raising FLINKML_BENCH_TIMEOUT doesn't get SIGKILLed mid-run.
+timeout $(( ${FLINKML_BENCH_TIMEOUT:-2100} + 600 )) python bench.py \
+    || echo "bench FAILED rc=$?"
 
 echo "=== done; transcribe results into BASELINE.md (log: $LOG) ==="
