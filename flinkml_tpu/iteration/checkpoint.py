@@ -45,19 +45,23 @@ def begin_resume(manager: Optional["CheckpointManager"], resume: bool,
     return manager.latest_epoch() if resume else None
 
 
-def save_replicated(manager: "CheckpointManager", state: Any, epoch: int,
-                    mesh=None, extra: Optional[dict] = None) -> None:
-    """Multi-process-safe save of a REPLICATED state: rank 0 writes to the
-    shared checkpoint directory, every process barriers on the commit.
+def save_agreed(manager: "CheckpointManager", state: Any, epoch: int,
+                mesh=None, per_rank: bool = False,
+                extra: Optional[dict] = None) -> None:
+    """Multi-process-safe checkpoint save with an agreed commit barrier.
 
-    The streamed trainers' carry (coefficients, centroids, EM stats…) is
-    identical on every host — having each rank write its own copy would
-    race on the shared directory's atomic rename, and skipping the
-    barrier would let fast ranks train past an uncommitted snapshot (the
-    crash-resume contract requires the snapshot durable before anyone
-    proceeds — the role of the reference's two-phase checkpoint commit,
-    ``Checkpoints.java:43-211``). Single-process this is exactly
-    ``manager.save`` (async write preserved; no barrier cost).
+    ``per_rank=False`` (replicated state — coefficients, centroids, EM
+    stats, identical on every host): rank 0 writes to the shared
+    directory (each rank writing its own copy would race on the atomic
+    rename). ``per_rank=True`` (rank-local state — GBT's per-row
+    margins): every rank writes to its own rank-scoped directory
+    (:func:`rank_scoped`). Either way, the agreement IS the commit
+    barrier: no rank trains past an uncommitted snapshot (the
+    crash-resume contract; the role of the reference's two-phase
+    checkpoint commit, ``Checkpoints.java:43-211``), and a write failure
+    aborts EVERY rank together — a bare barrier would strand the others
+    when the writing rank raises before reaching it. Single-process this
+    is exactly ``manager.save`` (async write preserved; no barrier).
     """
     if jax.process_count() == 1:
         manager.save(state, epoch, extra=extra)
@@ -65,21 +69,44 @@ def save_replicated(manager: "CheckpointManager", state: Any, epoch: int,
     from flinkml_tpu.iteration.stream_sync import agree_all_ok
 
     err = None
-    if jax.process_index() == 0:
+    if per_rank or jax.process_index() == 0:
         try:
             manager.save(state, epoch, extra=extra)
             manager.wait()  # durable before anyone trains past it
         except Exception as e:  # noqa: BLE001 — agreed below
             err = e
-    # The agreement doubles as the commit barrier; a rank-0 write failure
-    # aborts EVERY rank (a bare barrier would strand ranks 1..N-1 when
-    # rank 0 raises before reaching it).
     try:
         agree_all_ok(err is None, mesh, "checkpoint commit")
     except ValueError:
         if err is not None:
             raise err
         raise
+
+
+def save_replicated(manager: "CheckpointManager", state: Any, epoch: int,
+                    mesh=None, extra: Optional[dict] = None) -> None:
+    """Rank-0-writes commit of a REPLICATED state (see :func:`save_agreed`)."""
+    save_agreed(manager, state, epoch, mesh, per_rank=False, extra=extra)
+
+
+def rank_scoped(manager: "CheckpointManager") -> "CheckpointManager":
+    """A per-process view of a shared checkpoint directory, for trainers
+    whose snapshot includes RANK-LOCAL state (GBT's per-row margins and
+    node assignments live on the rank that owns those rows): every rank
+    saves its own shard under ``<dir>/rank-<i>``, so saves never collide
+    on the shared filesystem and each rank restores exactly its rows.
+    Single-process: returns the manager unchanged. Commit ordering across
+    ranks is the caller's job (agree the save outcome — see the GBT
+    snapshot path)."""
+    if jax.process_count() == 1:
+        return manager
+    return CheckpointManager(
+        os.path.join(manager.directory, f"rank-{jax.process_index()}"),
+        max_to_keep=manager.max_to_keep,
+        allow_rescale=manager.allow_rescale,
+        world_size=manager.world_size,
+        async_write=manager.async_write,
+    )
 
 
 def should_snapshot(manager: Optional["CheckpointManager"], interval: int,

@@ -329,6 +329,86 @@ def gather_vectors(local_vec: np.ndarray, mesh: DeviceMesh) -> np.ndarray:
     return np.stack(rows)
 
 
+_EXHAUSTED, _HAVE, _ERROR = 0, 1, 2
+_PAYLOAD_BASE = 1 << 22  # (code, payload) packed into one int32 agreement
+
+
+def synced_stream(
+    batches: Iterator[Any],
+    mesh: Optional[DeviceMesh],
+    check: Optional[Callable[[Any], None]] = None,
+    payload: Optional[Callable[[Any], int]] = None,
+) -> Iterator[Any]:
+    """Iterate a ONE-SHOT local stream in SPMD lockstep, without caching.
+
+    For single-pass trainers (PCA's mean+gram accumulation) the
+    cache-first :class:`SyncedReplayPlan` would double the IO just to
+    learn the step count — instead, every step all processes agree a
+    small state code (exhausted / have-data / local-error) in ONE tiny
+    collective:
+
+      - any process erred → every process raises together
+        (see :func:`agree_all_ok` for why rank-local raises must not
+        happen);
+      - any process has data → every process yields (exhausted ones get
+        ``None`` — the caller dispatches a zero-weight dummy step);
+      - all exhausted → iteration ends everywhere.
+
+    ``check`` (optional) validates each local item; its failure is
+    converted into the agreed error state instead of raising locally.
+
+    ``payload`` (optional) maps each local item to a small non-negative
+    int (< 2**22, e.g. the step's padded batch height); it rides the
+    SAME collective packed under the state code (pmax is lexicographic
+    on (code, payload)), and the generator then yields
+    ``(item, agreed_payload)`` pairs — the max payload over data-bearing
+    ranks — instead of bare items. Single-process: plain iteration, no
+    collectives.
+    """
+    if jax.process_count() == 1:
+        for item in batches:
+            if check is not None:
+                check(item)
+            yield item if payload is None else (item, payload(item))
+        return
+    it = iter(batches)
+    held_err: Optional[Exception] = None
+    while True:
+        item = next(it, None)
+        pay = 0
+        if item is None:
+            code = _EXHAUSTED
+        else:
+            code = _HAVE
+            if check is not None:
+                try:
+                    check(item)
+                except Exception as e:  # noqa: BLE001 — agreed below
+                    held_err = e
+                    code = _ERROR
+            if code == _HAVE and payload is not None:
+                pay = int(payload(item))
+                if not 0 <= pay < _PAYLOAD_BASE:
+                    held_err = ValueError(
+                        f"synced_stream payload {pay} out of range "
+                        f"[0, {_PAYLOAD_BASE})"
+                    )
+                    code = _ERROR
+        agreed = _device_agree(code * _PAYLOAD_BASE + pay, mesh, "max")
+        agreed_code, agreed_pay = divmod(agreed, _PAYLOAD_BASE)
+        if agreed_code == _ERROR:
+            if held_err is not None:
+                raise held_err
+            raise ValueError(
+                "stream validation failed on another process; all ranks "
+                "abort together to avoid a distributed hang"
+            )
+        if agreed_code == _EXHAUSTED:
+            return
+        # None on an exhausted rank → caller dispatches a dummy step.
+        yield item if payload is None else (item, agreed_pay)
+
+
 def pooled_sample(
     local_sample: np.ndarray,
     local_rows: int,

@@ -177,26 +177,30 @@ def train_gbt_stream(
     RNG is fast-forwarded one draw per completed tree.
     """
     from flinkml_tpu.models.gbt import bin_features, quantile_bin_edges
-    from flinkml_tpu.parallel.distributed import require_single_controller
-
-    require_single_controller("train_gbt_stream")
     from flinkml_tpu.utils.sampling import RowReservoir
+
+    # Multi-process (round 4): each process holds its OWN partition of
+    # the dataset as its local cache; per-row state (margins, node ids,
+    # subsample masks) stays on the rank that owns the rows, histograms
+    # psum globally, split decisions replicate. Agreements (bin edges
+    # from a pooled reservoir, base score from gathered sums, replay
+    # schedule) come from iteration/stream_sync.py; checkpoints are
+    # rank-scoped (per-row state) with an agreed commit.
+    multi = jax.process_count() > 1
 
     x_key, y_key, w_key = columns
     rng = np.random.default_rng(seed)
 
     # -- pass A: reservoir for bin edges + base-score sums -----------------
+    from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+    dv = DeferredValidation()
     reservoir = RowReservoir(reservoir_capacity, seed=seed)
     wy_sum = w_sum = wneg_sum = 0.0
     n_feat = None
-    for batch in cache.reader():
-        x = np.asarray(batch[x_key], np.float32)
-        y = np.asarray(batch[y_key], np.float32)
-        w = (
-            np.asarray(batch[w_key], np.float32)
-            if w_key is not None and w_key in batch
-            else np.ones(x.shape[0], np.float32)
-        )
+
+    def check_batch(x, y):
+        nonlocal n_feat
         if x.ndim != 2:
             raise ValueError(f"stream batches must be [n, d], got {x.shape}")
         if x.shape[0] == 0:
@@ -211,18 +215,62 @@ def train_gbt_stream(
             # Folded into this pass so a sealed out-of-core cache is not
             # read a whole extra time just for validation.
             label_check(y)
+
+    for batch in cache.reader():
+        x = np.asarray(batch[x_key], np.float32)
+        y = np.asarray(batch[y_key], np.float32)
+        w = (
+            np.asarray(batch[w_key], np.float32)
+            if w_key is not None and w_key in batch
+            else np.ones(x.shape[0], np.float32)
+        )
+        if multi:
+            # Held for the post-pass rendezvous: a rank-local raise would
+            # strand the peers in the first agreement collective.
+            dv.run(check_batch, x, y)
+        else:
+            check_batch(x, y)
         reservoir.add(x)
         wy_sum += float(np.sum(w * y))
         w_sum += float(np.sum(w))
         wneg_sum += float(np.sum(w * (1 - y)))
-    if n_feat is None or cache.num_rows == 0:
-        raise ValueError("training stream is empty")
-    n = cache.num_rows
+
+    if multi:
+        from flinkml_tpu.iteration.stream_sync import (
+            agree_feature_dim,
+            gather_vectors,
+            pooled_sample,
+        )
+
+        dv.rendezvous(mesh, "stream ingest validation")
+        dim = agree_feature_dim(
+            cache, x_key, mesh, local_dim=0 if n_feat is None else n_feat
+        )
+        if dim == 0:
+            raise ValueError("training stream is empty on every process")
+        n_feat = dim
+        sums = gather_vectors(
+            np.asarray([wy_sum, w_sum, wneg_sum, float(cache.num_rows)]),
+            mesh,
+        ).sum(axis=0)
+        wy_sum, w_sum, wneg_sum = sums[0], sums[1], sums[2]
+        sample = reservoir.sample()
+        if sample.size == 0:
+            sample = np.zeros((0, dim), np.float32)
+        sample = pooled_sample(
+            sample.astype(np.float32), cache.num_rows,
+            reservoir_capacity, seed, mesh,
+        )
+    else:
+        if n_feat is None or cache.num_rows == 0:
+            raise ValueError("training stream is empty")
+        sample = reservoir.sample()
+    n = cache.num_rows  # LOCAL rows: per-row state is rank-resident
     if logistic:
         base = float(np.log(max(wy_sum, 1e-12) / max(wneg_sum, 1e-12)))
     else:
         base = float(wy_sum / w_sum)
-    edges = quantile_bin_edges(reservoir.sample(), max_bins)
+    edges = quantile_bin_edges(sample, max_bins)
 
     # -- pass B: binned cache (uint8 bins: max_bins <= 256) ----------------
     # Re-binning per replay would cost d searchsorteds per batch per level;
@@ -293,21 +341,50 @@ def _build_forest(
     p_size = mesh.axis_size()
     row_tile = p_size * 8
     axis = DeviceMesh.DATA_AXIS
+    multi = jax.process_count() > 1
     hist_fn, hist_adv_fn, leaf_adv_fn = _stream_fns(
         mesh.mesh, axis, n_feat, max_bins, n_leaves, logistic
     )
 
-    # Host-resident per-row state: margin, node id, subsample mask.
+    # Host-resident per-row state: margin, node id, subsample mask —
+    # rank-local (each rank owns its partition's rows).
     pred = np.full(n, base, np.float32)
     node = np.zeros(n, np.int32)
     mask = np.ones(n, np.float32)
 
-    def shard_padded(arr):
-        """Zero-pad rows to the mesh row tile and shard (padded rows carry
-        w=0 downstream, so they are exact no-ops)."""
-        return mesh.shard_batch(pad_to_multiple(arr, row_tile)[0])
+    plan = None
+    if multi:
+        from flinkml_tpu.iteration.stream_sync import (
+            SyncedReplayPlan,
+            pad_rows_to,
+        )
+
+        plan = SyncedReplayPlan.create(binned_cache, mesh, row_tile)
+        height = plan.local_height
+
+        def shard_padded(arr):
+            """Fixed agreed height + global placement: every rank
+            contributes exactly ``height`` rows per step (zero-weight
+            padding / dummies are exact no-ops downstream)."""
+            return mesh.global_batch(pad_rows_to(arr, height))
+
+    else:
+
+        def shard_padded(arr):
+            """Zero-pad rows to the mesh row tile and shard (padded rows
+            carry w=0 downstream, so they are exact no-ops)."""
+            return mesh.shard_batch(pad_to_multiple(arr, row_tile)[0])
 
     def place(item):
+        if item is None:  # dummy step on a drained rank (multi only)
+            zb = np.zeros((plan.local_height, n_feat), np.uint8)
+            zf = np.zeros(plan.local_height, np.float32)
+            return (
+                0, 0,
+                mesh.global_batch(zb),
+                mesh.global_batch(zf),
+                mesh.global_batch(zf),
+            )
         start, rows, batch = item
         return (
             start, rows,
@@ -317,13 +394,13 @@ def _build_forest(
         )
 
     def feed():
-        return PrefetchingDeviceFeed(
-            (
-                (ranges[i][0], ranges[i][1], b)
-                for i, b in enumerate(binned_cache.reader())
-            ),
-            place=place, depth=prefetch_depth,
+        src = (
+            (ranges[i][0], ranges[i][1], b)
+            for i, b in enumerate(binned_cache.reader())
         )
+        if multi:
+            src = plan.epoch_batches(src, lambda: None)
+        return PrefetchingDeviceFeed(src, place=place, depth=prefetch_depth)
 
     def shard_state(arr, start, rows):
         return shard_padded(arr[start:start + rows])
@@ -334,9 +411,29 @@ def _build_forest(
     leaves_out = np.zeros((num_trees, n_leaves), np.float32)
 
     # -- checkpoint/resume: unit of recovery = one completed tree ----------
-    from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
+    from flinkml_tpu.iteration.checkpoint import (
+        begin_resume,
+        rank_scoped,
+        should_snapshot,
+    )
 
+    if multi and checkpoint_manager is not None:
+        # Per-row state (pred/node) is rank-local, so every rank saves
+        # its own shard under <dir>/rank-<i> (no shared-dir collisions).
+        checkpoint_manager = rank_scoped(checkpoint_manager)
     resume_tree = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
+    if multi and resume:
+        from flinkml_tpu.iteration.stream_sync import agree_max
+
+        # All ranks must resume from the SAME tree. A crash between one
+        # rank's save and the agreed commit can leave ranks one tree
+        # apart, so converge on the MINIMUM common checkpoint (every
+        # rank retains recent epochs); if any rank has none, all ranks
+        # restart from scratch together.
+        lo = -agree_max(
+            -(int(resume_tree) if resume_tree is not None else -1), mesh
+        )
+        resume_tree = None if lo < 0 else lo
     start_tree = 0
     if resume_tree is not None:
         like = (pred, feats_out, bins_out, gains_out, leaves_out)
@@ -353,6 +450,9 @@ def _build_forest(
             for _ in range(start_tree):
                 rng.random(n)
 
+    from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+    guard = DispatchGuard()  # multi-process backpressure (no-op single)
     lam = np.float64(reg_lambda)
     for t in range(start_tree, num_trees):
         if subsample < 1.0:
@@ -377,11 +477,13 @@ def _build_forest(
                         # level (the separate advance pass would re-read
                         # the whole spilled dataset).
                         hg, hh, new_node = hist_adv_fn(*args, *prev_split)
-                        node[start:start + rows] = np.asarray(new_node)[:rows]
+                        node[start:start + rows] = mesh.local_rows(new_node)[:rows]
                     hg_acc = hg if hg_acc is None else hg_acc + hg
                     hh_acc = hh if hh_acc is None else hh_acc + hh
+                    guard.after_dispatch(hh_acc)
             finally:
                 f.close()
+            guard.flush(hh_acc)
             bf, bbin, bgain = _best_level_splits(
                 hg_acc, hh_acc, lam, n_leaves, n_feat, max_bins
             )
@@ -403,11 +505,13 @@ def _build_forest(
                     shard_state(node, start, rows),
                     *prev_split,
                 )
-                node[start:start + rows] = np.asarray(new_node)[:rows]
+                node[start:start + rows] = mesh.local_rows(new_node)[:rows]
                 lg_acc = lg if lg_acc is None else lg_acc + lg
                 lh_acc = lh if lh_acc is None else lh_acc + lh
+                guard.after_dispatch(lh_acc)
         finally:
             f.close()
+        guard.flush(lh_acc)
         lg_np = np.asarray(lg_acc, np.float64)
         lh_np = np.asarray(lh_acc, np.float64)
         leaf = (-lg_np / np.maximum(lh_np + lam, _LAM_FLOOR)).astype(
@@ -419,7 +523,13 @@ def _build_forest(
         pred += learning_rate * leaf[node]
         if should_snapshot(checkpoint_manager, checkpoint_interval,
                            t + 1, num_trees):
-            checkpoint_manager.save(
-                (pred, feats_out, bins_out, gains_out, leaves_out), t + 1
+            from flinkml_tpu.iteration.checkpoint import save_agreed
+
+            # Rank-local state (pred): every rank writes its rank-scoped
+            # shard; the agreement is the commit barrier.
+            save_agreed(
+                checkpoint_manager,
+                (pred, feats_out, bins_out, gains_out, leaves_out),
+                t + 1, mesh, per_rank=True,
             )
     return feats_out, bins_out, gains_out, leaves_out, base, edges

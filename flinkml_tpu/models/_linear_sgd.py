@@ -1001,9 +1001,27 @@ def _train_linear_stream_multiprocess(
 
     # -- pass 0: cache only (step counts must be agreed before training) --
     dv = DeferredValidation()
+    first_dim = [None]
 
     def check_ingest(b):
+        """Everything place-time validation would catch — a place-time
+        raise on the feed thread is rank-local mid-collective (the hang
+        class DeferredValidation prevents), so iterable sources must be
+        FULLY validated here: x shape/dim consistency, label-column
+        presence, zero total weight, plus the estimator's hook."""
         x = np.asarray(b[x_key], dtype=dtype)
+        np.asarray(b[y_key], dtype=dtype)  # missing label column raises
+        if x.ndim != 2:
+            raise ValueError(
+                f"stream batches must be [n, d], got {x.shape}"
+            )
+        if first_dim[0] is None:
+            first_dim[0] = x.shape[1]
+        elif x.shape[1] != first_dim[0]:
+            raise ValueError(
+                f"batch feature dim {x.shape[1]} != first batch's "
+                f"{first_dim[0]}"
+            )
         if validate is not None:
             validate(b)
         w = (

@@ -95,53 +95,148 @@ class PCA(_PCAParams, Estimator):
                             np.asarray(g, np.float64), shift, k)
 
     def _fit_stream(self, source) -> "PCAModel":
-        """Out-of-core single-pass PCA (see class docstring)."""
-        from flinkml_tpu.iteration.datacache import DataCache
-        from flinkml_tpu.parallel.distributed import require_single_controller
+        """Out-of-core single-pass PCA (see class docstring).
 
-        require_single_controller("PCA streamed fit")
+        Multi-process (round 4): each process feeds its own stream
+        partition, iterated in SPMD lockstep WITHOUT caching
+        (``stream_sync.synced_stream`` — one tiny agreement collective
+        per step instead of the cache-first double IO the replay
+        trainers need); the centering shift is agreed from the
+        lowest-indexed non-empty rank, per-step padded heights are
+        agreed, and exhausted ranks dispatch zero-weight dummy steps.
+        """
+        import jax
+
+        from flinkml_tpu.iteration.datacache import DataCache
+
         input_col = self.get(self.INPUT_COL)
         k = self.get(self.K)
         mesh = self.mesh or DeviceMesh()
+        multi = jax.process_count() > 1
         fn = _mean_and_gram_fn(mesh.mesh, DeviceMesh.DATA_AXIS)
 
         column = input_col if isinstance(source, DataCache) else None
         batches = source.reader() if isinstance(source, DataCache) else source
 
-        cnt = 0.0
-        s = g = None
-        shift = None
-        d = None
-        for b in batches:
+        def extract(b):
             if column is not None:
-                x = np.asarray(b[column], np.float32)
-            else:
-                x = features_matrix(b, input_col).astype(np.float32)
+                return np.asarray(b[column], np.float32)
+            return features_matrix(b, input_col).astype(np.float32)
+
+        d = [None]
+
+        def check(b):
+            x = extract(b)
             if x.ndim != 2 or x.shape[0] == 0:
                 raise ValueError(
                     f"stream batches must be non-empty [n, d], got {x.shape}"
                 )
-            if d is None:
-                d = x.shape[1]
-                shift = np.array(x[0])  # first row of the stream
-            elif x.shape[1] != d:
+            if d[0] is None:
+                d[0] = x.shape[1]
+            elif x.shape[1] != d[0]:
                 raise ValueError(
-                    f"batch feature dim {x.shape[1]} != first batch's {d}"
+                    f"batch feature dim {x.shape[1]} != first batch's {d[0]}"
                 )
-            xd, wd = _shard_with_mask(x, mesh)
-            cb, sb, gb = fn(xd, wd, jnp.asarray(shift))
-            cnt += float(cb)
-            s = np.asarray(sb, np.float64) if s is None else (
-                s + np.asarray(sb, np.float64)
+
+        cnt = 0.0
+        s = g = None
+        shift = None
+
+        if not multi:
+            for b in batches:
+                check(b)
+                x = extract(b)
+                if shift is None:
+                    shift = np.array(x[0])  # first row of the stream
+                xd, wd = _shard_with_mask(x, mesh)
+                cb, sb, gb = fn(xd, wd, jnp.asarray(shift))
+                cnt += float(cb)
+                s = np.asarray(sb, np.float64) if s is None else (
+                    s + np.asarray(sb, np.float64)
+                )
+                g = np.asarray(gb, np.float64) if g is None else (
+                    g + np.asarray(gb, np.float64)
+                )
+            if shift is None:
+                raise ValueError("training stream is empty")
+        else:
+            from flinkml_tpu.iteration.stream_sync import (
+                agree_all_ok,
+                agree_max,
+                gather_vectors,
+                synced_stream,
             )
-            g = np.asarray(gb, np.float64) if g is None else (
-                g + np.asarray(gb, np.float64)
-            )
-        if d is None:
-            raise ValueError("training stream is empty")
-        if k > min(int(cnt), d):
+
+            row_tile = mesh.axis_size() * 8
+            it = iter(batches)
+            first = next(it, None)
+            held = None
+            if first is not None:
+                try:
+                    check(first)
+                except Exception as e:  # noqa: BLE001 — agreed below
+                    held = e
+            local_d = 0 if d[0] is None else d[0]
+            dim = agree_max(local_d, mesh)
+            try:
+                agree_all_ok(
+                    held is None and not (local_d and local_d != dim), mesh,
+                    f"feature-dim agreement (local {local_d}, global {dim})",
+                )
+            except ValueError:
+                if held is not None:
+                    raise held
+                raise
+            if dim == 0:
+                raise ValueError("training stream is empty on every process")
+            d[0] = dim  # empty ranks adopt the agreed dim
+            # Agreed centering shift: the first row of the lowest-indexed
+            # non-empty rank (gathered exactly; identical everywhere).
+            cand = np.zeros(1 + dim)
+            if first is not None:
+                cand[0] = 1.0
+                cand[1:] = extract(first)[0].astype(np.float64)
+            rows = gather_vectors(cand, mesh)
+            nonempty = np.nonzero(rows[:, 0] > 0)[0]
+            shift = rows[nonempty[0], 1:].astype(np.float32)
+
+            import itertools
+
+            stream = itertools.chain([first] if first is not None else [], it)
+            # The step's padded height (row_tile-bucketed so the set of
+            # compiled shapes stays small) rides the synced_stream
+            # agreement itself — one collective per step, not two.
+            height_of = lambda b: (
+                -(-max(extract(b).shape[0], 1) // row_tile)
+            ) * row_tile
+            for b, h in synced_stream(
+                stream, mesh, check=check, payload=height_of
+            ):
+                x = (
+                    extract(b) if b is not None
+                    else np.zeros((0, dim), np.float32)
+                )
+                x_pad = np.zeros((h, dim), np.float32)
+                x_pad[: x.shape[0]] = x
+                w = np.zeros(h, np.float32)
+                w[: x.shape[0]] = 1.0
+                cb, sb, gb = fn(
+                    mesh.global_batch(x_pad),
+                    mesh.global_batch(w),
+                    jnp.asarray(shift),
+                )
+                cnt += float(cb)
+                s = np.asarray(sb, np.float64) if s is None else (
+                    s + np.asarray(sb, np.float64)
+                )
+                g = np.asarray(gb, np.float64) if g is None else (
+                    g + np.asarray(gb, np.float64)
+                )
+
+        if k > min(int(cnt), d[0] if d[0] is not None else int(cnt)):
             raise ValueError(
-                f"k={k} must be <= min(n_rows, dim) = {min(int(cnt), d)}"
+                f"k={k} must be <= min(n_rows, dim) = "
+                f"{min(int(cnt), d[0] if d[0] is not None else int(cnt))}"
             )
         return self._finish(cnt, s, g, shift, k)
 

@@ -115,13 +115,12 @@ def require_single_controller(what: str) -> None:
     """Raise a clear error when ``what`` runs under a multi-process mesh.
 
     Most streamed out-of-core fits ARE multi-process-capable (round 4:
-    the linear family, KMeans, GMM, and the streamed-Adam runner behind
-    MLP/FM train from per-process stream partitions via
+    the linear family, KMeans, GMM, GBT, PCA, and the streamed-Adam
+    runner behind MLP/FM train from per-process stream partitions via
     ``iteration/stream_sync.py``). The families still guarded here keep
-    per-row or per-block state host-resident in layouts that are not yet
-    process-partitioned (GBT's per-row gradients/predictions, ALS's
-    factor blocks, LDA's document statistics, Word2Vec's pair cache,
-    PCA's single accumulation pass) — on a multi-process mesh they would
+    id-keyed or per-document host state in layouts that are not yet
+    process-partitioned (ALS's factor blocks, LDA's document
+    statistics, Word2Vec's pair cache) — on a multi-process mesh they would
     die opaquely inside ``device_put`` (non-addressable devices), so the
     defined behavior is this explicit rejection; multi-host training for
     them uses the in-RAM paths with ``mesh.global_batch`` per-host
@@ -135,7 +134,7 @@ def require_single_controller(what: str) -> None:
             "in-RAM fit with per-host `mesh.global_batch` ingest "
             "(docs/development/parallelism.md, examples/multihost_pod.py). "
             "Multi-process streamed fits are available for the linear "
-            "family, KMeans, GaussianMixture, and MLP/FM."
+            "family, KMeans, GaussianMixture, GBT, PCA, and MLP/FM."
         )
 
 

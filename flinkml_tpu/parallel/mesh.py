@@ -116,6 +116,23 @@ class DeviceMesh:
 
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
+    def local_rows(self, arr) -> np.ndarray:
+        """Fetch THIS PROCESS's contiguous row block of a data-sharded
+        output — the inverse of :meth:`global_batch`.
+
+        For per-row state that lives on the rank owning the rows (GBT's
+        node assignments), a full :meth:`to_host` gather would move every
+        other rank's rows across DCN just to throw them away; the local
+        addressable shards ARE this process's block, in row order.
+        Single-process (fully addressable): the whole array.
+        """
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        shards = sorted(
+            arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
     def global_batch(self, local_rows) -> jax.Array:
         """Assemble a globally-sharded batch from THIS PROCESS's rows.
 

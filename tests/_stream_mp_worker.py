@@ -131,11 +131,48 @@ mlp_acc = float(
     (mlp_out.column("prediction") == (x_all[:, 0] + x_all[:, 1] > 0)).mean()
 )
 
+# --- 6. GBT streamed fit: pooled bin edges + gathered base score +
+# rank-local per-row state + globally psum'd histograms. Ranks must
+# agree on the forest structure bit-exactly.
+from flinkml_tpu.models import GBTClassifier  # noqa: E402
+
+gbt_tables = [
+    Table({
+        "features": t.column("features"),
+        "label": (np.asarray(t.column("features"))[:, 0]
+                  + np.asarray(t.column("features"))[:, 1] > 0)
+        .astype(np.float64),
+    })
+    for t in mlp_tables
+]
+gbt = (
+    GBTClassifier(mesh=mesh).set_num_trees(3).set_max_depth(2)
+    .set_max_bins(16).set_learning_rate(0.3).set_seed(0)
+    .fit(iter(gbt_tables))
+)
+(gbt_out,) = gbt.transform(Table({"features": x_all}))
+gbt_acc = float(
+    (gbt_out.column("prediction") == (x_all[:, 0] + x_all[:, 1] > 0)).mean()
+)
+
+# --- 7. PCA streamed fit: cache-less lockstep single pass (agreed shift,
+# per-step height agreement, dummy steps on the drained rank).
+from flinkml_tpu.models.pca import PCA  # noqa: E402
+
+pca = (
+    PCA(mesh=mesh).set_k(3).set_input_col("features")
+    .fit(iter(Table({"features": t.column("features")})
+              for t in mlp_tables))
+)
+
 np.savez(
     os.path.join(workdir, f"result_{pid}.npz"),
     coef=coef, cents=cents, cents_rand=cents_rand,
     cents_empty=cents_empty,
     gmm_means=gm.means, gmm_weights=gm.weights,
     mlp_w0=np.asarray(mlp._weights[0]), mlp_acc=np.float64(mlp_acc),
+    gbt_feats=gbt._feats, gbt_leaves=gbt._leaves,
+    gbt_acc=np.float64(gbt_acc),
+    pca_components=pca.components, pca_variances=pca.explained_variance,
 )
 print(f"STREAM_OK {pid}")

@@ -126,14 +126,16 @@ def test_two_process_streamed_fit(tmp_path):
     ]
     # (a) replicated training state: every rank fitted the same model.
     for key in ("coef", "cents", "cents_rand", "cents_empty", "gmm_means",
-                "gmm_weights", "mlp_w0"):
+                "gmm_weights", "mlp_w0", "gbt_feats", "gbt_leaves",
+                "pca_components", "pca_variances"):
         assert np.array_equal(results[0][key], results[1][key]), key
 
     # GMM: pooled moments + pooled init recover the planted components.
     got = np.sort(results[0]["gmm_means"], axis=0)
     np.testing.assert_allclose(got, C.GMM_MEANS, atol=0.3)
-    # MLP (streamed-Adam runner): learns the separable target.
+    # MLP (streamed-Adam runner) and GBT learn the separable target.
     assert float(results[0]["mlp_acc"]) > 0.9, results[0]["mlp_acc"]
+    assert float(results[0]["gbt_acc"]) > 0.85, results[0]["gbt_acc"]
 
     # (b) single-process equivalence on the concatenated-step stream.
     mesh = DeviceMesh()

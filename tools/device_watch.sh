@@ -1,0 +1,44 @@
+#!/bin/bash
+# Persistent tunnel watcher. Probes every WATCH_INTERVAL seconds (default
+# 900); on the FIRST healthy probe runs the device evidence in PRIORITY
+# order — the staged bench first (the round's headline number), then the
+# sorted-scatter A/B, then the compile-ceiling sweep — and exits. The
+# 2026-07-31 session burned its only healthy window (~1 min) on the A/B
+# probes; the bench-first order is the lesson. Logs everything to
+# tools/device_watch_<UTC>.log. Single device client at all times.
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+LOG="tools/device_watch_${STAMP}.log"
+exec > >(tee "$LOG") 2>&1
+INTERVAL="${WATCH_INTERVAL:-900}"
+DEADLINE="${WATCH_DEADLINE_EPOCH:-0}"   # 0 = watch forever
+
+echo "=== device watch ${STAMP} (interval ${INTERVAL}s) ==="
+while :; do
+    if [ "$DEADLINE" != 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
+        echo "$(date -u +%FT%TZ) deadline reached; tunnel never healed"
+        exit 1
+    fi
+    if timeout 90 python tools/device_probe.py; then
+        echo "$(date -u +%FT%TZ) HEALTHY — capturing evidence (bench first)"
+        break
+    fi
+    echo "$(date -u +%FT%TZ) probe failed; sleeping ${INTERVAL}s"
+    sleep "$INTERVAL"
+done
+
+echo "--- 1. full staged bench ---"
+timeout $(( ${FLINKML_BENCH_TIMEOUT:-2100} + 600 )) python bench.py \
+    || echo "bench FAILED rc=$?"
+
+echo "--- 2. sorted-scatter A/B (900 s cap) ---"
+timeout 900 python tools/sorted_scatter_probe.py \
+    || echo "sorted_scatter_probe FAILED rc=$?"
+
+echo "--- 3. compile-ceiling sweep, device half (1800 s cap) ---"
+timeout 1800 python tools/compile_ceiling_probe.py \
+    || echo "compile_ceiling_probe FAILED rc=$?"
+
+echo "=== done; transcribe results into BASELINE.md (log: $LOG) ==="
