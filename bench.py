@@ -430,7 +430,12 @@ _INNER_STAGES = {
 
 
 def _run_stage(stage: str, timeout_s: float, deadline: float, retries: int = 1):
-    """Run one inner stage in a child process; returns float or None.
+    """Run one inner stage in a child process; returns ``(value, timed_out)``.
+
+    ``value`` is the stage's float result or None; ``timed_out`` is True
+    iff the LAST attempt hit its timeout (a hung tunnel), as opposed to a
+    fast stage-specific failure — callers use it to decide whether a
+    wedge-check probe is warranted.
 
     A child is the unit of failure isolation: a hung device tunnel takes
     the child (killed at timeout), never the bench. Retries are cheap
@@ -439,11 +444,12 @@ def _run_stage(stage: str, timeout_s: float, deadline: float, retries: int = 1):
     deadline — a hung stage must not consume 2x its cap), and no attempt
     starts past ``deadline`` (the FLINKML_BENCH_TIMEOUT total budget)."""
     stage_deadline = time.monotonic() + timeout_s
+    timed_out = False
     for attempt in range(retries + 1):
         timeout_s = min(stage_deadline, deadline) - time.monotonic()
         if timeout_s <= 5:
             _log(f"stage={stage} skipped: stage/total budget exhausted")
-            return None
+            return None, timed_out
         _log(f"stage={stage} attempt={attempt + 1} timeout={timeout_s:.0f}s")
         t0 = time.perf_counter()
         try:
@@ -458,18 +464,20 @@ def _run_stage(stage: str, timeout_s: float, deadline: float, retries: int = 1):
         except subprocess.TimeoutExpired:
             _log(f"stage={stage} timed out after {timeout_s:.0f}s "
                  "(device tunnel hung?)")
+            timed_out = True
             continue
+        timed_out = False
         dt = time.perf_counter() - t0
         if proc.returncode == 0:
             try:
                 value = float(proc.stdout.strip().splitlines()[-1])
                 _log(f"stage={stage} ok in {dt:.1f}s -> {value:.1f}")
-                return value
+                return value, False
             except (ValueError, IndexError):
                 _log(f"stage={stage} unparseable output: {proc.stdout!r}")
         else:
             _log(f"stage={stage} failed rc={proc.returncode}")
-    return None
+    return None, timed_out
 
 
 def _hunt_device(deadline: float, attempt_timeout: float,
@@ -491,7 +499,7 @@ def _hunt_device(deadline: float, attempt_timeout: float,
         stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         _log(f"probe attempt={len(attempts) + 1} at={stamp} "
              f"timeout={t:.0f}s budget_left={remaining:.0f}s")
-        value = _run_stage("probe", t, deadline, retries=0)
+        value, _ = _run_stage("probe", t, deadline, retries=0)
         attempts.append(stamp)
         if value is not None:
             return value
@@ -528,33 +536,54 @@ def main():
     stage_cap = float(os.environ.get("FLINKML_BENCH_STAGE_TIMEOUT", "600"))
     deadline = time.monotonic() + total_budget
 
-    device_sps = None
-    sparse_sps = None
-    bf16_sps = None
-    kmeans_pps = None
-    kmeans_stream_pps = None
-    gbt_rts = None
-    als_ups = None
-    w2v_wps = None
+    # Stage order is cheap-compile-first: the tunnel's observed failure
+    # mode (BASELINE.md round-4 session-2 log) is wedging UNDER a heavy
+    # compile, and the dim=1e6 sparse stage is the heaviest compile in
+    # the bench — it runs LAST so a wedge it triggers cannot starve the
+    # stages behind it. After any stage TIMEOUT (fast stage-specific
+    # failures don't qualify), a quick probe decides whether the tunnel
+    # is wedged (skip the rest immediately instead of burning stage_cap
+    # on each) or the hang was stage-specific.
+    stage_order = ["dense", "dense_bf16", "kmeans", "kmeans_stream",
+                   "gbt", "als", "word2vec", "sparse"]
+    results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
     # concurrent clients wedged the tunnel for 8+ hours in round 2
     # (BASELINE.md). Children inherit the held marker via os.environ.
     try:
         with device_client_lock(timeout_s=120.0):
             if _hunt_device(deadline, probe_timeout, probe_spacing) is not None:
-                device_sps = _run_stage("dense", stage_cap, deadline)
-                sparse_sps = _run_stage("sparse", stage_cap, deadline)
-                bf16_sps = _run_stage("dense_bf16", stage_cap, deadline)
-                kmeans_pps = _run_stage("kmeans", stage_cap, deadline)
-                kmeans_stream_pps = _run_stage("kmeans_stream", stage_cap,
-                                               deadline)
-                gbt_rts = _run_stage("gbt", stage_cap, deadline)
-                als_ups = _run_stage("als", stage_cap, deadline)
-                w2v_wps = _run_stage("word2vec", stage_cap, deadline)
+                for i, name in enumerate(stage_order):
+                    results[name], stage_timed_out = _run_stage(
+                        name, stage_cap, deadline)
+                    if stage_timed_out and i + 1 < len(stage_order):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 40:
+                            _log("total budget exhausted; skipping remaining "
+                                 f"stages: {', '.join(stage_order[i + 1:])}")
+                            break
+                        _log(f"stage={name} timed out; quick probe to check "
+                             "whether the tunnel wedged mid-bench")
+                        probe_val, _ = _run_stage(
+                            "probe", min(90.0, remaining - 10),
+                            deadline, retries=0)
+                        if probe_val is None:
+                            skipped = stage_order[i + 1:]
+                            _log("tunnel wedged mid-bench; skipping "
+                                 f"remaining stages: {', '.join(skipped)}")
+                            break
             else:
                 _log("probe failed; skipping device measurement")
     except TimeoutError as e:
         _log(f"device busy: {e}; skipping device measurement")
+    device_sps = results.get("dense")
+    sparse_sps = results.get("sparse")
+    bf16_sps = results.get("dense_bf16")
+    kmeans_pps = results.get("kmeans")
+    kmeans_stream_pps = results.get("kmeans_stream")
+    gbt_rts = results.get("gbt")
+    als_ups = results.get("als")
+    w2v_wps = results.get("word2vec")
 
     _log("measuring CPU reference-style baseline ...")
     n_cpu = 200_000
