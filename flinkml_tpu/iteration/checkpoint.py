@@ -97,12 +97,17 @@ def rank_scoped(manager: "CheckpointManager") -> "CheckpointManager":
     on the shared filesystem and each rank restores exactly its rows.
     Single-process: returns the manager unchanged. Commit ordering across
     ranks is the caller's job (agree the save outcome — see the GBT
-    snapshot path)."""
+    snapshot path).
+
+    ``max_to_keep`` is floored at 2: a crash between one rank's save of
+    epoch e+1 (which, at keep=1, prunes its epoch e) and the agreed
+    commit on the others would otherwise leave the ranks with DISJOINT
+    epoch sets — no common epoch to resume from, all progress lost."""
     if jax.process_count() == 1:
         return manager
     return CheckpointManager(
         os.path.join(manager.directory, f"rank-{jax.process_index()}"),
-        max_to_keep=manager.max_to_keep,
+        max_to_keep=max(manager.max_to_keep, 2),
         allow_rescale=manager.allow_rescale,
         world_size=manager.world_size,
         async_write=manager.async_write,

@@ -126,24 +126,37 @@ class DeferredValidation:
     Ingest validation (batch shapes, zero weights, label domains) fails
     on ONE rank's data — raising there immediately would strand the
     peers in their next collective (see :func:`agree_all_ok`). Instead
-    the caching loop records the first failure and keeps sealing the
-    cache (metadata-only planning tolerates a partial cache); after the
-    plan's collectives, :meth:`rendezvous` agrees the outcome across all
-    ranks — re-raising the ORIGINAL error on the failing rank and the
-    generic agreement error elsewhere.
+    the ingest loop holds the FIRST failure, skips the remaining items
+    (a partial cache is fine — it is never consumed), and
+    :meth:`rendezvous` agrees the outcome across all ranks BEFORE any
+    planning collective — re-raising the ORIGINAL error on the failing
+    rank and the generic agreement error elsewhere. Rendezvous-first
+    matters: skip-on-failure can leave every local cache empty, and a
+    plan built first would mask the real error as "stream is empty on
+    every process".
     """
 
     def __init__(self):
         self.err: Optional[Exception] = None
 
-    def run(self, fn, *args) -> None:
-        """Run a validation step; hold its first failure for the
-        rendezvous instead of raising."""
-        if self.err is None:
-            try:
-                fn(*args)
-            except Exception as e:  # noqa: BLE001 — held, re-raised later
-                self.err = e
+    def call(self, fn, *args):
+        """Run an ingest step that RETURNS values (extraction +
+        validation fused); returns None once a failure is held.
+
+        The caller must SKIP its accumulation (reservoir adds, moment
+        sums, cache appends) on a None return: accumulating a batch that
+        failed validation — or any batch after one — can itself raise
+        rank-locally (e.g. adding a ragged batch to a fixed-width
+        reservoir), which is exactly the hang class this class exists to
+        prevent. A partial cache/accumulation is fine: the rendezvous
+        aborts every rank before the result is consumed."""
+        if self.err is not None:
+            return None
+        try:
+            return fn(*args)
+        except Exception as e:  # noqa: BLE001 — held, re-raised later
+            self.err = e
+            return None
 
     def rendezvous(self, mesh: Optional[DeviceMesh], what: str) -> None:
         try:
@@ -152,6 +165,53 @@ class DeferredValidation:
             if self.err is not None:
                 raise self.err
             raise
+
+
+def guarded_iter(batches, dv: DeferredValidation):
+    """Iterate a source whose ``next()`` itself can raise rank-locally
+    (an IOError reading this rank's shard, a raising generator) — fold
+    the failure into ``dv`` and END the stream instead of propagating,
+    so the caller still reaches the post-loop rendezvous in lockstep
+    with its peers. Also stops early once ``dv`` holds any error: there
+    is no point pulling more local data for a fit that is agreed to
+    abort. Pair with :meth:`DeferredValidation.call` for the loop body;
+    multi-process ingest loops should use both (or just
+    :func:`checked_ingest`, which composes them)."""
+    it = iter(batches)
+    while dv.err is None:
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        except Exception as e:  # noqa: BLE001 — held for the rendezvous
+            dv.err = e
+            return
+        yield item
+
+
+def checked_ingest(source, dv: DeferredValidation, fn, multi: bool):
+    """THE multi-process-safe ingest loop, shared by every streamed
+    trainer's pass 0: run ``fn`` (extraction + validation + any cache
+    append / accumulation that depends on the validated invariants) over
+    ``source``, yielding its non-None results.
+
+    Multi-process, both the source iterator's own raises
+    (:func:`guarded_iter`) and ``fn``'s raises
+    (:meth:`DeferredValidation.call`) are held for the caller's
+    ``dv.rendezvous`` — and once an error is held the remaining items
+    are skipped, so accumulation after a failed invariant can never
+    raise rank-locally. Single-process, failures propagate immediately
+    at the offending item."""
+    if not multi:
+        for item in source:
+            out = fn(item)
+            if out is not None:
+                yield out
+        return
+    for item in guarded_iter(source, dv):
+        out = dv.call(fn, item)
+        if out is not None:
+            yield out
 
 
 def agree_feature_dim(
@@ -374,9 +434,19 @@ def synced_stream(
     it = iter(batches)
     held_err: Optional[Exception] = None
     while True:
-        item = next(it, None)
+        # The source iterator itself can raise (e.g. an IOError reading
+        # this rank's shard) — that failure is as rank-local as a failed
+        # check() and must ride the same agreement, not propagate out of
+        # the generator while the peers enter their next collective.
+        try:
+            item = next(it, None)
+        except Exception as e:  # noqa: BLE001 — agreed below
+            held_err = e
+            item = None
         pay = 0
-        if item is None:
+        if held_err is not None:
+            code = _ERROR
+        elif item is None:
             code = _EXHAUSTED
         else:
             code = _HAVE

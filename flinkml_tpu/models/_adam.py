@@ -235,7 +235,7 @@ def run_streamed_adam(
 
     first_dim = [None]
 
-    def checked_ingest(t):
+    def validate_ingest(t):
         """Full ingest-time validation (zero rows, ragged dims, zero
         total weight) — everything place-time validation would catch,
         because on a multi-process mesh a place-time raise is a
@@ -265,17 +265,21 @@ def run_streamed_adam(
         cache = source
     else:
         writer = DataCacheWriter(cache_dir, cache_memory_budget_bytes)
-        for t in source:
-            if multi:
-                # Held for the post-plan rendezvous: a rank-local raise
-                # here would strand the peers in the plan's collectives.
-                if dv.err is None:
-                    try:
-                        writer.append(checked_ingest(t))
-                    except Exception as e:  # noqa: BLE001
-                        dv.err = e
-            else:
-                writer.append(checked_ingest(t))
+
+        def ingest_and_append(t):
+            # The append is part of the checked step too: a rank-local
+            # writer failure (e.g. disk full while spilling a segment)
+            # must ride the rendezvous like any ingest failure.
+            writer.append(validate_ingest(t))
+
+        from flinkml_tpu.iteration.stream_sync import checked_ingest
+
+        # Multi-process, iterator and ingest failures are held for the
+        # post-plan rendezvous (see stream_sync.checked_ingest); a
+        # partial cache is fine — the rendezvous aborts every rank
+        # before it is consumed.
+        for _ in checked_ingest(source, dv, ingest_and_append, multi):
+            pass
         cache = writer.finish()
     if not multi and cache.num_rows == 0:
         raise ValueError("training stream is empty")
@@ -298,8 +302,12 @@ def run_streamed_adam(
             gather_vectors,
         )
 
-        plan = SyncedReplayPlan.create(cache, mesh, p * 8)
+        # Rendezvous BEFORE planning: a held ingest error must
+        # surface as itself, not as plan.create's "stream is empty
+        # on every process" (skip-on-failure can leave every local
+        # cache empty).
         dv.rendezvous(mesh, "stream ingest validation")
+        plan = SyncedReplayPlan.create(cache, mesh, p * 8)
         d = agree_feature_dim(cache, "x", mesh, local_dim=d)
         # Global per-chunk row counts → agreed Adam step schedule.
         local_rows = np.zeros(plan.global_steps)

@@ -216,7 +216,9 @@ def train_gbt_stream(
             # read a whole extra time just for validation.
             label_check(y)
 
-    for batch in cache.reader():
+    def ingest(batch):
+        # Extraction is part of the checked step: a missing column or a
+        # ragged value raises HERE, not in the accumulation below.
         x = np.asarray(batch[x_key], np.float32)
         y = np.asarray(batch[y_key], np.float32)
         w = (
@@ -224,12 +226,17 @@ def train_gbt_stream(
             if w_key is not None and w_key in batch
             else np.ones(x.shape[0], np.float32)
         )
-        if multi:
-            # Held for the post-pass rendezvous: a rank-local raise would
-            # strand the peers in the first agreement collective.
-            dv.run(check_batch, x, y)
-        else:
-            check_batch(x, y)
+        check_batch(x, y)
+        return x, y, w
+
+    from flinkml_tpu.iteration.stream_sync import checked_ingest
+
+    # Multi-process, iterator and ingest failures are held for the
+    # rendezvous below (a rank-local raise would strand the peers in the
+    # first agreement collective), and held failures skip the
+    # accumulation — adding a ragged batch to the fixed-width reservoir
+    # would itself raise rank-locally.
+    for x, y, w in checked_ingest(cache.reader(), dv, ingest, multi):
         reservoir.add(x)
         wy_sum += float(np.sum(w * y))
         w_sum += float(np.sum(w))
@@ -425,19 +432,44 @@ def _build_forest(
     if multi and resume:
         from flinkml_tpu.iteration.stream_sync import agree_max
 
-        # All ranks must resume from the SAME tree. A crash between one
-        # rank's save and the agreed commit can leave ranks one tree
-        # apart, so converge on the MINIMUM common checkpoint (every
-        # rank retains recent epochs); if any rank has none, all ranks
-        # restart from scratch together.
-        lo = -agree_max(
-            -(int(resume_tree) if resume_tree is not None else -1), mesh
-        )
-        resume_tree = None if lo < 0 else lo
+        # All ranks must resume from the SAME tree, and it must be one
+        # EVERY rank still holds on disk: a crash between one rank's save
+        # of tree t+1 (whose pruning may drop its tree t) and the agreed
+        # commit on the others can leave ranks one tree apart, so "min of
+        # latest" alone could pick an epoch the ahead rank already
+        # pruned. Walk down instead — agree the min over ranks of each
+        # rank's newest epoch <= cand until every rank holds cand (the
+        # newest COMMON epoch); if the intersection is empty, all ranks
+        # restart from scratch together. Every rank executes the same
+        # agreed iterates, so the collective count stays aligned.
+        local = set(checkpoint_manager.all_epochs())
+
+        def newest_at_most(c):
+            return max((e for e in local if e <= c), default=-1)
+
+        cand = -agree_max(-newest_at_most(1 << 30), mesh)
+        while cand >= 0:
+            nxt = -agree_max(-newest_at_most(cand), mesh)
+            if nxt == cand:
+                break
+            cand = nxt
+        resume_tree = None if cand < 0 else cand
     start_tree = 0
     if resume_tree is not None:
+        from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
         like = (pred, feats_out, bins_out, gains_out, leaves_out)
-        state, start_tree = checkpoint_manager.restore(resume_tree, like)
+        # The per-rank restore can still fail rank-locally (corrupt or
+        # missing shard) — hold the failure and agree the outcome so one
+        # rank's failure aborts every rank instead of stranding the
+        # peers in the training collectives. Single-process the
+        # rendezvous re-raises immediately.
+        dv_restore = DeferredValidation()
+        got = dv_restore.call(checkpoint_manager.restore, resume_tree, like)
+        dv_restore.rendezvous(
+            mesh, f"checkpoint restore (tree {resume_tree})"
+        )
+        state, start_tree = got
         # np.array: these are mutated in place below; the restore must
         # own its buffers.
         pred, feats_out, bins_out, gains_out, leaves_out = (

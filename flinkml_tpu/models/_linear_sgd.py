@@ -42,10 +42,18 @@ _LOSS_KEYS = ("logistic", "hinge", "squared")
 
 
 def _sorted_scatter_enabled() -> bool:
-    """A/B gate for the sorted-scatter sparse layout (default ON).
-    ``FLINKML_TPU_SORTED_SCATTER=0`` restores the per-step-sort layout —
-    kept so the win stays measurable on any backend/TPU generation."""
-    return os.environ.get("FLINKML_TPU_SORTED_SCATTER", "1") != "0"
+    """A/B gate for the sorted-scatter sparse layout (default OFF).
+
+    Round-4 device measurement (BASELINE.md "sorted-scatter A/B",
+    TPU v5 lite, Criteo shapes): the per-step-sort layout runs
+    69.1 ms/step vs 90.9 ms/step sorted (+flag) — XLA's segment_sum
+    does NOT pay a dominant per-step sort on this generation, and the
+    sorted layout's extra gathers make it 0.76x. The default follows
+    the measurement; ``FLINKML_TPU_SORTED_SCATTER=1`` enables the
+    sorted layout so the comparison stays repeatable on other
+    backends/generations (numerics pinned identical either way,
+    ``tests/test_sparse_scale.py``)."""
+    return os.environ.get("FLINKML_TPU_SORTED_SCATTER", "0") == "1"
 
 
 def _soft_threshold(x, t):
@@ -983,6 +991,7 @@ def _train_linear_stream_multiprocess(
         DeferredValidation,
         SyncedReplayPlan,
         agree_feature_dim,
+        checked_ingest,
     )
     from flinkml_tpu.parallel.dispatch import DispatchGuard
 
@@ -1038,14 +1047,29 @@ def _train_linear_stream_multiprocess(
     if is_cache:
         cache = batches
     else:
+
         writer = DataCacheWriter(cache_dir, memory_budget_bytes)
-        for b in batches:
-            dv.run(check_ingest, b)
+
+        def checked_append(b):
+            # Validation, the column copies, AND the append are one
+            # checked step: a ragged value's np.array ValueError or a
+            # rank-local writer failure (disk full while spilling) is
+            # held for the rendezvous, never raised rank-locally.
+            check_ingest(b)
             writer.append({k: np.array(v) for k, v in b.items()})
+
+        # This trainer IS the multi-process path (dispatched on
+        # process_count > 1), so iterator and ingest failures always
+        # ride the rendezvous.
+        for _ in checked_ingest(batches, dv, checked_append, multi=True):
+            pass
         cache = writer.finish()
 
-    plan = SyncedReplayPlan.create(cache, mesh, row_tile)
+    # Rendezvous BEFORE planning: a held ingest error must surface as
+    # itself, not as plan.create's "stream is empty on every process"
+    # (skip-on-failure can leave every local cache empty).
     dv.rendezvous(mesh, "stream ingest validation")
+    plan = SyncedReplayPlan.create(cache, mesh, row_tile)
     height = plan.local_height
     dim = agree_feature_dim(cache, x_key, mesh)
 

@@ -268,19 +268,43 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
         from flinkml_tpu.iteration.stream_sync import DeferredValidation
 
         dv = DeferredValidation()
-        take_in = ingest if not multi else (lambda x: dv.run(ingest, x))
+
+        def extract_cached(batch):
+            # Extraction is part of the checked step: a missing column or
+            # ragged value must ride the rendezvous, not raise rank-local.
+            x = np.asarray(batch[column], np.float32)
+            ingest(x)
+            return x
+
+        def extract_table(t):
+            x = features_matrix(t, features_col).astype(np.float32)
+            ingest(x)
+            return x
+
+        from flinkml_tpu.iteration.stream_sync import checked_ingest
+
+        # Multi-process, iterator and ingest failures are held for the
+        # post-plan rendezvous (see stream_sync.checked_ingest).
         if isinstance(source, DataCache):
             cache = source
-            for batch in cache.reader():
-                take_in(np.asarray(batch[column], np.float32))
+            for _ in checked_ingest(cache.reader(), dv, extract_cached,
+                                    multi):
+                pass
         else:
             writer = DataCacheWriter(
                 self.cache_dir, self.cache_memory_budget_bytes
             )
-            for t in source:
-                x = features_matrix(t, features_col).astype(np.float32)
-                take_in(x)
+
+            def extract_append(t):
+                # The append is part of the checked step too: a rank-local
+                # writer failure (e.g. disk full while spilling) must ride
+                # the rendezvous like any ingest failure. A partial cache
+                # is fine — the rendezvous aborts every rank first.
+                x = extract_table(t)
                 writer.append({column: np.array(x)})
+
+            for _ in checked_ingest(source, dv, extract_append, multi):
+                pass
             cache = writer.finish()
         plan = None
         if multi:
@@ -291,8 +315,12 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
                 pooled_sample,
             )
 
-            plan = SyncedReplayPlan.create(cache, mesh, row_tile)
+            # Rendezvous BEFORE planning: a held ingest error must
+            # surface as itself, not as plan.create's "stream is empty
+            # on every process" (skip-on-failure can leave every local
+            # cache empty).
             dv.rendezvous(mesh, "stream ingest validation")
+            plan = SyncedReplayPlan.create(cache, mesh, row_tile)
             d = agree_feature_dim(
                 cache, column, mesh, local_dim=0 if d is None else d
             )

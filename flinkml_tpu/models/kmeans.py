@@ -425,22 +425,37 @@ def train_kmeans_stream(
     from flinkml_tpu.iteration.stream_sync import DeferredValidation
 
     dv = DeferredValidation()
+
+    def ingest(b):
+        # Extraction is part of the checked step (a missing column or
+        # ragged value raises HERE, not in the reservoir add below).
+        x = np.asarray(b[column], np.float32)
+        check_dims(x)
+        return x
+
+    from flinkml_tpu.iteration.stream_sync import checked_ingest
+
     if isinstance(batches, DataCache):
         cache = batches
         if need_init:
-            for batch in cache.reader():
-                reservoir.add(np.asarray(batch[column], np.float32))
+            # Multi-process, iterator and ingest failures are held for
+            # the rendezvous below (a rank-local raise would strand the
+            # peers in plan.create's collective; adding a ragged batch
+            # to the fixed-width reservoir would be such a raise).
+            for x in checked_ingest(cache.reader(), dv, ingest, multi):
+                reservoir.add(x)
     else:
         writer = DataCacheWriter(cache_dir, memory_budget_bytes)
-        for b in batches:
-            x = np.asarray(b[column], np.float32)
-            if multi:
-                # Held for the post-plan rendezvous: a rank-local raise
-                # here would strand the peers in plan.create's collective.
-                dv.run(check_dims, x)
-            else:
-                check_dims(x)
+
+        def ingest_append(b):
+            # The append is part of the checked step too: a rank-local
+            # writer failure (e.g. disk full while spilling a segment)
+            # must ride the rendezvous like any ingest failure.
+            x = ingest(b)
             writer.append({column: np.array(x)})
+            return x
+
+        for x in checked_ingest(batches, dv, ingest_append, multi):
             if need_init:
                 reservoir.add(x)
         cache = writer.finish()
@@ -454,8 +469,12 @@ def train_kmeans_stream(
             pooled_sample,
         )
 
-        plan = SyncedReplayPlan.create(cache, mesh, row_tile)
+        # Rendezvous BEFORE planning: a held ingest error must
+        # surface as itself, not as plan.create's "stream is empty
+        # on every process" (skip-on-failure can leave every local
+        # cache empty).
         dv.rendezvous(mesh, "stream ingest validation")
+        plan = SyncedReplayPlan.create(cache, mesh, row_tile)
         dim = agree_feature_dim(cache, column, mesh, local_dim=dim)
         # f64 transport: global row counts can exceed int32.
         total_rows = int(

@@ -125,8 +125,10 @@ class PCA(_PCAParams, Estimator):
 
         d = [None]
 
-        def check(b):
-            x = extract(b)
+        def check_x(x):
+            # Validates an already-extracted matrix — extraction happens
+            # exactly once per batch (the stream below is pre-mapped), not
+            # once in the check and again in the loop body.
             if x.ndim != 2 or x.shape[0] == 0:
                 raise ValueError(
                     f"stream batches must be non-empty [n, d], got {x.shape}"
@@ -144,8 +146,8 @@ class PCA(_PCAParams, Estimator):
 
         if not multi:
             for b in batches:
-                check(b)
                 x = extract(b)
+                check_x(x)
                 if shift is None:
                     shift = np.array(x[0])  # first row of the stream
                 xd, wd = _shard_with_mask(x, mesh)
@@ -168,12 +170,22 @@ class PCA(_PCAParams, Estimator):
             )
 
             row_tile = mesh.axis_size() * 8
-            it = iter(batches)
-            first = next(it, None)
+            # Pre-map to extracted matrices: one extract per batch, and
+            # extract/iterator failures inside synced_stream ride its
+            # per-step agreement instead of raising rank-locally.
+            it = iter(extract(b) for b in batches)
+            first = None
             held = None
-            if first is not None:
+            try:
+                # The source iterator (and extract) can raise rank-locally
+                # (e.g. IOError on this rank's shard) — hold the failure
+                # for the agreement below rather than stranding peers.
+                first = next(it, None)
+            except Exception as e:  # noqa: BLE001 — agreed below
+                held = e
+            if first is not None and held is None:
                 try:
-                    check(first)
+                    check_x(first)
                 except Exception as e:  # noqa: BLE001 — agreed below
                     held = e
             local_d = 0 if d[0] is None else d[0]
@@ -195,7 +207,7 @@ class PCA(_PCAParams, Estimator):
             cand = np.zeros(1 + dim)
             if first is not None:
                 cand[0] = 1.0
-                cand[1:] = extract(first)[0].astype(np.float64)
+                cand[1:] = first[0].astype(np.float64)
             rows = gather_vectors(cand, mesh)
             nonempty = np.nonzero(rows[:, 0] > 0)[0]
             shift = rows[nonempty[0], 1:].astype(np.float32)
@@ -206,16 +218,14 @@ class PCA(_PCAParams, Estimator):
             # The step's padded height (row_tile-bucketed so the set of
             # compiled shapes stays small) rides the synced_stream
             # agreement itself — one collective per step, not two.
-            height_of = lambda b: (
-                -(-max(extract(b).shape[0], 1) // row_tile)
+            height_of = lambda x: (
+                -(-max(x.shape[0], 1) // row_tile)
             ) * row_tile
-            for b, h in synced_stream(
-                stream, mesh, check=check, payload=height_of
+            for x, h in synced_stream(
+                stream, mesh, check=check_x, payload=height_of
             ):
-                x = (
-                    extract(b) if b is not None
-                    else np.zeros((0, dim), np.float32)
-                )
+                if x is None:
+                    x = np.zeros((0, dim), np.float32)
                 x_pad = np.zeros((h, dim), np.float32)
                 x_pad[: x.shape[0]] = x
                 w = np.zeros(h, np.float32)

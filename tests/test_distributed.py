@@ -155,6 +155,22 @@ def test_two_process_streamed_fit(tmp_path):
     )
 
 
+def test_two_process_rank_local_failures_abort_all_ranks(tmp_path):
+    """Regression for the rank-local-failure hang class: a failure on ONE
+    rank (raising source iterator, ragged batch in streamed ingest, a
+    missing/corrupt rank-scoped checkpoint shard) must abort EVERY rank
+    together through the agreement layer — never strand the healthy rank
+    in its next collective. Also pins the straddled-checkpoint resume
+    protocol (newest COMMON tree, or an agreed restart when the rank
+    checkpoint sets are disjoint). See tests/_hang_guard_worker.py for
+    the cases; a hang fails this test's subprocess timeout."""
+    _launch_multiprocess_workers(
+        tmp_path, local_devices=1,
+        worker_script="_hang_guard_worker.py",
+        ok_token="GUARD_OK", check_artifacts=False,
+    )
+
+
 def _launch_multiprocess_workers(
     tmp_path, local_devices, worker_script="_dist_worker.py",
     ok_token="WORKER_OK", check_artifacts=True, n_procs=2,

@@ -215,3 +215,25 @@ def test_mlp_in_ram_rejects_checkpoint_knobs(mesh):
         _mlp(mesh, checkpoint_manager=CheckpointManager("/tmp/x")).fit(
             Table(b)
         )
+
+
+def test_pca_stream_extracts_each_batch_once(mesh, monkeypatch):
+    """The streamed pass materializes each batch's feature matrix exactly
+    once (extraction is fused with validation) — re-extracting in the
+    check, payload, and loop body would triple the host cost of a pure
+    accumulation pass."""
+    import flinkml_tpu.models.pca as pca_mod
+
+    real = pca_mod.features_matrix
+    calls = []
+
+    def counting(table, col):
+        calls.append(1)
+        return real(table, col)
+
+    monkeypatch.setattr(pca_mod, "features_matrix", counting)
+    batches = _pca_batches()
+    pca_mod.PCA(mesh=mesh).set_k(2).fit(
+        iter(Table({"input": b}) for b in batches)
+    )
+    assert len(calls) == len(batches)

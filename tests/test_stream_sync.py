@@ -71,3 +71,44 @@ def test_plan_empty_cache_raises(mesh):
     cache = cache_stream(iter([]))
     with pytest.raises(ValueError, match="empty on every process"):
         SyncedReplayPlan.create(cache, mesh, row_tile=8)
+
+
+def test_deferred_validation_call_skips_after_held_error():
+    """`call` fuses extraction + validation and returns None once a
+    failure is held, so callers skip accumulation that could itself
+    raise rank-locally (e.g. a fixed-width reservoir add of a ragged
+    batch) — the hang class the agreement layer exists to prevent."""
+    from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+    dv = DeferredValidation()
+    assert dv.call(lambda v: v * 2, 21) == 42
+    assert dv.err is None
+
+    boom = ValueError("bad batch")
+
+    def failing(_):
+        raise boom
+
+    assert dv.call(failing, 1) is None
+    assert dv.err is boom
+    # Held: later (healthy) steps are skipped entirely, first error wins.
+    calls = []
+    assert dv.call(lambda v: calls.append(v) or v, 2) is None
+    assert calls == []
+    assert dv.err is boom
+
+
+def test_synced_stream_single_process_propagates_iterator_error(mesh):
+    """Single-process there is no peer to strand: a raising source
+    iterator propagates as-is (the multi-process fold-into-agreement
+    behavior is pinned by the 2-process hang-guard IT)."""
+    from flinkml_tpu.iteration.stream_sync import synced_stream
+
+    def source():
+        yield np.ones((2, 2), np.float32)
+        raise IOError("injected")
+
+    it = synced_stream(source(), mesh)
+    assert next(it).shape == (2, 2)
+    with pytest.raises(IOError, match="injected"):
+        next(it)
