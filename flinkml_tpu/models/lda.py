@@ -224,7 +224,17 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
         corpus; each outer pass replays it, accumulating sstats / ll /
         token partials per batch. Per-batch E-step gamma inits draw from
         ``fold_in(fold_in(key, it), batch_index)`` so the trajectory is
-        deterministic (and independent of the RAM/spill split)."""
+        deterministic (and independent of the RAM/spill split).
+
+        Multi-process (round 4): each process feeds its own corpus
+        partition; the agreed SPMD replay schedule (fixed height +
+        zero-weight dummy steps — exact no-ops in the masked sstats/ll/
+        token sums), vocab agreement, held-failure rendezvous, bounded
+        dispatch, and rank-0-write replicated checkpoints follow the
+        GMM streamed pattern (`iteration/stream_sync.py`). The fitted
+        topics are identical on every rank; exact equality with a
+        single-process run requires the same global device count (the
+        per-device gamma init draws device-count-shaped blocks)."""
         from flinkml_tpu.iteration.checkpoint import (
             begin_resume,
             should_snapshot,
@@ -234,10 +244,12 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
             DataCacheWriter,
             PrefetchingDeviceFeed,
         )
+        from flinkml_tpu.iteration.stream_sync import (
+            DeferredValidation,
+            checked_ingest,
+        )
 
-        from flinkml_tpu.parallel.distributed import require_single_controller
-
-        require_single_controller("LDA streamed fit")
+        multi = jax.process_count() > 1
         if self.resume and not isinstance(source, DataCache):
             raise ValueError(
                 "resume=True requires a durable DataCache input: a one-shot "
@@ -278,23 +290,58 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
                 )
             return c
 
+        dv = DeferredValidation()
+        plan = None
         if isinstance(source, DataCache):
             cache = source
-            if cache.num_rows == 0:
+            if not multi and cache.num_rows == 0:
                 raise ValueError("training stream is empty")
-            reader = cache.reader()
-            to_counts(next(iter(reader)))  # vocab from the first batch
-            if hasattr(reader, "close"):
-                reader.close()
+            if multi:
+                # Validate EVERY cached batch before the rendezvous (the
+                # GMM pattern): a bad batch first seen at replay time
+                # would raise rank-locally on the feed thread while the
+                # peers sit in the psum collective.
+                for _ in checked_ingest(cache.reader(), dv, to_counts,
+                                        multi):
+                    pass
+            elif cache.num_batches:
+                reader = cache.reader()
+                to_counts(next(iter(reader)))  # vocab from the first batch
+                if hasattr(reader, "close"):
+                    reader.close()
         else:
             writer = DataCacheWriter(
                 self.cache_dir, self.cache_memory_budget_bytes
             )
-            for t in source:
+
+            def ingest_append(t):
+                # Extraction, validation, AND the append are one checked
+                # step (see stream_sync.checked_ingest).
                 writer.append({column: to_counts(t).astype(np.float32)})
+
+            for _ in checked_ingest(source, dv, ingest_append, multi):
+                pass
             cache = writer.finish()
-            if vocab[0] is None:
+            if not multi and vocab[0] is None:
                 raise ValueError("training stream is empty")
+
+        if multi:
+            from flinkml_tpu.iteration.stream_sync import (
+                SyncedReplayPlan,
+                agree_feature_dim,
+            )
+
+            # Rendezvous BEFORE planning: a held ingest error must
+            # surface as itself, not as plan.create's "stream is empty
+            # on every process".
+            dv.rendezvous(mesh, "stream ingest validation")
+            plan = SyncedReplayPlan.create(cache, mesh, p * 8)
+            vocab[0] = agree_feature_dim(
+                cache, column, mesh,
+                local_dim=0 if vocab[0] is None else vocab[0],
+            )
+            if vocab[0] == 0:
+                raise ValueError("training stream is empty on every process")
 
         key = jax.random.PRNGKey(self.get_seed())
         if resume_epoch is None:
@@ -320,6 +367,41 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
         def place_for(it):
             counter = [0]
 
+            def step_key():
+                b = counter[0]
+                counter[0] += 1
+                return jax.random.fold_in(jax.random.fold_in(key, it), b)
+
+            if multi:
+                from flinkml_tpu.iteration.stream_sync import pad_rows_to
+
+                height = plan.local_height
+
+                def place(batch):
+                    kb = step_key()
+                    if batch is None:  # dummy step on a drained rank
+                        # Zero rows_w masks the gamma draw, sstats, ll,
+                        # and token sums — an exact no-op step.
+                        return (
+                            mesh.global_batch(
+                                np.zeros((height, vocab[0]), np.float32)
+                            ),
+                            mesh.global_batch(np.zeros(height, np.float32)),
+                            kb,
+                        )
+                    c = to_counts(batch).astype(np.float32)
+                    c_pad = pad_rows_to(c, height)
+                    rows_w = pad_rows_to(
+                        np.ones(c.shape[0], np.float32), height
+                    )
+                    return (
+                        mesh.global_batch(c_pad),
+                        mesh.global_batch(rows_w),
+                        kb,
+                    )
+
+                return place
+
             def place(batch):
                 c = to_counts(batch).astype(np.float32)
                 # 8p row tile bounds the set of padded shapes -> compiles
@@ -327,15 +409,13 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
                 c_pad, n_valid = pad_to_multiple(c, p * 8)
                 rows_w = np.zeros(c_pad.shape[0], np.float32)
                 rows_w[:n_valid] = 1.0
-                b = counter[0]
-                counter[0] += 1
-                return (
-                    mesh.shard_batch(c_pad), mesh.shard_batch(rows_w),
-                    jax.random.fold_in(jax.random.fold_in(key, it), b),
-                )
+                return mesh.shard_batch(c_pad), mesh.shard_batch(rows_w), step_key()
 
             return place
 
+        from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+        guard = DispatchGuard()  # multi-process backpressure (no-op single)
         max_iter = self.get(self.MAX_ITER)
         for it in range(start_epoch, max_iter):
             if terminated:
@@ -343,17 +423,21 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
             lam_dev = jnp.asarray(lam, jnp.float32)
             alpha_dev = jnp.asarray(alpha, jnp.float32)
             sstats = ll_sum = tok_sum = None
-            feed = PrefetchingDeviceFeed(
-                cache.reader(), place=place_for(it), depth=2
+            src = (
+                plan.epoch_batches(cache.reader(), lambda: None)
+                if multi else cache.reader()
             )
+            feed = PrefetchingDeviceFeed(src, place=place_for(it), depth=2)
             try:
                 for cb, wb, kb in feed:
                     s, _, ll_b, tok_b = step(cb, wb, lam_dev, alpha_dev, kb)
                     sstats = s if sstats is None else sstats + s
                     ll_sum = ll_b if ll_sum is None else ll_sum + ll_b
                     tok_sum = tok_b if tok_sum is None else tok_sum + tok_b
+                    guard.after_dispatch(tok_sum)
             finally:
                 feed.close()
+            guard.flush(tok_sum)
             exp_elog_beta = np.asarray(
                 _exp_dirichlet_expectation(lam_dev), np.float64
             )
@@ -364,10 +448,15 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
             mgr = self.checkpoint_manager
             if should_snapshot(mgr, self.checkpoint_interval, it + 1,
                                max_iter, terminal=terminated):
-                mgr.save(
-                    (lam, np.float64(prev_ll), np.asarray(terminated)),
-                    it + 1,
-                )
+                state = (lam, np.float64(prev_ll), np.asarray(terminated))
+                if multi:
+                    from flinkml_tpu.iteration.checkpoint import (
+                        save_replicated,
+                    )
+
+                    save_replicated(mgr, state, it + 1, mesh)
+                else:
+                    mgr.save(state, it + 1)
             if terminated:
                 break
 

@@ -171,6 +171,23 @@ def case_linear_ragged_value():
 expect_all_ranks_raise("case4-linear-ragged", case_linear_ragged_value)
 
 
+# --- 4b. LDA from a sealed DataCache whose SECOND batch is invalid on
+# rank 0 only (negative count): the full-cache pre-validation must hold
+# it for the rendezvous, not raise rank-locally at replay time.
+def case_lda_bad_cached_batch():
+    from flinkml_tpu.models.lda import LDA
+
+    good = np.abs(rng.normal(size=(8, 6))).astype(np.float32)
+    bad = good.copy()
+    if pid == 0:
+        bad[0, 0] = -1.0
+    cache = cache_stream(iter({"features": b} for b in [good, bad]))
+    LDA(mesh=mesh).set_k(2).set_max_iter(2).fit(cache)
+
+
+expect_all_ranks_raise("case4b-lda-bad-cache", case_lda_bad_cached_batch)
+
+
 # --- 5. GBT straddled-checkpoint resume (rank-scoped snapshots).
 gbt_args = dict(
     mesh=mesh, logistic=True, num_trees=3, depth=2, max_bins=8,

@@ -100,3 +100,30 @@ def gmm_local_batches(pid: int, nproc: int):
     xs = x[start : start + base + (1 if pid < rem else 0)]
     bs = BATCH_SIZES[pid]
     return [xs[i : i + bs] for i in range(0, xs.shape[0], bs)]
+
+
+LDA_VOCAB = 12
+
+
+def lda_global_counts():
+    """Two planted topics: even docs draw from the first vocab half, odd
+    docs from the second — a fitted k=2 LDA must separate the halves."""
+    rng = np.random.default_rng(11)
+    docs = []
+    for i in range(240):
+        p = np.full(LDA_VOCAB, 0.01)
+        if i % 2 == 0:
+            p[: LDA_VOCAB // 2] = 1.0
+        else:
+            p[LDA_VOCAB // 2 :] = 1.0
+        docs.append(rng.multinomial(40, p / p.sum()))
+    return np.asarray(docs, np.float32)
+
+
+def lda_local_batches(pid: int, nproc: int):
+    c = lda_global_counts()
+    base, rem = divmod(c.shape[0], nproc)
+    start = pid * base + min(pid, rem)
+    cs = c[start : start + base + (1 if pid < rem else 0)]
+    bs = BATCH_SIZES[pid]
+    return [cs[i : i + bs] for i in range(0, cs.shape[0], bs)]

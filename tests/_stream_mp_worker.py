@@ -165,6 +165,17 @@ pca = (
               for t in mlp_tables))
 )
 
+# --- 8. LDA streamed fit (round-4 multi-process: per-process corpus
+# partitions through the agreed replay schedule; topics replicated).
+from flinkml_tpu.models.lda import LDA  # noqa: E402
+
+lda = (
+    LDA(mesh=mesh).set_k(2).set_max_iter(8).set_seed(3)
+    .fit(iter(Table({"features": b})
+              for b in C.lda_local_batches(pid, nproc)))
+)
+lda_topics = lda.topics_matrix
+
 np.savez(
     os.path.join(workdir, f"result_{pid}.npz"),
     coef=coef, cents=cents, cents_rand=cents_rand,
@@ -174,5 +185,6 @@ np.savez(
     gbt_feats=gbt._feats, gbt_leaves=gbt._leaves,
     gbt_acc=np.float64(gbt_acc),
     pca_components=pca.components, pca_variances=pca.explained_variance,
+    lda_topics=lda_topics,
 )
 print(f"STREAM_OK {pid}")

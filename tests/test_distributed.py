@@ -127,12 +127,19 @@ def test_two_process_streamed_fit(tmp_path):
     # (a) replicated training state: every rank fitted the same model.
     for key in ("coef", "cents", "cents_rand", "cents_empty", "gmm_means",
                 "gmm_weights", "mlp_w0", "gbt_feats", "gbt_leaves",
-                "pca_components", "pca_variances"):
+                "pca_components", "pca_variances", "lda_topics"):
         assert np.array_equal(results[0][key], results[1][key]), key
 
     # GMM: pooled moments + pooled init recover the planted components.
     got = np.sort(results[0]["gmm_means"], axis=0)
     np.testing.assert_allclose(got, C.GMM_MEANS, atol=0.3)
+
+    # LDA: the two fitted topics separate the planted vocab halves.
+    topics = results[0]["lda_topics"]  # [2, V], rows sum to 1
+    first_half = topics[:, : C.LDA_VOCAB // 2].sum(axis=1)
+    assert sorted(first_half) == pytest.approx([0.0, 1.0], abs=0.1), (
+        first_half
+    )
     # MLP (streamed-Adam runner) and GBT learn the separable target.
     assert float(results[0]["mlp_acc"]) > 0.9, results[0]["mlp_acc"]
     assert float(results[0]["gbt_acc"]) > 0.85, results[0]["gbt_acc"]

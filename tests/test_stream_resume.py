@@ -253,16 +253,15 @@ def test_kmeans_stream_double_failure_recovery(tmp_path, mesh, crash_epochs):
 
 def test_streamed_fits_reject_multi_process(mesh, monkeypatch):
     """Streamed fits whose host state is not yet process-partitioned
-    (ALS's id-keyed factor blocks, LDA's document statistics, Word2Vec's
-    pair cache) are single-controller: on a multi-process mesh they must
-    raise the defined error (not die opaquely inside device_put on a
-    non-addressable device). The linear/KMeans/GMM/MLP/FM/GBT/PCA
+    (ALS's id-keyed factor blocks, Word2Vec's pair cache) are
+    single-controller: on a multi-process mesh they must raise the
+    defined error (not die opaquely inside device_put on a
+    non-addressable device). The linear/KMeans/GMM/MLP/FM/GBT/PCA/LDA
     streamed fits are multi-process-capable
     (tests/test_distributed.py::test_two_process_streamed_fit)."""
     import jax
 
     from flinkml_tpu.models.als import ALS
-    from flinkml_tpu.models.lda import LDA
     from flinkml_tpu.table import Table
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
@@ -273,10 +272,6 @@ def test_streamed_fits_reject_multi_process(mesh, monkeypatch):
                 "item": np.asarray([0, 1]),
                 "rating": np.asarray([1.0, 2.0], np.float32),
             })])
-        )
-    with pytest.raises(RuntimeError, match="single-controller"):
-        LDA(mesh=mesh).set_k(2).set_max_iter(1).fit(
-            iter([Table({"features": np.ones((4, 6), np.float32)})])
         )
 
 
