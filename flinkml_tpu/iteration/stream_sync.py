@@ -241,10 +241,16 @@ def agree_feature_dim(
     return dim
 
 
-def _entry_rows(entry: Any) -> int:
+def entry_rows(entry: Any) -> int:
+    """Row count of one sealed-cache entry (RAM dict or spilled
+    Segment) — the public metadata hook schedule agreements are built
+    from (``SyncedReplayPlan.create``; ALS's chunk-level schedule)."""
     if isinstance(entry, Segment):
         return entry.num_rows
     return next(iter(entry.values())).shape[0] if entry else 0
+
+
+_entry_rows = entry_rows  # backward-compatible private alias
 
 
 def _round_up(n: int, multiple: int) -> int:

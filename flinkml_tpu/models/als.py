@@ -127,6 +127,30 @@ def _solve_factors(a, b, gram, reg, counts):
     return jax.scipy.linalg.cho_solve(cho, b[:, :, None])[:, :, 0]
 
 
+def _agree_id_vocab(local_ids: np.ndarray, mesh: DeviceMesh) -> np.ndarray:
+    """Union the per-process sorted unique id arrays through the device
+    fabric (multi-process streamed fit): each rank's ids ride the
+    f64-exact hi/lo transport of
+    :func:`~flinkml_tpu.iteration.stream_sync.gather_vectors` (exact for
+    integer |id| < 2**47), NaN-padded to the agreed max length; every
+    host computes the identical union. Returns int64 when every id is
+    integral, float64 otherwise. An empty local vocabulary is legal
+    (that rank feeds only dummy chunks)."""
+    from flinkml_tpu.iteration.stream_sync import agree_max, gather_vectors
+
+    h = agree_max(int(local_ids.shape[0]), mesh)
+    if h == 0:
+        raise ValueError("training stream is empty on every process")
+    pad = np.full(h, np.nan)
+    pad[: local_ids.shape[0]] = np.asarray(local_ids, np.float64)
+    rows = gather_vectors(pad, mesh)
+    ids = np.unique(rows[np.isfinite(rows)])
+    as_int = ids.astype(np.int64)
+    if np.array_equal(as_int.astype(np.float64), ids):
+        return as_int
+    return ids
+
+
 def _pad_coo(seg: np.ndarray, idx: np.ndarray, r: np.ndarray,
              n_dummy: int, multiple: int):
     """Pad the COO to ``multiple``; padded entries get segment id
@@ -260,7 +284,16 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
         accumulates the sorted id vocabularies; each half-step replays
         the cache, padding every batch to the row tile and accumulating
         the psum'd normal-equation partials on device. Only one batch
-        (plus prefetch depth) of the COO is device-resident at a time."""
+        (plus prefetch depth) of the COO is device-resident at a time.
+
+        Multi-process (round 4): each process feeds its own ratings
+        partition; the id vocabularies are unioned through the device
+        fabric (numeric ids, |id| < 2**47 — :func:`_agree_id_vocab`),
+        the per-half-step chunk schedule is agreed (drained ranks
+        dispatch all-sentinel dummy chunks — exact no-ops, every row
+        lands in the dropped segment), ingest failures ride the
+        held-error rendezvous, dispatches are bounded, and the
+        replicated factor pair checkpoints rank-0-write + barrier."""
         from flinkml_tpu.iteration.checkpoint import (
             begin_resume,
             should_snapshot,
@@ -270,10 +303,12 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
             DataCacheWriter,
             PrefetchingDeviceFeed,
         )
+        from flinkml_tpu.iteration.stream_sync import (
+            DeferredValidation,
+            checked_ingest,
+        )
 
-        from flinkml_tpu.parallel.distributed import require_single_controller
-
-        require_single_controller("ALS streamed fit")
+        multi = jax.process_count() > 1
         if self.resume and not isinstance(source, DataCache):
             raise ValueError(
                 "resume=True requires a durable DataCache input: a one-shot "
@@ -299,10 +334,32 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
 
         def ingest(u, i, r):
             nonlocal nnz
+            if not (u.shape[0] == i.shape[0] == r.shape[0]):
+                raise ValueError(
+                    "user/item/rating columns must have equal length, got "
+                    f"{u.shape[0]}/{i.shape[0]}/{r.shape[0]}"
+                )
             if implicit and (r < 0).any():
                 raise ValueError(
                     "implicitPrefs requires non-negative ratings"
                 )
+            if multi:
+                for arr, what in ((u, "user"), (i, "item")):
+                    ok = np.issubdtype(arr.dtype, np.number)
+                    if ok:
+                        a64 = np.asarray(arr, np.float64)
+                        ok = bool(
+                            np.all(np.isfinite(a64))
+                            and (a64.size == 0
+                                 or np.abs(a64).max() < 2.0 ** 47)
+                        )
+                    if not ok:
+                        raise ValueError(
+                            "multi-process ALS streamed fit requires "
+                            f"finite numeric {what} ids with |id| < 2**47 "
+                            "(they are unioned exactly through the "
+                            "device fabric's f64 hi/lo transport)"
+                        )
             user_parts.append(np.unique(u))
             item_parts.append(np.unique(i))
             nnz += r.shape[0]
@@ -320,33 +377,85 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
                 np.asarray(b[rating_col], np.float32),
             )
 
+        dv = DeferredValidation()
+
+        def checked_add(b):
+            # Extraction + validation are one checked step; multi-process
+            # failures (and iterator raises) are held for the rendezvous.
+            ingest(*batch_arrays(b))
+
         if isinstance(source, DataCache):
             cache = source
-            for b in cache.reader():
-                ingest(*batch_arrays(b))
+            for _ in checked_ingest(cache.reader(), dv, checked_add, multi):
+                pass
         else:
             writer = DataCacheWriter(
                 self.cache_dir, self.cache_memory_budget_bytes
             )
-            for b in source:
+
+            def add_append(b):
                 u, i, r = batch_arrays(b)
                 ingest(u, i, r)
+                # The append is part of the checked step too (a rank-local
+                # spill failure must ride the rendezvous).
                 writer.append({user_col: np.array(u), item_col: np.array(i),
                                rating_col: np.array(r)})
+
+            for _ in checked_ingest(source, dv, add_append, multi):
+                pass
             cache = writer.finish()
-        if nnz == 0:
-            raise ValueError("training stream is empty")
-        user_ids = np.unique(np.concatenate(user_parts))
-        item_ids = np.unique(np.concatenate(item_parts))
+
+        def local_unique(parts):
+            return (
+                np.unique(np.concatenate(parts)) if parts else np.empty(0)
+            )
+
+        if multi:
+            from flinkml_tpu.iteration.stream_sync import gather_vectors
+
+            # Rendezvous BEFORE any agreement: a held ingest error must
+            # surface as itself, not as "stream is empty".
+            dv.rendezvous(mesh, "stream ingest validation")
+            nnz = int(round(gather_vectors(
+                np.asarray([float(nnz)]), mesh
+            ).sum()))
+            if nnz == 0:
+                raise ValueError("training stream is empty on every process")
+            user_ids = _agree_id_vocab(local_unique(user_parts), mesh)
+            item_ids = _agree_id_vocab(local_unique(item_parts), mesh)
+        else:
+            if nnz == 0:
+                raise ValueError("training stream is empty")
+            user_ids = local_unique(user_parts)
+            item_ids = local_unique(item_parts)
         n_users, n_items = len(user_ids), len(item_ids)
 
-        # Replayed batches dispatch in FIXED chunk_g-row slices — the same
-        # CHUNK bound the in-RAM path uses to cap the [rows, k, k]
-        # normal-equation intermediate at chunk×k² per device, and a
-        # single compiled shape per target side regardless of how the
-        # cache happens to be batched.
+        # Replayed batches dispatch in FIXED chunk_local-row slices (this
+        # process's share of one dispatch) — the same CHUNK bound the
+        # in-RAM path uses to cap the [rows, k, k] normal-equation
+        # intermediate at chunk×k² per device, and a single compiled
+        # shape per target side regardless of how the cache happens to
+        # be batched. Under multi-process, nnz is the GLOBAL count
+        # (agreed above), so every rank compiles the same chunk shape.
         chunk = min(self.CHUNK, max(256, -(-nnz // mesh.axis_size())))
-        chunk_g = mesh.axis_size() * chunk
+        chunk_local = (mesh.axis_size() // jax.process_count()) * chunk
+
+        steps_half = None
+        if multi:
+            from flinkml_tpu.iteration.stream_sync import (
+                agree_max,
+                entry_rows,
+            )
+
+            # Agreed chunk schedule per half-step: every rank dispatches
+            # the same number of chunk calls; drained ranks fill with
+            # all-sentinel dummy chunks (exact no-ops — every padded row
+            # lands in the dropped dummy segment).
+            local_total = sum(
+                -(-entry_rows(e) // chunk_local) for e in cache.entries
+            )
+            steps_half = agree_max(local_total, mesh)
+
         chunk_fns = {
             True: _normal_eq_chunk_fn(
                 mesh.mesh, DeviceMesh.DATA_AXIS, n_users, implicit
@@ -357,6 +466,8 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
         }
         alpha_j = jnp.asarray(alpha, jnp.float32)
 
+        from flinkml_tpu.parallel.dispatch import DispatchGuard
+
         def replay_half(fixed, by_user: bool):
             """One half-step's accumulation over the replayed cache."""
             n_target = n_users if by_user else n_items
@@ -365,32 +476,56 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
             bvec = jnp.zeros((n_target, k), jnp.float32)
             cnt = jnp.zeros((n_target,), jnp.float32)
             fn = chunk_fns[by_user]
+            guard = DispatchGuard()  # multi-process backpressure
 
             def place(batch):
                 u, i, r = batch_arrays(batch)
                 u_idx = np.searchsorted(user_ids, u).astype(np.int32)
                 i_idx = np.searchsorted(item_ids, i).astype(np.int32)
                 seg, idx = (u_idx, i_idx) if by_user else (i_idx, u_idx)
-                seg, idx, r = _pad_coo(seg, idx, r, n_target, chunk_g)
+                seg, idx, r = _pad_coo(seg, idx, r, n_target, chunk_local)
                 return [
                     (
-                        mesh.shard_batch(seg[sl]), mesh.shard_batch(idx[sl]),
-                        mesh.shard_batch(r[sl]),
+                        mesh.global_batch(seg[sl]), mesh.global_batch(idx[sl]),
+                        mesh.global_batch(r[sl]),
                     )
                     for sl in (
-                        slice(c * chunk_g, (c + 1) * chunk_g)
-                        for c in range(seg.shape[0] // chunk_g)
+                        slice(c * chunk_local, (c + 1) * chunk_local)
+                        for c in range(seg.shape[0] // chunk_local)
                     )
                 ]
 
+            dispatched = 0
             feed = PrefetchingDeviceFeed(cache.reader(), place=place, depth=2)
             try:
                 for chunks in feed:
                     for seg, idx, r in chunks:
+                        if steps_half is not None and dispatched >= steps_half:
+                            raise RuntimeError(
+                                "local cache yielded more chunks than the "
+                                "agreed schedule — caches must be sealed "
+                                "before planning"
+                            )
                         pa, pb, pc = fn(seg, idx, r, fixed, alpha_j)
                         a, bvec, cnt = a + pa, bvec + pb, cnt + pc
+                        dispatched += 1
+                        guard.after_dispatch(cnt)
             finally:
                 feed.close()
+            if steps_half is not None and dispatched < steps_half:
+                # Drained before the agreed schedule: dummy chunks keep
+                # the SPMD dispatch count aligned across ranks.
+                dseg = mesh.global_batch(
+                    np.full(chunk_local, n_target, np.int32)
+                )
+                didx = mesh.global_batch(np.zeros(chunk_local, np.int32))
+                dr = mesh.global_batch(np.zeros(chunk_local, np.float32))
+                while dispatched < steps_half:
+                    pa, pb, pc = fn(dseg, didx, dr, fixed, alpha_j)
+                    a, bvec, cnt = a + pa, bvec + pb, cnt + pc
+                    dispatched += 1
+                    guard.after_dispatch(cnt)
+            guard.flush(cnt)
             if implicit:
                 gram = fixed.T @ fixed
             else:
@@ -423,9 +558,17 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
             item_f = replay_half(user_f, by_user=False)
             if should_snapshot(self.checkpoint_manager,
                                self.checkpoint_interval, epoch + 1, max_iter):
-                self.checkpoint_manager.save(
-                    (np.asarray(user_f), np.asarray(item_f)), epoch + 1
-                )
+                state = (np.asarray(user_f), np.asarray(item_f))
+                if multi:
+                    from flinkml_tpu.iteration.checkpoint import (
+                        save_replicated,
+                    )
+
+                    save_replicated(
+                        self.checkpoint_manager, state, epoch + 1, mesh
+                    )
+                else:
+                    self.checkpoint_manager.save(state, epoch + 1)
 
         model = ALSModel()
         model.copy_params_from(self)

@@ -127,3 +127,38 @@ def lda_local_batches(pid: int, nproc: int):
     cs = c[start : start + base + (1 if pid < rem else 0)]
     bs = BATCH_SIZES[pid]
     return [cs[i : i + bs] for i in range(0, cs.shape[0], bs)]
+
+
+ALS_USERS, ALS_ITEMS, ALS_RANK = 24, 18, 4
+
+
+def als_global_ratings():
+    """Low-rank planted ratings (noiseless): a rank-4 ALS fit must
+    reconstruct the observed entries to small RMSE."""
+    rng = np.random.default_rng(21)
+    uf = rng.normal(size=(ALS_USERS, ALS_RANK)) / np.sqrt(ALS_RANK)
+    vf = rng.normal(size=(ALS_ITEMS, ALS_RANK)) / np.sqrt(ALS_RANK)
+    u, i = np.meshgrid(
+        np.arange(ALS_USERS), np.arange(ALS_ITEMS), indexing="ij"
+    )
+    u, i = u.ravel(), i.ravel()
+    keep = rng.random(u.shape[0]) < 0.6
+    u, i = u[keep], i[keep]
+    r = np.sum(uf[u] * vf[i], axis=1).astype(np.float32)
+    return u.astype(np.int64), i.astype(np.int64), r
+
+
+def als_local_batches(pid: int, nproc: int):
+    """This process's ratings partition (by rating index, so a rank can
+    see only a subset of the users/items — exercising the vocab union)."""
+    u, i, r = als_global_ratings()
+    base, rem = divmod(len(u), nproc)
+    start = pid * base + min(pid, rem)
+    sl = slice(start, start + base + (1 if pid < rem else 0))
+    us, its, rs = u[sl], i[sl], r[sl]
+    bs = BATCH_SIZES[pid]
+    return [
+        {"user": us[j : j + bs], "item": its[j : j + bs],
+         "rating": rs[j : j + bs]}
+        for j in range(0, len(us), bs)
+    ]

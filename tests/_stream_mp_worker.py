@@ -176,6 +176,24 @@ lda = (
 )
 lda_topics = lda.topics_matrix
 
+# --- 9. ALS streamed fit (round-4 multi-process: per-process ratings
+# partitions; id vocabularies unioned through the device fabric, agreed
+# chunk schedule with dummy fills; factors replicated).
+from flinkml_tpu.models.als import ALS  # noqa: E402
+
+als = (
+    ALS(mesh=mesh).set_rank(C.ALS_RANK).set_max_iter(10)
+    .set_reg_param(0.01).set_seed(0)
+    .fit(iter(Table(b) for b in C.als_local_batches(pid, nproc)))
+)
+au, ai, ar = C.als_global_ratings()
+pred = np.sum(
+    als._user_factors[np.searchsorted(als._user_ids, au)]
+    * als._item_factors[np.searchsorted(als._item_ids, ai)],
+    axis=1,
+)
+als_rmse = float(np.sqrt(np.mean((pred - ar) ** 2)))
+
 np.savez(
     os.path.join(workdir, f"result_{pid}.npz"),
     coef=coef, cents=cents, cents_rand=cents_rand,
@@ -186,5 +204,7 @@ np.savez(
     gbt_acc=np.float64(gbt_acc),
     pca_components=pca.components, pca_variances=pca.explained_variance,
     lda_topics=lda_topics,
+    als_user_f=als._user_factors, als_item_f=als._item_factors,
+    als_rmse=np.float64(als_rmse),
 )
 print(f"STREAM_OK {pid}")

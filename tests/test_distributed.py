@@ -127,8 +127,12 @@ def test_two_process_streamed_fit(tmp_path):
     # (a) replicated training state: every rank fitted the same model.
     for key in ("coef", "cents", "cents_rand", "cents_empty", "gmm_means",
                 "gmm_weights", "mlp_w0", "gbt_feats", "gbt_leaves",
-                "pca_components", "pca_variances", "lda_topics"):
+                "pca_components", "pca_variances", "lda_topics",
+                "als_user_f", "als_item_f"):
         assert np.array_equal(results[0][key], results[1][key]), key
+
+    # ALS: the factors reconstruct the planted low-rank ratings.
+    assert float(results[0]["als_rmse"]) < 0.05, results[0]["als_rmse"]
 
     # GMM: pooled moments + pooled init recover the planted components.
     got = np.sort(results[0]["gmm_means"], axis=0)

@@ -252,26 +252,23 @@ def test_kmeans_stream_double_failure_recovery(tmp_path, mesh, crash_epochs):
 
 
 def test_streamed_fits_reject_multi_process(mesh, monkeypatch):
-    """Streamed fits whose host state is not yet process-partitioned
-    (ALS's id-keyed factor blocks, Word2Vec's pair cache) are
-    single-controller: on a multi-process mesh they must raise the
-    defined error (not die opaquely inside device_put on a
-    non-addressable device). The linear/KMeans/GMM/MLP/FM/GBT/PCA/LDA
-    streamed fits are multi-process-capable
+    """The one streamed fit whose host state is not process-partitioned
+    (Word2Vec's string vocabulary + pair cache — a global token union
+    has no device-fabric transport) is single-controller: on a
+    multi-process mesh it must raise the defined error (not die opaquely
+    inside device_put on a non-addressable device). Every other
+    streamed fit — linear/KMeans/GMM/MLP/FM/GBT/PCA/LDA/ALS — is
+    multi-process-capable
     (tests/test_distributed.py::test_two_process_streamed_fit)."""
     import jax
 
-    from flinkml_tpu.models.als import ALS
+    from flinkml_tpu.models.word2vec import Word2Vec
     from flinkml_tpu.table import Table
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     with pytest.raises(RuntimeError, match="single-controller"):
-        ALS(mesh=mesh).set_max_iter(1).fit(
-            iter([Table({
-                "user": np.asarray([0, 1]),
-                "item": np.asarray([0, 1]),
-                "rating": np.asarray([1.0, 2.0], np.float32),
-            })])
+        Word2Vec(mesh=mesh).set_input_col("tok").set_max_iter(1).fit(
+            iter([Table({"tok": np.asarray([["a", "b"]], dtype=object)})])
         )
 
 

@@ -37,7 +37,11 @@ echo "--- 2. sorted-scatter A/B (900 s cap) ---"
 timeout 900 python tools/sorted_scatter_probe.py \
     || echo "sorted_scatter_probe FAILED rc=$?"
 
-echo "--- 3. compile-ceiling sweep, device half (1800 s cap) ---"
+echo "--- 3. gather/scatter bounds-mode A/B (600 s cap) ---"
+timeout 600 python tools/sparse_pib_probe.py \
+    || echo "sparse_pib_probe FAILED rc=$?"
+
+echo "--- 4. compile-ceiling sweep, device half (1800 s cap) ---"
 timeout 1800 python tools/compile_ceiling_probe.py \
     || echo "compile_ceiling_probe FAILED rc=$?"
 
