@@ -257,6 +257,46 @@ def _round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
+def agree_first_item_dim(source, check, dim_of, mesh):
+    """First-item feature-dim agreement for UNCACHED lockstep streams
+    (PCA's single pass, the online trainers): pull the first item with
+    iterator raises HELD, validate it, agree the dim across processes,
+    and return ``(first, rest, dim)`` — the caller chains
+    ``[first] + rest`` into :func:`synced_stream`. An exhausted rank
+    returns ``first=None`` and adopts the agreed dim (it will feed only
+    zero-weight dummies); an empty GLOBAL stream raises on every rank,
+    as does a dim mismatch or any held failure (original error on the
+    failing rank). One definition so the three uncached trainers cannot
+    drift (the cached-stream variant is :func:`agree_feature_dim`)."""
+    it = iter(source)
+    first = None
+    held = None
+    try:
+        first = next(it, None)
+    except Exception as e:  # noqa: BLE001 — agreed below
+        held = e
+    local_d = 0
+    if first is not None and held is None:
+        try:
+            check(first)
+            local_d = int(dim_of(first))
+        except Exception as e:  # noqa: BLE001 — agreed below
+            held = e
+    dim = agree_max(local_d, mesh)
+    try:
+        agree_all_ok(
+            held is None and not (local_d and local_d != dim), mesh,
+            f"feature-dim agreement (local {local_d}, global {dim})",
+        )
+    except ValueError:
+        if held is not None:
+            raise held
+        raise
+    if dim == 0:
+        raise ValueError("training stream is empty on every process")
+    return first, it, dim
+
+
 @dataclasses.dataclass
 class SyncedReplayPlan:
     """The agreed per-epoch replay schedule for one sealed local cache.

@@ -18,6 +18,7 @@ or, if unset, from ``k`` random rows of the first batch.
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable, List, Optional, Tuple
 
 import jax
@@ -62,9 +63,37 @@ def _batch_stats(x, centroids):
     return onehot.T @ x, jnp.sum(onehot, axis=0)
 
 
+@functools.lru_cache(maxsize=16)
+def _batch_stats_sharded(mesh, axis: str):
+    """Multi-process assignment pass: per-device partial sums/counts
+    combined with one ``psum`` (zero-weight padding/dummy rows are exact
+    no-ops)."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(xl, wl, centroids):
+        d2 = blas.squared_distances(xl, centroids)
+        assign = jnp.argmin(d2, axis=-1)
+        onehot = (
+            jax.nn.one_hot(assign, centroids.shape[0], dtype=xl.dtype)
+            * wl[:, None]
+        )
+        return (
+            jax.lax.psum(onehot.T @ xl, axis),
+            jax.lax.psum(jnp.sum(onehot, axis=0), axis),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P()), out_specs=(P(), P()),
+        )
+    )
+
+
 class OnlineKMeans(_OnlineKMeansParams, Estimator):
-    def __init__(self):
+    def __init__(self, mesh=None):
         super().__init__()
+        self.mesh = mesh
         self._initial_centroids: Optional[np.ndarray] = None
 
     def set_initial_model_data(self, *inputs: Table) -> "OnlineKMeans":
@@ -81,10 +110,22 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         return self.fit_stream(table.batches(batch_size))
 
     def fit_stream(self, batches: Iterable[Table]) -> "OnlineKMeansModel":
+        """One decayed centroid update per arriving batch.
+
+        Multi-process (round 4): each process feeds its OWN arriving
+        stream partition; every update is one psum'd global assignment
+        pass in SPMD lockstep (``stream_sync.synced_stream``), initial
+        centroids pool across the ranks' first batches, and exhausted
+        ranks contribute zero-weight dummies until every stream ends.
+        The fitted centroids are identical on every rank."""
         k = self.get(self.K)
         decay = self.get(self.DECAY_FACTOR)
         features_col = self.get(self.FEATURES_COL)
         rng = np.random.default_rng(self.get_seed())
+        if jax.process_count() > 1:
+            return self._fit_stream_multiprocess(
+                batches, k, decay, features_col, rng
+            )
 
         state = {
             "centroids": self._initial_centroids,
@@ -129,6 +170,107 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         model.copy_params_from(self)
         model._centroids = np.asarray(final["centroids"])
         model._model_version = final["version"]
+        return model
+
+    def _fit_stream_multiprocess(
+        self, batches, k, decay, features_col, rng
+    ) -> "OnlineKMeansModel":
+        """The multi-host unbounded mode (see :meth:`fit_stream`)."""
+        import itertools
+
+        from flinkml_tpu.iteration.stream_sync import (
+            agree_first_item_dim,
+            pooled_sample,
+            synced_stream,
+        )
+        from flinkml_tpu.parallel import DeviceMesh
+        from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+        mesh = self.mesh or DeviceMesh()
+        row_tile = (mesh.axis_size() // jax.process_count()) * 8
+
+        def extract(t):
+            return features_matrix(t, features_col).astype(np.float32)
+
+        d_seen = [None]
+
+        def check(x):
+            if x.ndim != 2 or x.shape[0] == 0:
+                raise ValueError(
+                    f"stream batches must be non-empty [n, d], got {x.shape}"
+                )
+            if d_seen[0] is None:
+                d_seen[0] = x.shape[1]
+            elif x.shape[1] != d_seen[0]:
+                raise ValueError(
+                    f"batch feature dim {x.shape[1]} != first batch's "
+                    f"{d_seen[0]}"
+                )
+
+        first, it, dim = agree_first_item_dim(
+            (extract(t) for t in batches), check,
+            lambda x: x.shape[1], mesh,
+        )
+        d_seen[0] = dim
+
+        if self._initial_centroids is not None:
+            centroids = jnp.asarray(self._initial_centroids, jnp.float32)
+        else:
+            # Pool initial centroids across every rank's FIRST batch (the
+            # single-process path draws k random rows of the first batch;
+            # here "the first batch" is the union of the ranks' first
+            # batches — identical selection on every host).
+            if first is None:
+                local = np.zeros((0, dim), np.float32)
+                local_rows = 0
+            else:
+                take = min(k, first.shape[0])
+                local = first[
+                    rng.choice(first.shape[0], size=take, replace=False)
+                ]
+                local_rows = first.shape[0]
+            pooled = pooled_sample(
+                local, local_rows, k, self.get_seed(), mesh
+            )
+            if pooled.shape[0] < k:
+                raise ValueError(
+                    f"first batches hold {pooled.shape[0]} rows < k={k}; "
+                    "increase globalBatchSize or provide initial model data"
+                )
+            centroids = jnp.asarray(pooled, jnp.float32)
+        weights = jnp.zeros(k, jnp.float32)
+
+        step_fn = _batch_stats_sharded(mesh.mesh, DeviceMesh.DATA_AXIS)
+        guard = DispatchGuard()  # sustained dispatch needs backpressure
+        stream = itertools.chain([first] if first is not None else [], it)
+        height_of = lambda x: (-(-max(x.shape[0], 1) // row_tile)) * row_tile
+        version = 0
+        for x, h in synced_stream(
+            stream, mesh, check=check, payload=height_of
+        ):
+            if x is None:  # this rank drained; zero-weight dummy step
+                x = np.zeros((0, dim), np.float32)
+            x_pad = np.zeros((h, dim), np.float32)
+            x_pad[: x.shape[0]] = x
+            wl = np.zeros(h, np.float32)
+            wl[: x.shape[0]] = 1.0
+            sums, counts = step_fn(
+                mesh.global_batch(x_pad), mesh.global_batch(wl), centroids
+            )
+            old_w = weights * decay
+            new_w = old_w + counts
+            safe = jnp.maximum(new_w, 1e-12)[:, None]
+            updated = (old_w[:, None] * centroids + sums) / safe
+            centroids = jnp.where(new_w[:, None] > 0, updated, centroids)
+            weights = new_w
+            version += 1
+            guard.after_dispatch(centroids)
+        guard.flush(centroids)
+
+        model = OnlineKMeansModel()
+        model.copy_params_from(self)
+        model._centroids = np.asarray(centroids, np.float64)
+        model._model_version = version
         return model
 
 

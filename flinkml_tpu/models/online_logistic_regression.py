@@ -24,6 +24,7 @@ with λ1 = reg·elasticNet, λ2 = reg·(1−elasticNet).
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable, List, Optional, Tuple
 
 import jax
@@ -63,13 +64,10 @@ class _OnlineLogisticRegressionParams(
     BETA = FloatParam("beta", "The beta parameter of FTRL.", 0.1, ParamValidators.gt(0.0))
 
 
-@jax.jit
-def _ftrl_update(z, n, w_coef, x, y, weight, alpha, beta, l1, l2):
-    """One FTRL-proximal step on a batch; returns (z, n, new_coef, loss)."""
-    dot = x @ w_coef
-    p = jax.nn.sigmoid(dot)
-    wsum = jnp.maximum(jnp.sum(weight), 1e-12)
-    g = x.T @ (weight * (p - y)) / wsum
+def _ftrl_algebra(z, n, w_coef, g, alpha, beta, l1, l2):
+    """The FTRL-proximal state update given the (already-reduced) mean
+    gradient — one definition shared by the single-controller and the
+    multi-process psum'd steps, so their numerics can never drift."""
     sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / alpha
     z = z + g - sigma * w_coef
     n = n + g * g
@@ -78,14 +76,58 @@ def _ftrl_update(z, n, w_coef, x, y, weight, alpha, beta, l1, l2):
         0.0,
         -(z - jnp.sign(z) * l1) / ((beta + jnp.sqrt(n)) / alpha + l2),
     )
+    return z, n, new_coef
+
+
+@jax.jit
+def _ftrl_update(z, n, w_coef, x, y, weight, alpha, beta, l1, l2):
+    """One FTRL-proximal step on a batch; returns (z, n, new_coef, loss)."""
+    dot = x @ w_coef
+    p = jax.nn.sigmoid(dot)
+    wsum = jnp.maximum(jnp.sum(weight), 1e-12)
+    g = x.T @ (weight * (p - y)) / wsum
+    z, n, new_coef = _ftrl_algebra(z, n, w_coef, g, alpha, beta, l1, l2)
     ys = 2.0 * y - 1.0
     loss = jnp.sum(weight * jax.nn.softplus(-dot * ys)) / wsum
     return z, n, new_coef, loss
 
 
+@functools.lru_cache(maxsize=16)
+def _ftrl_sharded_fn(mesh, axis: str):
+    """Multi-process FTRL step: per-device partial gradients combined
+    with one ``psum`` — the reference's per-mini-batch allReduce of
+    parallel subtask gradients (``AllReduceImpl.java:52-299`` under
+    flink-ml's online training). Zero-weight (padding / dummy) rows are
+    exact no-ops; an all-zero-weight global step leaves the state
+    unchanged (g = 0)."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(xl, yl, wl, z, n, w_coef, alpha, beta, l1, l2):
+        dot = xl @ w_coef
+        p = jax.nn.sigmoid(dot)
+        wsum = jnp.maximum(jax.lax.psum(jnp.sum(wl), axis), 1e-12)
+        g = jax.lax.psum(xl.T @ (wl * (p - yl)), axis) / wsum
+        z, n, new_coef = _ftrl_algebra(z, n, w_coef, g, alpha, beta, l1, l2)
+        ys = 2.0 * yl - 1.0
+        loss = jax.lax.psum(
+            jnp.sum(wl * jax.nn.softplus(-dot * ys)), axis
+        ) / wsum
+        return z, n, new_coef, loss
+
+    a, r = P(axis), P()
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(a, a, a, r, r, r, r, r, r, r),
+            out_specs=(r, r, r, r),
+        )
+    )
+
+
 class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
-    def __init__(self):
+    def __init__(self, mesh=None):
         super().__init__()
+        self.mesh = mesh
         self._initial_coefficient: Optional[np.ndarray] = None
 
     def set_initial_model_data(self, *inputs: Table) -> "OnlineLogisticRegression":
@@ -104,12 +146,22 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
         return self.fit_stream(table.batches(batch_size))
 
     def fit_stream(self, batches: Iterable[Table]) -> "OnlineLogisticRegressionModel":
-        """True unbounded mode: one FTRL update per arriving batch."""
+        """True unbounded mode: one FTRL update per arriving batch.
+
+        Multi-process (round 4): each process feeds its OWN arriving
+        stream partition; every update is one psum'd global FTRL step
+        in SPMD lockstep (``stream_sync.synced_stream`` — exhausted
+        ranks contribute zero-weight dummy batches until every stream
+        ends), the reference's per-mini-batch allReduce of parallel
+        subtask gradients. The fitted model is identical on every rank.
+        """
         alpha = self.get(_OnlineLogisticRegressionParams.ALPHA)
         beta = self.get(_OnlineLogisticRegressionParams.BETA)
         reg = self.get(_OnlineLogisticRegressionParams.REG)
         en = self.get(_OnlineLogisticRegressionParams.ELASTIC_NET)
         l1, l2 = reg * en, reg * (1.0 - en)
+        if jax.process_count() > 1:
+            return self._fit_stream_multiprocess(batches, alpha, beta, l1, l2)
 
         state = {"z": None, "n": None, "coef": self._initial_coefficient, "version": 0}
 
@@ -154,6 +206,112 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
         model.copy_params_from(self)
         model._coefficient = np.asarray(final["coef"])
         model._model_version = final["version"]
+        return model
+
+    def _fit_stream_multiprocess(
+        self, batches, alpha, beta, l1, l2
+    ) -> "OnlineLogisticRegressionModel":
+        """The multi-host unbounded mode (see :meth:`fit_stream`)."""
+        import itertools
+
+        from flinkml_tpu.iteration.stream_sync import (
+            agree_first_item_dim,
+            synced_stream,
+        )
+        from flinkml_tpu.parallel import DeviceMesh
+        from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+        mesh = self.mesh or DeviceMesh()
+        local_devs = mesh.axis_size() // jax.process_count()
+        row_tile = local_devs * 8
+        fcol = self.get(_OnlineLogisticRegressionParams.FEATURES_COL)
+        lcol = self.get(_OnlineLogisticRegressionParams.LABEL_COL)
+        wcol = self.get(_OnlineLogisticRegressionParams.WEIGHT_COL)
+
+        def extract(t):
+            x, y, w = labeled_data(t, fcol, lcol, wcol)
+            return (
+                np.asarray(x, np.float32),
+                np.asarray(y, np.float32),
+                np.asarray(w, np.float32),
+            )
+
+        d_seen = [None]
+
+        def check(item):
+            x, y, w = item
+            if x.ndim != 2 or x.shape[0] == 0:
+                raise ValueError(
+                    f"stream batches must be non-empty [n, d], got {x.shape}"
+                )
+            if d_seen[0] is None:
+                d_seen[0] = x.shape[1]
+            elif x.shape[1] != d_seen[0]:
+                raise ValueError(
+                    f"batch feature dim {x.shape[1]} != first batch's "
+                    f"{d_seen[0]}"
+                )
+
+        # First-item dim agreement: an exhausted rank adopts the agreed
+        # dim so its zero-weight dummies are shaped; iterator raises are
+        # held for the same agreement.
+        first, it, dim = agree_first_item_dim(
+            (extract(t) for t in batches), check,
+            lambda item: item[0].shape[1], mesh,
+        )
+        d_seen[0] = dim
+
+        # Replicated FTRL state, warm start as the single-process path.
+        if self._initial_coefficient is None:
+            coef = jnp.zeros(dim, jnp.float32)
+            z = jnp.zeros(dim, jnp.float32)
+        else:
+            if self._initial_coefficient.shape[0] != dim:
+                raise ValueError(
+                    f"initial coefficient has dim "
+                    f"{self._initial_coefficient.shape[0]} but the stream "
+                    f"has dim {dim}"
+                )
+            coef = jnp.asarray(self._initial_coefficient, jnp.float32)
+            z = -coef * (beta / alpha + l2) - jnp.sign(coef) * l1
+            z = jnp.where(coef == 0.0, 0.0, z)
+        n = jnp.zeros(dim, jnp.float32)
+        a_j, b_j = jnp.float32(alpha), jnp.float32(beta)
+        l1_j, l2_j = jnp.float32(l1), jnp.float32(l2)
+
+        step_fn = _ftrl_sharded_fn(mesh.mesh, DeviceMesh.DATA_AXIS)
+        guard = DispatchGuard()  # sustained dispatch needs backpressure
+        stream = itertools.chain([first] if first is not None else [], it)
+        height_of = lambda item: (
+            -(-max(item[0].shape[0], 1) // row_tile)
+        ) * row_tile
+        version = 0
+        for item, h in synced_stream(
+            stream, mesh, check=check, payload=height_of
+        ):
+            if item is None:  # this rank drained; zero-weight dummy step
+                x = np.zeros((0, dim), np.float32)
+                y = w = np.zeros(0, np.float32)
+            else:
+                x, y, w = item
+            x_pad = np.zeros((h, dim), np.float32)
+            x_pad[: x.shape[0]] = x
+            y_pad = np.zeros(h, np.float32)
+            y_pad[: y.shape[0]] = y
+            w_pad = np.zeros(h, np.float32)
+            w_pad[: w.shape[0]] = w
+            z, n, coef, _ = step_fn(
+                mesh.global_batch(x_pad), mesh.global_batch(y_pad),
+                mesh.global_batch(w_pad), z, n, coef, a_j, b_j, l1_j, l2_j,
+            )
+            version += 1
+            guard.after_dispatch(coef)
+        guard.flush(coef)
+
+        model = OnlineLogisticRegressionModel()
+        model.copy_params_from(self)
+        model._coefficient = np.asarray(coef, np.float64)
+        model._model_version = version
         return model
 
 

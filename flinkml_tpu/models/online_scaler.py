@@ -46,6 +46,15 @@ class OnlineStandardScaler(
         )
 
     def fit_stream(self, batches: Iterable[Table]) -> "OnlineStandardScalerModel":
+        """One exact Chan-merge per arriving batch.
+
+        Multi-process (round 4): moment merging is associative and
+        exact, so each process consumes its OWN stream partition
+        independently (no per-step lockstep needed) and the per-rank
+        ``(n, mean, M2)`` triples merge once at stream end through the
+        device fabric's f64-exact transport — in rank order, so every
+        host computes the identical model. A rank-local failure is held
+        and agreed before the merge (no stranded peers)."""
         input_col = self.get(self.INPUT_COL)
 
         state = {"n": 0.0, "mean": None, "m2": None, "version": 0}
@@ -74,12 +83,34 @@ class OnlineStandardScaler(
             carry["version"] += 1
             return carry, None
 
-        result = Iterations.iterate_unbounded_streams(
-            step, state, batches, IterationConfig(TerminateOnMaxIter(2**31 - 1))
-        )
-        final = result.state
+        import jax
+
+        multi = jax.process_count() > 1
+        # Multi-process, the local pass's failures are HELD: a rank-local
+        # raise would strand the peers in the final merge collective.
+        final = state
+        err = None
+        try:
+            final = Iterations.iterate_unbounded_streams(
+                step, state, batches,
+                IterationConfig(TerminateOnMaxIter(2**31 - 1)),
+            ).state
+        except Exception as e:  # noqa: BLE001 — agreed below (multi)
+            err = e
+        if multi:
+            from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+            dv = DeferredValidation()
+            dv.err = err
+            dv.rendezvous(None, "online scaler stream")
+            final = self._merge_across_processes(final)
+        elif err is not None:
+            raise err
         if final["mean"] is None:
-            raise ValueError("training stream is empty")
+            raise ValueError(
+                "training stream is empty"
+                + (" on every process" if multi else "")
+            )
         model = OnlineStandardScalerModel()
         model.copy_params_from(self)
         model.set_model_data(Table({
@@ -88,6 +119,56 @@ class OnlineStandardScaler(
         }))
         model._model_version = final["version"]
         return model
+
+    @staticmethod
+    def _merge_across_processes(final):
+        """Chan-merge the per-rank (n, mean, M2, version) in rank order —
+        identical on every host (see :meth:`fit_stream`)."""
+        from flinkml_tpu.iteration.stream_sync import (
+            agree_all_ok,
+            agree_max,
+            gather_vectors,
+        )
+
+        local_d = 0 if final["mean"] is None else final["mean"].shape[0]
+        d = agree_max(local_d)
+        # Rank-SYMMETRIC mismatch abort: the max-dim rank always matches
+        # the agreed d, so a bare local raise would strand it in the
+        # gather below — every rank must pass through this agreement.
+        agree_all_ok(
+            not (local_d and local_d != d), None,
+            f"feature-dim agreement (local {local_d}, global {d})",
+        )
+        if d == 0:
+            return {"n": 0.0, "mean": None, "m2": None, "version": 0}
+        vec = np.zeros(2 + 2 * d)
+        vec[0] = final["n"]
+        vec[1] = float(final["version"])
+        if final["mean"] is not None:
+            vec[2 : 2 + d] = final["mean"]
+            vec[2 + d :] = final["m2"]
+        rows = gather_vectors(vec, None)
+        n = 0.0
+        mean = np.zeros(d)
+        m2 = np.zeros(d)
+        version = 0
+        for row in rows:  # rank order: identical merge on every host
+            nb = float(row[0])
+            version += int(round(row[1]))
+            if nb == 0.0:
+                continue
+            mb, m2b = row[2 : 2 + d], row[2 + d :]
+            if n == 0.0:
+                n, mean, m2 = nb, mb.copy(), m2b.copy()
+                continue
+            delta = mb - mean
+            tot = n + nb
+            mean = mean + delta * (nb / tot)
+            m2 = m2 + m2b + delta * delta * (n * nb / tot)
+            n = tot
+        if n == 0.0:
+            return {"n": 0.0, "mean": None, "m2": None, "version": version}
+        return {"n": n, "mean": mean, "m2": m2, "version": version}
 
 
 class OnlineStandardScalerModel(StandardScalerModel):

@@ -163,44 +163,20 @@ class PCA(_PCAParams, Estimator):
                 raise ValueError("training stream is empty")
         else:
             from flinkml_tpu.iteration.stream_sync import (
-                agree_all_ok,
-                agree_max,
+                agree_first_item_dim,
                 gather_vectors,
                 synced_stream,
             )
 
             row_tile = mesh.axis_size() * 8
             # Pre-map to extracted matrices: one extract per batch, and
-            # extract/iterator failures inside synced_stream ride its
-            # per-step agreement instead of raising rank-locally.
-            it = iter(extract(b) for b in batches)
-            first = None
-            held = None
-            try:
-                # The source iterator (and extract) can raise rank-locally
-                # (e.g. IOError on this rank's shard) — hold the failure
-                # for the agreement below rather than stranding peers.
-                first = next(it, None)
-            except Exception as e:  # noqa: BLE001 — agreed below
-                held = e
-            if first is not None and held is None:
-                try:
-                    check_x(first)
-                except Exception as e:  # noqa: BLE001 — agreed below
-                    held = e
-            local_d = 0 if d[0] is None else d[0]
-            dim = agree_max(local_d, mesh)
-            try:
-                agree_all_ok(
-                    held is None and not (local_d and local_d != dim), mesh,
-                    f"feature-dim agreement (local {local_d}, global {dim})",
-                )
-            except ValueError:
-                if held is not None:
-                    raise held
-                raise
-            if dim == 0:
-                raise ValueError("training stream is empty on every process")
+            # extract/iterator failures ride the agreements (first item:
+            # agree_first_item_dim; the rest: synced_stream's per-step
+            # agreement) instead of raising rank-locally.
+            first, it, dim = agree_first_item_dim(
+                (extract(b) for b in batches), check_x,
+                lambda x: x.shape[1], mesh,
+            )
             d[0] = dim  # empty ranks adopt the agreed dim
             # Agreed centering shift: the first row of the lowest-indexed
             # non-empty rank (gathered exactly; identical everywhere).

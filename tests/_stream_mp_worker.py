@@ -194,6 +194,51 @@ pred = np.sum(
 )
 als_rmse = float(np.sqrt(np.mean((pred - ar) ** 2)))
 
+# --- 10. Online (unbounded) operators, round-4 multi-process: FTRL and
+# decayed KMeans run psum'd lockstep steps per arriving batch (uneven
+# per-rank batch counts force the zero-weight dummy tail); the scaler
+# merges per-rank moments exactly at stream end.
+from flinkml_tpu.models.online_kmeans import OnlineKMeans  # noqa: E402
+from flinkml_tpu.models.online_logistic_regression import (  # noqa: E402
+    OnlineLogisticRegression,
+)
+from flinkml_tpu.models.online_scaler import (  # noqa: E402
+    OnlineStandardScaler,
+)
+
+olr = (
+    OnlineLogisticRegression(mesh=mesh).set_alpha(0.5).set_beta(0.1)
+    .set_reg(0.001).set_elastic_net(0.5)
+    .fit_stream(iter(Table({"features": b["x"], "label": b["y"]})
+                     for b in batches))
+)
+olr_coef = olr._coefficient
+olr_version = olr._model_version
+
+okm = (
+    OnlineKMeans(mesh=mesh).set_k(C.K_CLUSTERS).set_seed(7)
+    .set_decay_factor(0.9)
+    .fit_stream(iter(Table({"features": b["x"]}) for b in batches))
+)
+okm_cents = okm._centroids
+
+osc = OnlineStandardScaler().set_input_col("features").fit_stream(
+    iter(Table({"features": b["x"]}) for b in batches)
+)
+osc_mean = osc._mean
+osc_std = osc._std
+osc_version = osc.model_version
+# Exactness: the merged moments equal the GLOBAL dataset's f64 moments
+# (the scaler accumulates in f64; Chan merge is split-invariant to fp
+# rounding).
+x_g64 = C.global_data()[0].astype(np.float64)
+np.testing.assert_allclose(
+    osc_mean, x_g64.mean(axis=0), rtol=1e-9, atol=1e-12
+)
+np.testing.assert_allclose(
+    osc_std, x_g64.std(axis=0), rtol=1e-9, atol=1e-12
+)
+
 np.savez(
     os.path.join(workdir, f"result_{pid}.npz"),
     coef=coef, cents=cents, cents_rand=cents_rand,
@@ -206,5 +251,9 @@ np.savez(
     lda_topics=lda_topics,
     als_user_f=als._user_factors, als_item_f=als._item_factors,
     als_rmse=np.float64(als_rmse),
+    olr_coef=olr_coef, olr_version=np.int64(olr_version),
+    okm_cents=okm_cents,
+    osc_mean=osc_mean, osc_std=osc_std,
+    osc_version=np.int64(osc_version),
 )
 print(f"STREAM_OK {pid}")

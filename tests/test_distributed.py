@@ -128,11 +128,27 @@ def test_two_process_streamed_fit(tmp_path):
     for key in ("coef", "cents", "cents_rand", "cents_empty", "gmm_means",
                 "gmm_weights", "mlp_w0", "gbt_feats", "gbt_leaves",
                 "pca_components", "pca_variances", "lda_topics",
-                "als_user_f", "als_item_f"):
+                "als_user_f", "als_item_f", "olr_coef", "okm_cents",
+                "osc_mean", "osc_std"):
         assert np.array_equal(results[0][key], results[1][key]), key
 
     # ALS: the factors reconstruct the planted low-rank ratings.
     assert float(results[0]["als_rmse"]) < 0.05, results[0]["als_rmse"]
+
+    # Online FTRL learns the separable target's sign pattern; versions
+    # count GLOBAL steps (max of the ranks' batch counts, not the sum).
+    x_g, y_g = C.global_data()
+    acc = float(
+        (((x_g @ results[0]["olr_coef"]) > 0) == (y_g > 0.5)).mean()
+    )
+    assert acc > 0.8, acc
+    max_batches = max(
+        len(C.local_batches(p, 2)) for p in range(2)
+    )
+    assert int(results[0]["olr_version"]) == max_batches
+    assert int(results[0]["osc_version"]) == sum(
+        len(C.local_batches(p, 2)) for p in range(2)
+    )
 
     # GMM: pooled moments + pooled init recover the planted components.
     got = np.sort(results[0]["gmm_means"], axis=0)
