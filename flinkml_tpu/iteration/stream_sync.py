@@ -525,6 +525,36 @@ def synced_stream(
         yield item if payload is None else (item, agreed_pay)
 
 
+def synced_padded_stream(arrays_stream, mesh, check, row_tile, dummy_cols):
+    """Lockstep-iterate a one-shot stream of variable-height items into
+    fixed-shape dispatches — THE multi-process loop body shared by the
+    uncached trainers (PCA's single pass, online FTRL/KMeans): yields
+    ``(padded_arrays, valid_w, h)`` per agreed step, where each item is
+    a tuple of arrays sharing leading height n, zero-padded to the
+    agreed tile-rounded height h (h rides the :func:`synced_stream`
+    payload), ``valid_w`` is 1.0 on real rows and 0.0 on padding, and a
+    drained rank receives all-zero dummies shaped by ``dummy_cols``
+    (the per-array trailing shapes, e.g. ``((dim,), (), ())`` for an
+    (x, y, w) stream). Zero-weight rows must be exact no-ops in the
+    caller's reductions."""
+    def height_of(item):
+        return _round_up(max(item[0].shape[0], 1), row_tile)
+
+    for item, h in synced_stream(
+        arrays_stream, mesh, check=check, payload=height_of
+    ):
+        if item is None:  # this rank drained; zero-weight dummy step
+            item = tuple(
+                np.zeros((0,) + tuple(shp), np.float32)
+                for shp in dummy_cols
+            )
+        n = item[0].shape[0]
+        padded = tuple(pad_rows_to(a, h) for a in item)
+        valid_w = np.zeros(h, np.float32)
+        valid_w[:n] = 1.0
+        yield padded, valid_w, h
+
+
 def pooled_sample(
     local_sample: np.ndarray,
     local_rows: int,

@@ -181,7 +181,7 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         from flinkml_tpu.iteration.stream_sync import (
             agree_first_item_dim,
             pooled_sample,
-            synced_stream,
+            synced_padded_stream,
         )
         from flinkml_tpu.parallel import DeviceMesh
         from flinkml_tpu.parallel.dispatch import DispatchGuard
@@ -243,17 +243,12 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         step_fn = _batch_stats_sharded(mesh.mesh, DeviceMesh.DATA_AXIS)
         guard = DispatchGuard()  # sustained dispatch needs backpressure
         stream = itertools.chain([first] if first is not None else [], it)
-        height_of = lambda x: (-(-max(x.shape[0], 1) // row_tile)) * row_tile
         version = 0
-        for x, h in synced_stream(
-            stream, mesh, check=check, payload=height_of
+        for (x_pad,), wl, _h in synced_padded_stream(
+            ((x,) for x in stream), mesh,
+            check=lambda item: check(item[0]),
+            row_tile=row_tile, dummy_cols=((dim,),),
         ):
-            if x is None:  # this rank drained; zero-weight dummy step
-                x = np.zeros((0, dim), np.float32)
-            x_pad = np.zeros((h, dim), np.float32)
-            x_pad[: x.shape[0]] = x
-            wl = np.zeros(h, np.float32)
-            wl[: x.shape[0]] = 1.0
             sums, counts = step_fn(
                 mesh.global_batch(x_pad), mesh.global_batch(wl), centroids
             )

@@ -216,7 +216,7 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
 
         from flinkml_tpu.iteration.stream_sync import (
             agree_first_item_dim,
-            synced_stream,
+            synced_padded_stream,
         )
         from flinkml_tpu.parallel import DeviceMesh
         from flinkml_tpu.parallel.dispatch import DispatchGuard
@@ -282,24 +282,14 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
         step_fn = _ftrl_sharded_fn(mesh.mesh, DeviceMesh.DATA_AXIS)
         guard = DispatchGuard()  # sustained dispatch needs backpressure
         stream = itertools.chain([first] if first is not None else [], it)
-        height_of = lambda item: (
-            -(-max(item[0].shape[0], 1) // row_tile)
-        ) * row_tile
         version = 0
-        for item, h in synced_stream(
-            stream, mesh, check=check, payload=height_of
+        # The zero-padded user weights ARE the validity mask (padding and
+        # dummy rows carry weight 0), so the shared loop's valid_w is
+        # redundant here.
+        for (x_pad, y_pad, w_pad), _valid, _h in synced_padded_stream(
+            stream, mesh, check=check, row_tile=row_tile,
+            dummy_cols=((dim,), (), ()),
         ):
-            if item is None:  # this rank drained; zero-weight dummy step
-                x = np.zeros((0, dim), np.float32)
-                y = w = np.zeros(0, np.float32)
-            else:
-                x, y, w = item
-            x_pad = np.zeros((h, dim), np.float32)
-            x_pad[: x.shape[0]] = x
-            y_pad = np.zeros(h, np.float32)
-            y_pad[: y.shape[0]] = y
-            w_pad = np.zeros(h, np.float32)
-            w_pad[: w.shape[0]] = w
             z, n, coef, _ = step_fn(
                 mesh.global_batch(x_pad), mesh.global_batch(y_pad),
                 mesh.global_batch(w_pad), z, n, coef, a_j, b_j, l1_j, l2_j,

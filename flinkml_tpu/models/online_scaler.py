@@ -38,6 +38,10 @@ class OnlineStandardScaler(
     WITH_MEAN = StandardScalerModel.WITH_MEAN
     WITH_STD = StandardScalerModel.WITH_STD
 
+    def __init__(self, mesh=None):
+        super().__init__()
+        self.mesh = mesh
+
     def fit(self, *inputs: Table) -> "OnlineStandardScalerModel":
         """Consume the table as a stream of globalBatchSize mini-batches."""
         (table,) = inputs
@@ -102,8 +106,8 @@ class OnlineStandardScaler(
 
             dv = DeferredValidation()
             dv.err = err
-            dv.rendezvous(None, "online scaler stream")
-            final = self._merge_across_processes(final)
+            dv.rendezvous(self.mesh, "online scaler stream")
+            final = self._merge_across_processes(final, self.mesh)
         elif err is not None:
             raise err
         if final["mean"] is None:
@@ -121,7 +125,7 @@ class OnlineStandardScaler(
         return model
 
     @staticmethod
-    def _merge_across_processes(final):
+    def _merge_across_processes(final, mesh=None):
         """Chan-merge the per-rank (n, mean, M2, version) in rank order —
         identical on every host (see :meth:`fit_stream`)."""
         from flinkml_tpu.iteration.stream_sync import (
@@ -131,12 +135,12 @@ class OnlineStandardScaler(
         )
 
         local_d = 0 if final["mean"] is None else final["mean"].shape[0]
-        d = agree_max(local_d)
+        d = agree_max(local_d, mesh)
         # Rank-SYMMETRIC mismatch abort: the max-dim rank always matches
         # the agreed d, so a bare local raise would strand it in the
         # gather below — every rank must pass through this agreement.
         agree_all_ok(
-            not (local_d and local_d != d), None,
+            not (local_d and local_d != d), mesh,
             f"feature-dim agreement (local {local_d}, global {d})",
         )
         if d == 0:
@@ -147,7 +151,7 @@ class OnlineStandardScaler(
         if final["mean"] is not None:
             vec[2 : 2 + d] = final["mean"]
             vec[2 + d :] = final["m2"]
-        rows = gather_vectors(vec, None)
+        rows = gather_vectors(vec, mesh)
         n = 0.0
         mean = np.zeros(d)
         m2 = np.zeros(d)

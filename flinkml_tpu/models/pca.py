@@ -165,7 +165,7 @@ class PCA(_PCAParams, Estimator):
             from flinkml_tpu.iteration.stream_sync import (
                 agree_first_item_dim,
                 gather_vectors,
-                synced_stream,
+                synced_padded_stream,
             )
 
             row_tile = mesh.axis_size() * 8
@@ -191,21 +191,14 @@ class PCA(_PCAParams, Estimator):
             import itertools
 
             stream = itertools.chain([first] if first is not None else [], it)
-            # The step's padded height (row_tile-bucketed so the set of
-            # compiled shapes stays small) rides the synced_stream
-            # agreement itself — one collective per step, not two.
-            height_of = lambda x: (
-                -(-max(x.shape[0], 1) // row_tile)
-            ) * row_tile
-            for x, h in synced_stream(
-                stream, mesh, check=check_x, payload=height_of
+            # Fixed agreed heights + zero-weight padding/dummies come
+            # from the shared lockstep loop body (one collective per
+            # step; items are tuples, hence the (x,) wrapping).
+            for (x_pad,), w, _h in synced_padded_stream(
+                ((x,) for x in stream), mesh,
+                check=lambda item: check_x(item[0]),
+                row_tile=row_tile, dummy_cols=((dim,),),
             ):
-                if x is None:
-                    x = np.zeros((0, dim), np.float32)
-                x_pad = np.zeros((h, dim), np.float32)
-                x_pad[: x.shape[0]] = x
-                w = np.zeros(h, np.float32)
-                w[: x.shape[0]] = 1.0
                 cb, sb, gb = fn(
                     mesh.global_batch(x_pad),
                     mesh.global_batch(w),

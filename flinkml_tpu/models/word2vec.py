@@ -86,9 +86,49 @@ def _build_pairs(docs, vocab_index: Dict[str, int], window: int,
     return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
 
 
+def _agree_token_counts(tokens, counts, mesh) -> "Dict[str, int]":
+    """Union the per-process (token, count) maps through the device
+    fabric: each token rides as UTF-8 bytes (values 0-255 — exact on
+    the f64 hi/lo transport of ``stream_sync.gather_vectors``) with its
+    count, padded to the agreed (max tokens, max byte length); every
+    host decodes the gathered rows in rank order and sums counts per
+    token, so the merged map is identical everywhere. An empty local
+    vocabulary is legal. Transport cost is
+    ``P x max_tokens x (max_len + 2) x 8`` bytes through device memory
+    — sized for real vocabularies (1e5 tokens x 32 bytes ≈ 27 MB/rank),
+    not for unbounded cardinality."""
+    from flinkml_tpu.iteration.stream_sync import agree_max, gather_vectors
+
+    enc = [str(t).encode("utf-8") for t in tokens]
+    t_max = agree_max(len(enc), mesh)
+    if t_max == 0:
+        return {}
+    l_max = agree_max(max((len(b) for b in enc), default=0), mesh)
+    stride = 2 + l_max
+    vec = np.zeros(1 + t_max * stride)
+    vec[0] = len(enc)
+    for j, b in enumerate(enc):
+        off = 1 + j * stride
+        vec[off] = len(b)
+        vec[off + 1] = counts[j]
+        vec[off + 2 : off + 2 + len(b)] = np.frombuffer(b, np.uint8)
+    rows = gather_vectors(vec, mesh)
+    merged: Dict[str, int] = {}
+    for row in rows:  # rank order: identical merge on every host
+        for j in range(int(round(row[0]))):
+            off = 1 + j * stride
+            blen = int(round(row[off]))
+            tok = (
+                np.asarray(row[off + 2 : off + 2 + blen])
+                .astype(np.uint8).tobytes().decode("utf-8")
+            )
+            merged[tok] = merged.get(tok, 0) + int(round(row[off + 1]))
+    return merged
+
+
 @functools.lru_cache(maxsize=8)
 def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
-    def local(centers, contexts, pool, v0, u0, lr, n_steps, key):
+    def local(centers, contexts, wl, pool, v0, u0, lr, n_steps, key):
         n_local = centers.shape[0]
 
         def body(state):
@@ -98,6 +138,7 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
             idx = jax.random.randint(k1, (local_bs,), 0, n_local)
             c = centers[idx]
             ctx = contexts[idx]
+            wb = wl[idx]                   # [bs]; 0 on dummy chunks
             neg = pool[jax.random.randint(
                 k2, (local_bs, n_neg), 0, pool.shape[0]
             )]
@@ -106,8 +147,8 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
             un = u[neg]                    # [bs, neg, d]
             pos_score = jnp.sum(vc * uc, axis=1)
             neg_score = jnp.einsum("bd,bnd->bn", vc, un)
-            g_pos = jax.nn.sigmoid(pos_score) - 1.0          # [bs]
-            g_neg = jax.nn.sigmoid(neg_score)                # [bs, neg]
+            g_pos = (jax.nn.sigmoid(pos_score) - 1.0) * wb   # [bs]
+            g_neg = jax.nn.sigmoid(neg_score) * wb[:, None]  # [bs, neg]
             grad_vc = (
                 g_pos[:, None] * uc + jnp.einsum("bn,bnd->bd", g_neg, un)
             )
@@ -121,11 +162,15 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
                 )
             )
             # Device-invariant normalization: psum the per-device sums
-            # and divide by the GLOBAL batch size, so learningRate means
-            # "step on the mean pair gradient" regardless of mesh size
-            # (pmean of sums would shrink the step by the device count).
-            gbs = local_bs * jax.lax.psum(jnp.asarray(1, jnp.int32), axis)
-            scale = lr / gbs.astype(jnp.float32)
+            # and divide by the GLOBAL selected weight, so learningRate
+            # means "step on the mean pair gradient" regardless of mesh
+            # size (pmean of sums would shrink the step by the device
+            # count). All-ones weights make this exactly the global
+            # batch size (f32 sums of ones are exact at these sizes);
+            # zero-weight rows (multi-process dummy chunks) drop out of
+            # both the gradient and the normalizer.
+            tw = jnp.maximum(jax.lax.psum(jnp.sum(wb), axis), 1e-12)
+            scale = lr / tw
             dv = jax.lax.psum(dv, axis)
             du = jax.lax.psum(du, axis)
             return step + 1, v - scale * dv, u - scale * du
@@ -140,7 +185,7 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
     return jax.jit(
         jax.shard_map(
             local, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P(), P()),
             out_specs=(P(), P()),
         )
     )
@@ -221,6 +266,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
         )
         v, _u = trainer(
             mesh.shard_batch(centers_p), mesh.shard_batch(contexts_p),
+            mesh.shard_batch(np.ones(len(centers_p), np.float32)),
             jnp.asarray(pool), jnp.asarray(v0), jnp.asarray(u0),
             jnp.asarray(self.get(self.LEARNING_RATE), jnp.float32),
             jnp.asarray(n_steps, jnp.int32),
@@ -236,7 +282,19 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
     _PAIR_TILE = 2048
 
     def _fit_stream(self, source) -> "Word2VecModel":
-        """Out-of-core SGNS (see class docstring)."""
+        """Out-of-core SGNS (see class docstring).
+
+        Multi-process (round 4): each process feeds its OWN document
+        partition. The string vocabulary unions through the device
+        fabric — tokens ride as UTF-8 bytes on the f64-exact transport
+        (:func:`_agree_token_counts`) — so every rank holds the
+        identical (token, count) map; pair building then stays
+        rank-local (per-rank deterministic window RNG), and each
+        training dispatch is one agreed-step SGNS run over every rank's
+        resident chunk with psum'd gradients (drained ranks feed
+        zero-weight dummy chunks). The negative pool and embedding init
+        draw from a fresh seed-only RNG so they are identical on every
+        rank; the fitted vectors are identical on every rank."""
         import os
         import shutil
         import tempfile
@@ -256,9 +314,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
                 "(token documents are encoded internally; a raw DataCache "
                 "carries no string vocabulary)"
             )
-        from flinkml_tpu.parallel.distributed import require_single_controller
-
-        require_single_controller("Word2Vec streamed fit")
+        multi = jax.process_count() > 1
         input_col = self.get(self.INPUT_COL)
         min_count = self.get(self.MIN_COUNT)
         window = self.get(self.WINDOW_SIZE)
@@ -282,7 +338,8 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             doc_writer = DataCacheWriter(
                 doc_dir, self.cache_memory_budget_bytes
             )
-            for t in source:
+
+            def ingest_docs(t):
                 docs = _token_column(t, input_col)
                 codes: List[int] = []
                 lengths: List[int] = []
@@ -304,27 +361,71 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
                             [len(lengths)], lengths, codes
                         ]).astype(np.int32),
                     })
+
+            from flinkml_tpu.iteration.stream_sync import (
+                DeferredValidation,
+                checked_ingest,
+            )
+
+            dv = DeferredValidation()
+            for _ in checked_ingest(source, dv, ingest_docs, multi):
+                pass
             doc_cache = doc_writer.finish()
 
-            counts_arr = np.asarray(counts_list, np.int64)
             tokens = np.empty(len(pid), dtype=object)
             for tok, i in pid.items():
                 tokens[i] = tok
-            kept = [i for i in range(len(counts_list))
-                    if counts_arr[i] >= min_count]
-            kept.sort(key=lambda i: (-counts_arr[i], tokens[i]))
-            if not kept:
-                raise ValueError(
-                    f"no token reaches minCount={min_count}; vocabulary "
-                    "is empty"
+            if multi:
+                # Rendezvous BEFORE the vocab union: a held ingest error
+                # must surface as itself on every rank.
+                dv.rendezvous(mesh, "stream ingest validation")
+                merged = _agree_token_counts(
+                    list(tokens), counts_list, mesh
                 )
-            vocab = [tokens[i] for i in kept]
-            final_of_pid = np.full(len(counts_list), -1, np.int32)
-            for f, i in enumerate(kept):
-                final_of_pid[i] = f
+                if not merged:
+                    raise ValueError(
+                        "training stream is empty on every process"
+                    )
+                vocab = [t for t, c in merged.items() if c >= min_count]
+                vocab.sort(key=lambda t: (-merged[t], t))
+                if not vocab:  # merged is identical: symmetric raise
+                    raise ValueError(
+                        f"no token reaches minCount={min_count}; "
+                        "vocabulary is empty"
+                    )
+                final_of_token = {t: f for f, t in enumerate(vocab)}
+                final_of_pid = np.full(len(counts_list), -1, np.int32)
+                for i in range(len(counts_list)):
+                    final_of_pid[i] = final_of_token.get(str(tokens[i]), -1)
+                vocab_counts = np.asarray(
+                    [merged[t] for t in vocab], np.int64
+                )
+            else:
+                counts_arr = np.asarray(counts_list, np.int64)
+                kept = [i for i in range(len(counts_list))
+                        if counts_arr[i] >= min_count]
+                kept.sort(key=lambda i: (-counts_arr[i], tokens[i]))
+                if not kept:
+                    raise ValueError(
+                        f"no token reaches minCount={min_count}; vocabulary "
+                        "is empty"
+                    )
+                vocab = [tokens[i] for i in kept]
+                final_of_pid = np.full(len(counts_list), -1, np.int32)
+                for f, i in enumerate(kept):
+                    final_of_pid[i] = f
+                vocab_counts = counts_arr[kept]
 
             # -- pass B: replay doc cache into the pair cache --------------
-            rng = np.random.default_rng(self.get_seed())
+            # Multi-process: per-rank deterministic window RNG (pairs are
+            # rank-local); the pool/init RNG below is then seed-only so
+            # those draws are identical on every rank.
+            if multi:
+                rng = np.random.default_rng(
+                    [self.get_seed(), 1 + jax.process_index()]
+                )
+            else:
+                rng = np.random.default_rng(self.get_seed())
             pair_writer = DataCacheWriter(
                 self.cache_dir, self.cache_memory_budget_bytes
             )
@@ -356,12 +457,27 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             pair_cache = pair_writer.finish()
         finally:
             shutil.rmtree(doc_dir, ignore_errors=True)
-        if n_pairs == 0:
+        if multi:
+            from flinkml_tpu.iteration.stream_sync import gather_vectors
+
+            total_pairs = int(round(gather_vectors(
+                np.asarray([float(n_pairs)]), mesh
+            ).sum()))
+            if total_pairs == 0:
+                raise ValueError(
+                    "no (center, context) pairs on any process; documents "
+                    "too short"
+                )
+        elif n_pairs == 0:
             raise ValueError("no (center, context) pairs; documents too short")
 
-        # unigram^0.75 negative pool over the FINAL vocab.
-        freq = counts_arr[kept].astype(np.float64) ** 0.75
-        pool = rng.choice(
+        # unigram^0.75 negative pool over the FINAL vocab (seed-only RNG
+        # under multi-process — identical pool/init on every rank).
+        rng_global = (
+            np.random.default_rng(self.get_seed()) if multi else rng
+        )
+        freq = vocab_counts.astype(np.float64) ** 0.75
+        pool = rng_global.choice(
             len(vocab), size=_NEG_POOL, p=freq / freq.sum()
         ).astype(np.int32)
         pool_dev = jnp.asarray(pool)
@@ -381,8 +497,8 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
         start_epoch = 0
         if resume_epoch is None:
             v = jnp.asarray(
-                (rng.random((len(vocab), dim)) - 0.5).astype(np.float32)
-                / dim
+                (rng_global.random((len(vocab), dim)) - 0.5)
+                .astype(np.float32) / dim
             )
         else:
             v = jnp.zeros((len(vocab), dim), jnp.float32)  # restored below
@@ -393,28 +509,84 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             )
             v, u = jnp.asarray(v_h), jnp.asarray(u_h)
 
+        from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+        guard = DispatchGuard()  # multi-process backpressure (no-op single)
+        local_tile = (p // jax.process_count()) * self._PAIR_TILE
         max_iter = self.get(self.MAX_ITER)
         for epoch in range(start_epoch, max_iter):
-            for ci, batch in enumerate(pair_cache.reader()):
-                c, x = batch["c"], batch["x"]
-                rows = max(tile, -(-len(c) // tile) * tile)
-                # Pad by CYCLING real pairs (a zero pad would be a genuine
-                # (0, 0) positive pair — see the in-RAM path's rationale).
-                c_p, x_p = np.resize(c, rows), np.resize(x, rows)
-                steps = max(1, len(c) // batch_size)
-                v, u = trainer(
-                    mesh.shard_batch(c_p), mesh.shard_batch(x_p),
-                    pool_dev, v, u, lr, jnp.asarray(steps, jnp.int32),
-                    jax.random.fold_in(
-                        jax.random.fold_in(base_key, epoch), ci
-                    ),
+            if multi:
+                from flinkml_tpu.iteration.stream_sync import (
+                    agree_max,
+                    synced_stream,
                 )
+
+                # Data-proportional training intensity: distribute the
+                # single-process per-epoch step budget (global pairs /
+                # batch_size) evenly over the agreed dispatch count, so
+                # dummy padding on skewed or drained ranks never
+                # inflates the SGD step count over the real pairs.
+                n_dispatch = max(1, agree_max(pair_cache.num_batches, mesh))
+                steps = max(1, total_pairs // (batch_size * n_dispatch))
+                # Agreed per-dispatch height (tiles ride the step
+                # agreement), so every rank runs the same collectives;
+                # drained ranks feed zero-weight dummy chunks.
+                height_of = lambda b: -(-max(len(b["c"]), 1) // local_tile)
+                for ci, (b, tiles) in enumerate(synced_stream(
+                    pair_cache.reader(), mesh, payload=height_of
+                )):
+                    h = tiles * local_tile
+                    if b is None:
+                        c_p = np.zeros(h, np.int32)
+                        x_p = np.zeros(h, np.int32)
+                        w_p = np.zeros(h, np.float32)
+                    else:
+                        # Pad by CYCLING real pairs (a zero pad would be
+                        # a genuine (0, 0) positive pair).
+                        c_p, x_p = np.resize(b["c"], h), np.resize(b["x"], h)
+                        w_p = np.ones(h, np.float32)
+                    v, u = trainer(
+                        mesh.global_batch(c_p), mesh.global_batch(x_p),
+                        mesh.global_batch(w_p),
+                        pool_dev, v, u, lr,
+                        jnp.asarray(steps, jnp.int32),
+                        jax.random.fold_in(
+                            jax.random.fold_in(base_key, epoch), ci
+                        ),
+                    )
+                    guard.after_dispatch(v)
+            else:
+                for ci, batch in enumerate(pair_cache.reader()):
+                    c, x = batch["c"], batch["x"]
+                    rows = max(tile, -(-len(c) // tile) * tile)
+                    # Pad by CYCLING real pairs (a zero pad would be a
+                    # genuine (0, 0) positive pair — see the in-RAM
+                    # path's rationale).
+                    c_p, x_p = np.resize(c, rows), np.resize(x, rows)
+                    steps = max(1, len(c) // batch_size)
+                    v, u = trainer(
+                        mesh.shard_batch(c_p), mesh.shard_batch(x_p),
+                        mesh.shard_batch(np.ones(rows, np.float32)),
+                        pool_dev, v, u, lr, jnp.asarray(steps, jnp.int32),
+                        jax.random.fold_in(
+                            jax.random.fold_in(base_key, epoch), ci
+                        ),
+                    )
             if should_snapshot(self.checkpoint_manager,
                                self.checkpoint_interval, epoch + 1,
                                max_iter):
-                self.checkpoint_manager.save(
-                    (np.asarray(v), np.asarray(u)), epoch + 1
-                )
+                state = (np.asarray(v), np.asarray(u))
+                if multi:
+                    from flinkml_tpu.iteration.checkpoint import (
+                        save_replicated,
+                    )
+
+                    save_replicated(
+                        self.checkpoint_manager, state, epoch + 1, mesh
+                    )
+                else:
+                    self.checkpoint_manager.save(state, epoch + 1)
+        guard.flush(v)
 
         model = Word2VecModel()
         model.copy_params_from(self)

@@ -162,3 +162,19 @@ def als_local_batches(pid: int, nproc: int):
          "rating": rs[j : j + bs]}
         for j in range(0, len(us), bs)
     ]
+
+
+def w2v_local_docs(pid: int, nproc: int):
+    """Token documents with two co-occurrence groups (a* tokens appear
+    together, b* tokens appear together): fitted vectors must place
+    same-group tokens closer than cross-group ones."""
+    rng = np.random.default_rng(31)
+    group_a = [f"a{i}" for i in range(5)]
+    group_b = [f"b{i}" for i in range(5)]
+    docs = []
+    for i in range(200):
+        g = group_a if i % 2 == 0 else group_b
+        docs.append(list(rng.choice(g, size=6)))
+    mine = [d for j, d in enumerate(docs) if j % nproc == pid]
+    bs = max(4, BATCH_SIZES[pid] // 4)
+    return [mine[i : i + bs] for i in range(0, len(mine), bs)]

@@ -239,6 +239,24 @@ np.testing.assert_allclose(
     osc_std, x_g64.std(axis=0), rtol=1e-9, atol=1e-12
 )
 
+# --- 11. Word2Vec streamed fit (round-4 multi-process: per-process doc
+# partitions; STRING vocabulary unioned through the device fabric as
+# UTF-8 bytes; agreed-step SGNS dispatches with zero-weight dummies).
+from flinkml_tpu.models.word2vec import Word2Vec  # noqa: E402
+
+w2v_doc_batches = C.w2v_local_docs(pid, nproc)
+w2v = (
+    Word2Vec(mesh=mesh).set_input_col("tok").set_vector_size(8)
+    .set_min_count(1).set_max_iter(8).set_learning_rate(2.0)
+    .set_batch_size(512).set_seed(0)
+    .fit(iter(
+        Table({"tok": np.asarray(b, dtype=object)})
+        for b in w2v_doc_batches
+    ))
+)
+w2v_vocab = np.asarray(w2v.vocabulary, dtype=str)
+w2v_vecs = w2v.vectors
+
 np.savez(
     os.path.join(workdir, f"result_{pid}.npz"),
     coef=coef, cents=cents, cents_rand=cents_rand,
@@ -255,5 +273,6 @@ np.savez(
     okm_cents=okm_cents,
     osc_mean=osc_mean, osc_std=osc_std,
     osc_version=np.int64(osc_version),
+    w2v_vocab=w2v_vocab, w2v_vecs=w2v_vecs,
 )
 print(f"STREAM_OK {pid}")

@@ -129,8 +129,18 @@ def test_two_process_streamed_fit(tmp_path):
                 "gmm_weights", "mlp_w0", "gbt_feats", "gbt_leaves",
                 "pca_components", "pca_variances", "lda_topics",
                 "als_user_f", "als_item_f", "olr_coef", "okm_cents",
-                "osc_mean", "osc_std"):
+                "osc_mean", "osc_std", "w2v_vocab", "w2v_vecs"):
         assert np.array_equal(results[0][key], results[1][key]), key
+
+    # Word2Vec: same-group tokens (shared contexts) embed closer than
+    # cross-group ones; the vocabulary is the union of both ranks'.
+    vocab = list(results[0]["w2v_vocab"])
+    assert set(vocab) == {f"{g}{i}" for g in "ab" for i in range(5)}
+    vecs = results[0]["w2v_vecs"]
+    unit = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    a0, a1 = vocab.index("a0"), vocab.index("a1")
+    b0 = vocab.index("b0")
+    assert unit[a0] @ unit[a1] > unit[a0] @ unit[b0]
 
     # ALS: the factors reconstruct the planted low-rank ratings.
     assert float(results[0]["als_rmse"]) < 0.05, results[0]["als_rmse"]

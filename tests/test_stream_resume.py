@@ -251,25 +251,10 @@ def test_kmeans_stream_double_failure_recovery(tmp_path, mesh, crash_epochs):
     np.testing.assert_array_equal(final, golden)
 
 
-def test_streamed_fits_reject_multi_process(mesh, monkeypatch):
-    """The one streamed fit whose host state is not process-partitioned
-    (Word2Vec's string vocabulary + pair cache — a global token union
-    has no device-fabric transport) is single-controller: on a
-    multi-process mesh it must raise the defined error (not die opaquely
-    inside device_put on a non-addressable device). Every other
-    streamed fit — linear/KMeans/GMM/MLP/FM/GBT/PCA/LDA/ALS — is
-    multi-process-capable
-    (tests/test_distributed.py::test_two_process_streamed_fit)."""
-    import jax
-
-    from flinkml_tpu.models.word2vec import Word2Vec
-    from flinkml_tpu.table import Table
-
-    monkeypatch.setattr(jax, "process_count", lambda: 2)
-    with pytest.raises(RuntimeError, match="single-controller"):
-        Word2Vec(mesh=mesh).set_input_col("tok").set_max_iter(1).fit(
-            iter([Table({"tok": np.asarray([["a", "b"]], dtype=object)})])
-        )
+# Round-4 session 3 note: every streamed fit is now multi-process-capable
+# — linear/KMeans/GMM/MLP/FM/GBT/PCA/LDA/ALS/Word2Vec (the former
+# single-controller rejection test lived here; the multi-process behavior
+# is pinned by tests/test_distributed.py::test_two_process_streamed_fit).
 
 
 def test_gbt_stream_resume_after_completion_is_noop(tmp_path, mesh):
