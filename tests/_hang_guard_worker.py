@@ -188,6 +188,51 @@ def case_lda_bad_cached_batch():
 expect_all_ranks_raise("case4b-lda-bad-cache", case_lda_bad_cached_batch)
 
 
+# --- 4c. Online FTRL: the source stream raises on rank 0 mid-lockstep
+# (agree_first_item_dim + synced_padded_stream failure paths).
+def case_online_ftrl_iter_raise():
+    from flinkml_tpu.models.online_logistic_regression import (
+        OnlineLogisticRegression,
+    )
+    from flinkml_tpu.table import Table
+
+    def source():
+        b = good_batch()
+        yield Table({"features": b["x"], "label": b["y"]})
+        if pid == 0:
+            raise IOError("injected stream failure")
+        b = good_batch()
+        yield Table({"features": b["x"], "label": b["y"]})
+
+    OnlineLogisticRegression(mesh=mesh).fit_stream(source())
+
+
+expect_all_ranks_raise("case4c-ftrl-iter", case_online_ftrl_iter_raise)
+
+
+# --- 4d. Word2Vec: a bad document batch on rank 0 (missing token
+# column) must ride the ingest rendezvous, not raise rank-locally
+# before the vocabulary-union collective.
+def case_w2v_bad_batch():
+    from flinkml_tpu.models.word2vec import Word2Vec
+    from flinkml_tpu.table import Table
+
+    docs = np.asarray([["a", "b", "a", "c"]] * 4, dtype=object)
+    batches = [Table({"tok": docs})]
+    if pid == 0:
+        batches.append(Table({"wrong_col": docs}))
+    else:
+        batches.append(Table({"tok": docs}))
+    (
+        Word2Vec(mesh=mesh).set_input_col("tok").set_vector_size(4)
+        .set_min_count(1).set_max_iter(1).set_seed(0)
+        .fit(iter(batches))
+    )
+
+
+expect_all_ranks_raise("case4d-w2v-bad-batch", case_w2v_bad_batch)
+
+
 # --- 5. GBT straddled-checkpoint resume (rank-scoped snapshots).
 gbt_args = dict(
     mesh=mesh, logistic=True, num_trees=3, depth=2, max_bins=8,
