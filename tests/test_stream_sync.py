@@ -112,3 +112,42 @@ def test_synced_stream_single_process_propagates_iterator_error(mesh):
     assert next(it).shape == (2, 2)
     with pytest.raises(IOError, match="injected"):
         next(it)
+
+
+def test_synced_padded_stream_pads_and_masks(mesh):
+    from flinkml_tpu.iteration.stream_sync import synced_padded_stream
+
+    items = [
+        (np.ones((5, 3), np.float32), np.arange(5, dtype=np.float32)),
+        (np.ones((9, 3), np.float32), np.arange(9, dtype=np.float32)),
+    ]
+    out = list(synced_padded_stream(
+        iter(items), mesh, check=None, row_tile=8,
+        dummy_cols=((3,), ()),
+    ))
+    assert len(out) == 2
+    (x0, y0), w0, h0 = out[0]
+    assert h0 == 8 and x0.shape == (8, 3) and y0.shape == (8,)
+    assert w0.tolist() == [1.0] * 5 + [0.0] * 3
+    assert np.all(x0[5:] == 0.0) and np.all(y0[5:] == 0.0)
+    (x1, _y1), w1, h1 = out[1]
+    assert h1 == 16 and x1.shape == (16, 3)
+    assert w1.sum() == 9.0
+
+
+def test_agree_id_vocab_single_process_identity(mesh):
+    from flinkml_tpu.models.als import _agree_id_vocab
+
+    ids = _agree_id_vocab(np.asarray([7, 3, 3, 11], np.int64), mesh)
+    assert ids.dtype == np.int64
+    assert ids.tolist() == [3, 7, 11]
+    f = _agree_id_vocab(np.asarray([2.5, 1.5]), mesh)
+    assert f.dtype == np.float64 and f.tolist() == [1.5, 2.5]
+
+
+def test_agree_token_counts_single_process_identity(mesh):
+    from flinkml_tpu.models.word2vec import _agree_token_counts
+
+    merged = _agree_token_counts(["béta", "alpha"], [3, 5], mesh)
+    assert merged == {"béta": 3, "alpha": 5}
+    assert _agree_token_counts([], [], mesh) == {}
