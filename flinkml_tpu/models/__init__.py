@@ -125,6 +125,7 @@ from flinkml_tpu.models.string_indexer import (
     StringIndexer,
     StringIndexerModel,
 )
+from flinkml_tpu.models.sql_transformer import SQLTransformer
 from flinkml_tpu.models.vector_assembler import VectorAssembler
 from flinkml_tpu.models.evaluation import BinaryClassificationEvaluator
 from flinkml_tpu.models.evaluation_multi import (
@@ -219,6 +220,7 @@ __all__ = [
     "StringIndexer",
     "StringIndexerModel",
     "IndexToStringModel",
+    "SQLTransformer",
     "VectorAssembler",
     "BinaryClassificationEvaluator",
     "FeatureHasher",

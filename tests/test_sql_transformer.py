@@ -1,0 +1,127 @@
+"""SQLTransformer tests — the restricted SELECT surface (upstream
+flink-ml's SQLTransformer runs full Flink SQL; this one parses and
+vectorizes the pipeline-relevant subset, loudly rejecting the rest)."""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu.models import SQLTransformer
+from flinkml_tpu.table import Table
+
+
+def _t():
+    return Table({
+        "a": np.asarray([1.0, 2.0, 3.0, 4.0]),
+        "b": np.asarray([10.0, 20.0, 30.0, 40.0]),
+        "name": np.asarray(["w", "x", "y", "z"]),
+        "vec": np.arange(8.0).reshape(4, 2),
+    })
+
+
+def _sql(stmt):
+    return SQLTransformer().set_statement(stmt)
+
+
+def test_star_passthrough():
+    (out,) = _sql("SELECT * FROM __THIS__").transform(_t())
+    assert set(out.column_names) == {"a", "b", "name", "vec"}
+    np.testing.assert_array_equal(out.column("a"), [1.0, 2.0, 3.0, 4.0])
+
+
+def test_arithmetic_alias_and_functions():
+    (out,) = _sql(
+        "SELECT *, (a + b) / 2 AS mean_ab, SQRT(b) AS rb, "
+        "POW(a, 2) AS a2 FROM __THIS__"
+    ).transform(_t())
+    np.testing.assert_allclose(out.column("mean_ab"), [5.5, 11, 16.5, 22])
+    np.testing.assert_allclose(out.column("rb"), np.sqrt([10, 20, 30, 40]))
+    np.testing.assert_allclose(out.column("a2"), [1, 4, 9, 16])
+
+
+def test_default_output_name_is_expression():
+    (out,) = _sql("SELECT a * 2 FROM __THIS__").transform(_t())
+    assert out.column_names == ["a * 2"]
+    np.testing.assert_allclose(out.column("a * 2"), [2, 4, 6, 8])
+
+
+def test_where_filters_all_columns_including_vectors():
+    (out,) = _sql(
+        "SELECT * FROM __THIS__ WHERE a >= 2 AND NOT (b = 30)"
+    ).transform(_t())
+    np.testing.assert_array_equal(out.column("a"), [2.0, 4.0])
+    assert out.column("name").tolist() == ["x", "z"]
+    np.testing.assert_array_equal(
+        out.column("vec"), np.asarray([[2.0, 3.0], [6.0, 7.0]])
+    )
+
+
+def test_operator_precedence_and_unary_minus():
+    (out,) = _sql("SELECT a + b * 2 AS e, -a AS m FROM __THIS__").transform(
+        _t()
+    )
+    np.testing.assert_allclose(out.column("e"), [21, 42, 63, 84])
+    np.testing.assert_allclose(out.column("m"), [-1, -2, -3, -4])
+
+
+def test_bare_column_projection_keeps_vector_and_string():
+    (out,) = _sql("SELECT name, vec, a AS aa FROM __THIS__").transform(_t())
+    assert out.column("vec").shape == (4, 2)
+    assert out.column("name").tolist() == ["w", "x", "y", "z"]
+    np.testing.assert_array_equal(out.column("aa"), [1.0, 2.0, 3.0, 4.0])
+
+
+@pytest.mark.parametrize("stmt,match", [
+    ("UPDATE x SET y = 1", "supports 'SELECT"),
+    ("SELECT q FROM __THIS__", "unknown column"),
+    ("SELECT name + 1 FROM __THIS__", "not a 1-D numeric"),
+    ("SELECT FOO(a) FROM __THIS__", "unknown function"),
+    ("SELECT a FROM __THIS__ WHERE a + 1", "boolean row predicate"),
+    ("SELECT a b c FROM __THIS__", "trailing tokens"),
+])
+def test_rejects_unsupported(stmt, match):
+    with pytest.raises(ValueError, match=match):
+        _sql(stmt).transform(_t())
+
+
+def test_save_load_roundtrip(tmp_path):
+    est = _sql("SELECT a * 2 AS d FROM __THIS__")
+    est.save(str(tmp_path / "sql"))
+    loaded = SQLTransformer.load(str(tmp_path / "sql"))
+    (out,) = loaded.transform(_t())
+    np.testing.assert_allclose(out.column("d"), [2, 4, 6, 8])
+
+
+def test_in_pipeline():
+    from flinkml_tpu.pipeline import Pipeline
+    from flinkml_tpu.models import StandardScaler, VectorAssembler
+
+    stages = [
+        _sql("SELECT *, a * b AS ab FROM __THIS__ WHERE a < 4"),
+        VectorAssembler().set_input_cols(["a", "ab"]).set_output_col("f"),
+        StandardScaler().set_input_col("f").set_output_col("s"),
+    ]
+    model = Pipeline(stages).fit(_t())
+    (out,) = model.transform(_t())
+    assert out.column("s").shape == (3, 2)
+
+
+def test_constant_columns_and_constant_where():
+    (out,) = _sql(
+        "SELECT a, 1 AS one FROM __THIS__ WHERE 1 = 1"
+    ).transform(_t())
+    np.testing.assert_array_equal(out.column("one"), [1.0] * 4)
+    np.testing.assert_array_equal(out.column("a"), [1.0, 2.0, 3.0, 4.0])
+
+
+def test_where_filters_before_projection():
+    """SQL semantics: a / b WHERE b <> 0 never divides by the excluded
+    zeros (no warning, no inf in the result)."""
+    t = Table({
+        "a": np.asarray([6.0, 8.0, 9.0]),
+        "b": np.asarray([2.0, 0.0, 3.0]),
+    })
+    with np.errstate(divide="raise"):
+        (out,) = _sql(
+            "SELECT a / b AS r FROM __THIS__ WHERE b != 0"
+        ).transform(t)
+    np.testing.assert_allclose(out.column("r"), [3.0, 3.0])
