@@ -132,6 +132,31 @@ def worker(workdir: str) -> None:
     acc = float(np.mean((x_local @ coef_host > 0) == y_local))
     assert acc > 0.9, f"host {pid}: failed to learn (acc={acc})"
 
+    # 4b. The estimator catalog trains the same way (round 4: EVERY
+    # streamed and online fit accepts per-process stream partitions —
+    # agreed SPMD schedules, vocabulary/moment agreements through the
+    # device fabric, failure agreement instead of hangs). One example of
+    # each flavor on this pod:
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+    from flinkml_tpu.models.online_logistic_regression import (
+        OnlineLogisticRegression,
+    )
+    from flinkml_tpu.table import Table
+
+    cents = train_kmeans_stream(
+        iter({"x": x_local[s : s + 64]} for s in range(0, len(x_local), 64)),
+        k=4, mesh=mesh, max_iter=3, seed=0,
+    )
+    assert np.isfinite(cents).all()
+    log("streamed KMeans over per-host partitions done")
+    olr_model = OnlineLogisticRegression(mesh=mesh).fit_stream(iter(
+        Table({"features": x_local[s : s + 64],
+               "label": y_local[s : s + 64].astype(np.float64)})
+        for s in range(0, len(x_local), 64)
+    ))
+    assert np.isfinite(olr_model.coefficient).all()
+    log("online FTRL over per-host streams done")
+
     # 5. Barrier-ordered checkpoint commit (two-phase: shards → barrier →
     # manifest by host 0 → barrier → visible everywhere).
     shard_path = os.path.join(workdir, f"coef-shard-{pid}.npy")
