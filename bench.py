@@ -559,8 +559,13 @@ def main():
     # Hold the single-tenant device mutex across ALL device stages: two
     # concurrent clients wedged the tunnel for 8+ hours in round 2
     # (BASELINE.md). Children inherit the held marker via os.environ.
+    # Wait up to 900 s for the lock, clamped to the remaining total
+    # budget: the holder may be tools/device_watch.sh mid-capture on a
+    # freshly healed tunnel, and inheriting the healthy device after it
+    # finishes beats skipping to the CPU fallback.
+    lock_wait = min(900.0, max(0.0, deadline - time.monotonic() - 40))
     try:
-        with device_client_lock(timeout_s=120.0):
+        with device_client_lock(timeout_s=lock_wait):
             if _hunt_device(deadline, probe_timeout, probe_spacing) is not None:
                 for i, name in enumerate(stage_order):
                     results[name], stage_timed_out = _run_stage(
