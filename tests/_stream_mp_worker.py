@@ -194,6 +194,18 @@ pred = np.sum(
 )
 als_rmse = float(np.sqrt(np.mean((pred - ar) ** 2)))
 
+# --- 9b. an EMPTY ratings partition is legal: the empty rank adopts the
+# agreed vocabularies and dispatches only dummy chunks; factors still
+# replicate.
+als_empty = (
+    ALS(mesh=mesh).set_rank(C.ALS_RANK).set_max_iter(2)
+    .set_reg_param(0.01).set_seed(0)
+    .fit(iter(Table(b) for b in
+              (C.als_local_batches(pid, nproc) if pid == 0 else [])))
+)
+als_empty_uf = als_empty._user_factors
+als_empty_if = als_empty._item_factors
+
 # --- 10. Online (unbounded) operators, round-4 multi-process: FTRL and
 # decayed KMeans run psum'd lockstep steps per arriving batch (uneven
 # per-rank batch counts force the zero-weight dummy tail); the scaler
@@ -257,6 +269,19 @@ w2v = (
 w2v_vocab = np.asarray(w2v.vocabulary, dtype=str)
 w2v_vecs = w2v.vectors
 
+# --- 11b. an EMPTY document partition is legal: the empty rank adopts
+# the agreed (unioned) vocabulary and feeds only zero-weight dummy
+# chunks; vectors still replicate.
+w2v_empty = (
+    Word2Vec(mesh=mesh).set_input_col("tok").set_vector_size(8)
+    .set_min_count(1).set_max_iter(2).set_seed(0)
+    .fit(iter(
+        Table({"tok": np.asarray(b, dtype=object)})
+        for b in (w2v_doc_batches if pid == 0 else [])
+    ))
+)
+w2v_empty_vecs = w2v_empty.vectors
+
 np.savez(
     os.path.join(workdir, f"result_{pid}.npz"),
     coef=coef, cents=cents, cents_rand=cents_rand,
@@ -274,5 +299,7 @@ np.savez(
     osc_mean=osc_mean, osc_std=osc_std,
     osc_version=np.int64(osc_version),
     w2v_vocab=w2v_vocab, w2v_vecs=w2v_vecs,
+    als_empty_uf=als_empty_uf, als_empty_if=als_empty_if,
+    w2v_empty_vecs=w2v_empty_vecs,
 )
 print(f"STREAM_OK {pid}")

@@ -129,7 +129,8 @@ def test_two_process_streamed_fit(tmp_path):
                 "gmm_weights", "mlp_w0", "gbt_feats", "gbt_leaves",
                 "pca_components", "pca_variances", "lda_topics",
                 "als_user_f", "als_item_f", "olr_coef", "okm_cents",
-                "osc_mean", "osc_std", "w2v_vocab", "w2v_vecs"):
+                "osc_mean", "osc_std", "w2v_vocab", "w2v_vecs",
+                "als_empty_uf", "als_empty_if", "w2v_empty_vecs"):
         assert np.array_equal(results[0][key], results[1][key]), key
 
     # Word2Vec: same-group tokens (shared contexts) embed closer than
