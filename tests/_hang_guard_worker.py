@@ -305,4 +305,57 @@ def case_corrupt_restore():
 
 expect_all_ranks_raise("case5c-corrupt", case_corrupt_restore)
 
+
+# 5d. REAL crash-injection resume on the 2-rank mesh: the rank-scoped
+# manager raises after durably writing tree 2's snapshot, crashing the
+# fit as an AGREED abort (save_agreed holds the save failure and every
+# rank raises together); the resumed fit rebuilds tree 3 to reproduce
+# the uninterrupted forest exactly. The injection wraps rank_scoped
+# because the GBT path constructs its per-rank manager through it.
+def case5d_crash_resume():
+    import flinkml_tpu.iteration.checkpoint as ckpt_mod
+
+    ckpt = os.path.join(workdir, "ckpt_crashinject")
+    os.makedirs(ckpt, exist_ok=True)
+
+    class Crash(CheckpointManager):
+        fired = False
+
+        def save(self, state, epoch, extra=None):
+            p = super().save(state, epoch, extra)
+            if not Crash.fired and epoch >= 2:
+                Crash.fired = True
+                raise RuntimeError("injected crash")
+            return p
+
+    orig_rank_scoped = ckpt_mod.rank_scoped
+
+    def crashing_rank_scoped(manager):
+        inner = orig_rank_scoped(manager)
+        return Crash(
+            inner.directory, max_to_keep=inner.max_to_keep,
+            allow_rescale=inner.allow_rescale,
+            world_size=inner.world_size, async_write=inner.async_write,
+        )
+
+    ckpt_mod.rank_scoped = crashing_rank_scoped
+    try:
+        train_gbt_stream(
+            gbt_cache,
+            checkpoint_manager=CheckpointManager(ckpt, max_to_keep=3),
+            checkpoint_interval=1, **gbt_args,
+        )
+        raise SystemExit(f"case5d: rank {pid} did NOT crash")
+    except RuntimeError as e:
+        assert "injected crash" in str(e), e
+    finally:
+        ckpt_mod.rank_scoped = orig_rank_scoped
+    recovered = resume_fit(ckpt)
+    for a, b in zip(golden, recovered):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), "crash resume"
+    print(f"case5d-crash-resume: rank {pid} resumed exactly", flush=True)
+
+
+case5d_crash_resume()
+
 print(f"GUARD_OK {pid}", flush=True)
