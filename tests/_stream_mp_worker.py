@@ -84,7 +84,7 @@ cents_rand = train_kmeans_stream(
 assert np.all(np.isfinite(cents)) and np.all(np.isfinite(cents_rand))
 
 # --- 3b. an EMPTY local partition is legal (that rank feeds only dummy
-# steps; pooled init draws entirely from the other rank's reservoir).
+# steps; pooled init draws entirely from the non-empty ranks).
 cents_empty = train_kmeans_stream(
     iter(x_batches if pid == 0 else []),
     k=C.K_CLUSTERS, mesh=mesh, **C.KMEANS_HP,

@@ -106,6 +106,17 @@ def test_two_process_streamed_fit(tmp_path):
     equivalence contract of `iteration/stream_sync.py`. Reference: the
     partitioned-stream training the reference runs across TaskManagers
     (`ReplayOperator.java:62-250`, `LogisticRegression.java:334-386`)."""
+    _streamed_fit_check(tmp_path, nproc=2, local_devices=2)
+
+
+def test_four_process_streamed_fit(tmp_path):
+    """The same full streamed/online catalog on a 4-process pod: the
+    agreement layer (schedules, vocab unions, pooled init, failure
+    agreement) is not a pairwise special case."""
+    _streamed_fit_check(tmp_path, nproc=4, local_devices=1)
+
+
+def _streamed_fit_check(tmp_path, nproc, local_devices):
     import sys
 
     import numpy as np
@@ -116,13 +127,14 @@ def test_two_process_streamed_fit(tmp_path):
     from flinkml_tpu.models.kmeans import train_kmeans_stream
 
     workdir = _launch_multiprocess_workers(
-        tmp_path, local_devices=2,
+        tmp_path, local_devices=local_devices,
         worker_script="_stream_mp_worker.py",
-        ok_token="STREAM_OK", check_artifacts=False,
+        ok_token="STREAM_OK", check_artifacts=False, n_procs=nproc,
+        timeout_s=180 * max(1, nproc // 2),
     )
 
     results = [
-        np.load(workdir / f"result_{p}.npz") for p in range(2)
+        np.load(workdir / f"result_{p}.npz") for p in range(nproc)
     ]
     # (a) replicated training state: every rank fitted the same model.
     for key in ("coef", "cents", "cents_rand", "cents_empty", "gmm_means",
@@ -131,10 +143,14 @@ def test_two_process_streamed_fit(tmp_path):
                 "als_user_f", "als_item_f", "olr_coef", "okm_cents",
                 "osc_mean", "osc_std", "w2v_vocab", "w2v_vecs",
                 "als_empty_uf", "als_empty_if", "w2v_empty_vecs"):
-        assert np.array_equal(results[0][key], results[1][key]), key
+        for p in range(1, nproc):
+            assert np.array_equal(results[0][key], results[p][key]), (
+                key, p
+            )
 
     # Word2Vec: same-group tokens (shared contexts) embed closer than
-    # cross-group ones; the vocabulary is the union of both ranks'.
+    # cross-group ones; the vocabulary is the union of ALL ranks'
+    # partitions.
     vocab = list(results[0]["w2v_vocab"])
     assert set(vocab) == {f"{g}{i}" for g in "ab" for i in range(5)}
     vecs = results[0]["w2v_vecs"]
@@ -154,11 +170,11 @@ def test_two_process_streamed_fit(tmp_path):
     )
     assert acc > 0.8, acc
     max_batches = max(
-        len(C.local_batches(p, 2)) for p in range(2)
+        len(C.local_batches(p, nproc)) for p in range(nproc)
     )
     assert int(results[0]["olr_version"]) == max_batches
     assert int(results[0]["osc_version"]) == sum(
-        len(C.local_batches(p, 2)) for p in range(2)
+        len(C.local_batches(p, nproc)) for p in range(nproc)
     )
 
     # GMM: pooled moments + pooled init recover the planted components.
@@ -178,13 +194,13 @@ def test_two_process_streamed_fit(tmp_path):
     # (b) single-process equivalence on the concatenated-step stream.
     mesh = DeviceMesh()
     exp_coef = train_linear_model_stream(
-        iter(C.combined_batches(2)), mesh=mesh, **C.LINEAR_HP
+        iter(C.combined_batches(nproc)), mesh=mesh, **C.LINEAR_HP
     )
     np.testing.assert_allclose(
         results[0]["coef"], exp_coef, rtol=2e-4, atol=2e-5
     )
     exp_cents = train_kmeans_stream(
-        iter({"x": b["x"]} for b in C.combined_batches(2)),
+        iter({"x": b["x"]} for b in C.combined_batches(nproc)),
         k=C.K_CLUSTERS, mesh=mesh,
         initial_centroids=C.initial_centroids(), **C.KMEANS_HP,
     )
@@ -212,6 +228,7 @@ def test_two_process_rank_local_failures_abort_all_ranks(tmp_path):
 def _launch_multiprocess_workers(
     tmp_path, local_devices, worker_script="_dist_worker.py",
     ok_token="WORKER_OK", check_artifacts=True, n_procs=2,
+    timeout_s=180,
 ):
     import shutil
     import socket
@@ -251,7 +268,7 @@ def _launch_multiprocess_workers(
         outputs = []
         try:
             for p in procs:
-                out, _ = p.communicate(timeout=180)
+                out, _ = p.communicate(timeout=timeout_s)
                 outputs.append(out)
         except subprocess.TimeoutExpired:
             # Keep what the finished ranks printed — that is the evidence
