@@ -609,6 +609,11 @@ def main():
         # Device unreachable: still emit one JSON line so the driver
         # records something, but under a DIFFERENT metric name so a CPU
         # fallback can never be mistaken for a per-chip measurement.
+        _log(
+            "note: a CPU fallback reflects THIS run's tunnel state only — "
+            "check BASELINE.md's round tunnel log for device evidence "
+            "captured in earlier healthy windows of the same round."
+        )
         metric = "logreg_train_samples_per_sec_cpu_fallback"
         device_sps = cpu_sps
     else:

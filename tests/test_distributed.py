@@ -130,7 +130,7 @@ def _streamed_fit_check(tmp_path, nproc, local_devices):
         tmp_path, local_devices=local_devices,
         worker_script="_stream_mp_worker.py",
         ok_token="STREAM_OK", check_artifacts=False, n_procs=nproc,
-        timeout_s=180 * max(1, nproc // 2),
+        timeout_s=90 * nproc,
     )
 
     results = [
@@ -271,9 +271,19 @@ def _launch_multiprocess_workers(
                 out, _ = p.communicate(timeout=timeout_s)
                 outputs.append(out)
         except subprocess.TimeoutExpired:
-            # Keep what the finished ranks printed — that is the evidence
-            # for diagnosing which rank wedged.
-            outputs += ["<timeout>"] * (n_procs - len(outputs))
+            # Kill the stragglers, then drain EVERY remaining pipe:
+            # ranks after the wedged one may have finished and printed —
+            # that output is the evidence for diagnosing which rank
+            # wedged.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            while len(outputs) < n_procs:
+                try:
+                    out, _ = procs[len(outputs)].communicate(timeout=5)
+                except Exception:  # noqa: BLE001 — diagnostics only
+                    out = "<timeout>"
+                outputs.append(out)
         finally:
             for p in procs:
                 if p.poll() is None:
