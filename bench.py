@@ -1,6 +1,13 @@
-"""Benchmark: LogisticRegression training throughput (samples/sec/chip).
+"""Benchmark: LogisticRegression training throughput (samples/sec/chip)
+plus epochs-to-converge — both halves of BASELINE.json's metric.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Emits JSON lines of the shape {"metric", "value", "unit", "vs_baseline",
+"extras"}; the LAST line on stdout is the result. A provisional line
+(CPU fallback + hardware-independent epochs-to-tol + a pointer to the
+newest committed device capture) prints BEFORE any tunnel contact, so a
+driver kill mid-hunt still leaves a parseable artifact — rounds 1-4 all
+ended rc=124 with nothing on stdout; this is the fix. The final line
+re-prints with per-chip numbers when the device phase succeeds.
 
 The north-star metric (BASELINE.json): samples/sec/chip for
 LogisticRegression.fit. The reference publishes no numbers (BASELINE.md), so
@@ -30,9 +37,11 @@ bytes/step and flops/step (not just a wall clock) is in BASELINE.md
 ("Roofline" section).
 """
 
+import glob
 import json
 import math
 import os
+import re
 import subprocess
 import sys
 import time
@@ -41,6 +50,17 @@ import numpy as np
 
 _INNER_ENV = "_FLINKML_BENCH_INNER"
 _CACHE_DIR = "/tmp/jax_bench_cache"
+
+
+def _force_cpu():
+    """Pin this (child) process to the host CPU backend. The axon TPU
+    plugin prepends itself to ``jax_platforms`` at import time, overriding
+    the JAX_PLATFORMS env var, so stages that must never touch the tunnel
+    (the provisional convergence run) force CPU via config as well."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def make_data(n, dim, seed=0, dtype=np.float32):
@@ -64,14 +84,12 @@ def _setup_jax_cache():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
-def bench_tpu(x, y, w, global_batch_size, n_steps):
-    """Steady-state training throughput with the dataset resident in HBM —
-    the analog of the reference's steady state, which trains from data
-    cached in ListState (LogisticRegression.java:375-376) after epoch 0.
-
-    Timing: one dispatch of the whole training loop, synchronized by
-    materializing the result on host (np.asarray) — block_until_ready alone
-    is NOT reliable over this image's tunneled device (BASELINE.md)."""
+def _dense_trainer_setup(x, y, w, global_batch_size, tol):
+    """Shared setup for the dense throughput and convergence measurements:
+    mesh, product-path sharding and batch alignment (round-1 finding: a
+    hand-computed local_bs here could disagree with the product program),
+    trainer, initial carry, and the hyperparameter args. One definition so
+    the two measurements can never drift onto different programs."""
     import jax.numpy as jnp
     from flinkml_tpu.models import _linear_sgd
     from flinkml_tpu.models.logistic_regression import (
@@ -83,8 +101,6 @@ def bench_tpu(x, y, w, global_batch_size, n_steps):
     mesh = DeviceMesh()
     p = mesh.axis_size()
     xd, yd, wd = _shard_training_data(x, y, w, mesh)
-    # Same batch alignment as the product fit path (round-1 finding: a
-    # hand-computed local_bs here could disagree with the product program).
     local_bs = _linear_sgd.align_local_bs(
         global_batch_size, p, xd.shape[0] // p
     )
@@ -95,7 +111,23 @@ def bench_tpu(x, y, w, global_batch_size, n_steps):
         jnp.asarray(0, jnp.int32),
         jnp.asarray(jnp.inf, xd.dtype),
     )
-    args = (xd, yd, wd, f32(0.1), f32(0.0), f32(0.0), f32(0.0))
+    args = (xd, yd, wd, f32(0.1), f32(0.0), f32(0.0), f32(tol))
+    return trainer, carry0, args, local_bs, p
+
+
+def bench_tpu(x, y, w, global_batch_size, n_steps):
+    """Steady-state training throughput with the dataset resident in HBM —
+    the analog of the reference's steady state, which trains from data
+    cached in ListState (LogisticRegression.java:375-376) after epoch 0.
+
+    Timing: one dispatch of the whole training loop, synchronized by
+    materializing the result on host (np.asarray) — block_until_ready alone
+    is NOT reliable over this image's tunneled device (BASELINE.md)."""
+    import jax.numpy as jnp
+
+    trainer, carry0, args, local_bs, p = _dense_trainer_setup(
+        x, y, w, global_batch_size, tol=0.0
+    )
     _log("compiling + warm-up dispatch ...")
     np.asarray(trainer(*carry0, *args, jnp.asarray(10, jnp.int32))[0])
     _log("measuring ...")
@@ -164,6 +196,40 @@ def bench_tpu_sparse(indptr, indices, values, dim, y, w,
             "measurement invalid"
         )
     return sum(local_bss) * p * steps_ran / elapsed
+
+
+def bench_convergence(x, y, w, global_batch_size, tol, max_steps):
+    """Epochs/wall-clock to convergence — the other half of BASELINE.json's
+    north-star metric ("samples/sec/chip + epochs-to-converge").
+
+    Runs the SAME whole-loop device program as :func:`bench_tpu` but with a
+    positive ``tol``: the on-device while_loop exits as soon as the epoch's
+    mean logistic loss reaches ``tol`` (TerminateOnMaxIterOrTol semantics —
+    the contract `LogisticRegressionTest.java:60-90` pins at fixture scale).
+    Returns ``(steps_ran, elapsed_s)``; the caller converts steps to epochs
+    via ``steps * global_batch_size / n``."""
+    import jax.numpy as jnp
+
+    trainer, carry0, args, _, _ = _dense_trainer_setup(
+        x, y, w, global_batch_size, tol
+    )
+    _log("converge: compiling + warm-up dispatch ...")
+    np.asarray(trainer(*carry0, *args, jnp.asarray(2, jnp.int32))[0])
+    _log("converge: measuring steps-to-tol ...")
+    start = time.perf_counter()
+    coef_out, steps_out, loss_out = trainer(
+        *carry0, *args, jnp.asarray(max_steps, jnp.int32)
+    )
+    np.asarray(coef_out)
+    elapsed = time.perf_counter() - start
+    steps_ran = int(steps_out)
+    final_loss = float(loss_out)
+    if steps_ran >= max_steps or not math.isfinite(final_loss):
+        raise RuntimeError(
+            f"did not converge: steps={steps_ran}/{max_steps} "
+            f"loss={final_loss} tol={tol}"
+        )
+    return steps_ran, elapsed
 
 
 def bench_reference_style_cpu(x, y, w, global_batch_size, budget_s=10.0):
@@ -394,34 +460,115 @@ def _inner_word2vec() -> float:
     return local_bs * mesh.axis_size() * steps / elapsed
 
 
-def _inner_kmeans_stream() -> float:
-    """Stage: the streamed out-of-core KMeans path at the kmeans stage's
-    shape — same Lloyd math, but batch-replayed through the datacache +
-    prefetching device feed instead of whole-loop-on-device. The ratio
-    vs `kmeans_points_per_sec_per_chip` is the measured streaming
-    overhead (feed pipeline + per-batch dispatch + host accumulate)."""
-    _setup_jax_cache()
-    from flinkml_tpu.iteration.datacache import cache_stream
-    from flinkml_tpu.models.kmeans import train_kmeans_stream
-    from flinkml_tpu.parallel import DeviceMesh
+def _inner_feed_overlap(n_batches=32, bs=8_192, dim=128, k=512,
+                        inner_iters=256) -> dict:
+    """Stage: feed-overlap efficiency — the architecture-meaningful
+    replacement for the retired ``kmeans_stream`` device stage (which
+    measured 160 synchronous per-batch round trips over the tunnel,
+    i.e. WAN latency, not the framework — VERDICT r4 "weak" #4).
 
-    n, dim, k, iters, batch = 262_144, 128, 64, 20, 32_768
+    Measures ``fed_s / resident_s``: wall clock to push N large batches
+    through a compute-heavy jitted step when batches arrive via the
+    PrefetchingDeviceFeed (host -> device copy on a worker thread,
+    overlapped with compute) vs. when they are pre-resident in HBM.
+    Both modes dispatch per batch WITHOUT intermediate synchronization
+    (one materialization at the end), so link latency appears once, not
+    per batch; the step is sized so compute per batch dominates transfer
+    at any plausible link bandwidth. A ratio near 1.0 means the feed
+    pipeline fully hides the copy; the gap above 1.0 is the framework's
+    streaming overhead (queue handoff + unhidden copy tail)."""
+    _setup_jax_cache()
+    import jax
+    import jax.numpy as jnp
+    from flinkml_tpu.iteration.datacache import PrefetchingDeviceFeed
+
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(n, dim)).astype(np.float32)
-    cache = cache_stream(
-        iter({"x": x[s:s + batch]} for s in range(0, n, batch))
+    host_batches = [
+        rng.normal(size=(bs, dim)).astype(np.float32)
+        for _ in range(n_batches)
+    ]
+    cent0 = jnp.asarray(rng.normal(size=(k, dim)).astype(np.float32))
+
+    @jax.jit
+    def step(x, c):
+        xsq = (x * x).sum(1, keepdims=True)
+
+        def one(c, _):
+            d = xsq - 2.0 * (x @ c.T) + (c * c).sum(1)[None, :]
+            oh = jax.nn.one_hot(jnp.argmin(d, axis=1), c.shape[0],
+                                dtype=x.dtype)
+            counts = oh.sum(0)[:, None]
+            newc = (oh.T @ x) / jnp.maximum(counts, 1.0)
+            return jnp.where(counts > 0, newc, c), None
+
+        c, _ = jax.lax.scan(one, c, None, length=inner_iters)
+        return c
+
+    _log("feed_overlap: compiling + warm-up dispatch ...")
+    np.asarray(step(jnp.asarray(host_batches[0]), cent0))
+
+    def run(batch_iter):
+        start = time.perf_counter()
+        c = cent0
+        for b in batch_iter:
+            c = step(b, c)
+        np.asarray(c)  # single synchronization: latency appears once
+        return time.perf_counter() - start
+
+    _log("feed_overlap: resident pass ...")
+    dev_batches = [jax.device_put(b) for b in host_batches]
+    jax.block_until_ready(dev_batches)
+    resident_s = run(dev_batches)
+    del dev_batches
+    _log("feed_overlap: fed pass ...")
+    feed = PrefetchingDeviceFeed(iter(host_batches), depth=2)
+    try:
+        fed_s = run(feed)
+    finally:
+        feed.close()
+    return {
+        "ratio": round(fed_s / resident_s, 3),
+        "resident_s": round(resident_s, 3),
+        "fed_s": round(fed_s, 3),
+    }
+
+
+# Epoch-mean logistic-loss target for the convergence stage. Calibrated on
+# the seeded a9a-shaped config (CPU, f32): loss 0.599 after 1 epoch, 0.219
+# after 25, 0.169 after 50 — tol 0.20 lands at ~30 epochs: long enough to
+# be a convergence measurement, short enough to fit any stage cap.
+_CONVERGE_TOL = 0.20
+
+
+def _converge_stage() -> dict:
+    """Stage: dense LR epochs/wall-to-converge on the a9a-shaped config
+    (n=65_536, d=123, global batch 8_192), seeded, to fixed tol. Steps
+    and epochs are hardware-independent (same seeded program); wall_s is
+    the device's half of the metric."""
+    _setup_jax_cache()
+    n, dim, gbs = 65_536, 123, 8_192
+    x, y, w = make_data(n, dim)
+    steps, wall = bench_convergence(
+        x, y, w, gbs, tol=_CONVERGE_TOL, max_steps=4_000
     )
-    mesh = DeviceMesh()
-    init = np.ascontiguousarray(x[rng.choice(n, size=k, replace=False)])
-    _log("kmeans_stream: compiling + warm-up pass ...")
-    train_kmeans_stream(cache, k=k, mesh=mesh, max_iter=1, seed=0,
-                        initial_centroids=init)
-    _log("kmeans_stream: measuring ...")
-    start = time.perf_counter()
-    train_kmeans_stream(cache, k=k, mesh=mesh, max_iter=iters, seed=0,
-                        initial_centroids=init)
-    elapsed = time.perf_counter() - start
-    return n * iters / elapsed
+    return {
+        "epochs_to_tol": round(steps * gbs / n, 2),
+        "wall_s_to_tol": round(wall, 3),
+        "tol": _CONVERGE_TOL,
+        "steps": steps,
+    }
+
+
+def _inner_converge() -> dict:
+    return _converge_stage()
+
+
+def _inner_converge_cpu() -> dict:
+    """The same convergence program pinned to the host CPU backend: never
+    touches the tunnel, so the provisional line can always carry
+    epochs_to_tol (hardware-independent); its wall_s is labeled _cpu."""
+    _force_cpu()
+    return _converge_stage()
 
 
 _INNER_STAGES = {
@@ -431,11 +578,51 @@ _INNER_STAGES = {
     "sparse": _inner_sparse,
     "kmeans": _inner_kmeans,
     "kmeans_mnist": _inner_kmeans_mnist,
-    "kmeans_stream": _inner_kmeans_stream,
+    "feed_overlap": _inner_feed_overlap,
+    "converge": _inner_converge,
+    "converge_cpu": _inner_converge_cpu,
     "gbt": _inner_gbt,
     "als": _inner_als,
     "word2vec": _inner_word2vec,
 }
+
+
+def _last_device_evidence() -> "dict | None":
+    """Newest per-chip measurement from the committed capture logs
+    (tools/device_watch_*.log, tools/bench_manual_*.log). The provisional
+    JSON line points at this so a wedged-tunnel round still surfaces the
+    device evidence captured in an earlier healthy window of the same
+    image (VERDICT r4 missing #1: BENCH_r04 said nothing while the
+    committed watcher log held the numbers)."""
+    best = None
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    paths = glob.glob(os.path.join(tools_dir, "device_watch_*.log")) + \
+        glob.glob(os.path.join(tools_dir, "bench_manual_*.log"))
+
+    def stamp(path):
+        m = re.search(r"(\d{8}T\d{6}Z)", os.path.basename(path))
+        return m.group(1) if m else ""
+
+    for path in sorted(paths, key=stamp):  # newest UTC stamp wins
+        try:
+            with open(path, "r", errors="replace") as fh:
+                text = fh.read()
+        except OSError:
+            continue
+        for m in re.finditer(
+            r'\{"metric": "logreg_train_samples_per_sec_per_chip".*\}', text
+        ):
+            try:
+                rec = json.loads(m.group(0))
+            except ValueError:
+                continue
+            best = {
+                "file": os.path.join("tools", os.path.basename(path)),
+                "logreg_train_samples_per_sec_per_chip": rec["value"],
+                "vs_baseline": rec.get("vs_baseline"),
+            }
+    return best
 
 
 def _run_stage(stage: str, timeout_s: float, deadline: float, retries: int = 1):
@@ -479,8 +666,12 @@ def _run_stage(stage: str, timeout_s: float, deadline: float, retries: int = 1):
         dt = time.perf_counter() - t0
         if proc.returncode == 0:
             try:
-                value = float(proc.stdout.strip().splitlines()[-1])
-                _log(f"stage={stage} ok in {dt:.1f}s -> {value:.1f}")
+                last = proc.stdout.strip().splitlines()[-1]
+                # Scalar stages print one float; structured stages
+                # (converge, feed_overlap) print one JSON object.
+                value = (json.loads(last) if last.startswith("{")
+                         else float(last))
+                _log(f"stage={stage} ok in {dt:.1f}s -> {value}")
                 return value, False
             except (ValueError, IndexError):
                 _log(f"stage={stage} unparseable output: {proc.stdout!r}")
@@ -530,20 +721,61 @@ def main():
     if inner:
         # Stage children inherit the parent's held-lock marker and skip
         # re-acquiring; a stage run standalone takes the lock itself.
-        with device_client_lock():
-            print(f"{_INNER_STAGES[inner]():.1f}")
+        # converge_cpu is pinned to the host backend and never touches
+        # the tunnel, so it must not contend for the single-tenant lock
+        # (it runs while a watcher capture may hold the device).
+        if inner == "converge_cpu":
+            out = _INNER_STAGES[inner]()
+        else:
+            with device_client_lock():
+                out = _INNER_STAGES[inner]()
+        print(json.dumps(out) if isinstance(out, dict) else f"{out:.1f}")
         return
 
-    # FLINKML_BENCH_TIMEOUT is the TOTAL device-bench budget (same meaning
-    # as round 1); each stage attempt is additionally capped at
-    # FLINKML_BENCH_STAGE_TIMEOUT so one pathological compile cannot
-    # starve every stage behind it (observed: a d=784 kmeans compile ate
-    # the whole budget and the stages after it were skipped).
-    total_budget = float(os.environ.get("FLINKML_BENCH_TIMEOUT", "2100"))
+    # FLINKML_BENCH_TIMEOUT is the TOTAL bench wall-clock budget. The
+    # device phase gets that MINUS a reserve: rounds 1-4 all ended with
+    # the driver killing bench mid-hunt (rc=124) and an empty BENCH_rNN
+    # artifact, because the hunt's deadline equaled the total budget and
+    # the driver's own kill fired first. The reserve keeps the device
+    # phase >=180 s clear of the budget so the final line always prints;
+    # the default total (1680 s) sits ~2 min under the observed ~1800 s
+    # driver kill of round 4. Each stage attempt is additionally capped
+    # at FLINKML_BENCH_STAGE_TIMEOUT so one pathological compile cannot
+    # starve every stage behind it.
+    t_start = time.monotonic()
+    total_budget = float(os.environ.get("FLINKML_BENCH_TIMEOUT", "1680"))
+    reserve = max(180.0, 0.1 * total_budget)
     probe_timeout = float(os.environ.get("FLINKML_BENCH_PROBE_TIMEOUT", "240"))
     probe_spacing = float(os.environ.get("FLINKML_BENCH_PROBE_SPACING", "60"))
     stage_cap = float(os.environ.get("FLINKML_BENCH_STAGE_TIMEOUT", "600"))
-    deadline = time.monotonic() + total_budget
+    deadline = t_start + max(60.0, total_budget - reserve)
+
+    # ---- provisional phase: a parseable line BEFORE any tunnel contact.
+    # Everything here is tunnel-immune (numpy CPU baseline + a CPU-pinned
+    # convergence child), so even a driver kill mid-hunt leaves an honest
+    # record on stdout: the CPU fallback, the hardware-independent
+    # epochs-to-tol, and a pointer to the newest committed device capture.
+    _log("measuring CPU reference-style baseline ...")
+    x_cpu, y_cpu, w_cpu = make_data(200_000, 123)
+    cpu_sps = bench_reference_style_cpu(x_cpu, y_cpu, w_cpu, 16_384)
+    evidence = _last_device_evidence()
+    conv_cpu, _ = _run_stage(
+        "converge_cpu", 300.0, t_start + total_budget - 60, retries=0
+    )
+    provisional_extras = {"provisional": 1}
+    if conv_cpu is not None:
+        provisional_extras["convergence_cpu"] = conv_cpu
+    if evidence is not None:
+        provisional_extras["last_device_evidence"] = evidence
+    print(json.dumps({
+        "metric": "logreg_train_samples_per_sec_cpu_fallback",
+        "value": round(cpu_sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": 1.0,
+        "extras": provisional_extras,
+    }), flush=True)
+    _log("provisional line emitted; starting device phase "
+         f"(deadline in {deadline - time.monotonic():.0f}s)")
 
     # Stage order is cheap-compile-first: the tunnel's observed failure
     # mode (BASELINE.md round-4 session-2 log) is wedging UNDER a heavy
@@ -553,8 +785,9 @@ def main():
     # failures don't qualify), a quick probe decides whether the tunnel
     # is wedged (skip the rest immediately instead of burning stage_cap
     # on each) or the hang was stage-specific.
-    stage_order = ["dense", "dense_bf16", "kmeans", "kmeans_mnist",
-                   "kmeans_stream", "gbt", "als", "word2vec", "sparse"]
+    stage_order = ["dense", "dense_bf16", "converge", "kmeans",
+                   "kmeans_mnist", "feed_overlap", "gbt", "als",
+                   "word2vec", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
     # concurrent clients wedged the tunnel for 8+ hours in round 2
@@ -591,79 +824,60 @@ def main():
     except TimeoutError as e:
         _log(f"device busy: {e}; skipping device measurement")
     device_sps = results.get("dense")
-    sparse_sps = results.get("sparse")
-    bf16_sps = results.get("dense_bf16")
-    kmeans_pps = results.get("kmeans")
-    kmeans_mnist_pps = results.get("kmeans_mnist")
-    kmeans_stream_pps = results.get("kmeans_stream")
-    gbt_rts = results.get("gbt")
-    als_ups = results.get("als")
-    w2v_wps = results.get("word2vec")
-
-    _log("measuring CPU reference-style baseline ...")
-    n_cpu = 200_000
-    x, y, w = make_data(n_cpu, 123)
-    cpu_sps = bench_reference_style_cpu(x, y, w, 16_384)
 
     if device_sps is None:
-        # Device unreachable: still emit one JSON line so the driver
-        # records something, but under a DIFFERENT metric name so a CPU
-        # fallback can never be mistaken for a per-chip measurement.
+        # Device unreachable: re-emit the fallback as the FINAL line so
+        # the last parseable line is still honest, under a DIFFERENT
+        # metric name so a CPU fallback can never be mistaken for a
+        # per-chip measurement.
         _log(
             "note: a CPU fallback reflects THIS run's tunnel state only — "
             "check BASELINE.md's round tunnel log for device evidence "
             "captured in earlier healthy windows of the same round."
         )
         metric = "logreg_train_samples_per_sec_cpu_fallback"
-        device_sps = cpu_sps
+        value = cpu_sps
     else:
         metric = "logreg_train_samples_per_sec_per_chip"
+        value = device_sps
 
     record = {
         "metric": metric,
-        "value": round(device_sps, 1),
+        "value": round(value, 1),
         "unit": "samples/sec",
-        "vs_baseline": round(device_sps / cpu_sps, 2),
+        "vs_baseline": round(value / cpu_sps, 2),
     }
+    # Secondary measurements kept inside the single JSON line; each key
+    # maps a results[] stage to its extras name. The workload for each is
+    # documented on its _inner_* stage.
     extras = {}
-    if sparse_sps is not None:
-        # Criteo-profile sparse LR (dim=1e6, nnz=39/row).
-        extras["sparse_logreg_samples_per_sec_per_chip"] = round(sparse_sps, 1)
-    if bf16_sps is not None:
-        # Same dense workload, bf16-resident (measured ~1.02x over f32
-        # at this width — see BASELINE.md round-2 notes).
-        extras["dense_bf16_logreg_samples_per_sec_per_chip"] = round(bf16_sps, 1)
-    if kmeans_pps is not None:
-        # KMeans Lloyd (n=262k, d=128, k=64 — the round-2 measured
-        # profile, kept for continuity), whole loop on device.
-        extras["kmeans_points_per_sec_per_chip"] = round(kmeans_pps, 1)
-    if kmeans_mnist_pps is not None:
-        # KMeans on the MNIST-784/k=10 profile (BASELINE.json config #2).
-        extras["kmeans_mnist_points_per_sec_per_chip"] = round(
-            kmeans_mnist_pps, 1
-        )
-    if kmeans_stream_pps is not None:
-        # Same shape through the streamed out-of-core replay path; the
-        # ratio to kmeans_points_per_sec_per_chip is the streaming
-        # overhead.
-        extras["kmeans_stream_points_per_sec_per_chip"] = round(
-            kmeans_stream_pps, 1
-        )
-    if gbt_rts is not None:
-        # Histogram GBT forest build (n=262k, d=16, 32 bins, depth 4,
-        # 20 trees): row-tree builds per second.
-        extras["gbt_row_trees_per_sec_per_chip"] = round(gbt_rts, 1)
-    if als_ups is not None:
-        # ALS-WR (16k x 16k, 2M ratings, rank 32): rating visits/sec
-        # across both half-steps, through the public ALS.fit path.
-        extras["als_rating_visits_per_sec_per_chip"] = round(als_ups, 1)
-    if w2v_wps is not None:
-        # Word2Vec SGNS (vocab 32k, d=128, 5 negatives): pairs/sec.
-        extras["word2vec_pairs_per_sec_per_chip"] = round(w2v_wps, 1)
+    scalar_stages = {
+        "sparse": "sparse_logreg_samples_per_sec_per_chip",
+        "dense_bf16": "dense_bf16_logreg_samples_per_sec_per_chip",
+        "kmeans": "kmeans_points_per_sec_per_chip",
+        "kmeans_mnist": "kmeans_mnist_points_per_sec_per_chip",
+        "gbt": "gbt_row_trees_per_sec_per_chip",
+        "als": "als_rating_visits_per_sec_per_chip",
+        "word2vec": "word2vec_pairs_per_sec_per_chip",
+    }
+    for stage, key in scalar_stages.items():
+        if results.get(stage) is not None:
+            extras[key] = round(results[stage], 1)
+    if results.get("feed_overlap") is not None:
+        # fed/resident wall ratio — the streaming-architecture overhead,
+        # latency-insensitive (single end-of-run synchronization).
+        extras["feed_overlap"] = results["feed_overlap"]
+    if results.get("converge") is not None:
+        # Epochs + wall to fixed tol on device — the second half of
+        # BASELINE.json's "samples/sec/chip + epochs-to-converge".
+        extras["convergence"] = results["converge"]
+    elif conv_cpu is not None:
+        extras["convergence_cpu"] = conv_cpu
+    if device_sps is None and evidence is not None:
+        extras["last_device_evidence"] = evidence
     if extras:
-        # Secondary measurements kept inside the single JSON line.
         record["extras"] = extras
-    print(json.dumps(record))
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":
