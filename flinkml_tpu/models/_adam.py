@@ -450,9 +450,17 @@ def run_streamed_adam(
             tuple(np.zeros(t.shape, np.float32) for t in flat),
             np.int32(0), np.float64(0.0), np.asarray(False),
         )
-        (flat_h, m_h, v_h, step_h, prev_h, term), start_epoch = (
-            mgr.restore(resume_epoch, like)
+        # Agreed restore: a rank-local failure must abort every rank,
+        # not strand the peers in the Adam-step collectives (same
+        # protocol as _gbt_stream.py's resume).
+        from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+        dv_restore = DeferredValidation()
+        got = dv_restore.call(mgr.restore, resume_epoch, like)
+        dv_restore.rendezvous(
+            mesh, f"checkpoint restore (epoch {resume_epoch})"
         )
+        (flat_h, m_h, v_h, step_h, prev_h, term), start_epoch = got
         flat = tuple(jnp.asarray(t) for t in flat_h)
         m = tuple(jnp.asarray(t) for t in m_h)
         v = tuple(jnp.asarray(t) for t in v_h)

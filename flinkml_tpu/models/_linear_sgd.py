@@ -343,13 +343,23 @@ def _sparse_trainer(mesh, loss: str, local_bs: int, axis: str, dim: int):
     )
 
 
-def _restore_carry(checkpoint_manager, dim: int, dtype):
+def _restore_carry(checkpoint_manager, dim: int, dtype, mesh=None):
     """Restore the latest ``(coef, loss)`` carry; returns
     ``(coef_host, epoch, loss)`` or None. One definition shared by the
     dense chunked path and the stream path so the checkpoint payload shape
-    can never silently diverge between them."""
+    can never silently diverge between them.
+
+    Agreed restore: a rank-local failure (corrupt/unreadable checkpoint
+    on the shared FS) must abort every rank, not strand the peers in the
+    training collectives (same protocol as ``_gbt_stream.py``'s resume).
+    Post-rendezvous ``None`` means genuinely no checkpoint (a held error
+    raises at the rendezvous instead)."""
+    from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
     like = (np.zeros(dim, dtype=np.dtype(dtype)), np.float64(0.0))
-    restored = checkpoint_manager.restore_latest(like=like)
+    dv = DeferredValidation()
+    restored = dv.call(checkpoint_manager.restore_latest, like)
+    dv.rendezvous(mesh, "checkpoint restore (latest carry)")
     if restored is None:
         return None
     (coef_h, loss_h), epoch = restored
@@ -394,7 +404,9 @@ def _run_chunked(
     epoch = 0
     cur_loss = float("inf")
     if resume_epoch is not None:
-        coef_h, epoch, cur_loss = _restore_carry(checkpoint_manager, dim, dt)
+        coef_h, epoch, cur_loss = _restore_carry(
+            checkpoint_manager, dim, dt, mesh
+        )
         coef = jnp.asarray(coef_h, dt)
 
     chunk = (
@@ -1126,7 +1138,7 @@ def _train_linear_stream_multiprocess(
     epoch = 0
     cur_loss = math.inf
     if resume_epoch is not None:
-        restored = _restore_carry(checkpoint_manager, dim, dtype)
+        restored = _restore_carry(checkpoint_manager, dim, dtype, mesh)
         if restored is not None:
             coef_h, epoch, cur_loss = restored
             coef = jnp.asarray(coef_h, dt)
@@ -1355,7 +1367,7 @@ def train_linear_model_stream(
         if resume:
             first = next(iter(cache.reader()))
             dim = np.asarray(first[x_key]).shape[1]
-            restored = _restore_carry(checkpoint_manager, dim, dtype)
+            restored = _restore_carry(checkpoint_manager, dim, dtype, mesh)
             if restored is not None:
                 coef_h, epoch, cur_loss = restored
                 coef = jnp.asarray(coef_h, dt)

@@ -444,6 +444,15 @@ def train_kmeans_stream(
             # to the fixed-width reservoir would be such a raise).
             for x in checked_ingest(cache.reader(), dv, ingest, multi):
                 reservoir.add(x)
+        elif multi:
+            # Cached source with initial_centroids/resume: pre-validate
+            # every cached batch anyway — without this, a bad cached
+            # batch on one rank first raises rank-locally in
+            # place_multi's check_dims on the prefetch thread at replay,
+            # stranding the peers mid-collective (LDA's cached-source
+            # pre-validation, mirrored).
+            for _ in checked_ingest(cache.reader(), dv, ingest, multi):
+                pass
     else:
         writer = DataCacheWriter(cache_dir, memory_budget_bytes)
 
@@ -497,9 +506,19 @@ def train_kmeans_stream(
             d_feat = np.asarray(next(iter(reader))[column]).shape[1]
             if hasattr(reader, "close"):
                 reader.close()
-        centroids, start_epoch = checkpoint_manager.restore(
-            resume_epoch, like=np.zeros((k, d_feat), np.float32)
+        # Agreed restore: a rank-local failure (corrupt/unreadable
+        # checkpoint on the shared FS) must abort every rank, not strand
+        # the peers in the Lloyd collectives (same protocol as
+        # _gbt_stream.py's resume).
+        dv_restore = DeferredValidation()
+        got = dv_restore.call(
+            checkpoint_manager.restore, resume_epoch,
+            np.zeros((k, d_feat), np.float32),
         )
+        dv_restore.rendezvous(
+            mesh, f"checkpoint restore (epoch {resume_epoch})"
+        )
+        centroids, start_epoch = got
     elif initial_centroids is not None:
         centroids = np.asarray(initial_centroids, np.float32)
         if centroids.shape[0] != k:

@@ -358,9 +358,15 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
         terminated = False
         if resume_epoch is not None:
             like = (lam, np.float64(0.0), np.asarray(False))
-            (lam, prev_ll, term), start_epoch = (
-                self.checkpoint_manager.restore(resume_epoch, like)
-            )
+            # Agreed restore: a rank-local failure must abort every rank,
+            # not strand the peers in the VB-pass collectives (same
+            # protocol as _gbt_stream.py's resume).
+            from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+            dv = DeferredValidation()
+            got = dv.call(self.checkpoint_manager.restore, resume_epoch, like)
+            dv.rendezvous(mesh, f"checkpoint restore (epoch {resume_epoch})")
+            (lam, prev_ll, term), start_epoch = got
             prev_ll = float(prev_ll)
             terminated = bool(term)
 

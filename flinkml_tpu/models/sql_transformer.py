@@ -300,11 +300,24 @@ class SQLTransformer(Transformer):
             n_rows = int(mask.sum())
 
         out: Dict[str, np.ndarray] = {}
+
+        def assign(name: str, val) -> None:
+            # Upstream Flink SQL rejects duplicate output columns; a
+            # silent last-wins overwrite (SELECT a, a, two expressions
+            # aliased to one name, or '*' colliding with an explicit
+            # item in either order) would drop a projected column.
+            if name in out:
+                raise ValueError(
+                    f"SQLTransformer: duplicate output column {name!r}"
+                )
+            out[name] = val
+
         for part in _split_top_level_commas(_tokenize(m.group("items"))):
             if not part:
                 raise ValueError("SQLTransformer: empty projection item")
             if len(part) == 1 and part[0] == ("op", "*"):
-                out.update(columns)
+                for name, val in columns.items():
+                    assign(name, val)
                 continue
             # Optional trailing "AS alias".
             alias = None
@@ -321,7 +334,7 @@ class SQLTransformer(Transformer):
             if len(expr_toks) == 1 and expr_toks[0][0] == "ident" and (
                 expr_toks[0][1] in columns
             ):
-                out[alias or expr_toks[0][1]] = columns[expr_toks[0][1]]
+                assign(alias or expr_toks[0][1], columns[expr_toks[0][1]])
                 continue
             parser = _Parser(expr_toks + [("end", "")])
             fn = parser.expr()
@@ -334,7 +347,7 @@ class SQLTransformer(Transformer):
             val = np.asarray(fn(columns))
             if val.ndim == 0:  # constant column, e.g. SELECT 1 AS one
                 val = np.full(n_rows, float(val))
-            out[name] = val
+            assign(name, val)
 
         if not out:
             raise ValueError("SQLTransformer: empty projection")

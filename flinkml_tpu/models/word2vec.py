@@ -504,9 +504,15 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             v = jnp.zeros((len(vocab), dim), jnp.float32)  # restored below
         if resume_epoch is not None:
             like = (np.zeros((len(vocab), dim), np.float32),) * 2
-            (v_h, u_h), start_epoch = self.checkpoint_manager.restore(
-                resume_epoch, like
-            )
+            # Agreed restore: a rank-local failure must abort every rank,
+            # not strand the peers in the SGNS training collectives (same
+            # protocol as _gbt_stream.py's resume).
+            from flinkml_tpu.iteration.stream_sync import DeferredValidation
+
+            dv = DeferredValidation()
+            got = dv.call(self.checkpoint_manager.restore, resume_epoch, like)
+            dv.rendezvous(mesh, f"checkpoint restore (epoch {resume_epoch})")
+            (v_h, u_h), start_epoch = got
             v, u = jnp.asarray(v_h), jnp.asarray(u_h)
 
         from flinkml_tpu.parallel.dispatch import DispatchGuard

@@ -358,4 +358,55 @@ def case5d_crash_resume():
 
 case5d_crash_resume()
 
+
+# --- 6. Agreed restore for REPLICATED snapshots (ALS; LDA/Word2Vec share
+# the identical DeferredValidation-wrapped restore). A rank-local restore
+# failure (unreadable checkpoint on the shared FS) must abort every rank,
+# not strand the peer in the normal-equation collectives.
+def case6_als_restore_ioerror():
+    from flinkml_tpu.models.als import ALS
+
+    ckpt = os.path.join(workdir, "ckpt_als_restore")
+    os.makedirs(ckpt, exist_ok=True)
+    r = np.random.default_rng(40 + pid)
+    cache = cache_stream(iter([{
+        "user": r.integers(0, 8, size=32).astype(np.int32),
+        "item": r.integers(0, 8, size=32).astype(np.int32),
+        "rating": r.uniform(1, 5, size=32).astype(np.float32),
+    }]))
+    ALS(
+        mesh=mesh, checkpoint_manager=CheckpointManager(ckpt),
+        checkpoint_interval=1,
+    ).set_rank(2).set_max_iter(2).set_seed(0).fit(cache)
+
+    class BadRestore(CheckpointManager):
+        def restore(self, epoch, like):
+            raise IOError("injected unreadable checkpoint")
+
+    mgr = (BadRestore if pid == 0 else CheckpointManager)(ckpt)
+    ALS(
+        mesh=mesh, checkpoint_manager=mgr, checkpoint_interval=1,
+        resume=True,
+    ).set_rank(2).set_max_iter(3).set_seed(0).fit(cache)
+
+
+expect_all_ranks_raise("case6-als-restore", case6_als_restore_ioerror)
+
+
+# --- 7. Cached-source KMeans with need_init=False (initial_centroids):
+# pre-validation must still run — a bad cached batch on rank 0 would
+# otherwise first raise rank-locally in place_multi's check_dims on the
+# prefetch thread at replay, stranding the peer mid-collective.
+def case7_kmeans_cached_bad_batch_no_init():
+    blobs = [{"x": good_batch(16)["x"]}]
+    if pid == 0:
+        blobs.append({"x": np.zeros((4, 7), np.float32)})  # ragged dim
+    train_kmeans_stream(
+        cache_stream(iter(blobs)), k=2, mesh=mesh, max_iter=2, seed=0,
+        initial_centroids=np.zeros((2, 4), np.float32),
+    )
+
+
+expect_all_ranks_raise("case7-kmeans-cached", case7_kmeans_cached_bad_batch_no_init)
+
 print(f"GUARD_OK {pid}", flush=True)

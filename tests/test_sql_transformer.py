@@ -125,3 +125,27 @@ def test_where_filters_before_projection():
             "SELECT a / b AS r FROM __THIS__ WHERE b != 0"
         ).transform(t)
     np.testing.assert_allclose(out.column("r"), [3.0, 3.0])
+
+
+def test_duplicate_output_columns_rejected():
+    """Upstream Flink SQL rejects duplicate output columns; last-wins
+    overwriting would silently drop a projected column."""
+    t = Table({"a": np.array([1.0, 2.0]), "b": np.array([3.0, 4.0])})
+    for stmt in (
+        "SELECT a, a FROM __THIS__",
+        "SELECT a + b AS c, a - b AS c FROM __THIS__",
+        "SELECT *, a FROM __THIS__",
+    ):
+        with pytest.raises(ValueError, match="duplicate output column"):
+            _sql(stmt).transform(t)
+    # The '*' merge itself stays legal.
+    (out,) = _sql("SELECT * FROM __THIS__").transform(t)
+    assert set(out.column_names) == {"a", "b"}
+
+
+def test_duplicate_via_star_either_order():
+    t = Table({"a": np.array([1.0]), "b": np.array([2.0])})
+    with pytest.raises(ValueError, match="duplicate output column"):
+        _sql("SELECT a - b AS a, * FROM __THIS__").transform(t)
+    with pytest.raises(ValueError, match="duplicate output column"):
+        _sql("SELECT *, * FROM __THIS__").transform(t)
