@@ -72,6 +72,28 @@ def make_data(n, dim, seed=0, dtype=np.float32):
     return x, y, w
 
 
+def make_criteo_csr(n, dim=1_000_000, nnz=39, seed=0, n_active=256):
+    """Synthetic Criteo-profile CSR: ``nnz`` uniform-random columns per
+    row over ``dim``, labels planted by a sparse true model with
+    ``n_active`` nonzero coefficients. ONE definition shared by the
+    sparse throughput stage, the sparse convergence stage, and
+    ``tools/sparse_layout_probe.py`` so every sparse measurement sees
+    the same distribution."""
+    rng = np.random.default_rng(seed)
+    indptr = np.arange(n + 1, dtype=np.int64) * nnz
+    indices = rng.integers(0, dim, size=n * nnz).astype(np.int32)
+    values = rng.normal(size=n * nnz).astype(np.float32)
+    active = rng.choice(dim, size=n_active, replace=False)
+    beta = np.zeros(dim, dtype=np.float32)
+    beta[active] = rng.normal(size=n_active)
+    margins = (
+        values.reshape(n, nnz) * beta[indices.reshape(n, nnz)]
+    ).sum(axis=1)
+    y = (margins > 0).astype(np.float32)
+    w = np.ones(n, dtype=np.float32)
+    return indptr, indices, values, y, w
+
+
 def _log(msg):
     sys.stderr.write(f"[bench] {msg}\n")
     sys.stderr.flush()
@@ -161,16 +183,16 @@ def bench_tpu_sparse(indptr, indices, values, dim, y, w,
     mesh = DeviceMesh()
     p = mesh.axis_size()
     # Same pack/pad/shard/batching policy as the product fit path —
-    # including the FLINKML_TPU_SORTED_SCATTER A/B gate, so setting it
-    # to 0 really benchmarks the per-step-sort layout.
-    sorted_scatter = _linear_sgd._sorted_scatter_enabled()
+    # including the FLINKML_TPU_SPARSE_LAYOUT A/B gate, so setting it
+    # really benchmarks the selected gradient layout.
+    layout = _linear_sgd._sparse_layout()
     data_args, local_bss = _linear_sgd.prepare_sparse_buckets(
         indptr, indices, values, dim, y, w, mesh, global_batch_size,
-        seed=0, sorted_scatter=sorted_scatter,
+        seed=0, layout=layout,
     )
     trainer = _linear_sgd._sparse_trainer_bucketed(
         mesh.mesh, "logistic", local_bss, DeviceMesh.DATA_AXIS, int(dim),
-        sorted_scatter,
+        layout,
     )
     f32 = lambda v: jnp.asarray(v, jnp.float32)
     carry0 = (
@@ -341,19 +363,8 @@ def _inner_sparse() -> float:
     """Stage 3: Criteo-profile sparse LR (BASELINE.json config #5):
     dim = 1e6, 39 nnz per row, nnz-bucketed ELL resident in HBM."""
     _setup_jax_cache()
-    n, dim, nnz = 262_144, 1_000_000, 39
-    rng = np.random.default_rng(0)
-    indptr = np.arange(n + 1, dtype=np.int64) * nnz
-    indices = rng.integers(0, dim, size=n * nnz).astype(np.int32)
-    values = rng.normal(size=n * nnz).astype(np.float32)
-    active = rng.choice(dim, size=256, replace=False)
-    beta = np.zeros(dim, dtype=np.float32)
-    beta[active] = rng.normal(size=256)
-    margins = (
-        values.reshape(n, nnz) * beta[indices.reshape(n, nnz)]
-    ).sum(axis=1)
-    y = (margins > 0).astype(np.float32)
-    w = np.ones(n, dtype=np.float32)
+    n, dim = 262_144, 1_000_000
+    indptr, indices, values, y, w = make_criteo_csr(n, dim)
     return bench_tpu_sparse(
         indptr, indices, values, dim, y, w,
         global_batch_size=262_144, n_steps=200,
@@ -563,6 +574,61 @@ def _inner_converge() -> dict:
     return _converge_stage()
 
 
+def _inner_converge_sparse() -> dict:
+    """Stage: sparse (Criteo-profile) LR epochs/wall-to-converge — dim =
+    1e6, 39 nnz/row, n=65_536, global batch 16_384, lr=20, seeded. Tol
+    calibrated on the seeded config (CPU, f32): loss 0.693 at start,
+    0.265 after 80 epochs, 0.153 after 160 — tol 0.25 lands at ~85
+    epochs. Uses the product sparse trainer at the product layout gate,
+    so the number tracks the active layout."""
+    _setup_jax_cache()
+    import jax.numpy as jnp
+    from flinkml_tpu.models import _linear_sgd
+    from flinkml_tpu.parallel import DeviceMesh
+
+    n, dim, gbs, tol, max_steps = 65_536, 1_000_000, 16_384, 0.25, 2_000
+    indptr, indices, values, y, w = make_criteo_csr(n, dim)
+    mesh = DeviceMesh()
+    layout = _linear_sgd._sparse_layout()
+    data_args, local_bss = _linear_sgd.prepare_sparse_buckets(
+        indptr, indices, values, dim, y, w, mesh, gbs, seed=0,
+        layout=layout,
+    )
+    trainer = _linear_sgd._sparse_trainer_bucketed(
+        mesh.mesh, "logistic", local_bss, DeviceMesh.DATA_AXIS, dim, layout,
+    )
+    f32 = lambda v: jnp.asarray(v, jnp.float32)
+    carry0 = (
+        jnp.zeros(dim, jnp.float32),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(jnp.inf, jnp.float32),
+    )
+    hy = (f32(20.0), f32(0.0), f32(0.0), f32(tol))
+    _log("converge_sparse: compiling + warm-up dispatch ...")
+    np.asarray(trainer(*carry0, *data_args, *hy,
+                       jnp.asarray(2, jnp.int32))[0])
+    _log("converge_sparse: measuring steps-to-tol ...")
+    start = time.perf_counter()
+    coef_out, steps_out, loss_out = trainer(
+        *carry0, *data_args, *hy, jnp.asarray(max_steps, jnp.int32)
+    )
+    np.asarray(coef_out)
+    wall = time.perf_counter() - start
+    steps = int(steps_out)
+    if steps >= max_steps or not math.isfinite(float(loss_out)):
+        raise RuntimeError(
+            f"sparse did not converge: steps={steps}/{max_steps} "
+            f"loss={float(loss_out)} tol={tol}"
+        )
+    return {
+        "epochs_to_tol": round(steps * gbs / n, 2),
+        "wall_s_to_tol": round(wall, 3),
+        "tol": tol,
+        "steps": steps,
+        "layout": layout,
+    }
+
+
 def _inner_converge_cpu() -> dict:
     """The same convergence program pinned to the host CPU backend: never
     touches the tunnel, so the provisional line can always carry
@@ -581,6 +647,7 @@ _INNER_STAGES = {
     "feed_overlap": _inner_feed_overlap,
     "converge": _inner_converge,
     "converge_cpu": _inner_converge_cpu,
+    "converge_sparse": _inner_converge_sparse,
     "gbt": _inner_gbt,
     "als": _inner_als,
     "word2vec": _inner_word2vec,
@@ -785,9 +852,12 @@ def main():
     # failures don't qualify), a quick probe decides whether the tunnel
     # is wedged (skip the rest immediately instead of burning stage_cap
     # on each) or the hang was stage-specific.
+    # converge_sparse and sparse run LAST: the dim=1e6 compiles are the
+    # heaviest in the bench and the tunnel's observed failure mode is
+    # wedging UNDER a heavy compile.
     stage_order = ["dense", "dense_bf16", "converge", "kmeans",
                    "kmeans_mnist", "feed_overlap", "gbt", "als",
-                   "word2vec", "sparse"]
+                   "word2vec", "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
     # concurrent clients wedged the tunnel for 8+ hours in round 2
@@ -873,6 +943,8 @@ def main():
         extras["convergence"] = results["converge"]
     elif conv_cpu is not None:
         extras["convergence_cpu"] = conv_cpu
+    if results.get("converge_sparse") is not None:
+        extras["convergence_sparse"] = results["converge_sparse"]
     if device_sps is None and evidence is not None:
         extras["last_device_evidence"] = evidence
     if extras:

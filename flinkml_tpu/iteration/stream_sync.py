@@ -167,6 +167,33 @@ class DeferredValidation:
             raise
 
 
+def agreed_restore(manager, epoch, like, mesh: Optional[DeviceMesh],
+                   what: Optional[str] = None):
+    """Checkpoint restore with the rank-local-failure agreement protocol.
+
+    A corrupt or unreadable checkpoint on ONE rank's view of the shared
+    FS must abort EVERY rank — a rank-local raise strands the peers in
+    the training collectives (the hang class :func:`agree_all_ok`
+    documents). One definition for every streamed trainer's resume path
+    so the protocol cannot drift per estimator. Single-process, the
+    original error re-raises immediately."""
+    dv = DeferredValidation()
+    got = dv.call(manager.restore, epoch, like)
+    dv.rendezvous(mesh, what or f"checkpoint restore (epoch {epoch})")
+    return got
+
+
+def agreed_restore_latest(manager, like, mesh: Optional[DeviceMesh],
+                          what: str = "checkpoint restore (latest)"):
+    """:func:`agreed_restore` over ``manager.restore_latest``. A
+    post-rendezvous ``None`` means genuinely no checkpoint (a held
+    failure raises at the rendezvous instead)."""
+    dv = DeferredValidation()
+    got = dv.call(manager.restore_latest, like)
+    dv.rendezvous(mesh, what)
+    return got
+
+
 def guarded_iter(batches, dv: DeferredValidation):
     """Iterate a source whose ``next()`` itself can raise rank-locally
     (an IOError reading this rank's shard, a raising generator) — fold

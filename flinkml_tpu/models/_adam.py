@@ -450,17 +450,11 @@ def run_streamed_adam(
             tuple(np.zeros(t.shape, np.float32) for t in flat),
             np.int32(0), np.float64(0.0), np.asarray(False),
         )
-        # Agreed restore: a rank-local failure must abort every rank,
-        # not strand the peers in the Adam-step collectives (same
-        # protocol as _gbt_stream.py's resume).
-        from flinkml_tpu.iteration.stream_sync import DeferredValidation
+        from flinkml_tpu.iteration.stream_sync import agreed_restore
 
-        dv_restore = DeferredValidation()
-        got = dv_restore.call(mgr.restore, resume_epoch, like)
-        dv_restore.rendezvous(
-            mesh, f"checkpoint restore (epoch {resume_epoch})"
+        (flat_h, m_h, v_h, step_h, prev_h, term), start_epoch = (
+            agreed_restore(mgr, resume_epoch, like, mesh)
         )
-        (flat_h, m_h, v_h, step_h, prev_h, term), start_epoch = got
         flat = tuple(jnp.asarray(t) for t in flat_h)
         m = tuple(jnp.asarray(t) for t in m_h)
         v = tuple(jnp.asarray(t) for t in v_h)

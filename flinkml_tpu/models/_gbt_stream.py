@@ -456,20 +456,16 @@ def _build_forest(
         resume_tree = None if cand < 0 else cand
     start_tree = 0
     if resume_tree is not None:
-        from flinkml_tpu.iteration.stream_sync import DeferredValidation
+        from flinkml_tpu.iteration.stream_sync import agreed_restore
 
         like = (pred, feats_out, bins_out, gains_out, leaves_out)
         # The per-rank restore can still fail rank-locally (corrupt or
-        # missing shard) — hold the failure and agree the outcome so one
-        # rank's failure aborts every rank instead of stranding the
-        # peers in the training collectives. Single-process the
-        # rendezvous re-raises immediately.
-        dv_restore = DeferredValidation()
-        got = dv_restore.call(checkpoint_manager.restore, resume_tree, like)
-        dv_restore.rendezvous(
-            mesh, f"checkpoint restore (tree {resume_tree})"
+        # missing shard) — the agreed restore aborts every rank together
+        # instead of stranding the peers in the training collectives.
+        state, start_tree = agreed_restore(
+            checkpoint_manager, resume_tree, like, mesh,
+            f"checkpoint restore (tree {resume_tree})",
         )
-        state, start_tree = got
         # np.array: these are mutated in place below; the restore must
         # own its buffers.
         pred, feats_out, bins_out, gains_out, leaves_out = (

@@ -41,19 +41,47 @@ from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 _LOSS_KEYS = ("logistic", "hinge", "squared")
 
 
-def _sorted_scatter_enabled() -> bool:
-    """A/B gate for the sorted-scatter sparse layout (default OFF).
+_SPARSE_LAYOUTS = ("unsorted", "sorted", "cumsum")
 
-    Round-4 device measurement (BASELINE.md "sorted-scatter A/B",
-    TPU v5 lite, Criteo shapes): the per-step-sort layout runs
-    69.1 ms/step vs 90.9 ms/step sorted (+flag) — XLA's segment_sum
-    does NOT pay a dominant per-step sort on this generation, and the
-    sorted layout's extra gathers make it 0.76x. The default follows
-    the measurement; ``FLINKML_TPU_SORTED_SCATTER=1`` enables the
-    sorted layout so the comparison stays repeatable on other
-    backends/generations (numerics pinned identical either way,
-    ``tests/test_sparse_scale.py``)."""
-    return os.environ.get("FLINKML_TPU_SORTED_SCATTER", "0") == "1"
+
+def _sparse_layout() -> str:
+    """Measured-default gate for the sparse gradient layout.
+
+    Three candidates for the Criteo-scale gradient reduction (the step's
+    dominant cost at dim ~1e6 — BASELINE.md "Sparse roofline"):
+
+    - ``unsorted`` (default): one fused ``segment_sum`` per step. Round-4
+      device A/B: 69.1 ms/step — the measured winner of the first two.
+    - ``sorted`` (round-3 layout): pack-time per-window sort +
+      ``indices_are_sorted=True``, at the cost of a per-step O(cells)
+      random gather of the contributions. Round-4 device A/B: 90.9
+      ms/step (0.76x) — the permutation gather costs more than the sort
+      it removes. Kept for A/B repeatability.
+    - ``cumsum`` (round-5 layout): cells pre-sorted by column at pack
+      time WITH their values, so the step never touches a cells-sized
+      random permutation: contributions = sorted values x a gather of
+      ``mult`` from the [local_bs]-sized (VMEM-resident) table, segment
+      totals = one associative scan + a gather at precomputed static run
+      boundaries, and the only scatter left is ``<= distinct columns per
+      window`` sorted unique adds into [dim] — O(cells) streaming passes
+      instead of the per-step bitonic sort over every cell.
+
+    ``FLINKML_TPU_SPARSE_LAYOUT`` selects; the legacy
+    ``FLINKML_TPU_SORTED_SCATTER=1`` gate maps to ``sorted``. Numerics
+    across layouts are pinned by ``tests/test_sparse_scale.py``
+    (bit-exact for sorted/unsorted; allclose for cumsum, whose
+    running-sum-difference changes f32 summation order)."""
+    layout = os.environ.get("FLINKML_TPU_SPARSE_LAYOUT")
+    if layout is not None:
+        if layout not in _SPARSE_LAYOUTS:
+            raise ValueError(
+                f"FLINKML_TPU_SPARSE_LAYOUT={layout!r}: "
+                f"expected one of {_SPARSE_LAYOUTS}"
+            )
+        return layout
+    if os.environ.get("FLINKML_TPU_SORTED_SCATTER", "0") == "1":
+        return "sorted"
+    return "unsorted"
 
 
 def _soft_threshold(x, t):
@@ -147,9 +175,66 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
     return step
 
 
+_SPARSE_ARGS_PER_BUCKET = {"unsorted": 4, "sorted": 6, "cumsum": 8}
+
+# Chunk width of the two-level running sum below. Within-chunk prefix
+# sums bound the f32 cancellation error of a boundary difference by the
+# CHUNK's magnitude (~eps·sqrt(C)·sigma) instead of the whole window's
+# (~eps·sqrt(cells)·sigma — a fixed per-window bias on rare-column
+# gradients at 1e7 cells, since windows are deterministic).
+_CUMSUM_CHUNK = 65_536
+
+
+def _chunked_segment_totals(contrib, ends):
+    """Totals of contiguous runs of ``contrib`` ending at inclusive
+    indices ``ends`` (ascending; padding repeats an end, differencing to
+    exactly 0) — sort-free and cells-gather-free, with two-level
+    precision.
+
+    A single global running sum would make every boundary difference
+    carry absolute error ~eps·|global prefix|, which at 1e7 cells is a
+    biased ~1e-3·sigma on small (rare-column) segments. Decomposing by
+    chunks of ``_CUMSUM_CHUNK``: a segment inside one chunk differences
+    the LOCAL prefix sum (error ~eps·sqrt(C)·sigma); a segment spanning
+    chunks takes head/tail from local prefixes and the full chunks
+    between from a chunk-prefix difference that is exactly 0 unless the
+    segment contains >= 1 full chunk — in which case its own magnitude
+    is >= chunk-sized and the global-prefix error is relatively
+    negligible. Verified against a float64 reference at the 1e7-cell
+    bench shape (``tests/test_sparse_scale.py``)."""
+    cells = contrib.shape[0]
+    acc = contrib.dtype
+    C = _CUMSUM_CHUNK
+    # Front-pad one zero cell so every boundary index shifts to >= 1 and
+    # the "previous end" of the first run is index 0 (a zero); tail-pad
+    # to a whole number of chunks.
+    n_chunks = -(-(cells + 1) // C)
+    pad_tail = n_chunks * C - (cells + 1)
+    padded = jnp.concatenate([
+        jnp.zeros((1,), acc), contrib, jnp.zeros((pad_tail,), acc)
+    ])
+    lcs = jnp.cumsum(padded.reshape(n_chunks, C), axis=1)
+    chunk_tot = lcs[:, -1]
+    chunk_prefix = jnp.cumsum(chunk_tot)
+    flat_lcs = lcs.reshape(-1)
+
+    e1 = ends + 1
+    s1 = jnp.concatenate([jnp.zeros((1,), ends.dtype), e1[:-1]])
+    ce, cs = e1 // C, s1 // C
+    local_e = jnp.take(flat_lcs, e1)
+    local_s = jnp.take(flat_lcs, s1)
+    same = ce == cs
+    # Spanning: tail of the start chunk + full chunks between (exactly 0
+    # when ce == cs + 1) + head of the end chunk.
+    tail = jnp.take(chunk_tot, cs) - local_s
+    between = jnp.take(chunk_prefix, jnp.maximum(ce - 1, 0)) - \
+        jnp.take(chunk_prefix, cs)
+    return jnp.where(same, local_e - local_s, tail + between + local_e)
+
+
 def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
                               axis: str, dim: int,
-                              sorted_scatter: bool = True):
+                              layout: str = "unsorted"):
     """nnz-bucketed sparse step: one window per bucket, fused scatters.
 
     The batch is stratified across the nnz buckets (``ops.sparse.
@@ -157,24 +242,36 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
     proportionally to its row count, so every step sees a representative
     nnz mix and every epoch covers every bucket's rows.
 
-    ``sorted_scatter`` (the round-3 sort-elimination layout): the ELL
-    cell→column mapping is static across steps and the minibatch windows
-    are deterministic rotating tiles, so the pack step pre-sorts each
-    window's cells by column once and the scatter runs with
-    ``indices_are_sorted=True`` — XLA's sort-based ``segment_sum``
-    lowering skips its per-step sort, which round-2 measured as the
-    ~400× bottleneck at Criteo shapes (BASELINE.md "Sparse
-    sort-elimination groundwork"). The runtime cost is one O(cells)
-    gather of the contributions through the precomputed permutation;
-    blocks carry two extra arrays (perm, sorted ids) per bucket. One
-    sorted scatter per bucket (concatenating buckets would break the
-    global order); the ≤ max_buckets dense [dim] adds are noise next to
-    the removed sort.
+    ``layout`` selects the gradient reduction (measured A/B history in
+    :func:`_sparse_layout`):
+
+    - ``unsorted``: one fused ``segment_sum`` over every bucket's cells —
+      XLA's lowering pays a per-step bitonic sort over all cells.
+    - ``sorted`` (round-3): pack-time per-window sort + ``indices_are_
+      sorted=True``; the step pays an O(cells) random permutation gather
+      of the contributions instead (round-4 device A/B: the gather costs
+      MORE than the sort it removes — 0.76x).
+    - ``cumsum`` (round-5): the pack step stores each window's cells
+      column-sorted WITH their values and row indices
+      (:func:`_window_cumsum_tables`), so the step is sort-free AND
+      cells-sized-gather-free: contributions come from ``svals * mult[
+      srows]`` (``mult`` is a [local_bs] table — VMEM-resident), segment
+      totals from one running sum differenced at the precomputed run
+      boundaries, and the only scatter is ``<= max_d`` ascending unique
+      column adds. Every cells-sized op is a streaming pass.
     """
 
     def step(coef, epoch, blocks, learning_rate, reg_l2, reg_l1):
         acc = _acc_dt(coef.dtype)
-        per_bucket = 6 if sorted_scatter else 4
+        per_bucket = _SPARSE_ARGS_PER_BUCKET[layout]
+
+        def window_of(table2d, ep):
+            n_windows, width = table2d.shape
+            wnum = jnp.asarray(ep, jnp.int32) % n_windows
+            return jax.lax.dynamic_slice(
+                table2d, (wnum, jnp.zeros((), jnp.int32)), (1, width)
+            ).reshape(-1)
+
         contribs, flat_idx = [], []
         grad_local = jnp.zeros((dim,), coef.dtype)
         loss_l = jnp.zeros((), acc)
@@ -188,28 +285,32 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
             wb = _window(wl, epoch, local_bs)
             dot = jnp.sum(vb * coef[ib], axis=1)
             mult, per_ex = _margin_grad(loss, dot, yb, wb)
-            contrib = (vb * mult[:, None]).reshape(-1)
-            if sorted_scatter:
-                perml, sidsl = block[4:]
-                n_windows = perml.shape[0]
-                cells = perml.shape[1]
-                wnum = jnp.asarray(epoch, jnp.int32) % n_windows
-                perm_w = jax.lax.dynamic_slice(
-                    perml, (wnum, jnp.zeros((), jnp.int32)), (1, cells)
-                ).reshape(-1)
-                sids_w = jax.lax.dynamic_slice(
-                    sidsl, (wnum, jnp.zeros((), jnp.int32)), (1, cells)
-                ).reshape(-1)
+            if layout == "sorted":
+                contrib = (vb * mult[:, None]).reshape(-1)
+                perm_w = window_of(block[4], epoch)
+                sids_w = window_of(block[5], epoch)
                 grad_local = grad_local + jax.ops.segment_sum(
                     jnp.take(contrib, perm_w), sids_w,
                     num_segments=dim, indices_are_sorted=True,
                 )
+            elif layout == "cumsum":
+                srowsl, svalsl, endsl, colsl = block[4:]
+                srows_w = window_of(srowsl, epoch)
+                svals_w = window_of(svalsl, epoch)
+                ends_w = window_of(endsl, epoch)
+                cols_w = window_of(colsl, epoch)
+                contrib = svals_w * jnp.take(mult, srows_w)
+                seg = _chunked_segment_totals(contrib.astype(acc), ends_w)
+                grad_local = grad_local.at[cols_w].add(
+                    seg.astype(coef.dtype), indices_are_sorted=True,
+                )
             else:
+                contrib = (vb * mult[:, None]).reshape(-1)
                 contribs.append(contrib)
                 flat_idx.append(ib.reshape(-1))
             loss_l = loss_l + jnp.sum(per_ex.astype(acc))
             wsum_l = wsum_l + jnp.sum(wb.astype(acc))
-        if not sorted_scatter:
+        if layout == "unsorted":
             grad_local = jax.ops.segment_sum(
                 jnp.concatenate(contribs), jnp.concatenate(flat_idx),
                 num_segments=dim,
@@ -232,15 +333,15 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
 @functools.lru_cache(maxsize=128)
 def _sparse_trainer_bucketed(mesh, loss: str, local_bss: Tuple[int, ...],
                              axis: str, dim: int,
-                             sorted_scatter: bool = True):
+                             layout: str = "unsorted"):
     """Bucketed counterpart of :func:`_sparse_trainer` — same carry-style
-    contract; the data args are ``6·len(local_bss)`` sharded arrays
-    (indices, values, y, w, window perm, sorted ids per bucket), or
-    ``4·len(local_bss)`` with ``sorted_scatter=False``."""
+    contract; the data args are ``k·len(local_bss)`` sharded arrays where
+    ``k = _SPARSE_ARGS_PER_BUCKET[layout]`` (indices, values, y, w, plus
+    the layout's pack-time tables)."""
     local_step = make_sparse_step_bucketed(
-        loss, local_bss, axis, dim, sorted_scatter
+        loss, local_bss, axis, dim, layout
     )
-    n_args = (6 if sorted_scatter else 4) * len(local_bss)
+    n_args = _SPARSE_ARGS_PER_BUCKET[layout] * len(local_bss)
 
     def per_device(coef, epoch, cur_loss, *rest):
         blocks = rest[:n_args]
@@ -349,17 +450,16 @@ def _restore_carry(checkpoint_manager, dim: int, dtype, mesh=None):
     dense chunked path and the stream path so the checkpoint payload shape
     can never silently diverge between them.
 
-    Agreed restore: a rank-local failure (corrupt/unreadable checkpoint
-    on the shared FS) must abort every rank, not strand the peers in the
-    training collectives (same protocol as ``_gbt_stream.py``'s resume).
-    Post-rendezvous ``None`` means genuinely no checkpoint (a held error
-    raises at the rendezvous instead)."""
-    from flinkml_tpu.iteration.stream_sync import DeferredValidation
+    Restores through :func:`stream_sync.agreed_restore_latest` so a
+    rank-local failure aborts every rank instead of stranding the peers
+    in the training collectives; a ``None`` return means genuinely no
+    checkpoint."""
+    from flinkml_tpu.iteration.stream_sync import agreed_restore_latest
 
     like = (np.zeros(dim, dtype=np.dtype(dtype)), np.float64(0.0))
-    dv = DeferredValidation()
-    restored = dv.call(checkpoint_manager.restore_latest, like)
-    dv.rendezvous(mesh, "checkpoint restore (latest carry)")
+    restored = agreed_restore_latest(
+        checkpoint_manager, like, mesh, "checkpoint restore (latest carry)"
+    )
     if restored is None:
         return None
     (coef_h, loss_h), epoch = restored
@@ -588,10 +688,72 @@ def _window_sort_tables(
     return perm, sids
 
 
+def _window_cumsum_tables(
+    idx_pad: np.ndarray, val_pad: np.ndarray, p_size: int, local_bs: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Per-device, per-window tables for the ``cumsum`` sparse layout:
+    ``(srows, svals, ends, cols)``.
+
+    Window w on a device covers local rows ``min(w·bs, n_local−bs) ..
+    +bs`` (exactly :func:`_window`'s clamped rotating tile). Its
+    flattened cells are sorted by column id ONCE here, and the step
+    consumes them without any cells-sized permutation:
+
+    - ``srows [p·n_windows, cells] int32``: the within-window ROW of each
+      sorted cell — the step gathers ``mult`` (a [local_bs] table) by it.
+    - ``svals [p·n_windows, cells] f32``: the cell values, pre-sorted.
+    - ``ends [p·n_windows, max_d] int32``: inclusive cell index of each
+      column run's last cell, padded by repeating ``cells−1`` (the
+      running-sum difference of a repeated boundary is 0).
+    - ``cols [p·n_windows, max_d] int32``: the column id of each run,
+      ascending; padding repeats the last real column id, whose repeated
+      boundary contributes exactly 0.
+
+    ``max_d`` is the max distinct-column count over every (device,
+    window) so the stacked array is rectangular.
+    """
+    n_total, width = idx_pad.shape
+    n_local = n_total // p_size
+    n_windows = max(-(-n_local // local_bs), 1)
+    cells = local_bs * width
+    srows = np.empty((p_size * n_windows, cells), np.int32)
+    svals = np.empty((p_size * n_windows, cells), val_pad.dtype)
+    per_window = []
+    for d in range(p_size):
+        ishard = idx_pad[d * n_local:(d + 1) * n_local]
+        vshard = val_pad[d * n_local:(d + 1) * n_local]
+        for wnum in range(n_windows):
+            start = min(wnum * local_bs, max(n_local - local_bs, 0))
+            flat_i = ishard[start:start + local_bs].reshape(-1)
+            flat_v = vshard[start:start + local_bs].reshape(-1)
+            order = np.argsort(flat_i, kind="stable")
+            sids = flat_i[order]
+            row = d * n_windows + wnum
+            srows[row] = (order // width).astype(np.int32)
+            svals[row] = flat_v[order]
+            # Inclusive run ends: positions where the sorted id changes.
+            is_end = np.empty(cells, np.bool_)
+            is_end[:-1] = sids[:-1] != sids[1:]
+            is_end[-1] = True
+            e = np.nonzero(is_end)[0].astype(np.int32)
+            per_window.append((row, e, sids[e]))
+    max_d = max(e.size for _, e, _ in per_window)
+    ends = np.full((p_size * n_windows, max_d), cells - 1, np.int32)
+    cols = np.empty((p_size * n_windows, max_d), np.int32)
+    for row, e, c in per_window:
+        ends[row, : e.size] = e
+        cols[row, : e.size] = c
+        # Pad runs repeat the LAST real run's end (difference 0) and dump
+        # their zero contribution onto the last real column id — harmless
+        # (adds 0) and keeps the ids ascending for the sorted scatter.
+        cols[row, e.size:] = c[-1] if c.size else 0
+    return srows, svals, ends, cols
+
+
 def prepare_sparse_buckets(
     indptr, indices, values, dim: int, y, w, mesh: DeviceMesh,
     global_batch_size: int, max_buckets: int = 4, dtype=np.float32,
-    seed: Optional[int] = None, sorted_scatter: bool = True,
+    seed: Optional[int] = None, layout: str = "unsorted",
 ) -> Tuple[Tuple, Tuple[int, ...]]:
     """Pack, shuffle, pad, and shard CSR data for the bucketed trainer.
 
@@ -604,10 +766,11 @@ def prepare_sparse_buckets(
     ``seed`` shuffles rows *within* each bucket (bucket membership depends
     only on nnz, so this is the reference's partition shuffle applied
     post-bucketing — no re-gather of the full CSR needed).
-    ``sorted_scatter`` adds the per-window sort tables
-    (:func:`_window_sort_tables`) that let the gradient scatter skip its
-    per-step sort — +8 bytes/cell of HBM for the removal of the step's
-    dominant cost at high dim (see ``make_sparse_step_bucketed``).
+    ``layout`` selects the gradient-reduction layout (see
+    :func:`_sparse_layout`): ``sorted`` adds the per-window sort tables
+    (+8 B/cell of HBM), ``cumsum`` the sorted-cell value/row tables and
+    run boundaries (+12 B/cell) that remove the per-step cells-sized
+    sort AND permutation gather (see ``make_sparse_step_bucketed``).
     """
     from flinkml_tpu.ops.sparse import pack_ell_buckets
 
@@ -639,9 +802,17 @@ def prepare_sparse_buckets(
         share = max(1, math.ceil(global_batch_size * rows.size / (n * p_size)))
         local_bs = min(share, n_local)
         local_bss.append(local_bs)
-        if sorted_scatter:
+        if layout == "sorted":
             perm, sids = _window_sort_tables(idx_pad, p_size, local_bs)
             data_args += [mesh.shard_batch(perm), mesh.shard_batch(sids)]
+        elif layout == "cumsum":
+            srows, svals, ends, cols = _window_cumsum_tables(
+                idx_pad, val_pad, p_size, local_bs
+            )
+            data_args += [
+                mesh.shard_batch(srows), mesh.shard_batch(svals),
+                mesh.shard_batch(ends), mesh.shard_batch(cols),
+            ]
     return tuple(data_args), tuple(local_bss)
 
 
@@ -684,15 +855,15 @@ def train_linear_model_sparse_csr(
     n = np.asarray(indptr).size - 1
     if n == 0:
         raise ValueError("training table is empty")
-    sorted_scatter = _sorted_scatter_enabled()
+    layout = _sparse_layout()
     data_args, local_bss = prepare_sparse_buckets(
         indptr, indices, values, dim, y, w, mesh, global_batch_size,
         max_buckets=max_buckets, dtype=dtype, seed=seed,
-        sorted_scatter=sorted_scatter,
+        layout=layout,
     )
     trainer = _sparse_trainer_bucketed(
         mesh.mesh, loss, tuple(local_bss), DeviceMesh.DATA_AXIS, int(dim),
-        sorted_scatter,
+        layout,
     )
     return _run_chunked(
         trainer, tuple(data_args), int(dim), jnp.dtype(dtype),

@@ -546,16 +546,11 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
             item_f = jnp.zeros((n_items, rank), jnp.float32)  # restored below
             like = (np.zeros((n_users, rank), np.float32),
                     np.zeros((n_items, rank), np.float32))
-            # Agreed restore: a rank-local failure (corrupt/unreadable
-            # checkpoint on the shared FS) must abort every rank, not
-            # strand the peers in the training collectives below
-            # (same protocol as _gbt_stream.py's resume).
-            from flinkml_tpu.iteration.stream_sync import DeferredValidation
+            from flinkml_tpu.iteration.stream_sync import agreed_restore
 
-            dv = DeferredValidation()
-            got = dv.call(self.checkpoint_manager.restore, resume_epoch, like)
-            dv.rendezvous(mesh, f"checkpoint restore (epoch {resume_epoch})")
-            (user_h, item_h), start_epoch = got
+            (user_h, item_h), start_epoch = agreed_restore(
+                self.checkpoint_manager, resume_epoch, like, mesh
+            )
             user_f = jnp.asarray(user_h)
             item_f = jnp.asarray(item_h)
 

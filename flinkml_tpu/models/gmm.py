@@ -402,19 +402,11 @@ class GaussianMixture(StreamingEstimatorMixin, _GMMParams, Estimator):
         terminated = False
         if resume_epoch is not None:
             like = (weights, means, covs, np.float64(0.0), np.asarray(False))
-            # Agreed restore: a rank-local failure must abort every rank,
-            # not strand the peers in the EM collectives (same protocol
-            # as _gbt_stream.py's resume).
-            from flinkml_tpu.iteration.stream_sync import (
-                DeferredValidation,
-            )
+            from flinkml_tpu.iteration.stream_sync import agreed_restore
 
-            dv_restore = DeferredValidation()
-            got = dv_restore.call(mgr.restore, resume_epoch, like)
-            dv_restore.rendezvous(
-                mesh, f"checkpoint restore (epoch {resume_epoch})"
+            (weights, means, covs, prev_ll, term), start_epoch = (
+                agreed_restore(mgr, resume_epoch, like, mesh)
             )
-            (weights, means, covs, prev_ll, term), start_epoch = got
             prev_ll = float(prev_ll)
             terminated = bool(term)
 

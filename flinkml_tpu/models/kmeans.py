@@ -506,19 +506,12 @@ def train_kmeans_stream(
             d_feat = np.asarray(next(iter(reader))[column]).shape[1]
             if hasattr(reader, "close"):
                 reader.close()
-        # Agreed restore: a rank-local failure (corrupt/unreadable
-        # checkpoint on the shared FS) must abort every rank, not strand
-        # the peers in the Lloyd collectives (same protocol as
-        # _gbt_stream.py's resume).
-        dv_restore = DeferredValidation()
-        got = dv_restore.call(
-            checkpoint_manager.restore, resume_epoch,
-            np.zeros((k, d_feat), np.float32),
+        from flinkml_tpu.iteration.stream_sync import agreed_restore
+
+        centroids, start_epoch = agreed_restore(
+            checkpoint_manager, resume_epoch,
+            np.zeros((k, d_feat), np.float32), mesh,
         )
-        dv_restore.rendezvous(
-            mesh, f"checkpoint restore (epoch {resume_epoch})"
-        )
-        centroids, start_epoch = got
     elif initial_centroids is not None:
         centroids = np.asarray(initial_centroids, np.float32)
         if centroids.shape[0] != k:

@@ -358,15 +358,11 @@ class LDA(StreamingEstimatorMixin, _LDAParams, Estimator):
         terminated = False
         if resume_epoch is not None:
             like = (lam, np.float64(0.0), np.asarray(False))
-            # Agreed restore: a rank-local failure must abort every rank,
-            # not strand the peers in the VB-pass collectives (same
-            # protocol as _gbt_stream.py's resume).
-            from flinkml_tpu.iteration.stream_sync import DeferredValidation
+            from flinkml_tpu.iteration.stream_sync import agreed_restore
 
-            dv = DeferredValidation()
-            got = dv.call(self.checkpoint_manager.restore, resume_epoch, like)
-            dv.rendezvous(mesh, f"checkpoint restore (epoch {resume_epoch})")
-            (lam, prev_ll, term), start_epoch = got
+            (lam, prev_ll, term), start_epoch = agreed_restore(
+                self.checkpoint_manager, resume_epoch, like, mesh
+            )
             prev_ll = float(prev_ll)
             terminated = bool(term)
 

@@ -504,15 +504,11 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             v = jnp.zeros((len(vocab), dim), jnp.float32)  # restored below
         if resume_epoch is not None:
             like = (np.zeros((len(vocab), dim), np.float32),) * 2
-            # Agreed restore: a rank-local failure must abort every rank,
-            # not strand the peers in the SGNS training collectives (same
-            # protocol as _gbt_stream.py's resume).
-            from flinkml_tpu.iteration.stream_sync import DeferredValidation
+            from flinkml_tpu.iteration.stream_sync import agreed_restore
 
-            dv = DeferredValidation()
-            got = dv.call(self.checkpoint_manager.restore, resume_epoch, like)
-            dv.rendezvous(mesh, f"checkpoint restore (epoch {resume_epoch})")
-            (v_h, u_h), start_epoch = got
+            (v_h, u_h), start_epoch = agreed_restore(
+                self.checkpoint_manager, resume_epoch, like, mesh
+            )
             v, u = jnp.asarray(v_h), jnp.asarray(u_h)
 
         from flinkml_tpu.parallel.dispatch import DispatchGuard

@@ -329,6 +329,132 @@ def test_sorted_scatter_layout_matches_unsorted(mesh, monkeypatch):
     assert acc > 0.9, acc
 
 
+def test_cumsum_layout_matches_unsorted(mesh, monkeypatch):
+    """Round-5 sort-free layout: pack-time column-sorted cells + running-
+    sum boundary differences must train to the same model (allclose —
+    the running-sum difference changes f32 summation order)."""
+    from flinkml_tpu.models import _linear_sgd
+
+    rng = np.random.default_rng(7)
+    n, dim = 640, 3000
+    # Skewed nnz so multiple ELL buckets exist, plus a Zipfian column
+    # distribution (the Criteo profile the layout exists for).
+    nnz = np.clip(rng.geometric(0.2, size=n), 1, 40)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(nnz, out=indptr[1:])
+    total = int(indptr[-1])
+    indices = np.minimum(
+        rng.zipf(1.3, size=total) - 1, dim - 1
+    ).astype(np.int32)
+    values = rng.normal(size=total).astype(np.float32)
+    beta = np.zeros(dim, np.float32)
+    beta[rng.choice(dim, 50, replace=False)] = rng.normal(size=50)
+    y = np.zeros(n, np.float32)
+    for r in range(n):
+        sl = slice(indptr[r], indptr[r + 1])
+        y[r] = float((values[sl] * beta[indices[sl]]).sum() > 0)
+    w = np.ones(n, np.float32)
+
+    def train(layout):
+        monkeypatch.setenv("FLINKML_TPU_SPARSE_LAYOUT", layout)
+        return _linear_sgd.train_linear_model_sparse_csr(
+            indptr, indices, values, dim, y, w, loss="logistic",
+            mesh=mesh, max_iter=30, learning_rate=0.5,
+            global_batch_size=256, reg=0.01, elastic_net=0.1, tol=0.0,
+            seed=3,
+        )
+
+    base = train("unsorted")
+    cum = train("cumsum")
+    np.testing.assert_allclose(cum, base, atol=2e-4, rtol=2e-4)
+
+
+def test_sparse_layout_env_validation(monkeypatch):
+    from flinkml_tpu.models._linear_sgd import _sparse_layout
+
+    monkeypatch.setenv("FLINKML_TPU_SPARSE_LAYOUT", "bogus")
+    with pytest.raises(ValueError, match="FLINKML_TPU_SPARSE_LAYOUT"):
+        _sparse_layout()
+    monkeypatch.delenv("FLINKML_TPU_SPARSE_LAYOUT")
+    monkeypatch.setenv("FLINKML_TPU_SORTED_SCATTER", "1")
+    assert _sparse_layout() == "sorted"
+
+
+def test_chunked_segment_totals_precision_at_bench_scale():
+    """The two-level running sum must hold f32 precision at the REAL
+    Criteo cell count: a single global f32 prefix sum random-walks to
+    ~3e3x a cell magnitude by 1e7 cells, putting a fixed ~1e-3-relative
+    bias on rare-column (small-run) segment totals; the chunked
+    decomposition bounds the error by the chunk scale instead. Checked
+    against a float64 reference with a Zipf run-length profile."""
+    import jax.numpy as jnp
+
+    from flinkml_tpu.models._linear_sgd import _chunked_segment_totals
+
+    rng = np.random.default_rng(0)
+    cells = 10_000_000
+    contrib = rng.normal(size=cells).astype(np.float32)
+    # Zipfian run lengths: many 1-cell runs (rare columns) plus hot runs.
+    lens = np.minimum(rng.zipf(1.5, size=cells), 200_000)
+    lens = lens[np.cumsum(lens) <= cells]
+    total = int(lens.sum())
+    lens = np.concatenate([lens, [cells - total]]) if total < cells else lens
+    ends = np.cumsum(lens).astype(np.int32) - 1
+    seg32 = np.asarray(_chunked_segment_totals(
+        jnp.asarray(contrib), jnp.asarray(ends)
+    ))
+    c64 = np.cumsum(contrib.astype(np.float64))
+    t = c64[ends]
+    seg64 = t - np.concatenate([[0.0], t[:-1]])
+    # Absolute error relative to each segment's own scale (>= 1 cell's
+    # typical magnitude). Chunked error is bounded by the CHUNK's
+    # running-sum magnitude (measured ~8e-5 here); the single global f32
+    # prefix sum it replaces carries the full window's magnitude into
+    # every small segment — an order worse, checked below.
+    denom = np.maximum(np.abs(seg64), 1.0)
+    rel = np.abs(seg32 - seg64) / denom
+    assert rel.max() < 3e-4, rel.max()
+    c32 = np.cumsum(contrib)  # the naive scheme
+    t32 = c32[ends]
+    naive = t32 - np.concatenate([[np.float32(0)], t32[:-1]])
+    naive_rel = np.abs(naive - seg64) / denom
+    assert rel.max() < naive_rel.max() / 5, (rel.max(), naive_rel.max())
+
+
+def test_window_cumsum_tables_reconstruct_segment_sums():
+    """The pack-time tables must reproduce an exact per-window histogram:
+    sum(svals[run] * mult[srows[run]]) grouped by cols == dense reference."""
+    from flinkml_tpu.models._linear_sgd import _window_cumsum_tables
+
+    rng = np.random.default_rng(0)
+    p, n_local, width, local_bs, dim = 2, 12, 3, 5, 17
+    idx_pad = rng.integers(0, dim, size=(p * n_local, width)).astype(np.int32)
+    val_pad = rng.normal(size=(p * n_local, width)).astype(np.float64)
+    srows, svals, ends, cols = _window_cumsum_tables(
+        idx_pad, val_pad, p, local_bs
+    )
+    n_windows = -(-n_local // local_bs)
+    assert srows.shape == (p * n_windows, local_bs * width)
+    for d in range(p):
+        mult = rng.normal(size=local_bs)
+        for wnum in range(n_windows):
+            row = d * n_windows + wnum
+            start = min(wnum * local_bs, n_local - local_bs)
+            ib = idx_pad[d * n_local + start:d * n_local + start + local_bs]
+            vb = val_pad[d * n_local + start:d * n_local + start + local_bs]
+            expect = np.zeros(dim)
+            np.add.at(expect, ib.reshape(-1),
+                      (vb * mult[:, None]).reshape(-1))
+            contrib = svals[row] * mult[srows[row]]
+            csum = np.cumsum(contrib)
+            t = csum[ends[row]]
+            seg = t - np.concatenate([[0.0], t[:-1]])
+            got = np.zeros(dim)
+            np.add.at(got, cols[row], seg)
+            np.testing.assert_allclose(got, expect, atol=1e-12)
+            assert (np.diff(cols[row]) >= 0).all()
+
+
 def test_window_sort_tables_are_sorted_and_permute_back():
     from flinkml_tpu.models._linear_sgd import _window_sort_tables
 

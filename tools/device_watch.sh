@@ -33,16 +33,16 @@ echo "--- 1. full staged bench ---"
 timeout $(( ${FLINKML_BENCH_TIMEOUT:-2100} + 600 )) python bench.py \
     || echo "bench FAILED rc=$?"
 
-echo "--- 2. sorted-scatter A/B (900 s cap) ---"
-timeout 900 python tools/sorted_scatter_probe.py \
-    || echo "sorted_scatter_probe FAILED rc=$?"
+echo "--- 2. sparse layout A/B (1200 s cap) ---"
+timeout 1200 python tools/sparse_layout_probe.py \
+    || echo "sparse_layout_probe FAILED rc=$?"
 
 echo "--- 3. gather/scatter bounds-mode A/B (600 s cap) ---"
 timeout 600 python tools/sparse_pib_probe.py \
     || echo "sparse_pib_probe FAILED rc=$?"
 
-echo "--- 4. compile-ceiling sweep, device half (1800 s cap) ---"
-timeout 1800 python tools/compile_ceiling_probe.py \
-    || echo "compile_ceiling_probe FAILED rc=$?"
+echo "--- 4. bf16 dense profile trace (600 s cap) ---"
+timeout 600 python tools/bf16_profile_probe.py \
+    || echo "bf16_profile_probe FAILED rc=$?"
 
 echo "=== done; transcribe results into BASELINE.md (log: $LOG) ==="

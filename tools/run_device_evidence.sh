@@ -1,10 +1,12 @@
 #!/bin/bash
-# One-shot device-evidence capture for the moment the tunnel heals.
-# Runs, in order, with generous but bounded timeouts and full logging:
+# One-shot device-evidence capture for the moment the tunnel heals —
+# the same suite as device_watch.sh, without the watching loop:
 #   1. health probe (aborts early if the tunnel is still wedged)
-#   2. sorted-scatter A/B at Criteo shapes (VERDICT r3 item 4a)
-#   3. compile-ceiling sweep, device half   (VERDICT r3 item 4b)
-#   4. full staged bench -> one JSON line   (the round's headline number)
+#   2. full staged bench -> JSON result line (the round's headline numbers)
+#   3. sparse layout 3-way A/B           (VERDICT r4 item 2 — decides the
+#      cumsum-vs-unsorted product default)
+#   4. gather/scatter bounds-mode A/B
+#   5. bf16 gap attribution sweep        (VERDICT r4 item 6)
 # All output lands in tools/device_evidence_<UTC>.log; append the numbers
 # to BASELINE.md afterwards. Never run concurrently with another device
 # client (each step takes the single-tenant device lock itself).
@@ -24,18 +26,22 @@ if ! timeout 90 python tools/device_probe.py; then
     exit 1
 fi
 
-echo "--- 2. sorted-scatter A/B (600 s cap) ---"
-timeout 600 python tools/sorted_scatter_probe.py \
-    || echo "sorted_scatter_probe FAILED rc=$?"
-
-echo "--- 3. compile-ceiling sweep, device half (1800 s cap) ---"
-timeout 1800 python tools/compile_ceiling_probe.py \
-    || echo "compile_ceiling_probe FAILED rc=$?"
-
-echo "--- 4. full staged bench (FLINKML_BENCH_TIMEOUT=${FLINKML_BENCH_TIMEOUT:-2100} s) ---"
+echo "--- 2. full staged bench (FLINKML_BENCH_TIMEOUT=${FLINKML_BENCH_TIMEOUT:-1680} s) ---"
 # Outer kill-cap tracks the bench's own budget (+10 min of slack) so an
 # operator raising FLINKML_BENCH_TIMEOUT doesn't get SIGKILLed mid-run.
-timeout $(( ${FLINKML_BENCH_TIMEOUT:-2100} + 600 )) python bench.py \
+timeout $(( ${FLINKML_BENCH_TIMEOUT:-1680} + 600 )) python bench.py \
     || echo "bench FAILED rc=$?"
+
+echo "--- 3. sparse layout A/B (1200 s cap) ---"
+timeout 1200 python tools/sparse_layout_probe.py \
+    || echo "sparse_layout_probe FAILED rc=$?"
+
+echo "--- 4. gather/scatter bounds-mode A/B (600 s cap) ---"
+timeout 600 python tools/sparse_pib_probe.py \
+    || echo "sparse_pib_probe FAILED rc=$?"
+
+echo "--- 5. bf16 dense profile sweep (600 s cap) ---"
+timeout 600 python tools/bf16_profile_probe.py \
+    || echo "bf16_profile_probe FAILED rc=$?"
 
 echo "=== done; transcribe results into BASELINE.md (log: $LOG) ==="
