@@ -102,3 +102,40 @@ def test_persistence_and_determinism(tmp_path):
     np.testing.assert_allclose(v2["vec"], v1["vec"])
     model2, _ = _fit(docs, max_iter=2)
     np.testing.assert_array_equal(model2.vectors, model.vectors)
+
+
+def test_sharded_trainer_matches_dense(monkeypatch):
+    """Above the vocab threshold the in-RAM fit switches to the
+    vocab-sharded ring trainer; forcing the threshold to 0 must
+    reproduce the dense trainer's vectors on the same seed (identical
+    sampling sequence; f32 summation order differs only through the
+    ring's masked partial adds)."""
+    docs, animals, tools = _topic_corpus()
+    dense_model, _ = _fit(docs)
+    monkeypatch.setenv("FLINKML_W2V_SHARD_VOCAB", "0")
+    sharded_model, _ = _fit(docs)
+    dv = dense_model._vectors
+    sv = sharded_model._vectors
+    np.testing.assert_allclose(sv, dv, rtol=2e-3, atol=2e-4)
+    # And the sharded embedding still carries the topic structure.
+    vec = {str(t): sv[i] for i, t in enumerate(sharded_model._vocab)}
+    same = _cos(vec["cat"], vec["dog"])
+    cross = _cos(vec["cat"], vec["hammer"])
+    assert same > cross, (same, cross)
+
+
+def test_streamed_fit_rejects_vocab_above_shard_threshold(monkeypatch):
+    """The streamed fit has no sharded path: above the threshold it must
+    fail loudly with guidance, not silently psum [vocab, dim] forever."""
+    monkeypatch.setenv("FLINKML_W2V_SHARD_VOCAB", "3")
+    docs, _, _ = _topic_corpus(n_docs=80)
+    t = Table({"text": np.asarray(docs)})
+    (tok,) = (
+        Tokenizer().set_input_col("text").set_output_col("tok").transform(t)
+    )
+    w2v = (
+        Word2Vec().set_input_col("tok").set_output_col("vec")
+        .set_vector_size(8).set_min_count(2).set_max_iter(1).set_seed(0)
+    )
+    with pytest.raises(ValueError, match="scale ceiling"):
+        w2v.fit(iter([tok]))
