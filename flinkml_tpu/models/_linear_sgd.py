@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.ops.losses import margin_terms as _margin_grad
+from flinkml_tpu.ops.sparse import chunked_run_totals
 from flinkml_tpu.parallel import DeviceMesh, pad_to_multiple
 
 _LOSS_KEYS = ("logistic", "hinge", "squared")
@@ -177,60 +178,6 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
 
 _SPARSE_ARGS_PER_BUCKET = {"unsorted": 4, "sorted": 6, "cumsum": 8}
 
-# Chunk width of the two-level running sum below. Within-chunk prefix
-# sums bound the f32 cancellation error of a boundary difference by the
-# CHUNK's magnitude (~eps·sqrt(C)·sigma) instead of the whole window's
-# (~eps·sqrt(cells)·sigma — a fixed per-window bias on rare-column
-# gradients at 1e7 cells, since windows are deterministic).
-_CUMSUM_CHUNK = 65_536
-
-
-def _chunked_segment_totals(contrib, ends):
-    """Totals of contiguous runs of ``contrib`` ending at inclusive
-    indices ``ends`` (ascending; padding repeats an end, differencing to
-    exactly 0) — sort-free and cells-gather-free, with two-level
-    precision.
-
-    A single global running sum would make every boundary difference
-    carry absolute error ~eps·|global prefix|, which at 1e7 cells is a
-    biased ~1e-3·sigma on small (rare-column) segments. Decomposing by
-    chunks of ``_CUMSUM_CHUNK``: a segment inside one chunk differences
-    the LOCAL prefix sum (error ~eps·sqrt(C)·sigma); a segment spanning
-    chunks takes head/tail from local prefixes and the full chunks
-    between from a chunk-prefix difference that is exactly 0 unless the
-    segment contains >= 1 full chunk — in which case its own magnitude
-    is >= chunk-sized and the global-prefix error is relatively
-    negligible. Verified against a float64 reference at the 1e7-cell
-    bench shape (``tests/test_sparse_scale.py``)."""
-    cells = contrib.shape[0]
-    acc = contrib.dtype
-    C = _CUMSUM_CHUNK
-    # Front-pad one zero cell so every boundary index shifts to >= 1 and
-    # the "previous end" of the first run is index 0 (a zero); tail-pad
-    # to a whole number of chunks.
-    n_chunks = -(-(cells + 1) // C)
-    pad_tail = n_chunks * C - (cells + 1)
-    padded = jnp.concatenate([
-        jnp.zeros((1,), acc), contrib, jnp.zeros((pad_tail,), acc)
-    ])
-    lcs = jnp.cumsum(padded.reshape(n_chunks, C), axis=1)
-    chunk_tot = lcs[:, -1]
-    chunk_prefix = jnp.cumsum(chunk_tot)
-    flat_lcs = lcs.reshape(-1)
-
-    e1 = ends + 1
-    s1 = jnp.concatenate([jnp.zeros((1,), ends.dtype), e1[:-1]])
-    ce, cs = e1 // C, s1 // C
-    local_e = jnp.take(flat_lcs, e1)
-    local_s = jnp.take(flat_lcs, s1)
-    same = ce == cs
-    # Spanning: tail of the start chunk + full chunks between (exactly 0
-    # when ce == cs + 1) + head of the end chunk.
-    tail = jnp.take(chunk_tot, cs) - local_s
-    between = jnp.take(chunk_prefix, jnp.maximum(ce - 1, 0)) - \
-        jnp.take(chunk_prefix, cs)
-    return jnp.where(same, local_e - local_s, tail + between + local_e)
-
 
 def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
                               axis: str, dim: int,
@@ -300,7 +247,7 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
                 ends_w = window_of(endsl, epoch)
                 cols_w = window_of(colsl, epoch)
                 contrib = svals_w * jnp.take(mult, srows_w)
-                seg = _chunked_segment_totals(contrib.astype(acc), ends_w)
+                seg = chunked_run_totals(contrib.astype(acc), ends_w)
                 grad_local = grad_local.at[cols_w].add(
                     seg.astype(coef.dtype), indices_are_sorted=True,
                 )

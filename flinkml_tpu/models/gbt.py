@@ -26,6 +26,7 @@ rather than translated from CPU tree libraries:
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional, Tuple
 
 import jax
@@ -123,10 +124,88 @@ def bin_features(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
 
 # -- device forest builder ----------------------------------------------------
 
+
+def _hist_layout() -> str:
+    """Measured-default gate for the per-level histogram reduction.
+
+    ``segment`` (default): one ``segment_sum`` over ``n·d`` cells per
+    level — XLA's sort-based lowering re-sorts every cell at every level
+    of every tree (measured 0.22% of the streaming bound, BASELINE.md
+    "rooflines": the same sort class as sparse LR). ``cumsum``: the
+    (feature, bin) half of the key is STATIC per fit, so cells are
+    sorted once at pack time (:func:`gbt_hist_tables`) and each level
+    reduces ``2^level``-wide one-hot-expanded (grad, hess) columns with
+    :func:`~flinkml_tpu.ops.sparse.chunked_run_totals` — streaming
+    passes, no per-level sort. ``FLINKML_TPU_GBT_HISTOGRAM`` selects;
+    the device A/B (``tools/gbt_hist_probe.py``) decides the default."""
+    layout = os.environ.get("FLINKML_TPU_GBT_HISTOGRAM", "segment")
+    if layout not in ("segment", "cumsum"):
+        raise ValueError(
+            f"FLINKML_TPU_GBT_HISTOGRAM={layout!r}: expected "
+            "'segment' or 'cumsum'"
+        )
+    return layout
+
+
+def gbt_hist_tables(b_pad: np.ndarray, p_size: int, n_bins: int):
+    """Pack-time tables for the ``cumsum`` histogram layout.
+
+    Per device shard of the padded binned matrix ``[n, d]``: flatten the
+    ``n_local·d`` cells row-major, sort ONCE by the static key
+    ``feat·n_bins + bin``, and record
+
+    - ``srow [p·cells] int32`` — row-in-shard of each sorted cell (the
+      level body gathers grad/hess/node through it);
+    - ``ends [p·max_runs] int32`` — inclusive end of each (feat, bin)
+      run, padded by repeating the last end (differences to exactly 0);
+    - ``cols [p·max_runs] int32`` — the run's static key, ascending.
+    """
+    n, d = b_pad.shape
+    n_local = n // p_size
+    cells = n_local * d
+    srow = np.empty((p_size, cells), np.int32)
+    per_dev = []
+    for dev in range(p_size):
+        shard = b_pad[dev * n_local:(dev + 1) * n_local]
+        key = (np.arange(d, dtype=np.int64)[None, :] * n_bins
+               + shard).reshape(-1)
+        order = np.argsort(key, kind="stable")
+        skey = key[order]
+        srow[dev] = (order // d).astype(np.int32)
+        is_end = np.empty(cells, np.bool_)
+        is_end[:-1] = skey[:-1] != skey[1:]
+        is_end[-1] = True
+        e = np.nonzero(is_end)[0].astype(np.int32)
+        per_dev.append((e, skey[e].astype(np.int32)))
+    max_runs = max(e.size for e, _ in per_dev)
+    ends = np.full((p_size, max_runs), cells - 1, np.int32)
+    cols = np.empty((p_size, max_runs), np.int32)
+    for dev, (e, c) in enumerate(per_dev):
+        ends[dev, : e.size] = e
+        cols[dev, : e.size] = c
+        cols[dev, e.size:] = c[-1] if c.size else 0
+    return srow.reshape(-1), ends.reshape(-1), cols.reshape(-1)
+
+
+def sharded_hist_args(b_pad: np.ndarray, mesh, n_bins: int,
+                      hist_layout: str) -> tuple:
+    """The extra sharded builder args for ``hist_layout`` — ONE
+    definition shared by the product fit path, the bench GBT stage, and
+    ``tools/gbt_hist_probe.py``, so every consumer passes the builder
+    the identical table layout. Empty for ``segment``."""
+    if hist_layout != "cumsum":
+        return ()
+    srow, ends, cols = gbt_hist_tables(b_pad, mesh.axis_size(), n_bins)
+    return (
+        mesh.shard_batch(srow), mesh.shard_batch(ends),
+        mesh.shard_batch(cols),
+    )
+
+
 @functools.lru_cache(maxsize=16)
 def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
                     num_trees: int, logistic: bool, boosting: bool = True,
-                    feat_subset: int = 0):
+                    feat_subset: int = 0, hist_layout: str = "segment"):
     """One compiled program that builds the whole forest.
 
     Static config in the cache key; runtime inputs are the sharded
@@ -151,9 +230,37 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
             return (p - y) * w, jnp.maximum(p * (1 - p), 1e-6) * w
         return (pred - y) * w, w
 
-    def local(binned, y, w, base, lr, lam, subsample, key):
+    def local(binned, y, w, base, lr, lam, subsample, key, *hist_tables):
         n_local = binned.shape[0]
         feat_ids = jnp.arange(n_feat, dtype=jnp.int32)[None, :]
+        if hist_layout == "cumsum":
+            srow, ends, cols = hist_tables
+
+        def level_hists_cumsum(g, h, node, level):
+            """Sort-free per-level histograms: gather by the pack-time
+            cell order, expand by a 2^level-wide node one-hot, reduce
+            grad and hess columns in ONE fused run-totals pass at the
+            static (feat, bin) boundaries."""
+            from flinkml_tpu.ops.sparse import chunked_run_totals
+
+            width = 1 << level
+            oh = jax.nn.one_hot(node[srow], width, dtype=g.dtype)
+            both = jnp.concatenate(
+                [g[srow][:, None] * oh, h[srow][:, None] * oh], axis=1
+            )
+            t2 = chunked_run_totals(both, ends)    # [runs, 2*width]
+            out = []
+            for t in (t2[:, :width], t2[:, width:]):
+                fb = jnp.zeros((n_feat * n_bins, width), g.dtype) \
+                    .at[cols].add(t)
+                full = jnp.zeros((n_leaves, n_feat, n_bins), g.dtype) \
+                    .at[:width].set(
+                        jnp.moveaxis(
+                            fb.reshape(n_feat, n_bins, width), -1, 0
+                        )
+                    )
+                out.append(full)
+            return out[0], out[1]
 
         def build_tree(g, h, fmask):
             node = jnp.zeros(n_local, jnp.int32)   # index within level
@@ -161,14 +268,19 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
             bin_arr = jnp.zeros(n_inner, jnp.int32)
             gain_arr = jnp.zeros(n_inner, jnp.float32)
             for level in range(depth):
-                ids = ((node[:, None] * n_feat + feat_ids) * n_bins
-                       + binned).reshape(-1)
-                hg = jax.lax.psum(jax.ops.segment_sum(
-                    jnp.repeat(g, n_feat), ids, num_segments=seg), axis)
-                hh = jax.lax.psum(jax.ops.segment_sum(
-                    jnp.repeat(h, n_feat), ids, num_segments=seg), axis)
-                hg = hg.reshape(n_leaves, n_feat, n_bins)
-                hh = hh.reshape(n_leaves, n_feat, n_bins)
+                if hist_layout == "cumsum":
+                    hg, hh = level_hists_cumsum(g, h, node, level)
+                    hg = jax.lax.psum(hg, axis)
+                    hh = jax.lax.psum(hh, axis)
+                else:
+                    ids = ((node[:, None] * n_feat + feat_ids) * n_bins
+                           + binned).reshape(-1)
+                    hg = jax.lax.psum(jax.ops.segment_sum(
+                        jnp.repeat(g, n_feat), ids, num_segments=seg), axis)
+                    hh = jax.lax.psum(jax.ops.segment_sum(
+                        jnp.repeat(h, n_feat), ids, num_segments=seg), axis)
+                    hg = hg.reshape(n_leaves, n_feat, n_bins)
+                    hh = hh.reshape(n_leaves, n_feat, n_bins)
                 gl = jnp.cumsum(hg, axis=2)
                 hl = jnp.cumsum(hh, axis=2)
                 gt = gl[:, :, -1:]
@@ -254,10 +366,12 @@ def _forest_builder(mesh, axis: str, n_feat: int, n_bins: int, depth: int,
         _, trees = jax.lax.scan(tree_step, pred0, keys)
         return trees
 
+    hist_specs = (P(axis),) * 3 if hist_layout == "cumsum" else ()
     return jax.jit(
         jax.shard_map(
             local, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
+            in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P())
+            + hist_specs,
             out_specs=(P(), P(), P(), P()),
         )
     )
@@ -402,18 +516,21 @@ class _GBTBase(StreamingEstimatorMixin, _GBTParams, Estimator):
         feat_subset = (
             0 if f >= 1.0 else max(1, int(round(f * x.shape[1])))
         )
+        hist_layout = _hist_layout()
         builder = _forest_builder(
             mesh.mesh, DeviceMesh.DATA_AXIS, x.shape[1], max_bins, depth,
             self.get(self.NUM_TREES), self._LOGISTIC,
             boosting=self._BOOSTING, feat_subset=feat_subset,
+            hist_layout=hist_layout,
         )
+        hist_args = sharded_hist_args(b_pad, mesh, max_bins, hist_layout)
         f32 = lambda v: jnp.asarray(v, jnp.float32)
         feats, bins, gains, leaves = builder(
             mesh.shard_batch(b_pad), mesh.shard_batch(y_pad),
             mesh.shard_batch(w_pad),
             f32(base), f32(self.get(self.LEARNING_RATE)),
             f32(self.get(self.REG_LAMBDA)), f32(self.get(self.SUBSAMPLE)),
-            jax.random.PRNGKey(self.get_seed()),
+            jax.random.PRNGKey(self.get_seed()), *hist_args,
         )
         feats = np.asarray(feats)
         bins = np.asarray(bins)

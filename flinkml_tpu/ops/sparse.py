@@ -319,3 +319,61 @@ def pack_ell_buckets(indptr, indices, values, dim: int,
         buckets.append({"indices": bi, "values": bv})
         row_ids.append(rows)
     return buckets, row_ids
+
+
+# Chunk width of the two-level running sum in chunked_run_totals. Within-
+# chunk prefix sums bound the f32 cancellation error of a boundary
+# difference by the CHUNK's magnitude (~eps·sqrt(C)·sigma) instead of the
+# whole array's (~eps·sqrt(cells)·sigma — a fixed bias on small runs at
+# 1e7 cells when the inputs are deterministic across steps).
+CUMSUM_CHUNK = 65_536
+
+
+def chunked_run_totals(contrib, ends):
+    """Totals of contiguous runs of ``contrib`` (1-D ``[cells]`` or 2-D
+    ``[cells, k]``, reduced over axis 0 per column) ending at inclusive
+    indices ``ends`` (ascending; a repeated end differences to exactly
+    0) — the sort-free segmented reduction behind the ``cumsum`` sparse
+    gradient layout and the GBT histogram fast path.
+
+    A single global running sum would give every boundary difference
+    absolute error ~eps·|global prefix|; the two-level decomposition
+    bounds it by the chunk scale instead: a run inside one chunk
+    differences the LOCAL prefix sum, a run spanning chunks takes
+    head/tail locally and the full chunks between from a chunk-prefix
+    difference that is exactly 0 unless the run contains >= 1 full chunk
+    — in which case its own magnitude is chunk-sized and the global
+    error is relatively negligible. Verified against float64 at the
+    1e7-cell bench shape (``tests/test_sparse_scale.py``)."""
+    flat = contrib.ndim == 1
+    if flat:
+        contrib = contrib[:, None]
+    cells, k = contrib.shape
+    acc = contrib.dtype
+    C = CUMSUM_CHUNK
+    # Front-pad one zero cell so every boundary index shifts to >= 1 and
+    # the "previous end" of the first run is index 0 (a zero); tail-pad
+    # to a whole number of chunks.
+    n_chunks = -(-(cells + 1) // C)
+    pad_tail = n_chunks * C - (cells + 1)
+    padded = jnp.concatenate([
+        jnp.zeros((1, k), acc), contrib, jnp.zeros((pad_tail, k), acc)
+    ])
+    lcs = jnp.cumsum(padded.reshape(n_chunks, C, k), axis=1)
+    chunk_tot = lcs[:, -1, :]                      # [n_chunks, k]
+    chunk_prefix = jnp.cumsum(chunk_tot, axis=0)
+    flat_lcs = lcs.reshape(-1, k)
+
+    e1 = ends + 1
+    s1 = jnp.concatenate([jnp.zeros((1,), ends.dtype), e1[:-1]])
+    ce, cs = e1 // C, s1 // C
+    local_e = jnp.take(flat_lcs, e1, axis=0)
+    local_s = jnp.take(flat_lcs, s1, axis=0)
+    same = (ce == cs)[:, None]
+    # Spanning: tail of the start chunk + full chunks between (exactly 0
+    # when ce == cs + 1) + head of the end chunk.
+    tail = jnp.take(chunk_tot, cs, axis=0) - local_s
+    between = jnp.take(chunk_prefix, jnp.maximum(ce - 1, 0), axis=0) - \
+        jnp.take(chunk_prefix, cs, axis=0)
+    out = jnp.where(same, local_e - local_s, tail + between + local_e)
+    return out[:, 0] if flat else out

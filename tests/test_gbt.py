@@ -460,3 +460,60 @@ def test_random_forest_on_sparse_input():
     )
     (out,) = model.transform(table)
     assert float(np.mean(out["prediction"] == y)) > 0.7
+
+
+def test_cumsum_histogram_layout_matches_segment(mesh, monkeypatch):
+    """FLINKML_TPU_GBT_HISTOGRAM=cumsum (pack-time-sorted cells +
+    chunked run totals) must build the identical forest: same splits,
+    same leaf values, same raw predictions."""
+    from flinkml_tpu.models.gbt import GBTClassifier
+
+    rng = np.random.default_rng(3)
+    n = 512
+    x = rng.uniform(-1, 1, size=(n, 5)).astype(np.float32)
+    y = ((x[:, 0] * x[:, 1] > 0)).astype(np.float64)
+    t = Table({"features": x, "label": y})
+
+    def fit(layout):
+        monkeypatch.setenv("FLINKML_TPU_GBT_HISTOGRAM", layout)
+        m = (
+            GBTClassifier(mesh=mesh).set_num_trees(6).set_max_depth(3)
+            .set_max_bins(16).set_subsample(0.8).set_seed(0).fit(t)
+        )
+        (out,) = m.transform(t)
+        return m, np.asarray(out["rawPrediction"])
+
+    m_seg, raw_seg = fit("segment")
+    m_cum, raw_cum = fit("cumsum")
+    np.testing.assert_array_equal(m_seg._feats, m_cum._feats)
+    np.testing.assert_allclose(m_seg._leaves, m_cum._leaves, rtol=1e-5)
+    np.testing.assert_allclose(raw_cum, raw_seg, rtol=1e-5, atol=1e-6)
+
+
+def test_gbt_hist_tables_reconstruct_histograms():
+    from flinkml_tpu.models.gbt import gbt_hist_tables
+
+    rng = np.random.default_rng(0)
+    p, n_local, d, n_bins = 2, 24, 3, 4
+    b = rng.integers(0, n_bins, size=(p * n_local, d)).astype(np.int32)
+    srow, ends, cols = gbt_hist_tables(b, p, n_bins)
+    cells = n_local * d
+    g = rng.normal(size=p * n_local)
+    for dev in range(p):
+        shard = b[dev * n_local:(dev + 1) * n_local]
+        expect = np.zeros(d * n_bins)
+        np.add.at(
+            expect,
+            (np.arange(d)[None, :] * n_bins + shard).reshape(-1),
+            np.repeat(g[dev * n_local:(dev + 1) * n_local], d),
+        )
+        sr = srow[dev * cells:(dev + 1) * cells]
+        e = ends[dev * (ends.size // p):(dev + 1) * (ends.size // p)]
+        c = cols[dev * (cols.size // p):(dev + 1) * (cols.size // p)]
+        contrib = g[dev * n_local + sr]
+        csum = np.cumsum(contrib)
+        tvals = csum[e]
+        seg = tvals - np.concatenate([[0.0], tvals[:-1]])
+        got = np.zeros(d * n_bins)
+        np.add.at(got, c, seg)
+        np.testing.assert_allclose(got, expect, atol=1e-10)

@@ -389,7 +389,7 @@ def test_chunked_segment_totals_precision_at_bench_scale():
     against a float64 reference with a Zipf run-length profile."""
     import jax.numpy as jnp
 
-    from flinkml_tpu.models._linear_sgd import _chunked_segment_totals
+    from flinkml_tpu.ops.sparse import chunked_run_totals
 
     rng = np.random.default_rng(0)
     cells = 10_000_000
@@ -400,7 +400,7 @@ def test_chunked_segment_totals_precision_at_bench_scale():
     total = int(lens.sum())
     lens = np.concatenate([lens, [cells - total]]) if total < cells else lens
     ends = np.cumsum(lens).astype(np.int32) - 1
-    seg32 = np.asarray(_chunked_segment_totals(
+    seg32 = np.asarray(chunked_run_totals(
         jnp.asarray(contrib), jnp.asarray(ends)
     ))
     c64 = np.cumsum(contrib.astype(np.float64))

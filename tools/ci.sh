@@ -1,0 +1,78 @@
+#!/bin/bash
+# One-command CI (the reference's tools/ci/ role): lint, full suite,
+# 8-device sharding dryrun, bench smoke, example smoke — everything runs
+# on the host CPU (FLINKML_BENCH_SKIP_DEVICE=1 keeps the bench off the
+# single-tenant tunnel), so this is safe to run any time, including
+# while a device capture is in flight.
+#
+#   bash tools/ci.sh            # full run (suite ~8 min)
+#   CI_FAST=1 bash tools/ci.sh  # skip the full pytest suite (rest ~3 min)
+#
+# Exit code 0 = every stage green. Log: tools/ci_<UTC>.log
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+LOG="tools/ci_${STAMP}.log"
+exec > >(tee "$LOG") 2>&1
+
+FAIL=0
+stage() {  # stage <name> <cmd...>
+    local name=$1; shift
+    echo "=== ci: $name ==="
+    local t0=$SECONDS
+    if "$@"; then
+        echo "=== ci: $name OK ($((SECONDS - t0))s) ==="
+    else
+        echo "=== ci: $name FAILED rc=$? ($((SECONDS - t0))s) ==="
+        FAIL=1
+    fi
+}
+
+stage "lint (compileall)" python -m compileall -q \
+    flinkml_tpu tests tools examples bench.py __graft_entry__.py
+
+if [ "${CI_FAST:-0}" != 1 ]; then
+    stage "full suite" python -m pytest tests/ -x -q
+fi
+
+stage "8-device dryrun" env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -c "
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as g
+g.entry()
+g.dryrun_multichip(8)
+"
+
+bench_smoke() {
+    local out
+    out=$(FLINKML_BENCH_SKIP_DEVICE=1 timeout 600 python bench.py) || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+assert {'metric', 'value', 'unit', 'vs_baseline'} <= set(rec), rec
+assert 'cpu_fallback' in rec['metric'], rec['metric']
+print('bench smoke: parseable result line:', rec['metric'], rec['value'])
+"
+}
+stage "bench smoke (CPU, no tunnel)" bench_smoke
+
+example_smoke() {
+    local ex
+    for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
+        echo "--- example: $ex ---"
+        JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            timeout 420 python "examples/${ex}.py" || return 1
+    done
+}
+stage "example smoke (CPU mesh)" example_smoke
+
+if [ "$FAIL" = 0 ]; then
+    echo "=== ci: ALL STAGES GREEN (log: $LOG) ==="
+else
+    echo "=== ci: FAILURES — see $LOG ==="
+fi
+exit $FAIL

@@ -37,6 +37,10 @@ echo "--- 2. sparse layout A/B (1200 s cap) ---"
 timeout 1200 python tools/sparse_layout_probe.py \
     || echo "sparse_layout_probe FAILED rc=$?"
 
+echo "--- 2b. GBT histogram layout A/B (900 s cap) ---"
+timeout 900 python tools/gbt_hist_probe.py \
+    || echo "gbt_hist_probe FAILED rc=$?"
+
 echo "--- 3. gather/scatter bounds-mode A/B (600 s cap) ---"
 timeout 600 python tools/sparse_pib_probe.py \
     || echo "sparse_pib_probe FAILED rc=$?"
