@@ -49,12 +49,6 @@ import time
 import numpy as np
 
 _INNER_ENV = "_FLINKML_BENCH_INNER"
-
-
-class _SkipDevice(Exception):
-    """Raised to bypass the device phase (FLINKML_BENCH_SKIP_DEVICE=1):
-    no lock, no probes, no forensic line."""
-
 _CACHE_DIR = "/tmp/jax_bench_cache"
 
 
@@ -881,37 +875,37 @@ def main():
     if skip_device:
         # CI smoke mode: never touch the (single-tenant, wedge-prone)
         # tunnel — no lock, no probes, no forensic line (the forensic
-        # trail must only record sessions that actually probed).
+        # trail must only record sessions that actually probed). The
+        # fallback line above stands as the result.
         _log("FLINKML_BENCH_SKIP_DEVICE=1: skipping the device phase")
-    lock_wait = min(900.0, max(0.0, deadline - time.monotonic() - 40))
+        deadline = None  # device block below is guarded out
+    lock_wait = (0.0 if skip_device else
+                 min(900.0, max(0.0, deadline - time.monotonic() - 40)))
     try:
-        if skip_device:
-            raise _SkipDevice
-        with device_client_lock(timeout_s=lock_wait):
-            if _hunt_device(deadline, probe_timeout, probe_spacing) is not None:
-                for i, name in enumerate(stage_order):
-                    results[name], stage_timed_out = _run_stage(
-                        name, stage_cap, deadline)
-                    if stage_timed_out and i + 1 < len(stage_order):
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 40:
-                            _log("total budget exhausted; skipping remaining "
-                                 f"stages: {', '.join(stage_order[i + 1:])}")
-                            break
-                        _log(f"stage={name} timed out; quick probe to check "
-                             "whether the tunnel wedged mid-bench")
-                        probe_val, _ = _run_stage(
-                            "probe", min(90.0, remaining - 10),
-                            deadline, retries=0)
-                        if probe_val is None:
-                            skipped = stage_order[i + 1:]
-                            _log("tunnel wedged mid-bench; skipping "
-                                 f"remaining stages: {', '.join(skipped)}")
-                            break
-            else:
-                _log("probe failed; skipping device measurement")
-    except _SkipDevice:
-        pass
+        if not skip_device:
+            with device_client_lock(timeout_s=lock_wait):
+                if _hunt_device(deadline, probe_timeout, probe_spacing) is not None:
+                    for i, name in enumerate(stage_order):
+                        results[name], stage_timed_out = _run_stage(
+                            name, stage_cap, deadline)
+                        if stage_timed_out and i + 1 < len(stage_order):
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 40:
+                                _log("total budget exhausted; skipping remaining "
+                                     f"stages: {', '.join(stage_order[i + 1:])}")
+                                break
+                            _log(f"stage={name} timed out; quick probe to check "
+                                 "whether the tunnel wedged mid-bench")
+                            probe_val, _ = _run_stage(
+                                "probe", min(90.0, remaining - 10),
+                                deadline, retries=0)
+                            if probe_val is None:
+                                skipped = stage_order[i + 1:]
+                                _log("tunnel wedged mid-bench; skipping "
+                                     f"remaining stages: {', '.join(skipped)}")
+                                break
+                else:
+                    _log("probe failed; skipping device measurement")
     except TimeoutError as e:
         _log(f"device busy: {e}; skipping device measurement")
     device_sps = results.get("dense")

@@ -30,6 +30,7 @@ confidence ``c = 1 + α·r``, preference 1 for observed pairs,
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional, Tuple
 
 import jax
@@ -69,24 +70,73 @@ class _ALSParams(HasMaxIter, HasPredictionCol, HasSeed):
     )
 
 
+def _als_layout() -> str:
+    """Measured-default gate for the normal-equation reduction.
+
+    ``segment`` (default): per-chunk ``segment_sum`` of the ``[rows, k,
+    k]`` outer products — XLA's sort-based lowering drags the 4 KB
+    per-row payload through a sort every chunk of every half-step
+    (measured 1.4% of the streaming bound, BASELINE.md "rooflines").
+    ``cumsum``: the rating→target assignment is STATIC across
+    iterations, so the in-RAM fit sorts the COO by target once at pack
+    time and each chunk reduces at precomputed run boundaries with
+    :func:`~flinkml_tpu.ops.sparse.chunked_run_totals` — streaming
+    passes plus a runs-sized sorted scatter. ``FLINKML_TPU_ALS_REDUCTION``
+    selects; the device A/B decides the default. The streamed fit always
+    uses ``segment`` (its chunks come from cache replay, unsorted)."""
+    layout = os.environ.get("FLINKML_TPU_ALS_REDUCTION", "segment")
+    if layout not in ("segment", "cumsum"):
+        raise ValueError(
+            f"FLINKML_TPU_ALS_REDUCTION={layout!r}: expected "
+            "'segment' or 'cumsum'"
+        )
+    return layout
+
+
+def als_run_tables(seg_padded: np.ndarray, p_size: int, chunk: int):
+    """Per-(chunk, device) run boundaries for the ``cumsum`` reduction:
+    ``(ends, cols)``, each ``[n_chunks, p·max_runs]``, over a COO that
+    is PRE-SORTED by segment id (padding ids sort last by construction).
+    One :func:`~flinkml_tpu.ops.sparse.run_boundary_tables` call over
+    the COO reshaped to one row per (chunk, device) slice."""
+    from flinkml_tpu.ops.sparse import run_boundary_tables
+
+    chunk_g = p_size * chunk
+    n_chunks = seg_padded.shape[0] // chunk_g
+    if n_chunks == 0:  # empty table: zero chunks, zero table rows
+        empty = np.zeros((0, 1), np.int32)
+        return empty, empty
+    ends, cols = run_boundary_tables(
+        seg_padded[: n_chunks * chunk_g].reshape(n_chunks * p_size, chunk)
+    )
+    return (
+        ends.reshape(n_chunks, -1),
+        cols.reshape(n_chunks, -1),
+    )
+
+
 @functools.lru_cache(maxsize=32)
-def _normal_eq_chunk_fn(mesh, axis: str, n_segments: int, implicit: bool):
+def _normal_eq_chunk_fn(mesh, axis: str, n_segments: int, implicit: bool,
+                        layout: str = "segment"):
     """Accumulate one COO chunk into the normal equations.
 
     Chunk inputs are sharded over the data axis; the returned partial
-    ``A``/``b`` are replicated (segment_sum locally + one psum). Padded
+    ``A``/``b`` are replicated (local reduction + one psum). Padded
     entries carry segment id ``n_segments`` and fall into a dummy row.
+    ``layout="cumsum"`` takes two extra sharded args (per-device run
+    ``ends``/``cols`` from :func:`als_run_tables`) and reduces without
+    the per-chunk sort (see :func:`_als_layout`).
     """
+
+    def weights(r, alpha):
+        if implicit:
+            conf_minus_1 = alpha * r
+            return conf_minus_1, 1.0 + conf_minus_1  # Σ(c-1)yyᵀ / Σc·y
+        return jnp.ones_like(r), r                   # Σyyᵀ / Σr·y
 
     def local(seg, idx, r, fixed, alpha):
         y = fixed[idx]                  # per-device gather of the fixed side
-        if implicit:
-            conf_minus_1 = alpha * r
-            a_w = conf_minus_1          # Σ (c-1) y yᵀ
-            b_w = 1.0 + conf_minus_1    # Σ c·y (preference = 1)
-        else:
-            a_w = jnp.ones_like(r)      # Σ y yᵀ
-            b_w = r                     # Σ r·y
+        a_w, b_w = weights(r, alpha)
         # Padded entries carry seg == n_segments and a_w/b_w of 0 (their
         # rating is 0; explicit a_w=1 is harmless in the dummy row).
         outer = (y[:, :, None] * y[:, None, :]) * a_w[:, None, None]
@@ -101,10 +151,39 @@ def _normal_eq_chunk_fn(mesh, axis: str, n_segments: int, implicit: bool):
             jax.lax.psum(cnt[:-1], axis),
         )
 
+    def local_cumsum(seg, idx, r, fixed, alpha, ends, cols):
+        from flinkml_tpu.ops.sparse import chunked_run_totals
+
+        k = fixed.shape[1]
+        rows = seg.shape[0]
+        y = fixed[idx]
+        a_w, b_w = weights(r, alpha)
+        outer = ((y[:, :, None] * y[:, None, :])
+                 * a_w[:, None, None]).reshape(rows, k * k)
+        payload = jnp.concatenate(
+            [outer, b_w[:, None] * y, jnp.ones((rows, 1), y.dtype)], axis=1
+        )
+        runs = chunked_run_totals(payload, ends)     # [max_runs, k²+k+1]
+        a = jnp.zeros((n_segments + 1, k * k), y.dtype).at[cols].add(
+            runs[:, : k * k], indices_are_sorted=True
+        )
+        b = jnp.zeros((n_segments + 1, k), y.dtype).at[cols].add(
+            runs[:, k * k: k * k + k], indices_are_sorted=True
+        )
+        cnt = jnp.zeros((n_segments + 1,), y.dtype).at[cols].add(
+            runs[:, -1], indices_are_sorted=True
+        )
+        return (
+            jax.lax.psum(a[:-1].reshape(n_segments, k, k), axis),
+            jax.lax.psum(b[:-1], axis),
+            jax.lax.psum(cnt[:-1], axis),
+        )
+
     return jax.jit(
         jax.shard_map(
-            local, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(), P()),
+            local_cumsum if layout == "cumsum" else local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(), P())
+            + ((P(axis), P(axis)) if layout == "cumsum" else ()),
             out_specs=(P(), P(), P()),
         )
     )
@@ -173,17 +252,22 @@ def _half_step(
     implicit: bool,
     alpha: float,
     chunk: int,
+    run_tables=None,
 ) -> jnp.ndarray:
     """One ALS half-step: solve all n_target factors given the fixed side.
 
     Chunks of ``devices × chunk`` COO rows stream through the
     normal-equation kernel, bounding the [rows, k, k] intermediate to
-    ``chunk × k²`` per device.
+    ``chunk × k²`` per device. ``run_tables`` (a list of per-chunk
+    device-resident ``(ends, cols)`` pairs from :func:`als_run_tables`,
+    over a target-sorted COO) switches the reduction to the sort-free
+    ``cumsum`` layout.
     """
     k = fixed.shape[1]
     chunk_g = mesh.axis_size() * chunk
+    layout = "segment" if run_tables is None else "cumsum"
     fn = _normal_eq_chunk_fn(
-        mesh.mesh, DeviceMesh.DATA_AXIS, n_target, implicit
+        mesh.mesh, DeviceMesh.DATA_AXIS, n_target, implicit, layout
     )
     a = jnp.zeros((n_target, k, k), jnp.float32)
     b = jnp.zeros((n_target, k), jnp.float32)
@@ -191,9 +275,12 @@ def _half_step(
     alpha_j = jnp.asarray(alpha, jnp.float32)
     for c in range(seg.shape[0] // chunk_g):
         sl = slice(c * chunk_g, (c + 1) * chunk_g)
+        # run_tables entries are per-chunk DEVICE-resident pairs, placed
+        # once at fit time (they are iteration-invariant).
+        extra = () if run_tables is None else run_tables[c]
         pa, pb, pc = fn(
             mesh.shard_batch(seg[sl]), mesh.shard_batch(idx[sl]),
-            mesh.shard_batch(r[sl]), fixed, alpha_j,
+            mesh.shard_batch(r[sl]), fixed, alpha_j, *extra,
         )
         a, b, cnt = a + pa, b + pb, cnt + pc
     if implicit:
@@ -263,14 +350,43 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
         )
 
         chunk_g = mesh.axis_size() * chunk
-        by_user = _pad_coo(u_idx, i_idx, ratings, n_users, chunk_g)
-        by_item = _pad_coo(i_idx, u_idx, ratings, n_items, chunk_g)
+        user_tabs = item_tabs = None
+        if _als_layout() == "cumsum":
+            # Sort each side by target ONCE (the assignment is static
+            # across iterations); padding ids (n_targets) sort last by
+            # construction, so _pad_coo keeps the order.
+            ou = np.argsort(u_idx, kind="stable")
+            oi = np.argsort(i_idx, kind="stable")
+            by_user = _pad_coo(
+                u_idx[ou], i_idx[ou], ratings[ou], n_users, chunk_g
+            )
+            by_item = _pad_coo(
+                i_idx[oi], u_idx[oi], ratings[oi], n_items, chunk_g
+            )
+            p = mesh.axis_size()
+
+            def place_tabs(tabs):
+                # Device-place the iteration-invariant tables ONCE, as
+                # per-chunk sharded pairs.
+                ends, cols = tabs
+                return [
+                    (mesh.shard_batch(e), mesh.shard_batch(c))
+                    for e, c in zip(ends, cols)
+                ]
+
+            user_tabs = place_tabs(als_run_tables(by_user[0], p, chunk))
+            item_tabs = place_tabs(als_run_tables(by_item[0], p, chunk))
+        else:
+            by_user = _pad_coo(u_idx, i_idx, ratings, n_users, chunk_g)
+            by_item = _pad_coo(i_idx, u_idx, ratings, n_items, chunk_g)
         for _ in range(self.get(self.MAX_ITER)):
             user_f = _half_step(
-                mesh, *by_user, item_f, n_users, reg, implicit, alpha, chunk,
+                mesh, *by_user, item_f, n_users, reg, implicit, alpha,
+                chunk, run_tables=user_tabs,
             )
             item_f = _half_step(
-                mesh, *by_item, user_f, n_items, reg, implicit, alpha, chunk,
+                mesh, *by_item, user_f, n_items, reg, implicit, alpha,
+                chunk, run_tables=item_tabs,
             )
         model = ALSModel()
         model.copy_params_from(self)

@@ -160,30 +160,21 @@ def gbt_hist_tables(b_pad: np.ndarray, p_size: int, n_bins: int):
       run, padded by repeating the last end (differences to exactly 0);
     - ``cols [p·max_runs] int32`` — the run's static key, ascending.
     """
+    from flinkml_tpu.ops.sparse import run_boundary_tables
+
     n, d = b_pad.shape
     n_local = n // p_size
     cells = n_local * d
     srow = np.empty((p_size, cells), np.int32)
-    per_dev = []
+    skeys = np.empty((p_size, cells), np.int64)
     for dev in range(p_size):
         shard = b_pad[dev * n_local:(dev + 1) * n_local]
         key = (np.arange(d, dtype=np.int64)[None, :] * n_bins
                + shard).reshape(-1)
         order = np.argsort(key, kind="stable")
-        skey = key[order]
         srow[dev] = (order // d).astype(np.int32)
-        is_end = np.empty(cells, np.bool_)
-        is_end[:-1] = skey[:-1] != skey[1:]
-        is_end[-1] = True
-        e = np.nonzero(is_end)[0].astype(np.int32)
-        per_dev.append((e, skey[e].astype(np.int32)))
-    max_runs = max(e.size for e, _ in per_dev)
-    ends = np.full((p_size, max_runs), cells - 1, np.int32)
-    cols = np.empty((p_size, max_runs), np.int32)
-    for dev, (e, c) in enumerate(per_dev):
-        ends[dev, : e.size] = e
-        cols[dev, : e.size] = c
-        cols[dev, e.size:] = c[-1] if c.size else 0
+        skeys[dev] = key[order]
+    ends, cols = run_boundary_tables(skeys)
     return srow.reshape(-1), ends.reshape(-1), cols.reshape(-1)
 
 

@@ -377,3 +377,31 @@ def chunked_run_totals(contrib, ends):
         jnp.take(chunk_prefix, cs, axis=0)
     out = jnp.where(same, local_e - local_s, tail + between + local_e)
     return out[:, 0] if flat else out
+
+
+def run_boundary_tables(sorted_keys: np.ndarray):
+    """Run boundaries of each ROW of ``sorted_keys [R, L]`` (each row
+    ascending): ``(ends, cols)``, both ``[R, max_runs] int32`` — the
+    pack-time companion of :func:`chunked_run_totals`. Padding repeats
+    the last real end (whose running-sum difference is exactly 0) and
+    the last real key. ``max_runs`` is at least 1 (an empty input yields
+    a single zero-length table row)."""
+    sorted_keys = np.asarray(sorted_keys)
+    R, L = sorted_keys.shape
+    per = []
+    for row in range(R):
+        s = sorted_keys[row]
+        is_end = np.empty(L, np.bool_)
+        is_end[:-1] = s[:-1] != s[1:]
+        if L:
+            is_end[-1] = True
+        per.append(np.nonzero(is_end)[0].astype(np.int32))
+    max_runs = max((e.size for e in per), default=1) or 1
+    ends = np.full((R, max_runs), max(L - 1, 0), np.int32)
+    cols = np.zeros((R, max_runs), np.int32)
+    for row, e in enumerate(per):
+        ends[row, : e.size] = e
+        cols[row, : e.size] = sorted_keys[row, e]
+        if e.size:
+            cols[row, e.size:] = sorted_keys[row, e[-1]]
+    return ends, cols

@@ -167,3 +167,57 @@ def test_reg_zero_underdetermined_user_stays_finite():
     assert np.isfinite(model.item_factors).all()
     (out,) = model.transform(t)
     assert np.isfinite(out["prediction"]).all()
+
+
+def test_cumsum_reduction_matches_segment(monkeypatch):
+    """FLINKML_TPU_ALS_REDUCTION=cumsum (target-sorted COO + chunked run
+    totals) must produce the same factors as the segment_sum reduction,
+    explicit and implicit modes (allclose — summation order differs)."""
+    from flinkml_tpu.models.als import ALS
+
+    rng = np.random.default_rng(7)
+    nnz = 3000
+    t = Table({
+        "user": rng.integers(0, 64, size=nnz).astype(np.int32),
+        "item": rng.integers(0, 50, size=nnz).astype(np.int32),
+        "rating": rng.uniform(1, 5, size=nnz).astype(np.float32),
+    })
+
+    for implicit in (False, True):
+        def fit(layout):
+            monkeypatch.setenv("FLINKML_TPU_ALS_REDUCTION", layout)
+            est = ALS().set_rank(6).set_max_iter(4).set_seed(0)
+            if implicit:
+                est = est.set_implicit_prefs(True)
+            return est.fit(t)
+
+        m_seg = fit("segment")
+        m_cum = fit("cumsum")
+        np.testing.assert_allclose(
+            m_cum._user_factors, m_seg._user_factors, rtol=5e-4, atol=5e-5
+        )
+        np.testing.assert_allclose(
+            m_cum._item_factors, m_seg._item_factors, rtol=5e-4, atol=5e-5
+        )
+
+
+def test_cumsum_reduction_empty_and_tiny_tables(monkeypatch):
+    """The cumsum layout must match segment on degenerate inputs: an
+    empty run-table path (zero chunks) and a single-rating table."""
+    from flinkml_tpu.models.als import ALS, als_run_tables
+
+    empty_e, empty_c = als_run_tables(np.zeros(0, np.int32), 2, 8)
+    assert empty_e.shape[0] == 0 and empty_c.shape[0] == 0
+
+    t = Table({
+        "user": np.asarray([3], np.int32),
+        "item": np.asarray([1], np.int32),
+        "rating": np.asarray([4.0], np.float32),
+    })
+    monkeypatch.setenv("FLINKML_TPU_ALS_REDUCTION", "cumsum")
+    m_cum = ALS().set_rank(3).set_max_iter(2).set_seed(0).fit(t)
+    monkeypatch.setenv("FLINKML_TPU_ALS_REDUCTION", "segment")
+    m_seg = ALS().set_rank(3).set_max_iter(2).set_seed(0).fit(t)
+    np.testing.assert_allclose(
+        m_cum._user_factors, m_seg._user_factors, rtol=1e-5
+    )
