@@ -45,6 +45,10 @@ echo "--- 2c. ALS reduction A/B (900 s cap) ---"
 timeout 900 python tools/als_reduction_probe.py \
     || echo "als_reduction_probe FAILED rc=$?"
 
+echo "--- 2d. W2V scatter-formulation A/B (600 s cap) ---"
+timeout 600 python tools/w2v_scatter_probe.py \
+    || echo "w2v_scatter_probe FAILED rc=$?"
+
 echo "--- 3. gather/scatter bounds-mode A/B (600 s cap) ---"
 timeout 600 python tools/sparse_pib_probe.py \
     || echo "sparse_pib_probe FAILED rc=$?"
