@@ -281,6 +281,21 @@ def choose_ell_widths(nnz: np.ndarray, max_buckets: int = 4,
     return sorted(set(bounds))
 
 
+def fill_ell(bi, bv, row_starts, counts, indices, values) -> None:
+    """Vectorized CSR→ELL fill: write each row's ``counts[r]`` cells
+    (sourced at ``row_starts[r]``) into the padded blocks ``bi``/``bv``
+    in place — the one definition of the scatter-gather shared by
+    :func:`pack_ell_buckets` and the streamed uniform pack."""
+    counts = np.asarray(counts, dtype=np.int64)
+    row_rep = np.repeat(np.arange(counts.size), counts)
+    slot = np.arange(int(counts.sum()), dtype=np.int64) - np.repeat(
+        np.cumsum(counts) - counts, counts
+    )
+    src = np.repeat(np.asarray(row_starts, dtype=np.int64), counts) + slot
+    bi[row_rep, slot] = indices[src]
+    bv[row_rep, slot] = values[src]
+
+
 def pack_ell_buckets(indptr, indices, values, dim: int,
                      max_buckets: int = 4, dtype=np.float32):
     """Pack CSR rows into nnz-bucketed ELL blocks.
@@ -307,15 +322,7 @@ def pack_ell_buckets(indptr, indices, values, dim: int,
         w = int(width)
         bi = np.zeros((rows.size, w), dtype=np.int32)
         bv = np.zeros((rows.size, w), dtype=dtype)
-        # Vectorized gather: flat source positions for every (row, slot).
-        counts = nnz[rows]
-        row_rep = np.repeat(np.arange(rows.size), counts)
-        slot = np.arange(int(counts.sum()), dtype=np.int64) - np.repeat(
-            np.cumsum(counts) - counts, counts
-        )
-        src = np.repeat(indptr[rows], counts) + slot
-        bi[row_rep, slot] = indices[src]
-        bv[row_rep, slot] = values[src]
+        fill_ell(bi, bv, indptr[rows], nnz[rows], indices, values)
         buckets.append({"indices": bi, "values": bv})
         row_ids.append(rows)
     return buckets, row_ids
