@@ -106,18 +106,17 @@ def _setup_jax_cache():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
-def _dense_trainer_setup(x, y, w, global_batch_size, tol):
-    """Shared setup for the dense throughput and convergence measurements:
-    mesh, product-path sharding and batch alignment (round-1 finding: a
-    hand-computed local_bs here could disagree with the product program),
-    trainer, initial carry, and the hyperparameter args. One definition so
-    the two measurements can never drift onto different programs."""
+def _dense_trainer_setup(x, y, w, global_batch_size, tol,
+                         loss="logistic", reg_l2=0.0, reg_l1=0.0):
+    """Shared setup for the dense throughput, convergence, and proximal
+    (SVC) measurements: mesh, product-path sharding and batch alignment
+    (round-1 finding: a hand-computed local_bs here could disagree with
+    the product program), trainer, initial carry, and the hyperparameter
+    args. One definition so the measurements can never drift onto
+    different programs."""
     import jax.numpy as jnp
     from flinkml_tpu.models import _linear_sgd
-    from flinkml_tpu.models.logistic_regression import (
-        _device_trainer,
-        _shard_training_data,
-    )
+    from flinkml_tpu.models.logistic_regression import _shard_training_data
     from flinkml_tpu.parallel import DeviceMesh
 
     mesh = DeviceMesh()
@@ -126,14 +125,16 @@ def _dense_trainer_setup(x, y, w, global_batch_size, tol):
     local_bs = _linear_sgd.align_local_bs(
         global_batch_size, p, xd.shape[0] // p
     )
-    trainer = _device_trainer(mesh.mesh, local_bs, DeviceMesh.DATA_AXIS)
+    trainer = _linear_sgd._dense_trainer(
+        mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS
+    )
     f32 = lambda v: jnp.asarray(v, xd.dtype)
     carry0 = (
         jnp.zeros(xd.shape[1], xd.dtype),
         jnp.asarray(0, jnp.int32),
         jnp.asarray(jnp.inf, xd.dtype),
     )
-    args = (xd, yd, wd, f32(0.1), f32(0.0), f32(0.0), f32(tol))
+    args = (xd, yd, wd, f32(0.1), f32(reg_l2), f32(reg_l1), f32(tol))
     return trainer, carry0, args, local_bs, p
 
 
@@ -307,6 +308,87 @@ def _dense_stage(dtype=None) -> float:
 
 def _inner_dense() -> float:
     return _dense_stage()
+
+
+def _inner_svc() -> float:
+    """Stage: LinearSVC proximal SGD (BASELINE.json config #3) — hinge
+    loss with an elastic-net proximal step (both L1 and L2 active so the
+    soft-threshold path is really measured), same a9a-like workload and
+    timing discipline as the dense stage, through the loss-generic
+    product trainer (`_linear_sgd._dense_trainer`)."""
+    _setup_jax_cache()
+    import jax.numpy as jnp
+
+    n, dim, gbs, n_steps = 1_000_000, 123, 262_144, 400
+    x, y, w = make_data(n, dim)
+    trainer, carry0, args, local_bs, p = _dense_trainer_setup(
+        x, y, w, gbs, tol=0.0, loss="hinge", reg_l2=1e-4, reg_l1=1e-4
+    )
+    _log("svc: compiling + warm-up dispatch ...")
+    np.asarray(trainer(*carry0, *args, jnp.asarray(10, jnp.int32))[0])
+    _log("svc: measuring ...")
+    start = time.perf_counter()
+    coef_out, steps_out, _ = trainer(
+        *carry0, *args, jnp.asarray(n_steps, jnp.int32)
+    )
+    np.asarray(coef_out)
+    elapsed = time.perf_counter() - start
+    if int(steps_out) != n_steps:
+        raise RuntimeError(
+            f"svc trainer stopped after {int(steps_out)}/{n_steps} steps"
+        )
+    return local_bs * p * n_steps / elapsed
+
+
+def _inner_ftrl() -> float:
+    """Stage: OnlineLogisticRegression FTRL (BASELINE.json config #4) —
+    steady-state per-batch step throughput of the unbounded online path.
+    Batches are pre-resident and the (z, n, coef) state chains through
+    async dispatches with ONE end-of-run synchronization, so the number
+    measures the architecture (per-batch dispatch + FTRL algebra +
+    psum), not tunnel latency — the same discipline as feed_overlap."""
+    _setup_jax_cache()
+    import jax.numpy as jnp
+    from flinkml_tpu.models.online_logistic_regression import (
+        _ftrl_sharded_fn,
+    )
+    from flinkml_tpu.parallel import DeviceMesh
+
+    n_batches, bs, dim, passes = 64, 16_384, 123, 8
+    rng = np.random.default_rng(0)
+    true_coef = rng.normal(size=dim).astype(np.float32)
+    mesh = DeviceMesh()
+    step = _ftrl_sharded_fn(mesh.mesh, DeviceMesh.DATA_AXIS)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(bs, dim)).astype(np.float32)
+        y = (x @ true_coef > 0).astype(np.float32)
+        batches.append((
+            mesh.shard_batch(x), mesh.shard_batch(y),
+            mesh.shard_batch(np.ones(bs, np.float32)),
+        ))
+    import jax
+
+    jax.block_until_ready(batches)
+    f32 = lambda v: jnp.asarray(v, jnp.float32)
+    hy = (f32(0.1), f32(1.0), f32(0.001), f32(0.001))
+    zeros = jnp.zeros(dim, jnp.float32)
+
+    def run(n_passes):
+        z, nacc, coef = zeros, zeros, zeros
+        for _ in range(n_passes):
+            for xb, yb, wb in batches:
+                z, nacc, coef, _ = step(xb, yb, wb, z, nacc, coef, *hy)
+        np.asarray(coef)  # single synchronization
+        return coef
+
+    _log("ftrl: compiling + warm-up pass ...")
+    run(1)
+    _log("ftrl: measuring ...")
+    start = time.perf_counter()
+    run(passes)
+    elapsed = time.perf_counter() - start
+    return n_batches * bs * passes / elapsed
 
 
 def _inner_dense_bf16() -> float:
@@ -646,6 +728,8 @@ _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
     "dense_bf16": _inner_dense_bf16,
+    "svc": _inner_svc,
+    "ftrl": _inner_ftrl,
     "sparse": _inner_sparse,
     "kmeans": _inner_kmeans,
     "kmeans_mnist": _inner_kmeans_mnist,
@@ -860,9 +944,9 @@ def main():
     # converge_sparse and sparse run LAST: the dim=1e6 compiles are the
     # heaviest in the bench and the tunnel's observed failure mode is
     # wedging UNDER a heavy compile.
-    stage_order = ["dense", "dense_bf16", "converge", "kmeans",
-                   "kmeans_mnist", "feed_overlap", "gbt", "als",
-                   "word2vec", "converge_sparse", "sparse"]
+    stage_order = ["dense", "dense_bf16", "svc", "converge", "ftrl",
+                   "kmeans", "kmeans_mnist", "feed_overlap", "gbt",
+                   "als", "word2vec", "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
     # concurrent clients wedged the tunnel for 8+ hours in round 2
@@ -938,6 +1022,8 @@ def main():
     extras = {}
     scalar_stages = {
         "sparse": "sparse_logreg_samples_per_sec_per_chip",
+        "svc": "svc_proximal_samples_per_sec_per_chip",
+        "ftrl": "ftrl_online_samples_per_sec_per_chip",
         "dense_bf16": "dense_bf16_logreg_samples_per_sec_per_chip",
         "kmeans": "kmeans_points_per_sec_per_chip",
         "kmeans_mnist": "kmeans_mnist_points_per_sec_per_chip",
