@@ -944,6 +944,256 @@ def train_softmax_model(
     )
 
 
+def _run_multiprocess_stream_epochs(
+    cache, plan, place, stepper, dim, hy, dt, criterion,
+    checkpoint_manager, checkpoint_interval, listeners, prefetch_depth,
+    mesh, coef, epoch, cur_loss, after_first_epoch=None,
+):
+    """The shared multi-process epoch driver for the dense and sparse
+    stream trainers: agreed-schedule replay through the prefetching
+    feed, bounded in-flight dispatch, watermark listeners, rank-0 +
+    barrier checkpoint commits, and the termination epilogue (async
+    checkpoint ``wait`` — which also surfaces a failed final write —
+    plus ``on_iteration_terminated``). ONE definition so the two paths
+    cannot drift (they already had once: the sparse copy dropped the
+    epilogue)."""
+    from flinkml_tpu.iteration.checkpoint import save_replicated
+    from flinkml_tpu.iteration.datacache import PrefetchingDeviceFeed
+    from flinkml_tpu.parallel.dispatch import DispatchGuard
+
+    guard = DispatchGuard()
+
+    def run_epoch(coef):
+        loss_acc = jnp.zeros((), dt)
+        wsum_acc = jnp.zeros((), dt)
+        feed = PrefetchingDeviceFeed(
+            plan.epoch_batches(cache.reader(), lambda: _DUMMY_BATCH),
+            place=place,
+            depth=prefetch_depth,
+        )
+        try:
+            for tensors in feed:
+                if coef is None:
+                    coef = jnp.zeros(dim, dt)
+                coef, ls, ws = stepper(coef, *tensors, *hy)
+                loss_acc = loss_acc + ls
+                wsum_acc = wsum_acc + ws
+                coef = guard.after_dispatch(coef)
+        finally:
+            feed.close()
+        coef = guard.flush(coef)
+        return coef, float(loss_acc) / float(wsum_acc)
+
+    while not (epoch > 0 and criterion.should_terminate(epoch - 1, cur_loss)):
+        coef, cur_loss = run_epoch(coef)
+        epoch += 1
+        if after_first_epoch is not None:
+            after_first_epoch()
+        coef_host = np.asarray(coef)
+        for listener in listeners:
+            listener.on_epoch_watermark_incremented(epoch - 1, coef_host)
+        terminated = criterion.should_terminate(epoch - 1, cur_loss)
+        if checkpoint_manager is not None and (
+            terminated
+            or (checkpoint_interval > 0 and epoch % checkpoint_interval == 0)
+        ):
+            save_replicated(
+                checkpoint_manager,
+                (coef_host, np.float64(cur_loss)),
+                epoch,
+                mesh,
+            )
+
+    result = np.asarray(coef)
+    if checkpoint_manager is not None:
+        checkpoint_manager.wait()  # surface a failed final async write
+    for listener in listeners:
+        listener.on_iteration_terminated(result)
+    return result
+
+
+def _train_linear_sparse_stream_multiprocess(
+    batches,
+    loss: str,
+    mesh: DeviceMesh,
+    max_iter: int,
+    learning_rate: float,
+    reg: float,
+    elastic_net: float,
+    tol: float,
+    cache_dir: Optional[str],
+    memory_budget_bytes: Optional[int],
+    checkpoint_manager,
+    checkpoint_interval: int,
+    resume: bool,
+    listeners,
+    prefetch_depth: int,
+    dtype,
+    validate,
+    sparse_dim: int,
+) -> np.ndarray:
+    """Multi-process body of the sparse-native stream (the pod-scale
+    Criteo path): each process feeds its OWN partition of flat CSR
+    batches. SPMD invariants mirror
+    :func:`_train_linear_stream_multiprocess`, with ONE extra agreed
+    quantity — a single global ELL width (the max quantized per-batch
+    width across every rank's stream), so every collective dispatch has
+    one fixed ``[height, width]`` shape. Ingest failures, including
+    dim-mismatched or ragged CSR components, ride the held-error
+    rendezvous; short ranks feed zero-weight dummy blocks (exact
+    no-ops). O(nnz) cache and HBM cost at any ``dim``, per rank."""
+    from flinkml_tpu.iteration.checkpoint import begin_resume
+    from flinkml_tpu.iteration.datacache import DataCache, DataCacheWriter
+    from flinkml_tpu.iteration.runtime import TerminateOnMaxIterOrTol
+    from flinkml_tpu.iteration.stream_sync import (
+        DeferredValidation,
+        SyncedReplayPlan,
+        agree_all_ok,
+        agree_max,
+        checked_ingest,
+        pad_rows_to,
+    )
+
+    is_cache = isinstance(batches, DataCache)
+    resume_epoch = begin_resume(checkpoint_manager, resume, mesh.mesh.size)
+
+    p_size = mesh.axis_size()
+    row_tile = p_size * 8
+    axis = DeviceMesh.DATA_AXIS
+    stepper = _sparse_stream_stepper(mesh.mesh, loss, axis, int(sparse_dim))
+    l2 = reg * (1.0 - elastic_net)
+    l1 = reg * elastic_net
+
+    # -- pass 0: cache + local (rows, width) maxima; everything a
+    # place-time raise could hit is validated HERE (a feed-thread raise
+    # is rank-local mid-collective — the hang class).
+    dv = DeferredValidation()
+    local_max = [0, 0]  # rows, quantized width
+
+    def check_and_stats(b):
+        indptr = np.asarray(b["indptr"])[0]
+        n = indptr.size - 1
+        d = int(np.asarray(b["dim"]).reshape(-1)[0])
+        if d != sparse_dim:
+            raise ValueError(
+                f"CSR stream batch has dim {d}, expected {sparse_dim}"
+            )
+        indices = np.asarray(b["indices"])[0]
+        values = np.asarray(b["values"])[0]
+        if indices.shape != values.shape or indices.size != int(indptr[-1]):
+            raise ValueError(
+                "ragged CSR batch: indices/values/indptr disagree"
+            )
+        y = np.asarray(b["y"])[0]
+        w = (np.asarray(b["w"])[0] if "w" in b
+             else np.ones(n, dtype=dtype))
+        if y.shape[0] != n or w.shape[0] != n:
+            raise ValueError("ragged CSR batch: y/w rows != indptr rows")
+        if validate is not None:
+            validate(b)
+        if n == 0 or float(w.sum()) == 0.0:
+            raise ValueError(
+                "stream batch has zero total weight (empty batch or all "
+                "weights 0); drop such batches before training"
+            )
+        nnz = np.diff(indptr)
+        local_max[0] = max(local_max[0], n)
+        local_max[1] = max(
+            local_max[1], _ell_width_for(np.max(nnz, initial=1))
+        )
+
+    if is_cache:
+        cache = batches
+        for _ in checked_ingest(
+            cache.reader(), dv, check_and_stats, multi=True
+        ):
+            pass
+    else:
+        writer = DataCacheWriter(cache_dir, memory_budget_bytes)
+
+        def checked_append(b):
+            check_and_stats(b)
+            writer.append({k: np.array(v) for k, v in b.items()})
+
+        for _ in checked_ingest(batches, dv, checked_append, multi=True):
+            pass
+        cache = writer.finish()
+
+    dv.rendezvous(mesh, "sparse stream ingest validation")
+    # Agree the feature dimension itself (the dense path's
+    # agree_feature_dim role): per-rank validation above only checks
+    # batches against the RANK-LOCAL sparse_dim — two ranks fed
+    # partitions from different feature spaces would otherwise compile
+    # different [dim] coefficient shapes and diverge inside the
+    # collectives (the exact hang class pass 0 exists to prevent).
+    agree_all_ok(
+        agree_max(int(sparse_dim), mesh) == int(sparse_dim), mesh,
+        "sparse stream feature-dimension agreement",
+    )
+    steps = agree_max(cache.num_batches, mesh)
+    if steps == 0:
+        raise ValueError("training stream is empty on every process")
+    height = agree_max(
+        -(-max(local_max[0], 1) // row_tile) * row_tile, mesh
+    )
+    width = agree_max(max(local_max[1], 1), mesh)
+    plan = SyncedReplayPlan(
+        global_steps=steps, local_height=height, mesh=mesh
+    )
+
+    def place(batch):
+        if "_dummy" in batch:
+            bi = np.zeros((height, width), np.int32)
+            bv = np.zeros((height, width), dtype)
+            y = np.zeros(height, dtype)
+            w = np.zeros(height, dtype)
+        else:
+            indptr = np.asarray(batch["indptr"])[0]
+            n = indptr.size - 1
+            bi, bv = _pack_uniform_ell(
+                indptr, np.asarray(batch["indices"])[0],
+                np.asarray(batch["values"])[0], dtype, width=width,
+            )
+            bi = pad_rows_to(bi, height)
+            bv = pad_rows_to(bv, height)
+            y = pad_rows_to(
+                np.asarray(batch["y"])[0].astype(dtype), height
+            )
+            w = pad_rows_to(
+                (np.asarray(batch["w"])[0].astype(dtype)
+                 if "w" in batch else np.ones(n, dtype=dtype)),
+                height,
+            )
+        return (
+            mesh.global_batch(bi), mesh.global_batch(bv),
+            mesh.global_batch(y), mesh.global_batch(w),
+        )
+
+    dt = jnp.dtype(dtype)
+    hy = (
+        jnp.asarray(learning_rate, dt),
+        jnp.asarray(l2, dt),
+        jnp.asarray(l1, dt),
+    )
+    criterion = TerminateOnMaxIterOrTol(max_iter, tol)
+
+    coef = None
+    epoch = 0
+    cur_loss = math.inf
+    if resume_epoch is not None:
+        restored = _restore_carry(checkpoint_manager, sparse_dim, dtype,
+                                  mesh)
+        if restored is not None:
+            coef_h, epoch, cur_loss = restored
+            coef = jnp.asarray(coef_h, dt)
+
+    return _run_multiprocess_stream_epochs(
+        cache, plan, place, stepper, int(sparse_dim), hy, dt, criterion,
+        checkpoint_manager, checkpoint_interval, listeners, prefetch_depth,
+        mesh, coef, epoch, cur_loss,
+    )
+
+
 def streamed_linear_fit(
     source,
     *,
@@ -964,8 +1214,9 @@ def streamed_linear_fit(
     (round 5): batches are cached and trained as CSR — O(nnz) cache and
     HBM cost at any ``dim`` — instead of densifying to ``[n, dim]``
     (ruinous at the Criteo profile: a 64-row batch at dim=1e6 would
-    cache 256 MB). Single-process only; on a multi-process mesh sparse
-    features keep the dense agreement-layer path. A sealed DataCache
+    cache 256 MB). Multi-process meshes stream per-rank CSR partitions
+    through the agreement layer with one extra agreed quantity (a
+    global ELL width). A sealed DataCache
     whose batches carry ``indptr/indices/values/dim`` replays through
     the same sparse stream (this is also the resume route)."""
     from flinkml_tpu.iteration.datacache import DataCache
@@ -1012,10 +1263,7 @@ def streamed_linear_fit(
         raise ValueError("training stream is empty") from None
     tables = itertools.chain([first_t], it)
 
-    if (
-        sparse_features(first_t, features_col) is not None
-        and jax.process_count() == 1
-    ):
+    if sparse_features(first_t, features_col) is not None:
         indptr0, indices0, values0, dim0, y0, w0 = labeled_sparse_data(
             first_t, features_col, label_col, weight_col
         )
@@ -1176,17 +1424,25 @@ def _sparse_stream_stepper(mesh, loss: str, axis: str, dim: int):
     )
 
 
-def _pack_uniform_ell(indptr, indices, values, dtype):
-    """Pack one CSR batch into uniform ELL with the width QUANTIZED up to
-    the next power of two — so the stream's per-batch nnz variation maps
-    to a log-bounded set of compiled step shapes, not one per batch.
-    Padding cells carry index 0 / value 0 (exact no-ops)."""
+def _ell_width_for(max_nnz: int) -> int:
+    """Quantize a batch's max nnz up to the next power of two, so the
+    stream's per-batch nnz variation maps to a log-bounded set of
+    compiled step shapes, not one per batch."""
+    return 1 << max(int(max_nnz) - 1, 0).bit_length()
+
+
+def _pack_uniform_ell(indptr, indices, values, dtype, width=None):
+    """Pack one CSR batch into uniform ELL (width quantized via
+    :func:`_ell_width_for` unless an agreed ``width`` is given — the
+    multi-process path fixes ONE global width). Padding cells carry
+    index 0 / value 0 (exact no-ops)."""
     from flinkml_tpu.ops.sparse import fill_ell
 
     indptr = np.asarray(indptr, dtype=np.int64)
     n = indptr.size - 1
     nnz = np.diff(indptr)
-    width = 1 << max(int(np.max(nnz, initial=1)) - 1, 0).bit_length()
+    if width is None:
+        width = _ell_width_for(np.max(nnz, initial=1))
     bi = np.zeros((n, width), dtype=np.int32)
     bv = np.zeros((n, width), dtype=dtype)
     fill_ell(bi, bv, indptr[:-1], nnz, indices, values)
@@ -1387,7 +1643,6 @@ def _train_linear_stream_multiprocess(
         jnp.asarray(l1, dt),
     )
     criterion = TerminateOnMaxIterOrTol(max_iter, tol)
-    guard = DispatchGuard()
 
     coef = None
     epoch = 0
@@ -1398,52 +1653,14 @@ def _train_linear_stream_multiprocess(
             coef_h, epoch, cur_loss = restored
             coef = jnp.asarray(coef_h, dt)
 
-    def run_epoch(coef):
-        loss_acc = jnp.zeros((), dt)
-        wsum_acc = jnp.zeros((), dt)
-        feed = PrefetchingDeviceFeed(
-            plan.epoch_batches(cache.reader(), lambda: _DUMMY_BATCH),
-            place=place,
-            depth=prefetch_depth,
-        )
-        try:
-            for xb, yb, wb in feed:
-                if coef is None:
-                    coef = jnp.zeros(dim, dt)
-                coef, ls, ws = stepper(coef, xb, yb, wb, *hy)
-                loss_acc = loss_acc + ls
-                wsum_acc = wsum_acc + ws
-                coef = guard.after_dispatch(coef)
-        finally:
-            feed.close()
-        coef = guard.flush(coef)
-        return coef, float(loss_acc) / float(wsum_acc)
-
-    while not (epoch > 0 and criterion.should_terminate(epoch - 1, cur_loss)):
-        coef, cur_loss = run_epoch(coef)
-        epoch += 1
+    def mark_validated():
         first_pass_done[0] = True
-        coef_host = np.asarray(coef)
-        for listener in listeners:
-            listener.on_epoch_watermark_incremented(epoch - 1, coef_host)
-        terminated = criterion.should_terminate(epoch - 1, cur_loss)
-        if checkpoint_manager is not None and (
-            terminated
-            or (checkpoint_interval > 0 and epoch % checkpoint_interval == 0)
-        ):
-            save_replicated(
-                checkpoint_manager,
-                (coef_host, np.float64(cur_loss)),
-                epoch,
-                mesh,
-            )
 
-    result = np.asarray(coef)
-    if checkpoint_manager is not None:
-        checkpoint_manager.wait()
-    for listener in listeners:
-        listener.on_iteration_terminated(result)
-    return result
+    return _run_multiprocess_stream_epochs(
+        cache, plan, place, stepper, dim, hy, dt, criterion,
+        checkpoint_manager, checkpoint_interval, listeners, prefetch_depth,
+        mesh, coef, epoch, cur_loss, after_first_epoch=mark_validated,
+    )
 
 
 def train_linear_model_stream(
@@ -1485,9 +1702,9 @@ def train_linear_model_stream(
     CSR (O(nnz) disk/RAM, not O(n·dim)), packed per batch into
     power-of-two-width uniform ELL at place time, and trained through
     :func:`_sparse_stream_stepper` against the dense replicated
-    ``[sparse_dim]`` coefficient. Single-process only (the multi-process
-    agreement layer streams dense batches; ``streamed_linear_fit``
-    routes accordingly).
+    ``[sparse_dim]`` coefficient. Multi-process meshes route to
+    :func:`_train_linear_sparse_stream_multiprocess` (per-rank CSR
+    partitions, agreed schedule + global ELL width).
 
     Reference parity: ``ReplayOperator.java:62-250`` — epoch 0 caches the
     data stream to ``DataCacheWriter`` segments AND forwards it to training;
@@ -1532,10 +1749,14 @@ def train_linear_model_stream(
         )
     if jax.process_count() > 1:
         if sparse_dim is not None:
-            raise ValueError(
-                "sparse_dim streaming is single-process; multi-process "
-                "streamed linear fits use the dense agreement-layer path "
-                "(streamed_linear_fit routes this automatically)"
+            # Per-process CSR partitions + agreed SPMD schedule with one
+            # extra agreed quantity (the global ELL width).
+            return _train_linear_sparse_stream_multiprocess(
+                batches, loss, mesh, max_iter, learning_rate, reg,
+                elastic_net, tol, cache_dir, memory_budget_bytes,
+                checkpoint_manager, checkpoint_interval, resume,
+                listeners, prefetch_depth, dtype, validate,
+                int(sparse_dim),
             )
         # Per-process stream partitions + agreed SPMD schedule; see
         # _train_linear_stream_multiprocess for the invariants.

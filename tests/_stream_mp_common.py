@@ -178,3 +178,73 @@ def w2v_local_docs(pid: int, nproc: int):
     mine = [d for j, d in enumerate(docs) if j % nproc == pid]
     bs = max(4, BATCH_SIZES[pid] // 4)
     return [mine[i : i + bs] for i in range(0, len(mine), bs)]
+
+
+# --- round 5: sparse-native multi-process streaming -----------------------
+
+SPARSE_DIM = 5_000
+
+
+def _sparse_rows(lo: int, hi: int):
+    """Deterministic per-GLOBAL-row sparse features + labels, so any
+    partitioning of the row range yields the same underlying data."""
+    rows = []
+    for i in range(lo, hi):
+        r = np.random.default_rng(1000 + i)
+        nnz = 1 + int(r.integers(1, 7))
+        idx = np.sort(r.choice(SPARSE_DIM, nnz, replace=False))
+        rows.append((idx, r.normal(size=nnz), float(r.random() > 0.5)))
+    return rows
+
+
+def _sparse_tables_from(rows, bs):
+    from flinkml_tpu.linalg import Vectors
+    from flinkml_tpu.table import Table
+
+    out = []
+    for i in range(0, len(rows), bs):
+        chunk = rows[i:i + bs]
+        vecs = np.array(
+            [Vectors.sparse(SPARSE_DIM, idx.tolist(), val)
+             for idx, val, _ in chunk],
+            dtype=object,
+        )
+        y = np.asarray([lab for _, _, lab in chunk])
+        out.append(Table({"features": vecs, "label": y}))
+    return out
+
+
+def sparse_local_tables(pid: int, nproc: int):
+    sl = slice_for(pid, nproc)
+    return _sparse_tables_from(
+        _sparse_rows(sl.start, sl.stop), BATCH_SIZES[pid]
+    )
+
+
+def sparse_combined_tables(nproc: int):
+    """Single-process equivalent: step t concatenates every rank's batch
+    t (same construction as :func:`combined_batches`)."""
+    from flinkml_tpu.linalg import Vectors
+    from flinkml_tpu.table import Table
+
+    per = []
+    for p in range(nproc):
+        sl = slice_for(p, nproc)
+        rows = _sparse_rows(sl.start, sl.stop)
+        bs = BATCH_SIZES[p]
+        per.append([rows[i:i + bs] for i in range(0, len(rows), bs)])
+    steps = max(len(b) for b in per)
+    out = []
+    for t in range(steps):
+        chunk = [r for b in per if t < len(b) for r in b[t]]
+        vecs = np.array(
+            [Vectors.sparse(SPARSE_DIM, idx.tolist(), val)
+             for idx, val, _ in chunk],
+            dtype=object,
+        )
+        y = np.asarray([lab for _, _, lab in chunk])
+        out.append(Table({"features": vecs, "label": y}))
+    return out
+
+
+SPARSE_HP = dict(max_iter=4, learning_rate=0.5, reg=0.01, tol=0.0)

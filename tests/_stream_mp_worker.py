@@ -282,9 +282,21 @@ w2v_empty = (
 )
 w2v_empty_vecs = w2v_empty.vectors
 
+# --- round 5: sparse-native CSR streaming across ranks — per-process
+# SparseVector partitions (uneven sizes, uneven nnz -> agreed global ELL
+# width + dummy tail), cross-checked vs single-process by the parent.
+from flinkml_tpu.models.logistic_regression import (  # noqa: E402
+    LogisticRegression,
+)
+
+sp_est = LogisticRegression(mesh=mesh)
+for k, v in C.SPARSE_HP.items():
+    getattr(sp_est, f"set_{k}")(v)
+sp_coef = sp_est.fit(iter(C.sparse_local_tables(pid, nproc)))._coefficient
+
 np.savez(
     os.path.join(workdir, f"result_{pid}.npz"),
-    coef=coef, cents=cents, cents_rand=cents_rand,
+    coef=coef, sp_coef=sp_coef, cents=cents, cents_rand=cents_rand,
     cents_empty=cents_empty,
     gmm_means=gm.means, gmm_weights=gm.weights,
     mlp_w0=np.asarray(mlp._weights[0]), mlp_acc=np.float64(mlp_acc),

@@ -199,6 +199,18 @@ def _streamed_fit_check(tmp_path, nproc, local_devices):
     np.testing.assert_allclose(
         results[0]["coef"], exp_coef, rtol=2e-4, atol=2e-5
     )
+    # (b2) sparse-native CSR streaming: the 2-rank fit over SparseVector
+    # partitions must match the single-process fit whose step-t batch
+    # concatenates every rank's batch t.
+    from flinkml_tpu.models.logistic_regression import LogisticRegression
+
+    sp_est = LogisticRegression(mesh=mesh)
+    for k, v in C.SPARSE_HP.items():
+        getattr(sp_est, f"set_{k}")(v)
+    exp_sp = sp_est.fit(iter(C.sparse_combined_tables(nproc)))._coefficient
+    np.testing.assert_allclose(
+        results[0]["sp_coef"], exp_sp, rtol=2e-4, atol=2e-5
+    )
     exp_cents = train_kmeans_stream(
         iter({"x": b["x"]} for b in C.combined_batches(nproc)),
         k=C.K_CLUSTERS, mesh=mesh,
