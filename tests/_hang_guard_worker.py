@@ -409,4 +409,33 @@ def case7_kmeans_cached_bad_batch_no_init():
 
 expect_all_ranks_raise("case7-kmeans-cached", case7_kmeans_cached_bad_batch_no_init)
 
+
+# --- 8. Sparse-native CSR streaming (round 5): a ragged CSR batch on
+# rank 0 (indices/indptr disagree) must abort every rank at the ingest
+# rendezvous, not raise rank-locally before the agreed schedule.
+def case8_sparse_stream_ragged_csr():
+    from flinkml_tpu.models._linear_sgd import train_linear_model_stream
+
+    def csr(n=8, dim=50, nnz=3, broken=False):
+        r = np.random.default_rng(60 + pid)
+        indptr = np.arange(n + 1, dtype=np.int64) * nnz
+        k = n * nnz - (1 if broken else 0)  # broken: indices too short
+        return {
+            "indptr": indptr[None, :],
+            "indices": r.integers(0, dim, k).astype(np.int32)[None, :],
+            "values": r.normal(size=k).astype(np.float32)[None, :],
+            "y": (r.random(n) > 0.5).astype(np.float32)[None, :],
+            "w": np.ones(n, np.float32)[None, :],
+            "dim": np.asarray([[dim]], np.int64),
+        }
+
+    train_linear_model_stream(
+        iter([csr(), csr(broken=(pid == 0))]),
+        loss="logistic", mesh=mesh, max_iter=2, learning_rate=0.5,
+        reg=0.0, elastic_net=0.0, tol=0.0, sparse_dim=50,
+    )
+
+
+expect_all_ranks_raise("case8-sparse-ragged", case8_sparse_stream_ragged_csr)
+
 print(f"GUARD_OK {pid}", flush=True)
