@@ -14,8 +14,9 @@ Each step gathers the batch's embedding rows, computes
 them back with ``.at[].add``, ``psum``s the dense embedding gradients
 and steps by the GLOBAL-batch mean (device-count invariant; below
 ``_shard_vocab_threshold`` a dense psum per step beats bespoke sparse
-collectives). ABOVE the threshold the in-RAM fit switches to
-``_sgns_trainer_sharded``: embedding tables shard over the mesh and
+collectives). ABOVE the threshold the in-RAM fit AND the
+single-process streamed fit switch to ``_sgns_trainer_sharded``:
+embedding tables shard over the mesh and
 batch-sized payloads ride a ``ppermute`` ring, so per-step traffic is
 independent of vocab. Spark trains hierarchical softmax on the JVM —
 SGNS is the TPU-idiomatic equivalent and is documented as such, not
@@ -614,16 +615,21 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             # Scale guard BEFORE pass B: the vocabulary is final here,
             # and failing now costs seconds — after pass B it would cost
             # a full doc-cache replay and a pair cache on disk first.
-            if p > 1 and len(vocab) > _shard_vocab_threshold():
+            # Single-process multi-device streams switch to the
+            # vocab-sharded ring trainer below instead; only the
+            # multi-PROCESS stream (whose per-rank pair partitions the
+            # ring trainer does not yet route) rejects.
+            if multi and len(vocab) > _shard_vocab_threshold():
                 raise ValueError(
-                    f"streamed Word2Vec fit: vocabulary ({len(vocab)} "
-                    f"tokens) exceeds the dense-gradient scale ceiling "
-                    f"({_shard_vocab_threshold()}): every SGNS step would "
-                    "psum a full [vocab, dim] gradient across the mesh. "
-                    "Use the in-RAM fit (a single Table input), which "
-                    "switches to the vocab-sharded ring trainer above this "
-                    "threshold, raise minCount to prune the vocabulary, or "
-                    "override via FLINKML_W2V_SHARD_VOCAB."
+                    f"multi-process streamed Word2Vec fit: vocabulary "
+                    f"({len(vocab)} tokens) exceeds the dense-gradient "
+                    f"scale ceiling ({_shard_vocab_threshold()}): every "
+                    "SGNS step would psum a full [vocab, dim] gradient "
+                    "across processes. Use the in-RAM fit or a "
+                    "single-process mesh (both switch to the "
+                    "vocab-sharded ring trainer above this threshold), "
+                    "raise minCount to prune the vocabulary, or override "
+                    "via FLINKML_W2V_SHARD_VOCAB."
                 )
 
             # -- pass B: replay doc cache into the pair cache --------------
@@ -695,31 +701,54 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
         dim = self.get(self.VECTOR_SIZE)
         batch_size = self.get(self.BATCH_SIZE)
         local_bs = max(1, batch_size // p)
-        trainer = _sgns_trainer(
-            mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
-            self.get(self.NUM_NEGATIVES),
-        )
+        # Above the vocab threshold on a single-process multi-device
+        # mesh, the streamed fit uses the same vocab-sharded ring
+        # trainer as the in-RAM fit (the multi-PROCESS case was
+        # rejected with guidance right after the vocabulary was final).
+        use_sharded = p > 1 and len(vocab) > _shard_vocab_threshold()
+        if use_sharded:
+            shard_rows = -(-len(vocab) // p)
+            vocab_pad = shard_rows * p
+            trainer = _sgns_trainer_sharded(
+                mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
+                self.get(self.NUM_NEGATIVES), shard_rows,
+            )
+        else:
+            trainer = _sgns_trainer(
+                mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
+                self.get(self.NUM_NEGATIVES),
+            )
         lr = jnp.asarray(self.get(self.LEARNING_RATE), jnp.float32)
         base_key = jax.random.PRNGKey(self.get_seed())
         tile = p * self._PAIR_TILE
 
-        u = jnp.zeros((len(vocab), dim), jnp.float32)
+        def place_vu(v_h, u_h):
+            """Device placement of the embedding pair: replicated for the
+            dense trainer, row-sharded (padded) for the ring trainer."""
+            if not use_sharded:
+                return jnp.asarray(v_h), jnp.asarray(u_h)
+            pad = vocab_pad - len(vocab)
+            z = np.zeros((pad, dim), np.float32)
+            return (
+                mesh.shard_batch(np.concatenate([v_h, z])),
+                mesh.shard_batch(np.concatenate([u_h, z])),
+            )
+
+        u_h0 = np.zeros((len(vocab), dim), np.float32)
         start_epoch = 0
         if resume_epoch is None:
-            v = jnp.asarray(
+            v_h0 = (
                 (rng_global.random((len(vocab), dim)) - 0.5)
                 .astype(np.float32) / dim
             )
         else:
-            v = jnp.zeros((len(vocab), dim), jnp.float32)  # restored below
-        if resume_epoch is not None:
             like = (np.zeros((len(vocab), dim), np.float32),) * 2
             from flinkml_tpu.iteration.stream_sync import agreed_restore
 
-            (v_h, u_h), start_epoch = agreed_restore(
+            (v_h0, u_h0), start_epoch = agreed_restore(
                 self.checkpoint_manager, resume_epoch, like, mesh
             )
-            v, u = jnp.asarray(v_h), jnp.asarray(u_h)
+        v, u = place_vu(v_h0, u_h0)
 
         from flinkml_tpu.parallel.dispatch import DispatchGuard
 
@@ -787,7 +816,12 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             if should_snapshot(self.checkpoint_manager,
                                self.checkpoint_interval, epoch + 1,
                                max_iter):
-                state = (np.asarray(v), np.asarray(u))
+                # Slice off the shard padding rows (no-op unsharded) so
+                # checkpoints are layout-independent.
+                state = (
+                    np.asarray(v)[: len(vocab)],
+                    np.asarray(u)[: len(vocab)],
+                )
                 if multi:
                     from flinkml_tpu.iteration.checkpoint import (
                         save_replicated,
@@ -802,7 +836,10 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
 
         model = Word2VecModel()
         model.copy_params_from(self)
-        model._set(np.asarray(vocab, dtype=str), np.asarray(v, np.float64))
+        model._set(
+            np.asarray(vocab, dtype=str),
+            np.asarray(v, np.float64)[: len(vocab)],
+        )
         return model
 
 

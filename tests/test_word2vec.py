@@ -124,18 +124,28 @@ def test_sharded_trainer_matches_dense(monkeypatch):
     assert same > cross, (same, cross)
 
 
-def test_streamed_fit_rejects_vocab_above_shard_threshold(monkeypatch):
-    """The streamed fit has no sharded path: above the threshold it must
-    fail loudly with guidance, not silently psum [vocab, dim] forever."""
-    monkeypatch.setenv("FLINKML_W2V_SHARD_VOCAB", "3")
-    docs, _, _ = _topic_corpus(n_docs=80)
+def test_streamed_fit_shards_above_vocab_threshold(monkeypatch):
+    """Above the threshold, the single-process streamed fit switches to
+    the vocab-sharded ring trainer (same SGD trajectory as the dense
+    streamed trainer up to ring summation order) instead of psumming a
+    [vocab, dim] gradient per step."""
+    docs, _, _ = _topic_corpus(n_docs=200)
     t = Table({"text": np.asarray(docs)})
     (tok,) = (
         Tokenizer().set_input_col("text").set_output_col("tok").transform(t)
     )
-    w2v = (
-        Word2Vec().set_input_col("tok").set_output_col("vec")
-        .set_vector_size(8).set_min_count(2).set_max_iter(1).set_seed(0)
+
+    def fit():
+        return (
+            Word2Vec().set_input_col("tok").set_output_col("vec")
+            .set_vector_size(8).set_min_count(2).set_max_iter(3)
+            .set_learning_rate(1.0).set_batch_size(256).set_seed(0)
+            .fit(iter([tok]))
+        )
+
+    dense_model = fit()
+    monkeypatch.setenv("FLINKML_W2V_SHARD_VOCAB", "0")
+    sharded_model = fit()
+    np.testing.assert_allclose(
+        sharded_model._vectors, dense_model._vectors, rtol=2e-3, atol=2e-4
     )
-    with pytest.raises(ValueError, match="scale ceiling"):
-        w2v.fit(iter([tok]))
