@@ -558,6 +558,92 @@ def _inner_word2vec() -> float:
     return local_bs * mesh.axis_size() * steps / elapsed
 
 
+def _pipeline_fused_stage(n=100_000, d=32, reps=5) -> dict:
+    """Stage: fused pipeline inference throughput — a 5-stage all-kernel
+    chain (StandardScaler → MinMaxScaler → MaxAbsScaler → RobustScaler →
+    LogisticRegressionModel) through ``PipelineModel.transform``, fused
+    (one XLA program, device-resident intermediates, shape-bucketed
+    compile cache) vs unfused (the per-stage path: N host↔device round
+    trips and four host numpy scaler passes). Metric:
+    ``pipeline_transform_rows_per_sec`` for both executions, plus the
+    speedup — the per-stage-materialization overhead the fused executor
+    (flinkml_tpu/pipeline_fusion.py) exists to delete."""
+    from flinkml_tpu import pipeline_fusion
+    from flinkml_tpu.models.logistic_regression import LogisticRegression
+    from flinkml_tpu.models.scalers import (
+        MaxAbsScaler, MinMaxScaler, RobustScaler, StandardScaler,
+    )
+    from flinkml_tpu.pipeline import PipelineModel
+    from flinkml_tpu.table import Table
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    train = Table({"features": x, "label": y})
+    stages, cur, prev = [], train, "features"
+    for i, cls in enumerate(
+        (StandardScaler, MinMaxScaler, MaxAbsScaler, RobustScaler), start=1
+    ):
+        m = cls().set(cls.INPUT_COL, prev).set(cls.OUTPUT_COL, f"s{i}")
+        m = m.fit(cur)
+        (cur,) = m.transform(cur)
+        prev = f"s{i}"
+        stages.append(m)
+    lr = (
+        LogisticRegression()
+        .set(LogisticRegression.FEATURES_COL, prev)
+        .set(LogisticRegression.LABEL_COL, "label")
+        .set_max_iter(2)
+        .fit(cur)
+    )
+    stages.append(lr)
+    pipeline_model = PipelineModel(stages)
+    apply_table = train.select("features")
+
+    def rows_per_sec():
+        # Warm-up covers compiles on both paths; each timed call ends by
+        # materializing the prediction column on host (the device→host
+        # sync; block_until_ready alone is unreliable over the tunnel).
+        np.asarray(
+            pipeline_model.transform(apply_table)[0].column("prediction")
+        )
+        start = time.perf_counter()
+        for _ in range(reps):
+            out = pipeline_model.transform(apply_table)[0]
+            np.asarray(out.column("prediction"))
+        return n * reps / (time.perf_counter() - start)
+
+    pipeline_fusion.set_enabled(False)
+    try:
+        unfused = rows_per_sec()
+    finally:
+        pipeline_fusion.set_enabled(True)
+    pipeline_fusion.reset_cache()
+    fused = rows_per_sec()
+    return {
+        "pipeline_transform_rows_per_sec": round(fused, 1),
+        "pipeline_transform_rows_per_sec_unfused": round(unfused, 1),
+        "fused_speedup": round(fused / unfused, 2),
+        "rows": n,
+        "dim": d,
+        "stages": 5,
+    }
+
+
+def _inner_pipeline_fused() -> dict:
+    _setup_jax_cache()
+    return _pipeline_fused_stage()
+
+
+def _inner_pipeline_fused_cpu() -> dict:
+    """The same fused-vs-unfused measurement pinned to the host CPU
+    backend: tunnel-immune, so the provisional line always carries the
+    fusion trajectory (ISSUE-1 acceptance tracks the CPU-fallback
+    speedup; device numbers ride the device phase when healthy)."""
+    _force_cpu()
+    return _pipeline_fused_stage()
+
+
 def _inner_feed_overlap(n_batches=32, bs=8_192, dim=128, k=512,
                         inner_iters=256) -> dict:
     """Stage: feed-overlap efficiency — the architecture-meaningful
@@ -733,6 +819,8 @@ _INNER_STAGES = {
     "sparse": _inner_sparse,
     "kmeans": _inner_kmeans,
     "kmeans_mnist": _inner_kmeans_mnist,
+    "pipeline_fused": _inner_pipeline_fused,
+    "pipeline_fused_cpu": _inner_pipeline_fused_cpu,
     "feed_overlap": _inner_feed_overlap,
     "converge": _inner_converge,
     "converge_cpu": _inner_converge_cpu,
@@ -880,7 +968,7 @@ def main():
         # converge_cpu is pinned to the host backend and never touches
         # the tunnel, so it must not contend for the single-tenant lock
         # (it runs while a watcher capture may hold the device).
-        if inner == "converge_cpu":
+        if inner in ("converge_cpu", "pipeline_fused_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():
@@ -918,9 +1006,14 @@ def main():
     conv_cpu, _ = _run_stage(
         "converge_cpu", 300.0, t_start + total_budget - 60, retries=0
     )
+    pf_cpu, _ = _run_stage(
+        "pipeline_fused_cpu", 300.0, t_start + total_budget - 60, retries=0
+    )
     provisional_extras = {"provisional": 1}
     if conv_cpu is not None:
         provisional_extras["convergence_cpu"] = conv_cpu
+    if pf_cpu is not None:
+        provisional_extras["pipeline_transform_cpu"] = pf_cpu
     if evidence is not None:
         provisional_extras["last_device_evidence"] = evidence
     print(json.dumps({
@@ -945,7 +1038,8 @@ def main():
     # heaviest in the bench and the tunnel's observed failure mode is
     # wedging UNDER a heavy compile.
     stage_order = ["dense", "dense_bf16", "svc", "converge", "ftrl",
-                   "kmeans", "kmeans_mnist", "feed_overlap", "gbt",
+                   "kmeans", "kmeans_mnist", "pipeline_fused",
+                   "feed_overlap", "gbt",
                    "als", "word2vec", "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
@@ -1034,6 +1128,13 @@ def main():
     for stage, key in scalar_stages.items():
         if results.get(stage) is not None:
             extras[key] = round(results[stage], 1)
+    if results.get("pipeline_fused") is not None:
+        # Fused vs per-stage PipelineModel.transform rows/sec — the
+        # ISSUE-1 fused-executor trajectory (workload on
+        # _pipeline_fused_stage).
+        extras["pipeline_transform"] = results["pipeline_fused"]
+    elif pf_cpu is not None:
+        extras["pipeline_transform_cpu"] = pf_cpu
     if results.get("feed_overlap") is not None:
         # fed/resident wall ratio — the streaming-architecture overhead,
         # latency-insensitive (single end-of-run synchronization).

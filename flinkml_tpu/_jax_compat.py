@@ -1,0 +1,88 @@
+"""Compatibility shims for the range of jax versions this package runs on.
+
+The codebase targets the modern public surface (``jax.shard_map``,
+``jax.distributed.is_initialized``); older jax releases (<= 0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` and expose the distributed client
+state privately. Installing the missing attributes once keeps every call
+site on the modern spelling with no per-module guards.
+
+Imported by the jax-facing modules (``parallel/mesh.py``,
+``parallel/distributed.py`` and the direct consumers of the newer APIs) —
+NOT by the package root, so ``import flinkml_tpu`` stays jax-free and user
+code can still set ``JAX_PLATFORMS``/``XLA_FLAGS`` after importing the
+package but before first device use. Installation is idempotent.
+
+Import side effects only — this module defines nothing for callers.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install() -> None:
+    if not hasattr(jax, "shard_map"):
+        import functools
+
+        from jax.experimental.shard_map import shard_map
+
+        # The experimental version's replication checker predates rules for
+        # several primitives the modern one handles (e.g. `while`); modern
+        # call sites expect those to just work, so the check defaults off.
+        @functools.wraps(shard_map)
+        def _shard_map(f, **kwargs):
+            kwargs.setdefault("check_rep", False)
+            return shard_map(f, **kwargs)
+
+        jax.shard_map = _shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of a Python scalar constant-folds to the static axis
+            # size (never a tracer) on every jax this shim targets.
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax.lax, "pcast"):
+        # Replication-tracking cast (replicated <-> device-varying). Older
+        # jax has no varying-manual-axes machinery, and shard_map runs with
+        # check_rep=False there (see above), so the cast is a no-op.
+        def pcast(x, axis_name, *, to):
+            del axis_name, to
+            return x
+
+        jax.lax.pcast = pcast
+
+    if not hasattr(jax, "typeof"):
+        class _AvalView:
+            """jax.typeof result shim: the underlying aval plus the modern
+            ``.vma`` (varying-manual-axes) attribute, which is always empty
+            here — consistent with pcast being a no-op."""
+
+            __slots__ = ("_aval",)
+            vma = frozenset()
+
+            def __init__(self, aval):
+                self._aval = aval
+
+            def __getattr__(self, name):
+                return getattr(self._aval, name)
+
+        def typeof(x):
+            import jax.core
+
+            return _AvalView(jax.core.get_aval(x))
+
+        jax.typeof = typeof
+
+    if not hasattr(jax.distributed, "is_initialized"):
+        def is_initialized() -> bool:
+            from jax._src import distributed
+
+            return getattr(distributed.global_state, "client", None) is not None
+
+        jax.distributed.is_initialized = is_initialized
+
+
+_install()

@@ -16,11 +16,57 @@ exists to serve Flink's deployment model, not the ML semantics.
 from __future__ import annotations
 
 import abc
-from typing import List, Tuple
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 from flinkml_tpu.io import read_write
 from flinkml_tpu.params import WithParams
 from flinkml_tpu.table import Table
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnKernel:
+    """A stage's transform as a pure columnar device function — the unit the
+    fused pipeline executor (:mod:`flinkml_tpu.pipeline_fusion`) composes
+    into one XLA program per run of kernel-capable stages.
+
+    ``fn(cols, consts, valid)`` maps a dict of device arrays (one per
+    ``input_cols`` entry, leading axis = padded rows) plus a dict of
+    constant arrays (the fitted model data, uploaded as traced arguments so
+    model-data changes never force a retrace) and a float32 ``[rows]``
+    validity mask (1.0 for real rows, 0.0 for bucket padding) to a dict of
+    output device arrays named by ``output_cols``.
+
+    Contract:
+      - ``fn`` must be pure and total: no data-dependent host control flow,
+        no raising on bad values (stages whose transform validates input
+        must gate ``transform_kernel`` to configurations that don't).
+      - ``fn``'s *traced structure* must be fully determined by
+        ``fingerprint``: two kernels with equal fingerprints and equal
+        constant shapes/dtypes must trace to the same program. Anything
+        that changes the math (column names, flags, static sizes) belongs
+        in the fingerprint; anything that only changes values belongs in
+        ``constants``.
+      - Row-wise semantics: padded rows may compute garbage; the executor
+        slices them off. Cross-row reductions must apply ``valid``.
+      - ``pin_inputs``: set True when ``fn`` contains ops whose XLA
+        lowering is fusion-context-sensitive (transcendentals, matmuls,
+        reductions — anything not exactly rounded elementwise). The
+        executor then materializes this kernel's chain-produced input
+        columns as program outputs, pinning the fusion boundary so the
+        kernel's ops lower in the same context as the stand-alone
+        per-stage program — without this, a sigmoid fused into an
+        upstream scaler chain can differ from the per-stage path by
+        1 ulp. Exactly-rounded elementwise kernels (scalers, one-hot,
+        concat) leave it False and fuse freely.
+    """
+
+    input_cols: Tuple[str, ...]
+    output_cols: Tuple[str, ...]
+    fn: Callable[[Dict[str, Any], Dict[str, Any], Any], Dict[str, Any]]
+    constants: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    fingerprint: Tuple = ()
+    pin_inputs: bool = False
 
 
 class Stage(WithParams, abc.ABC):
@@ -52,6 +98,22 @@ class AlgoOperator(Stage):
     @abc.abstractmethod
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         """Apply the operator to the inputs; returns a tuple of result tables."""
+
+    def transform_kernel(self) -> Optional[ColumnKernel]:
+        """The stage's transform as a fusable :class:`ColumnKernel`, or
+        ``None`` when the stage (or its current configuration) cannot be
+        expressed as a pure columnar device function.
+
+        ``PipelineModel.transform`` partitions its chain into maximal runs
+        of kernel-capable stages and compiles each run as ONE jitted
+        program (:mod:`flinkml_tpu.pipeline_fusion`); stages returning
+        ``None`` execute through the regular per-stage ``transform`` path.
+        The kernel must reproduce ``transform``'s output bit-for-bit on
+        valid dense input (same dtypes, same op order) — the fused and
+        per-stage paths are interchangeable, not approximations of each
+        other.
+        """
+        return None
 
 
 class Transformer(AlgoOperator):

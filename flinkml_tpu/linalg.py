@@ -22,6 +22,16 @@ from typing import Iterable, Sequence, Union
 import numpy as np
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two ≥ ``n`` (and ≥ 1). Shared size-quantization
+    helper: padded shapes quantized to powers of two bound the number of
+    distinct XLA programs to log2(max size) per call site (row buckets in
+    :mod:`flinkml_tpu.pipeline_fusion`, cumsum chunk widths in
+    :mod:`flinkml_tpu.ops.sparse`)."""
+    n = int(n)
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
 class Vector:
     """Abstract vector. Parity: ``ml/linalg/Vector.java``."""
 

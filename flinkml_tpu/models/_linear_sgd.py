@@ -1084,6 +1084,7 @@ def _train_linear_sparse_stream_multiprocess(
             raise ValueError(
                 "ragged CSR batch: indices/values/indptr disagree"
             )
+        nnz = _check_csr_structure(indptr, indices, sparse_dim)
         y = np.asarray(b["y"])[0]
         w = (np.asarray(b["w"])[0] if "w" in b
              else np.ones(n, dtype=dtype))
@@ -1096,7 +1097,6 @@ def _train_linear_sparse_stream_multiprocess(
                 "stream batch has zero total weight (empty batch or all "
                 "weights 0); drop such batches before training"
             )
-        nnz = np.diff(indptr)
         local_max[0] = max(local_max[0], n)
         local_max[1] = max(
             local_max[1], _ell_width_for(np.max(nnz, initial=1))
@@ -1429,6 +1429,35 @@ def _ell_width_for(max_nnz: int) -> int:
     stream's per-batch nnz variation maps to a log-bounded set of
     compiled step shapes, not one per batch."""
     return 1 << max(int(max_nnz) - 1, 0).bit_length()
+
+
+def _check_csr_structure(indptr, indices, sparse_dim: int):
+    """Structural CSR validation shared by both sparse stream paths;
+    returns ``nnz = diff(indptr)``.
+
+    A non-monotone indptr passes the ragged check (``indices.size ==
+    indptr[-1]``) but later raises rank-locally inside the ELL fill
+    (``np.repeat`` with negative counts) on the prefetch thread at place
+    time — the exact mid-collective hang class pass-0 validation exists
+    to prevent — so it must be rejected HERE, where the failure rides the
+    held-error rendezvous like every other ingest check. Out-of-range
+    column indices never raise at all: the jitted gather/scatter clamps
+    them, silently misattributing gradient mass to boundary columns."""
+    nnz = np.diff(indptr)
+    if indptr.size == 0 or indptr[0] != 0 or np.any(nnz < 0):
+        raise ValueError(
+            "invalid CSR batch: indptr must start at 0 and be "
+            "non-decreasing"
+        )
+    if indices.size and (
+        int(indices.min()) < 0 or int(indices.max()) >= sparse_dim
+    ):
+        raise ValueError(
+            "invalid CSR batch: column indices must lie in "
+            f"[0, {sparse_dim}); got range "
+            f"[{int(indices.min())}, {int(indices.max())}]"
+        )
+    return nnz
 
 
 def _pack_uniform_ell(indptr, indices, values, dtype, width=None):
@@ -1835,6 +1864,9 @@ def train_linear_model_stream(
                 raise ValueError(
                     f"CSR stream batch has dim {d}, expected {sparse_dim}"
                 )
+            _check_csr_structure(
+                indptr, np.asarray(batch["indices"])[0], sparse_dim
+            )
             if validate is not None:
                 validate(batch)
             if n == 0 or float(w.sum()) == 0.0:

@@ -188,6 +188,46 @@ class KMeansModel(_KMeansParams, Model):
             table.with_column(self.get(_KMeansParams.PREDICTION_COL), assign),
         )
 
+    def transform_kernel(self):
+        """Nearest-centroid assignment as a fusable kernel — the same
+        ``DistanceMeasure.nearest`` the per-stage path dispatches, with
+        the centroids travelling as a traced constant. The per-stage
+        path's dtypes follow the ambient x64 flag (``jnp.asarray`` on the
+        float64 feature matrix, argmin's canonical index dtype), so the
+        kernel captures that flag at build time rather than inheriting
+        the fused executor's always-x64 trace context."""
+        if self._centroids is None:
+            return None
+        if self.get(_KMeansParams.DISTANCE_MEASURE) != "euclidean":
+            return None
+        fcol = self.get(_KMeansParams.FEATURES_COL)
+        pcol = self.get(_KMeansParams.PREDICTION_COL)
+        import jax
+
+        x64 = bool(jax.config.jax_enable_x64)
+        dt = jnp.float64 if x64 else jnp.float32
+        idt = jnp.int64 if x64 else jnp.int32
+
+        from flinkml_tpu.api import ColumnKernel
+
+        def fn(cols, consts, valid):
+            x = cols[fcol]
+            if x.ndim == 1:
+                x = x.reshape(-1, 1)
+            x = x.astype(dt)
+            measure = DistanceMeasure.get_instance("euclidean")
+            assign = measure.nearest(x, consts["centroids"].astype(dt))
+            return {pcol: assign.astype(idt)}
+
+        return ColumnKernel(
+            input_cols=(fcol,), output_cols=(pcol,), fn=fn,
+            constants={"centroids": self._centroids},
+            fingerprint=("KMeansModel", fcol, pcol, "euclidean", x64),
+            # Distance reductions + argmin lower context-sensitively: the
+            # input column must be materialized for per-stage bit parity.
+            pin_inputs=True,
+        )
+
     def save(self, path: str) -> None:
         self._require_model()
         self._save_with_arrays(path, {"centroids": self._centroids})
@@ -534,43 +574,54 @@ def train_kmeans_stream(
             # reference's shuffled selection (KMeans.java:314-335).
             centroids = sample[rng.permutation(sample.shape[0])[:k]]
 
-    from flinkml_tpu.parallel.dispatch import DispatchGuard
+    from flinkml_tpu.parallel.dispatch import DispatchGuard, local_execution_lock
 
     guard = DispatchGuard()  # multi-process backpressure (no-op single)
     cent_dev = jnp.asarray(centroids)
-    for epoch in range(start_epoch, max_iter):
-        sums = None
-        counts = None
-        if multi:
-            src = plan.epoch_batches(cache.reader(), lambda: {"_dummy": True})
-            place_fn = make_multi_place(plan.local_height, dim)
-        else:
-            src = cache.reader()
-            place_fn = place
-        feed = PrefetchingDeviceFeed(src, place=place_fn, depth=prefetch_depth)
-        try:
-            for xb, wb in feed:
-                s, c = fn(xb, wb, cent_dev)
-                sums = s if sums is None else sums + s
-                counts = c if counts is None else counts + c
-                counts = guard.after_dispatch(counts)
-        finally:
-            feed.close()
-        if sums is None:
-            raise ValueError("training stream is empty")
-        counts = guard.flush(counts)
-        safe = jnp.maximum(counts, 1.0)[:, None]
-        cent_dev = jnp.where(counts[:, None] > 0, sums / safe, cent_dev)
-        if should_snapshot(checkpoint_manager, checkpoint_interval,
-                           epoch + 1, max_iter):
+    # Serialize vs. concurrent fits from other host threads: interleaved
+    # multi-device collective dispatch deadlocks (see local_execution_lock).
+    with local_execution_lock():
+        for epoch in range(start_epoch, max_iter):
+            sums = None
+            counts = None
             if multi:
-                from flinkml_tpu.iteration.checkpoint import save_replicated
-
-                save_replicated(
-                    checkpoint_manager, np.asarray(cent_dev), epoch + 1, mesh
+                src = plan.epoch_batches(
+                    cache.reader(), lambda: {"_dummy": True}
                 )
+                place_fn = make_multi_place(plan.local_height, dim)
             else:
-                checkpoint_manager.save(np.asarray(cent_dev), epoch + 1)
+                src = cache.reader()
+                place_fn = place
+            feed = PrefetchingDeviceFeed(
+                src, place=place_fn, depth=prefetch_depth
+            )
+            try:
+                for xb, wb in feed:
+                    s, c = fn(xb, wb, cent_dev)
+                    sums = s if sums is None else sums + s
+                    counts = c if counts is None else counts + c
+                    counts = guard.after_dispatch(counts)
+            finally:
+                feed.close()
+            if sums is None:
+                raise ValueError("training stream is empty")
+            counts = guard.flush(counts)
+            safe = jnp.maximum(counts, 1.0)[:, None]
+            cent_dev = jnp.where(counts[:, None] > 0, sums / safe, cent_dev)
+            if should_snapshot(checkpoint_manager, checkpoint_interval,
+                               epoch + 1, max_iter):
+                if multi:
+                    from flinkml_tpu.iteration.checkpoint import (
+                        save_replicated,
+                    )
+
+                    save_replicated(
+                        checkpoint_manager, np.asarray(cent_dev), epoch + 1,
+                        mesh,
+                    )
+                else:
+                    checkpoint_manager.save(np.asarray(cent_dev), epoch + 1)
+        jax.block_until_ready(cent_dev)
     return np.asarray(cent_dev)
 
 

@@ -274,6 +274,60 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
         )
         return (out,)
 
+    def transform_kernel(self):
+        """Dense single-device inference as a fusable kernel (the same
+        math as :func:`_predict`/:func:`_predict_multinomial`). The
+        per-stage path's compute dtype is whatever ``jnp.asarray`` gives
+        the float64 feature matrix — float64 under the ambient x64 flag,
+        float32 otherwise — so the kernel captures that flag at build
+        time (the fused executor always traces under x64 for the scaler
+        kernels' sake, and must not let that leak into this stage's
+        dtypes). Sparse feature columns are object columns, which the
+        fused executor rejects per-table — those chains fall back to the
+        O(nnz) per-stage path. Multi-device meshes keep the sharded
+        per-stage path (fusion is single-program, not SPMD, today)."""
+        if self._coefficient is None:
+            return None
+        if self.mesh is not None and self.mesh.num_devices > 1:
+            return None
+        multinomial = self._coefficient.ndim == 2
+        fcol = self.get(_LogisticRegressionParams.FEATURES_COL)
+        pcol = self.get(_LogisticRegressionParams.PREDICTION_COL)
+        rcol = self.get(_LogisticRegressionParams.RAW_PREDICTION_COL)
+        x64 = bool(jax.config.jax_enable_x64)
+        dt = jnp.float64 if x64 else jnp.float32
+
+        from flinkml_tpu.api import ColumnKernel
+
+        def fn(cols, consts, valid):
+            x = cols[fcol]
+            if x.ndim == 1:
+                x = x.reshape(-1, 1)
+            x = x.astype(dt)
+            coef = consts["coefficient"].astype(dt)
+            if multinomial:
+                logits = x @ coef.T
+                raw = jax.nn.softmax(logits, axis=-1)
+                pred = jnp.argmax(logits, axis=-1).astype(x.dtype)
+            else:
+                dot = x @ coef
+                p = jax.nn.sigmoid(dot)
+                pred = (dot >= 0).astype(x.dtype)
+                raw = jnp.stack([1.0 - p, p], axis=-1)
+            return {pcol: pred, rcol: raw}
+
+        return ColumnKernel(
+            input_cols=(fcol,), output_cols=(pcol, rcol), fn=fn,
+            constants={"coefficient": self._coefficient},
+            fingerprint=(
+                "LogisticRegressionModel", fcol, pcol, rcol, multinomial,
+                x64,
+            ),
+            # dot + sigmoid/softmax lower context-sensitively: the input
+            # column must be materialized for per-stage bit parity.
+            pin_inputs=True,
+        )
+
 
 
 def _check_binomial_labels(y: np.ndarray) -> None:

@@ -32,7 +32,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.api import ColumnKernel, Estimator, Model
 from flinkml_tpu.common_params import HasHandleInvalid, HasInputCols, HasOutputCols
 from flinkml_tpu.linalg import SparseVector
 from flinkml_tpu.params import BoolParam, ParamValidators, StringParam
@@ -189,6 +189,61 @@ class OneHotEncoderModel(_OneHotEncoderParams, Model):
                 onehot[rows, hot[rows]] = 1.0
             out = out.with_column(out_col, onehot)
         return (out,)
+
+    def transform_kernel(self):
+        """Fusable only for ``outputFormat='dense'`` with
+        ``handleInvalid='keep'``: sparse output is a per-row object column
+        (no device representation), and ``error`` raises on out-of-range /
+        non-integral values, which a pure device function cannot. In keep
+        mode invalids clamp to the catch-all slot exactly as the host path
+        does; note the host path's non-integral-value check does not run
+        on device (non-integral values truncate toward zero, the same cast
+        the host applies after its check)."""
+        if self._max_indices is None:
+            return None
+        if self.get(_OneHotEncoderParams.OUTPUT_FORMAT) != "dense":
+            return None
+        if self.get(_OneHotEncoderParams.HANDLE_INVALID) != HasHandleInvalid.KEEP_INVALID:
+            return None
+        input_cols = self.get(_OneHotEncoderParams.INPUT_COLS)
+        output_cols = self.get(_OneHotEncoderParams.OUTPUT_COLS)
+        if (
+            not input_cols
+            or not output_cols
+            or len(input_cols) != len(output_cols)
+            or len(input_cols) != len(self._max_indices)
+        ):
+            return None
+        input_cols = tuple(input_cols)
+        output_cols = tuple(output_cols)
+        drop_last = self.get(_OneHotEncoderParams.DROP_LAST)
+        max_idx = tuple(int(m) for m in self._max_indices)
+
+        def fn(cols, consts, valid):
+            import jax
+            import jax.numpy as jnp
+
+            outs = {}
+            for col, out_col, mv in zip(input_cols, output_cols, max_idx):
+                idx = cols[col].astype(jnp.int32)
+                base_size = mv + (0 if drop_last else 1)
+                invalid = (idx < 0) | (idx > mv)
+                # keep semantics: catch-all slot appended after base_size.
+                hot = jnp.where(invalid, base_size, idx)
+                oh = jax.nn.one_hot(hot, base_size + 1, dtype=jnp.float64)
+                if drop_last:
+                    zero_row = (~invalid) & (idx == mv)
+                    oh = jnp.where(zero_row[:, None], 0.0, oh)
+                outs[out_col] = oh
+            return outs
+
+        return ColumnKernel(
+            input_cols=input_cols, output_cols=output_cols, fn=fn,
+            fingerprint=(
+                "OneHotEncoderModel", input_cols, output_cols, drop_last,
+                max_idx,
+            ),
+        )
 
     def save(self, path: str) -> None:
         self._require_model()

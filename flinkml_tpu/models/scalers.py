@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from flinkml_tpu.api import Estimator, Model
+from flinkml_tpu.api import ColumnKernel, Estimator, Model
 from flinkml_tpu.common_params import HasInputCol, HasOutputCol
 from flinkml_tpu.models._data import features_matrix
 from flinkml_tpu.params import BoolParam, FloatParam, ParamValidators
@@ -33,6 +33,33 @@ class _HasInputOutputCol(HasInputCol, HasOutputCol):
     """Shared single-column in/out mixin (common_params is the canonical
     home of the Has* params; this alias keeps the scaler class lists
     short)."""
+
+
+def _scaler_kernel(model, name, consts, apply, extra_static=()):
+    """Shared :class:`ColumnKernel` scaffold for the four scaler models.
+
+    ``apply(x, consts)`` is the stage's elementwise math on a float64
+    ``[n, d]`` block — the same op sequence as the host transform, so the
+    fused output is bit-identical (float64 elementwise ops are exactly
+    rounded in both numpy and XLA). The fitted statistics travel as traced
+    constants; only the flag configuration is baked into the fingerprint.
+    """
+    in_col = model.get(model.INPUT_COL)
+    out_col = model.get(model.OUTPUT_COL)
+
+    def fn(cols, c, valid):
+        x = cols[in_col]
+        if x.ndim == 1:
+            x = x.reshape(-1, 1)
+        return {out_col: apply(x.astype(jnp.float64), c)}
+
+    return ColumnKernel(
+        input_cols=(in_col,),
+        output_cols=(out_col,),
+        fn=fn,
+        constants=consts,
+        fingerprint=(name, in_col, out_col) + tuple(extra_static),
+    )
 
 
 @functools.lru_cache(maxsize=32)
@@ -164,6 +191,26 @@ class StandardScalerModel(_HasInputOutputCol, Model):
             out = out / safe
         return (table.with_column(self.get(self.OUTPUT_COL), out),)
 
+    def transform_kernel(self):
+        if self._mean is None:
+            return None
+        with_mean = self.get(self.WITH_MEAN)
+        with_std = self.get(self.WITH_STD)
+
+        def apply(x, c):
+            out = x
+            if with_mean:
+                out = out - c["mean"]
+            if with_std:
+                out = out / c["safe"]
+            return out
+
+        return _scaler_kernel(
+            self, "StandardScalerModel",
+            {"mean": self._mean, "safe": np.where(self._std > 0, self._std, 1.0)},
+            apply, (with_mean, with_std),
+        )
+
     def save(self, path: str) -> None:
         self._require()
         self._save_with_arrays(path, {"mean": self._mean, "std": self._std})
@@ -247,6 +294,23 @@ class MinMaxScalerModel(_HasInputOutputCol, Model):
             table.with_column(self.get(self.OUTPUT_COL), unit * (hi - lo) + lo),
         )
 
+    def transform_kernel(self):
+        if self._data_min is None:
+            return None
+        lo, hi = self.get(self.MIN), self.get(self.MAX)
+
+        def apply(x, c):
+            span = c["dataMax"] - c["dataMin"]
+            safe = jnp.where(span > 0, span, 1.0)
+            unit = jnp.where(span > 0, (x - c["dataMin"]) / safe, 0.5)
+            return unit * (hi - lo) + lo
+
+        return _scaler_kernel(
+            self, "MinMaxScalerModel",
+            {"dataMin": self._data_min, "dataMax": self._data_max},
+            apply, (lo, hi),
+        )
+
     def save(self, path: str) -> None:
         self._require()
         self._save_with_arrays(
@@ -311,6 +375,15 @@ class MaxAbsScalerModel(_HasInputOutputCol, Model):
         x = features_matrix(table, self.get(self.INPUT_COL))
         safe = np.where(self._max_abs > 0, self._max_abs, 1.0)
         return (table.with_column(self.get(self.OUTPUT_COL), x / safe),)
+
+    def transform_kernel(self):
+        if self._max_abs is None:
+            return None
+        return _scaler_kernel(
+            self, "MaxAbsScalerModel",
+            {"safe": np.where(self._max_abs > 0, self._max_abs, 1.0)},
+            lambda x, c: x / c["safe"],
+        )
 
     def save(self, path: str) -> None:
         self._require()
@@ -403,6 +476,27 @@ class RobustScalerModel(_HasInputOutputCol, Model):
             safe = np.where(self._range > 0, self._range, 1.0)
             out = out / safe
         return (table.with_column(self.get(self.OUTPUT_COL), out),)
+
+    def transform_kernel(self):
+        if self._median is None:
+            return None
+        centering = self.get(self.WITH_CENTERING)
+        scaling = self.get(self.WITH_SCALING)
+
+        def apply(x, c):
+            out = x
+            if centering:
+                out = out - c["median"]
+            if scaling:
+                out = out / c["safe"]
+            return out
+
+        return _scaler_kernel(
+            self, "RobustScalerModel",
+            {"median": self._median,
+             "safe": np.where(self._range > 0, self._range, 1.0)},
+            apply, (centering, scaling),
+        )
 
     def save(self, path: str) -> None:
         self._require()

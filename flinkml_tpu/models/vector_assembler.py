@@ -14,7 +14,7 @@ from typing import List, Tuple
 
 import numpy as np
 
-from flinkml_tpu.api import AlgoOperator
+from flinkml_tpu.api import AlgoOperator, ColumnKernel
 from flinkml_tpu.common_params import HasHandleInvalid, HasInputCols
 from flinkml_tpu.models._data import features_matrix
 from flinkml_tpu.params import StringParam
@@ -22,6 +22,32 @@ from flinkml_tpu.params import StringParam
 
 class VectorAssembler(HasInputCols, HasHandleInvalid, AlgoOperator):
     OUTPUT_COL = StringParam("outputCol", "Output column name.", "features")
+
+    def transform_kernel(self):
+        """Fusable only with ``handleInvalid='keep'``: ``skip`` changes the
+        row count (shapes are static under jit) and ``error`` raises on
+        data values (no data-dependent control flow on device)."""
+        cols = self.get(self.INPUT_COLS)
+        if not cols or self.get(self.HANDLE_INVALID) != HasHandleInvalid.KEEP_INVALID:
+            return None
+        cols = tuple(cols)
+        out_col = self.get(self.OUTPUT_COL)
+
+        def fn(colvals, consts, valid):
+            import jax.numpy as jnp
+
+            parts = []
+            for c in cols:
+                p = colvals[c]
+                if p.ndim == 1:
+                    p = p.reshape(-1, 1)
+                parts.append(p.astype(jnp.float64))
+            return {out_col: jnp.concatenate(parts, axis=1)}
+
+        return ColumnKernel(
+            input_cols=cols, output_cols=(out_col,), fn=fn,
+            fingerprint=("VectorAssembler", cols, out_col),
+        )
 
     def transform(self, *inputs: Tuple) -> Tuple:
         (table,) = inputs

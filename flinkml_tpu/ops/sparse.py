@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flinkml_tpu.linalg import SparseVector
+from flinkml_tpu.linalg import SparseVector, next_pow2
 
 
 class BatchedCSR:
@@ -357,7 +357,13 @@ def chunked_run_totals(contrib, ends):
         contrib = contrib[:, None]
     cells, k = contrib.shape
     acc = contrib.dtype
-    C = CUMSUM_CHUNK
+    # Effective chunk width: inputs smaller than one chunk must not pad up
+    # to the full 65536 rows — at the ALS cumsum layout ([chunk, k*k+k+1]
+    # payload) a 4k-row chunk at rank ~100 would otherwise materialize a
+    # multi-GB transient for a few-MB input. The error-bound rationale for
+    # chunking is unaffected: an input smaller than one chunk has a single
+    # chunk either way.
+    C = min(CUMSUM_CHUNK, next_pow2(cells + 1))
     # Front-pad one zero cell so every boundary index shifts to >= 1 and
     # the "previous end" of the first run is index 0 (a zero); tail-pad
     # to a whole number of chunks.

@@ -21,12 +21,34 @@ is sufficient and extra host syncs only add latency).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, Optional
 
 import jax
 
 _ENV_INTERVAL = "FLINKML_SYNC_INTERVAL"
 _DEFAULT_MULTIPROCESS_INTERVAL = 8
+
+#: Process-wide mutex for whole training loops launched from concurrent
+#: host THREADS over this process's devices. Two multi-device SPMD
+#: programs dispatched concurrently from different threads interleave
+#: their per-device collective enqueues in different orders on different
+#: devices — on the CPU backend that deadlocks the collective rendezvous
+#: outright (observed: two threaded `train_kmeans_stream` calls over an
+#: 8-virtual-device mesh wedge with every thread asleep); on real fabrics
+#: it is undefined dispatch-order territory. Concurrent fits time-share
+#: the mesh by serializing here: correctness over parallelism (the
+#: devices are one shared resource either way). Reentrant so nested
+#: training loops (e.g. a fit inside a tuning fold) self-compose.
+_LOCAL_EXECUTION_LOCK = threading.RLock()
+
+
+def local_execution_lock() -> threading.RLock:
+    """The process-wide collective-dispatch mutex (see above). Hold it
+    (``with local_execution_lock():``) around any host-driven loop that
+    dispatches multi-device collective programs and may legally be called
+    from concurrent threads."""
+    return _LOCAL_EXECUTION_LOCK
 
 
 def default_sync_interval() -> int:

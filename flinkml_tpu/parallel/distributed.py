@@ -25,6 +25,7 @@ from __future__ import annotations
 import os
 from typing import Optional, Tuple
 
+import flinkml_tpu._jax_compat  # noqa: F401  (jax version shims; install before first jax use)
 import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
@@ -64,12 +65,39 @@ def init_distributed(
         and num_processes > 1
         and not jax.distributed.is_initialized()
     ):
+        _enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
     return jax.process_index(), jax.process_count()
+
+
+def _enable_cpu_collectives() -> None:
+    """Select a cross-process collectives backend for multi-process CPU
+    meshes (the virtual-pod dev/test path; TPU pods use ICI and never get
+    here). XLA:CPU defaults to no collectives implementation and raises
+    "Multiprocess computations aren't implemented on the CPU backend" at
+    first cross-process dispatch, so pick gloo when this jaxlib ships it.
+    Must run before the CPU backend is created; an explicit user setting
+    wins."""
+    platforms = jax.config.jax_platforms or os.environ.get(
+        "JAX_PLATFORMS", ""
+    )
+    if "cpu" not in str(platforms).split(","):
+        return
+    current = getattr(jax.config, "jax_cpu_collectives_implementation", None)
+    if current not in (None, "none"):
+        return
+    try:
+        from jax._src.lib import xla_client
+
+        if not hasattr(xla_client._xla, "make_gloo_tcp_collectives"):
+            return
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover — best effort on exotic builds
+        return
 
 
 def host_barrier(mesh=None, tag: int = 0) -> int:

@@ -10,6 +10,13 @@ Parity with ``ml/builder/Pipeline.java:45-107`` and
 
 A ``Pipeline`` is itself an Estimator and a ``PipelineModel`` a Model, so
 pipelines nest.
+
+TPU-native divergence: ``PipelineModel.transform`` does not simply chain
+per-stage transforms. Runs of stages that expose a
+:class:`~flinkml_tpu.api.ColumnKernel` fuse into single XLA programs with
+device-resident intermediates and a shape-bucketed compile cache — see
+:mod:`flinkml_tpu.pipeline_fusion` and ``docs/operators/pipeline_fusion.md``
+for the protocol, the bucketing policy, and how to make a stage fusable.
 """
 
 from __future__ import annotations
@@ -72,7 +79,18 @@ class Pipeline(Estimator):
 class PipelineModel(Model):
     """Chain of transformer stages applied sequentially.
 
-    Parity: ``PipelineModel.java:44-68``.
+    Parity: ``PipelineModel.java:44-68`` — with one TPU-native execution
+    upgrade: instead of dispatching every stage separately (N host↔device
+    round trips for N stages), ``transform`` partitions the chain into
+    maximal runs of kernel-capable stages (stages exposing
+    :meth:`~flinkml_tpu.api.AlgoOperator.transform_kernel`) and compiles
+    each run as ONE ``jax.jit`` program via
+    :mod:`flinkml_tpu.pipeline_fusion` — intermediate columns stay in
+    device memory, and a shape-bucketed compile cache serves repeated
+    calls at any row count without retracing. Stages without kernels (or
+    whose inputs aren't dense columns) fall back to the per-stage path, so
+    mixed chains keep working; fused and per-stage execution produce
+    bit-identical outputs.
     """
 
     def __init__(self, stages: Sequence[AlgoOperator] = ()):  # noqa: D107
@@ -84,9 +102,28 @@ class PipelineModel(Model):
         return list(self._stages)
 
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        from flinkml_tpu import pipeline_fusion
+
         outputs: Tuple[Table, ...] = tuple(inputs)
-        for stage in self._stages:
-            outputs = tuple(stage.transform(*outputs))
+        stages = self._stages
+        i = 0
+        while i < len(stages):
+            # Fusion applies to the single-table spine of the chain; multi-
+            # table stages (and disabled fusion) take the per-stage path.
+            if len(outputs) == 1 and pipeline_fusion.enabled():
+                kernels, end = pipeline_fusion.collect_run(
+                    outputs[0], stages, i
+                )
+                if len(kernels) >= 2:
+                    outputs = (
+                        pipeline_fusion.execute_kernel_chain(
+                            outputs[0], kernels
+                        ),
+                    )
+                    i = end
+                    continue
+            outputs = tuple(stages[i].transform(*outputs))
+            i += 1
         return outputs
 
     def save(self, path: str) -> None:

@@ -1,0 +1,417 @@
+"""Fused device-resident pipeline execution.
+
+The per-stage transform path pays, for an N-stage :class:`PipelineModel`,
+N host→device uploads, N separate XLA dispatches, and N device→host
+downloads — exactly the per-stage materialization the columnar data plane
+exists to avoid. This module makes the *pipeline* the unit of compilation:
+a run of kernel-capable stages (stages exposing
+:meth:`flinkml_tpu.api.AlgoOperator.transform_kernel`) compiles into ONE
+``jax.jit`` program; intermediate columns never leave device memory, and
+the result :class:`~flinkml_tpu.table.Table` carries device-resident output
+columns that materialize to host lazily.
+
+Compile cache and row bucketing
+-------------------------------
+
+Programs are cached under a key of
+
+  ``(chain fingerprint, external input col specs, constant specs,
+  requested output columns, bucket)``
+
+where the chain fingerprint is the tuple of each kernel's ``fingerprint``,
+input col specs are ``(name, dtype, trailing shape)`` of every column the
+run reads from the table, constant specs are the shapes/dtypes of each
+kernel's model data, and ``bucket`` is the row count padded up to a power
+of two (≥ :data:`MIN_ROW_BUCKET`). Padding rows to the bucket plus a
+float32 validity mask means one compiled program serves every batch size
+within the bucket — repeated ``transform`` calls with differing row counts
+cause **zero recompiles** until a call crosses a power-of-two boundary.
+Padded rows may compute garbage; the executor slices them off before
+returning, and kernels with cross-row reductions apply the mask.
+
+Model data (coefficients, fitted statistics) is passed as *traced
+arguments*, so refreshing model data — or loading a different model of the
+same shape — reuses the compiled program.
+
+Lazy intermediates (dead-code elimination)
+------------------------------------------
+
+A run's eager program returns only its *terminal* columns (those no later
+kernel of the run consumes); XLA dead-code-eliminates the rest, so unread
+intermediate columns are never even written to memory. Intermediates land
+in the result table as :class:`~flinkml_tpu.table.LazyDeviceColumn`: shape
+and dtype come from an abstract trace, and the first read executes a
+DCE'd program for just that column through the same compile cache. Typical
+inference (read the prediction column only) therefore costs one program
+that computes nothing it doesn't need.
+
+Precision: programs trace and execute under ``jax.experimental.enable_x64``
+so kernels reproduce each stage's host-path dtypes exactly (scalers run in
+float64 like their numpy transform; predict kernels capture the *ambient*
+x64 flag at kernel-build time and cast to the same dtypes ``jnp.asarray``
+would give the per-stage path under it). Fused output is bit-identical to
+the per-stage path for exactly-rounded ops always, and for everything
+under x64 (the framework's test/golden configuration — pinned by the test
+suite). The one carve-out: under ambient float32, outputs of
+``pin_inputs`` kernels (matmul/transcendental stages) are numerically
+equivalent rather than bitwise — f32 matmul reassociation differs between
+the bucket-padded fused shape and the exact-row per-stage shape.
+
+Instrumentation (``metrics.group("pipeline.fusion")``): ``compiles`` /
+``cache_hits`` counters, ``fused_segments`` / ``fused_stages``,
+``host_to_device_transfers`` / ``host_to_device_bytes``, and
+``host_transfer_bytes_avoided`` (bytes of intermediate columns that would
+have round-tripped host↔device under per-stage execution). Tests can hook
+compilation via :data:`on_compile`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from flinkml_tpu.api import ColumnKernel
+from flinkml_tpu.linalg import next_pow2
+from flinkml_tpu.table import LazyDeviceColumn, PaddedDeviceColumn, Table
+from flinkml_tpu.utils.metrics import metrics
+
+#: Smallest row bucket: tiny tables all share one program.
+MIN_ROW_BUCKET = 8
+
+#: Callbacks invoked with the cache key whenever a new fused program is
+#: compiled (test hook: assert zero retraces across row counts).
+on_compile: List[Callable[[Tuple], None]] = []
+
+_CACHE: Dict[Tuple, Callable] = {}
+_LOCK = threading.Lock()
+_ENABLED = [True]
+
+
+def enabled() -> bool:
+    """Fusion master switch: the ``FLINKML_TPU_DISABLE_FUSION=1`` env var or
+    :func:`set_enabled` (used by the bench's unfused baseline) turns the
+    fused executor off, restoring pure per-stage execution."""
+    return _ENABLED[0] and os.environ.get("FLINKML_TPU_DISABLE_FUSION") != "1"
+
+
+def set_enabled(flag: bool) -> None:
+    _ENABLED[0] = bool(flag)
+
+
+def reset_cache() -> None:
+    """Drop every compiled program (tests; never needed in production)."""
+    with _LOCK:
+        _CACHE.clear()
+
+
+def compiled_program_count() -> int:
+    """Number of compiled programs in the cache (shape-spec entries from
+    the abstract trace don't count — they cost no compile)."""
+    with _LOCK:
+        return sum(1 for k in _CACHE if "__specs__" not in k)
+
+
+def row_bucket(n: int) -> int:
+    """Padded row count for ``n`` rows: next power of two, floored at
+    :data:`MIN_ROW_BUCKET`."""
+    return max(MIN_ROW_BUCKET, next_pow2(n))
+
+
+def _dense_in_table(table: Table, name: str) -> bool:
+    """Whether ``name`` is a column the executor can place on device."""
+    if name not in table:
+        return False
+    if table.is_device_resident(name):
+        return True
+    return table.column(name).dtype.kind in "fiub"
+
+
+def collect_run(table: Table, stages: Sequence, start: int):
+    """Longest run of kernel-capable stages beginning at ``stages[start]``
+    whose external inputs are dense columns of ``table`` (or products of
+    earlier kernels in the run). Returns ``(kernels, next_index)`` —
+    ``kernels`` empty when ``stages[start]`` cannot join a run."""
+    kernels: List[ColumnKernel] = []
+    produced: set = set()
+    i = start
+    while i < len(stages):
+        kernel = stages[i].transform_kernel()
+        if kernel is None:
+            break
+        if any(
+            c not in produced and not _dense_in_table(table, c)
+            for c in kernel.input_cols
+        ):
+            break
+        kernels.append(kernel)
+        produced.update(kernel.output_cols)
+        i += 1
+    return kernels, i
+
+
+def external_inputs(kernels: Sequence[ColumnKernel]) -> List[str]:
+    """Columns a run reads from the table (not produced inside the run),
+    in first-use order."""
+    ext: List[str] = []
+    produced: set = set()
+    for k in kernels:
+        for c in k.input_cols:
+            if c not in produced and c not in ext:
+                ext.append(c)
+        produced.update(k.output_cols)
+    return ext
+
+
+def _output_cols(kernels: Sequence[ColumnKernel]) -> List[str]:
+    out: List[str] = []
+    for k in kernels:
+        for c in k.output_cols:
+            if c not in out:
+                out.append(c)
+    return out
+
+
+def _closure_outputs(kernels: Sequence[ColumnKernel],
+                     requested: Sequence[str]) -> Tuple[str, ...]:
+    """``requested`` plus the materialization pins its dependency closure
+    demands: for every kernel with ``pin_inputs`` that the requested
+    columns (transitively) depend on, the kernel's chain-produced input
+    columns join the program outputs — materializing them pins the fusion
+    boundary so the kernel's context-sensitive ops (transcendentals,
+    matmuls) lower exactly as in the stand-alone per-stage program.
+    Kernels outside the closure stay dead code."""
+    producer = {}
+    for j, k in enumerate(kernels):
+        for c in k.output_cols:
+            producer[c] = j
+    needed: set = set()
+    stack = [producer[c] for c in requested if c in producer]
+    while stack:
+        j = stack.pop()
+        if j in needed:
+            continue
+        needed.add(j)
+        stack.extend(
+            producer[c] for c in kernels[j].input_cols if c in producer
+        )
+    pins: List[str] = []
+    for j in sorted(needed):
+        if kernels[j].pin_inputs:
+            for c in kernels[j].input_cols:
+                if c in producer and c not in pins:
+                    pins.append(c)
+    return tuple(dict.fromkeys([*pins, *requested]))
+
+
+def _chain_fn(kernels: Sequence[ColumnKernel], ext_names: Sequence[str],
+              out_names: Sequence[str], bucket: int):
+    """The pure cols→cols chain function for ``kernels``, returning only
+    ``out_names``. Constants arrive as traced arguments (sorted by name
+    per kernel) so model-data value changes reuse the compiled
+    executable, and the row count arrives as a traced scalar (the
+    validity mask is built on device, so differing row counts within a
+    bucket share one program AND allocate nothing host-side). Columns NOT
+    in ``out_names`` — and every kernel feeding only such columns — are
+    dead code XLA eliminates, which is how lazy intermediate columns cost
+    nothing until someone reads them."""
+    import jax
+    import jax.numpy as jnp
+
+    kernels = tuple(kernels)
+    ext_names = tuple(ext_names)
+    out_names = tuple(out_names)
+
+    def run(ext_vals, const_vals, n_valid):
+        valid = (jnp.arange(bucket) < n_valid).astype(jnp.float32)
+        cols = dict(zip(ext_names, ext_vals))
+        last = len(kernels) - 1
+        for i, (kernel, cv) in enumerate(zip(kernels, const_vals)):
+            consts = dict(zip(sorted(kernel.constants), cv))
+            outs = kernel.fn(
+                {c: cols[c] for c in kernel.input_cols}, consts, valid
+            )
+            if i != last:
+                # Pin per-stage rounding: without the barrier XLA's
+                # algebraic simplifier rewrites across stage boundaries
+                # (e.g. two chained scaler divisions (x/s1)/s2 become
+                # x/(s1*s2)), breaking the bit-parity contract with the
+                # per-stage path. Still ONE program / one dispatch;
+                # only cross-stage op rewriting is fenced.
+                outs = jax.lax.optimization_barrier(outs)
+            cols.update(outs)
+        return {c: cols[c] for c in out_names}
+
+    return run
+
+
+def _run_program(kernels, ext_names, out_names, ext_specs, const_specs,
+                 ext_vals, const_vals, bucket: int, n: int):
+    """Compile-or-reuse the program for (chain, requested outputs, bucket)
+    and execute it; returns the dict of bucket-padded output buffers."""
+    import jax
+
+    group = metrics.group("pipeline.fusion")
+    key = (
+        tuple(k.fingerprint for k in kernels),
+        tuple(ext_specs),
+        const_specs,
+        tuple(out_names),
+        bucket,
+    )
+    with _LOCK:
+        program = _CACHE.get(key)
+        if program is None:
+            program = jax.jit(
+                _chain_fn(kernels, ext_names, out_names, bucket)
+            )
+            _CACHE[key] = program
+            compiled = True
+        else:
+            compiled = False
+    if compiled:
+        group.counter("compiles")
+        for hook in list(on_compile):
+            hook(key)
+    else:
+        group.counter("cache_hits")
+    with jax.experimental.enable_x64(True):
+        return program(
+            tuple(ext_vals), const_vals, np.int32(n)
+        )
+
+
+def execute_kernel_chain(table: Table, kernels: Sequence[ColumnKernel]) -> Table:
+    """Run ``kernels`` over ``table`` as one fused program.
+
+    One host→device upload per external host-resident input column, zero
+    host transfers for device-resident inputs and intermediates, and a
+    result table whose new columns are device-resident (host copy deferred
+    to :meth:`Table.column`).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not kernels:
+        return table
+    group = metrics.group("pipeline.fusion")
+    n = table.num_rows
+    bucket = row_bucket(n)
+    ext = external_inputs(kernels)
+    out_names = _output_cols(kernels)
+
+    # Partition outputs: a column consumed by a later kernel of the run is
+    # an *intermediate* — nobody may ever read it, so it is not computed
+    # eagerly. The eager program returns only terminal columns, XLA
+    # dead-code-eliminates the rest (on the CPU fallback this alone is the
+    # difference between ~1x and ~3x over per-stage execution: four unread
+    # [rows, dim] float64 buffers never get written). Intermediates become
+    # LazyDeviceColumns: first access runs a DCE'd program for just that
+    # column, through the same compile cache.
+    producer = {}
+    for j, k in enumerate(kernels):
+        for c in k.output_cols:
+            producer[c] = j
+    terminal = [
+        c for c in out_names
+        if not any(
+            c in kernels[j].input_cols
+            for j in range(producer[c] + 1, len(kernels))
+        )
+    ]
+    # Terminals plus the pinned inputs their closure demands (pin_inputs
+    # kernels need their input columns materialized for bit parity).
+    eager_names = list(_closure_outputs(kernels, terminal))
+    lazy_names = [c for c in out_names if c not in eager_names]
+
+    with jax.experimental.enable_x64(True):
+        ext_vals = []
+        ext_specs = []
+        for name in ext:
+            if not table.has_device_copy(name):
+                # The upload below is a real host→device copy; further
+                # transforms over this (immutable) table hit the cache.
+                group.counter("host_to_device_transfers")
+                group.counter(
+                    "host_to_device_bytes", float(table.column(name).nbytes)
+                )
+            arr = table.device_column_padded(name, bucket)
+            ext_vals.append(arr)
+            ext_specs.append((name, str(arr.dtype), tuple(arr.shape[1:])))
+
+        const_vals = tuple(
+            tuple(jnp.asarray(k.constants[c]) for c in sorted(k.constants))
+            for k in kernels
+        )
+        const_specs = tuple(
+            tuple(
+                (c, str(v.dtype), tuple(v.shape))
+                for c, v in zip(sorted(k.constants), cv)
+            )
+            for k, cv in zip(kernels, const_vals)
+        )
+
+        # Abstract trace (no compile, no compute): padded shape/dtype of
+        # every output, for lazy-column construction and the bytes-avoided
+        # accounting. Cached alongside the programs.
+        spec_key = (
+            tuple(k.fingerprint for k in kernels),
+            tuple(ext_specs),
+            const_specs,
+            "__specs__",
+            bucket,
+        )
+        with _LOCK:
+            specs = _CACHE.get(spec_key)
+        if specs is None:
+            abstract = jax.eval_shape(
+                _chain_fn(kernels, ext, out_names, bucket),
+                tuple(ext_vals), const_vals, np.int32(n),
+            )
+            specs = {
+                c: (tuple(v.shape), v.dtype) for c, v in abstract.items()
+            }
+            with _LOCK:
+                _CACHE[spec_key] = specs
+
+    outs = _run_program(
+        kernels, ext, eager_names, ext_specs, const_specs,
+        ext_vals, const_vals, bucket, n,
+    )
+
+    group.counter("fused_segments")
+    group.counter("fused_stages", float(len(kernels)))
+    # Per-stage execution would download every intermediate column and
+    # re-upload it for the next stage; fused, those bytes never move.
+    avoided = 0.0
+    for name in lazy_names:
+        shape, dtype = specs[name]
+        row = int(np.prod(shape[1:], dtype=np.int64))
+        avoided += 2.0 * n * row * np.dtype(dtype).itemsize
+    if avoided:
+        group.counter("host_transfer_bytes_avoided", avoided)
+
+    # Outputs stay bucket-padded behind PaddedDeviceColumn: result
+    # construction costs no device work; the prefix slice (and any
+    # device→host copy) happens lazily at column access. Intermediates go
+    # one step lazier: even their compute waits for the first read.
+    result = table
+    for name in eager_names:
+        result = result.with_column(
+            name, PaddedDeviceColumn(outs[name], n)
+        )
+    for name in lazy_names:
+        shape, dtype = specs[name]
+
+        def thunk(name=name):
+            return _run_program(
+                kernels, ext, _closure_outputs(kernels, (name,)),
+                ext_specs, const_specs, ext_vals, const_vals, bucket, n,
+            )[name]
+
+        result = result.with_column(
+            name, LazyDeviceColumn(thunk, n, shape, dtype)
+        )
+    return result

@@ -3,10 +3,27 @@
 The reference moves data as row streams (``Table`` ↔ ``DataStream<Row>``,
 e.g. ``LogisticRegression.java:111-130`` maps rows to POJOs one at a time).
 On TPU, per-record processing wastes the MXU; the native representation is a
-batched columnar store: each column is a host numpy array with leading axis =
-rows (feature columns are 2-D ``[rows, dim]``), shipped to device HBM as
-batches via ``jax.device_put``. This single type replaces the reference's
-Table conversions and record-at-a-time operators.
+batched columnar store: each column is an array with leading axis = rows
+(feature columns are 2-D ``[rows, dim]``). This single type replaces the
+reference's Table conversions and record-at-a-time operators.
+
+Columns live in one of two homes:
+
+  - **host**: a numpy array (the ingest format, and the only home for
+    object/ragged columns);
+  - **device**: a ``jax.Array`` resident in accelerator memory — the output
+    format of the fused pipeline executor
+    (:mod:`flinkml_tpu.pipeline_fusion`), which keeps intermediate columns
+    on device across stage boundaries instead of round-tripping per stage.
+
+The relational ops (``select`` / ``with_column`` / ``drop`` / ``rename``)
+are **zero-copy for device-backed columns**: they rebind buffers under new
+names without touching the host. ``column(name)`` materializes a
+device-backed column to numpy **lazily** (cached after the first fetch);
+``device_column(name)`` hands back the device buffer with no host copy
+(uploading a host column on first use, also cached). Row-indexed ops
+(``take`` / ``slice`` / ``concat`` / ``to_rows``) operate on the host
+representation.
 """
 
 from __future__ import annotations
@@ -16,22 +33,131 @@ from typing import Any, Dict, Iterable, Iterator, List, Mapping, Optional
 import numpy as np
 
 
+def _is_device_array(x: Any) -> bool:
+    """True for a jax.Array (without importing jax when it can't be one)."""
+    if isinstance(x, np.ndarray) or x is None:
+        return False
+    mod = type(x).__module__
+    if not (mod == "jax" or mod.startswith("jax.") or mod.startswith("jaxlib")):
+        return False
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+class PaddedDeviceColumn:
+    """A device-resident column whose backing buffer carries extra padding
+    rows beyond the column's logical row count.
+
+    The fused pipeline executor (:mod:`flinkml_tpu.pipeline_fusion`)
+    computes on row-bucket-padded buffers; wrapping its outputs instead of
+    slicing them keeps result construction free of device work — the
+    prefix slice happens lazily at access time (and on the CPU backend a
+    host read is a zero-copy view). Rows past ``rows`` are unspecified
+    (bucket-padding garbage); every consumer must go through
+    :meth:`Table.column` / :meth:`Table.device_column`, which slice.
+    """
+
+    __slots__ = ("buf", "rows")
+
+    def __init__(self, buf, rows: int):
+        if buf.shape[0] < rows:
+            raise ValueError(
+                f"padded buffer has {buf.shape[0]} rows < logical {rows}"
+            )
+        self.buf = buf
+        self.rows = int(rows)
+
+    @property
+    def shape(self):
+        return (self.rows,) + tuple(self.buf.shape[1:])
+
+    @property
+    def ndim(self) -> int:
+        return self.buf.ndim
+
+    @property
+    def dtype(self):
+        return self.buf.dtype
+
+
+class LazyDeviceColumn(PaddedDeviceColumn):
+    """A :class:`PaddedDeviceColumn` whose buffer is not computed yet.
+
+    The fused pipeline executor materializes only a run's *terminal*
+    columns eagerly; intermediates consumed inside the run are wrapped in
+    this class with a thunk that, on first access, executes a
+    dead-code-eliminated program computing just that column. Shape and
+    dtype are known statically (from an abstract trace), so table
+    construction and relational ops never trigger the compute.
+    """
+
+    __slots__ = ("_thunk", "_buf", "_padded_shape", "_dtype")
+
+    def __init__(self, thunk, rows: int, padded_shape, dtype):
+        if padded_shape[0] < rows:
+            raise ValueError(
+                f"padded buffer has {padded_shape[0]} rows < logical {rows}"
+            )
+        self._thunk = thunk
+        self._buf = None
+        self._padded_shape = tuple(padded_shape)
+        self._dtype = dtype
+        self.rows = int(rows)
+
+    @property
+    def buf(self):
+        if self._buf is None:
+            self._buf = self._thunk()
+            self._thunk = None
+        return self._buf
+
+    @property
+    def shape(self):
+        return (self.rows,) + self._padded_shape[1:]
+
+    @property
+    def ndim(self) -> int:
+        return len(self._padded_shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+
+def _is_device_backed(x: Any) -> bool:
+    return _is_device_array(x) or isinstance(x, PaddedDeviceColumn)
+
+
+def _materialization_metrics():
+    """The table metric group (lazy import: metrics pulls in the iteration
+    runtime, which must not become a hard dependency of the data plane)."""
+    from flinkml_tpu.utils.metrics import metrics
+
+    return metrics.group("table")
+
+
 class Table:
-    """Immutable named-column container backed by host numpy arrays.
+    """Immutable named-column container backed by host numpy arrays and/or
+    device-resident ``jax.Array`` columns.
 
     All columns share the same leading dimension (row count). Columns may be:
       - 1-D arrays (scalar columns: labels, weights, categories),
       - N-D arrays (vector/matrix columns: features ``[rows, dim]``),
-      - object arrays (ragged data, e.g. sparse vectors before densify).
+      - object arrays (ragged data, e.g. sparse vectors before densify),
+      - ``jax.Array`` buffers (device-resident columns; see module docstring).
     """
 
     def __init__(self, columns: Mapping[str, Any]):
         if not columns:
             raise ValueError("Table requires at least one column")
-        conv: Dict[str, np.ndarray] = {}
+        conv: Dict[str, Any] = {}
         n_rows: Optional[int] = None
         for name, col in columns.items():
-            arr = col if isinstance(col, np.ndarray) else _to_array(col)
+            if isinstance(col, np.ndarray) or _is_device_backed(col):
+                arr = col
+            else:
+                arr = _to_array(col)
             if arr.ndim == 0:
                 # Scalar columns become single-row columns so every column
                 # supports row slicing uniformly.
@@ -45,6 +171,10 @@ class Table:
             conv[name] = arr
         self._columns = conv
         self._num_rows = int(n_rows or 0)
+        # Lazy per-home caches: a device column fetched to host (or a host
+        # column uploaded to device) is converted at most once per Table.
+        self._host_cache: Dict[str, np.ndarray] = {}
+        self._device_cache: Dict[str, Any] = {}
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -74,22 +204,114 @@ class Table:
     def __contains__(self, name: str) -> bool:
         return name in self._columns
 
-    def column(self, name: str) -> np.ndarray:
+    def _raw_column(self, name: str) -> Any:
         if name not in self._columns:
             raise KeyError(
                 f"Column {name!r} not in table (has {self.column_names})"
             )
         return self._columns[name]
 
+    def is_device_resident(self, name: str) -> bool:
+        """True when the column's backing buffer lives in device memory."""
+        return _is_device_backed(self._raw_column(name))
+
+    def column(self, name: str) -> np.ndarray:
+        """The column as a host numpy array.
+
+        Device-backed columns materialize lazily HERE (one device→host
+        transfer, cached); until this call they cost no host bandwidth.
+        """
+        col = self._raw_column(name)
+        if not _is_device_backed(col):
+            return col
+        if name not in self._host_cache:
+            if isinstance(col, PaddedDeviceColumn):
+                host = np.asarray(col.buf)[: col.rows]
+            else:
+                host = np.asarray(col)
+            group = _materialization_metrics()
+            group.counter("device_to_host_materializations")
+            group.counter("device_to_host_bytes", float(host.nbytes))
+            self._host_cache[name] = host
+        return self._host_cache[name]
+
     __getitem__ = column
 
+    def device_column(self, name: str):
+        """The column as a device-resident ``jax.Array`` — no host copy for
+        device-backed columns; host columns upload on first use (cached).
+
+        Object (ragged) columns have no device representation and raise.
+        """
+        col = self._raw_column(name)
+        if _is_device_array(col):
+            return col
+        if isinstance(col, PaddedDeviceColumn):
+            if name not in self._device_cache:
+                self._device_cache[name] = col.buf[: col.rows]
+            return self._device_cache[name]
+        if col.dtype == object:
+            raise TypeError(
+                f"Column {name!r} is an object (ragged) column; it has no "
+                "device representation"
+            )
+        if name not in self._device_cache:
+            import jax
+            import jax.numpy as jnp
+
+            # Uploads preserve the host dtype exactly (a float64 column
+            # stays float64 even when the ambient x64 flag is off): the
+            # fused executor's bit-parity contract depends on the device
+            # copy being the same bits as the host column.
+            with jax.experimental.enable_x64(True):
+                self._device_cache[name] = jnp.asarray(col)
+        return self._device_cache[name]
+
+    def has_device_copy(self, name: str) -> bool:
+        """True when :meth:`device_column` would cost no host→device copy
+        (the column is device-backed, or its upload is already cached)."""
+        return _is_device_backed(self._raw_column(name)) or name in self._device_cache
+
+    def device_column_padded(self, name: str, rows: int):
+        """:meth:`device_column` zero-padded on device to ``rows`` rows,
+        cached per ``(column, rows)`` — the fused pipeline executor's
+        ingest path. Tables are immutable, so repeated ``transform`` calls
+        over the same table reuse the padded buffer with zero host work.
+        """
+        key = (name, int(rows))
+        if key not in self._device_cache:
+            raw = self._raw_column(name)
+            if isinstance(raw, PaddedDeviceColumn) and raw.buf.shape[0] == rows:
+                # A fused-executor output re-entering a fused run at the
+                # same bucket: hand the padded buffer straight through
+                # (rows past the logical count are unspecified either way;
+                # kernels see only what the validity mask admits).
+                self._device_cache[key] = raw.buf
+            else:
+                import jax
+                import jax.numpy as jnp
+
+                arr = self.device_column(name)
+                pad = int(rows) - arr.shape[0]
+                if pad > 0:
+                    with jax.experimental.enable_x64(True):
+                        arr = jnp.concatenate(
+                            [arr, jnp.zeros((pad,) + arr.shape[1:], arr.dtype)]
+                        )
+                self._device_cache[key] = arr
+        return self._device_cache[key]
+
     # -- relational ops ----------------------------------------------------
+    # Zero-copy on device-backed columns: buffers are rebound, never fetched.
     def select(self, *names: str) -> "Table":
-        return Table({n: self.column(n) for n in names})
+        return Table({n: self._raw_column(n) for n in names})
 
     def with_column(self, name: str, values: Any) -> "Table":
         cols = dict(self._columns)
-        cols[name] = _to_array(values) if not isinstance(values, np.ndarray) else values
+        if isinstance(values, np.ndarray) or _is_device_backed(values):
+            cols[name] = values
+        else:
+            cols[name] = _to_array(values)
         return Table(cols)
 
     def drop(self, *names: str) -> "Table":
@@ -99,17 +321,18 @@ class Table:
     def rename(self, mapping: Mapping[str, str]) -> "Table":
         return Table({mapping.get(n, n): c for n, c in self._columns.items()})
 
+    # Row-indexed ops operate on the host representation.
     def take(self, indices: np.ndarray) -> "Table":
-        return Table({n: c[indices] for n, c in self._columns.items()})
+        return Table({n: self.column(n)[indices] for n in self._columns})
 
     def slice(self, start: int, stop: int) -> "Table":
-        return Table({n: c[start:stop] for n, c in self._columns.items()})
+        return Table({n: self.column(n)[start:stop] for n in self._columns})
 
     def concat(self, other: "Table") -> "Table":
         if set(self.column_names) != set(other.column_names):
             raise ValueError("concat requires identical column sets")
         return Table(
-            {n: np.concatenate([self._columns[n], other.column(n)]) for n in self.column_names}
+            {n: np.concatenate([self.column(n), other.column(n)]) for n in self.column_names}
         )
 
     # -- iteration ---------------------------------------------------------
@@ -122,12 +345,13 @@ class Table:
 
     def to_rows(self) -> List[Dict[str, Any]]:
         return [
-            {n: c[i] for n, c in self._columns.items()} for i in range(self._num_rows)
+            {n: self.column(n)[i] for n in self._columns} for i in range(self._num_rows)
         ]
 
     def __repr__(self) -> str:  # pragma: no cover
         cols = ", ".join(
-            f"{n}:{c.dtype}{list(c.shape[1:])}" for n, c in self._columns.items()
+            f"{n}:{c.dtype}{list(c.shape[1:])}{'@device' if _is_device_backed(c) else ''}"
+            for n, c in self._columns.items()
         )
         return f"Table[{self._num_rows} rows; {cols}]"
 

@@ -258,6 +258,11 @@ def _launch_multiprocess_workers(
         env.pop("XLA_FLAGS", None)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # Workers share the suite's persistent XLA cache: repeat runs (and
+    # retries) skip recompiling the cross-process programs, which
+    # otherwise dominate these tests' wall clock.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
     def attempt(workdir):
         # Probe a free ephemeral port. The bind-then-close window is racy

@@ -473,3 +473,51 @@ def test_window_sort_tables_are_sorted_and_permute_back():
             # sids is flat permuted by perm, and non-decreasing.
             np.testing.assert_array_equal(flat[perm[row]], sids[row])
             assert (np.diff(sids[row]) >= 0).all()
+
+
+def test_chunked_run_totals_small_input_avoids_full_chunk_pad():
+    """ADVICE r5 (low): inputs smaller than one CUMSUM_CHUNK must not pad
+    their cumsum transient up to 65536 rows — at the ALS cumsum layout
+    ([chunk, k*k+k+1] payload, rank ~100) that is a multi-GB intermediate
+    for a few-MB input. The trace for a 4k-cell input must contain no
+    array whose leading dim reaches CUMSUM_CHUNK, and results must stay
+    correct at every small size (a sub-chunk input is a single chunk
+    either way, so the error-bound rationale is untouched)."""
+    import jax
+
+    from flinkml_tpu.ops.sparse import CUMSUM_CHUNK, chunked_run_totals
+
+    rng = np.random.default_rng(1)
+    cells, k = 4_000, 7
+    contrib = rng.normal(size=(cells, k)).astype(np.float32)
+    ends = np.sort(
+        rng.choice(cells - 1, size=36, replace=False)
+    ).astype(np.int32)
+    ends = np.concatenate([ends, [cells - 1]]).astype(np.int32)
+
+    jaxpr = jax.make_jaxpr(chunked_run_totals)(contrib, ends)
+    dims = [
+        d
+        for eqn in jaxpr.jaxpr.eqns
+        for v in eqn.outvars
+        for d in getattr(v.aval, "shape", ())
+    ]
+    assert max(dims) < CUMSUM_CHUNK, (
+        f"4k-cell input materialized a {max(dims)}-row transient"
+    )
+
+    # Correctness across small sizes, against a float64 prefix-sum ref.
+    import jax.numpy as jnp
+
+    for cells2 in (1, 3, 100, 4_000):
+        c2 = rng.normal(size=cells2)
+        e2 = np.unique(
+            rng.integers(0, cells2, size=min(cells2, 11))
+        ).astype(np.int32)
+        e2[-1] = cells2 - 1
+        got = np.asarray(
+            chunked_run_totals(jnp.asarray(c2), jnp.asarray(e2))
+        )
+        pref = np.cumsum(c2)[e2]
+        ref = pref - np.concatenate([[0.0], pref[:-1]])
+        np.testing.assert_allclose(got, ref, rtol=1e-12, atol=1e-12)

@@ -702,3 +702,66 @@ def test_sparse_streamed_csr_cache_edge_cases(tmp_path, mesh):
         streamed_linear_fit(
             cache_stream(iter([csr_row(500, 0), csr_row(900, 1)])), **hyper
         )
+
+
+def _flat_csr_batch(indptr, indices, values, y, dim):
+    """One batch in the flat CSR stream format (each component a 2-D row)."""
+    return {
+        "indptr": np.asarray(indptr, np.int64)[None],
+        "indices": np.asarray(indices, np.int32)[None],
+        "values": np.asarray(values, np.float32)[None],
+        "y": np.asarray(y, np.float32)[None],
+        "dim": np.array([[dim]], np.int64),
+    }
+
+
+def test_csr_stream_rejects_non_monotone_indptr(mesh):
+    """ADVICE r5 (medium): a non-monotone indptr passes the ragged check
+    (indices.size == indptr[-1]) but raises rank-locally inside the ELL
+    fill at place time — stranding peers mid-collective. It must be
+    rejected at ingest, where the failure rides the held-error
+    rendezvous like every other input check."""
+    dim = 32
+    bad = _flat_csr_batch(
+        [0, 5, 3, 9], np.zeros(9), np.ones(9), np.ones(3), dim
+    )
+    with pytest.raises(ValueError, match="non-decreasing"):
+        _train([bad], mesh, sparse_dim=dim)
+    # indptr not starting at 0 is the same class of corruption.
+    bad0 = _flat_csr_batch(
+        [1, 4, 9], np.zeros(9), np.ones(9), np.ones(2), dim
+    )
+    with pytest.raises(ValueError, match="start at 0"):
+        _train([bad0], mesh, sparse_dim=dim)
+
+
+def test_csr_stream_rejects_out_of_range_indices(mesh):
+    """ADVICE r5 (low): out-of-range column ids never raise on device —
+    the jitted gather/scatter clamps them, silently misattributing
+    gradient mass to boundary columns. Both polarities must be rejected
+    at ingest."""
+    dim = 32
+    neg = _flat_csr_batch(
+        [0, 2, 4], [1, -3, 5, 2], np.ones(4), np.ones(2), dim
+    )
+    with pytest.raises(ValueError, match="column indices"):
+        _train([neg], mesh, sparse_dim=dim)
+    high = _flat_csr_batch(
+        [0, 2, 4], [1, 3, dim, 2], np.ones(4), np.ones(2), dim
+    )
+    with pytest.raises(ValueError, match="column indices"):
+        _train([high], mesh, sparse_dim=dim)
+
+
+def test_check_csr_structure_accepts_valid_and_returns_nnz():
+    """The shared validator must not reject well-formed CSR (including
+    empty rows and boundary column ids) and returns diff(indptr) so the
+    callers' ELL-width accounting stays single-pass."""
+    from flinkml_tpu.models._linear_sgd import _check_csr_structure
+
+    nnz = _check_csr_structure(
+        np.array([0, 2, 2, 5]), np.array([0, 31, 4, 0, 30]), 32
+    )
+    np.testing.assert_array_equal(nnz, [2, 0, 3])
+    with pytest.raises(ValueError):
+        _check_csr_structure(np.array([], np.int64), np.array([], np.int64), 32)
