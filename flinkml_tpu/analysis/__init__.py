@@ -1,0 +1,54 @@
+"""Ahead-of-time static analysis for flinkml_tpu pipelines.
+
+Three passes, all device-free (run them under ``JAX_PLATFORMS=cpu`` on
+any host):
+
+  1. **Graph validator** (:mod:`.validator`, :mod:`.ast_lint`) — schema
+     flow, kernel abstract evaluation via ``jax.eval_shape``, fusion
+     topology, fingerprint stability; over live ``Pipeline`` /
+     ``PipelineModel`` / ``Graph`` objects or over scripts' ASTs.
+  2. **Collective-order checker** (:mod:`.collectives`) — extracts
+     ordered collective sequences from jaxprs, compares them across
+     ranks, and flags unlocked concurrent multi-device dispatch (the
+     PR 1 rendezvous-deadlock shape) from recorded dispatch traces.
+  3. **Transfer/retrace guard** (:mod:`.guard`) — runtime budget checks
+     for hot loops: compile-cache misses beyond the bucket policy and
+     host↔device transfers beyond declared budgets; backs the
+     ``no_retrace`` pytest marker.
+
+CLI: ``python -m flinkml_tpu.analysis <paths...> [--fail-on-findings]``
+(see :mod:`.__main__`); rule catalog in :data:`.findings.RULES` and
+``docs/development/static_analysis.md``.
+"""
+
+from flinkml_tpu.analysis.findings import (  # noqa: F401
+    ERROR,
+    Finding,
+    Report,
+    RULES,
+    WARNING,
+)
+from flinkml_tpu.analysis.validator import (  # noqa: F401
+    ColumnSpec,
+    StageIO,
+    analyze_graph,
+    analyze_pipeline,
+    kernel_output_specs,
+    schema_of,
+    stage_io,
+)
+from flinkml_tpu.analysis.ast_lint import lint_paths, lint_source  # noqa: F401
+from flinkml_tpu.analysis.collectives import (  # noqa: F401
+    COLLECTIVE_PRIMITIVES,
+    CollectiveOp,
+    DispatchEvent,
+    check_dispatch_trace,
+    check_rank_order,
+    extract_collectives,
+    load_trace,
+)
+from flinkml_tpu.analysis.guard import (  # noqa: F401
+    GuardViolation,
+    TransferRetraceGuard,
+    transfer_retrace_guard,
+)

@@ -1,0 +1,197 @@
+"""``python -m flinkml_tpu.analysis`` — the ahead-of-time lint gate.
+
+Runs all three analysis passes device-free over the given targets:
+
+  1. *graph validation*: every ``.py`` target (file or directory) is
+     AST-linted for pipeline schema/ordering/collision findings;
+  2. *collective order*: every ``*.trace.json`` target (a recorded
+     dispatch trace, e.g. a fixture of the PR 1 threaded-kmeans deadlock)
+     is checked for unlocked concurrent collective dispatch;
+  3. *transfer/retrace self-check*: a representative fused scaler→
+     predictor chain is executed at several row counts inside one bucket
+     under :class:`~flinkml_tpu.analysis.guard.TransferRetraceGuard` —
+     zero cache misses and exactly one upload per transform, or findings.
+
+Exit status: 0 when clean, 1 on any error-severity finding (or on ANY
+finding with ``--fail-on-findings``). ``--json`` emits machine-readable
+findings, ``--suppress FML104,...`` drops rules, ``--rules`` prints the
+catalog. See ``docs/development/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Device-free by construction: pin the CPU backend before anything can
+# import jax (the TPU plugin may override JAX_PLATFORMS at import time;
+# re-pinned via jax.config below for that case).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import argparse
+import sys
+
+from flinkml_tpu.analysis.findings import RULES, Report
+
+
+def _pin_cpu() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def _pass_lint(py_targets, report: Report) -> None:
+    from flinkml_tpu.analysis.ast_lint import lint_paths
+
+    report.extend(lint_paths(py_targets))
+
+
+def _pass_traces(trace_targets, report: Report) -> None:
+    from flinkml_tpu.analysis.collectives import (
+        check_dispatch_trace,
+        load_trace,
+    )
+
+    for path in trace_targets:
+        report.extend(
+            check_dispatch_trace(load_trace(path), location=path)
+        )
+
+
+def _pass_retrace_selfcheck(report: Report) -> None:
+    """Drive the bench's ``pipeline_fused`` chain (4 scalers + a
+    LogisticRegressionModel, the 5-stage all-kernel spine ``bench.py``
+    measures) across varying batch sizes within one row bucket (and one
+    boundary crossing) under a zero-budget guard — the runtime half of
+    the bucket-policy contract, checked device-free."""
+    import numpy as np
+
+    _pin_cpu()
+    from flinkml_tpu.analysis.guard import TransferRetraceGuard
+    from flinkml_tpu.models.logistic_regression import LogisticRegressionModel
+    from flinkml_tpu.models.scalers import (
+        MaxAbsScalerModel,
+        MinMaxScalerModel,
+        RobustScalerModel,
+        StandardScalerModel,
+    )
+    from flinkml_tpu.pipeline import PipelineModel
+    from flinkml_tpu.table import Table
+
+    rng = np.random.default_rng(0)
+    n, d = 200, 8
+    x = rng.normal(size=(n, d))
+    table = Table({"features": x})
+
+    stages = []
+    prev = "features"
+    scaler_data = {
+        StandardScalerModel: {"mean": x.mean(0)[None], "std": x.std(0)[None]},
+        MinMaxScalerModel: {"dataMin": x.min(0)[None],
+                            "dataMax": x.max(0)[None]},
+        MaxAbsScalerModel: {"maxAbs": np.abs(x).max(0)[None]},
+        RobustScalerModel: {"median": np.median(x, 0)[None],
+                            "range": np.ones((1, d))},
+    }
+    for i, (cls, data) in enumerate(scaler_data.items(), start=1):
+        m = cls().set(cls.INPUT_COL, prev).set(cls.OUTPUT_COL, f"s{i}")
+        m.set_model_data(Table(data))
+        stages.append(m)
+        prev = f"s{i}"
+    lr = LogisticRegressionModel().set(
+        LogisticRegressionModel.FEATURES_COL, prev
+    )
+    lr.set_model_data(Table({"coefficient": rng.normal(size=(1, d))}))
+    stages.append(lr)
+    pm = PipelineModel(stages)
+
+    # Warmup: one compile for the 128-row bucket.
+    pm.transform(table.slice(0, 100))
+
+    guard = TransferRetraceGuard(
+        allow_compiles=0,
+        allow_new_buckets=True,          # crossing 128 -> 256 is policy
+        allow_host_to_device=5,          # one declared upload per new table
+        allow_device_to_host=0,          # nothing reads back in the loop
+        raise_on_violation=False,
+        location="selfcheck:pipeline_fused",
+    )
+    with guard:
+        for rows in (100, 77, 96, 128):  # same bucket: zero compiles
+            pm.transform(table.slice(0, rows))
+        pm.transform(table.slice(0, 129))  # new bucket: allowed compile
+    report.extend(guard.findings)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flinkml_tpu.analysis",
+        description="Ahead-of-time pipeline validation, collective-order "
+                    "checking, and a transfer/retrace lint gate.",
+    )
+    parser.add_argument(
+        "targets", nargs="*",
+        help=".py files / directories to lint and *.trace.json dispatch "
+             "traces to check",
+    )
+    parser.add_argument(
+        "--fail-on-findings", action="store_true",
+        help="exit non-zero on ANY finding (default: errors only)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as JSON")
+    parser.add_argument(
+        "--suppress", default="",
+        help="comma-separated rule ids to drop (e.g. FML104,FML106)",
+    )
+    parser.add_argument("--rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument(
+        "--no-selfcheck", action="store_true",
+        help="skip the transfer/retrace executor self-check pass",
+    )
+    args = parser.parse_args(argv)
+
+    if args.rules:
+        for rule, (sev, desc) in sorted(RULES.items()):
+            print(f"{rule} [{sev}] {desc}")
+        return 0
+
+    py_targets, trace_targets = [], []
+    for t in args.targets:
+        if t.endswith(".trace.json"):
+            trace_targets.append(t)
+        else:
+            py_targets.append(t)
+            if os.path.isdir(t):
+                for root, _dirs, names in os.walk(t):
+                    trace_targets.extend(
+                        os.path.join(root, n) for n in sorted(names)
+                        if n.endswith(".trace.json")
+                    )
+
+    report = Report()
+    if py_targets:
+        _pass_lint(py_targets, report)
+    if trace_targets:
+        _pass_traces(trace_targets, report)
+    if not args.no_selfcheck:
+        _pass_retrace_selfcheck(report)
+
+    if args.suppress:
+        report = report.suppress(
+            [r.strip() for r in args.suppress.split(",") if r.strip()]
+        )
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+
+    if args.fail_on_findings:
+        return 1 if report else 0
+    return 1 if report.errors() else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""Pass 2 — collective-order extraction and deadlock-shape detection.
+
+Collective rendezvous (psum/ppermute/all_gather/...) requires every
+participant to reach the *same* collectives in the *same* order. Two
+program shapes break that:
+
+  1. **Cross-rank divergence** (FML301): ranks compile programs whose
+     collective sequences differ — rank 0 waits in a psum while rank 1
+     waits in an all_gather, forever. :func:`extract_collectives` pulls
+     the ordered collective sequence out of any traceable function's
+     jaxpr (recursing through pjit/shard_map/scan/while/cond), and
+     :func:`check_rank_order` compares sequences across ranks.
+
+  2. **Unlocked concurrent dispatch** (FML302): two host *threads* each
+     dispatch multi-device collective programs over overlapping devices.
+     Per-device execution streams then see the two programs' collective
+     enqueues in different orders on different devices — the exact
+     intermittent wedge PR 1's ``local_execution_lock`` papers over.
+     :func:`check_dispatch_trace` flags the unsafe shape statically from
+     a recorded :class:`DispatchEvent` trace: any pair of multi-device
+     collective dispatches from different threads over intersecting
+     device sets that do not share a lock token is a potential
+     rendezvous deadlock — *possibility* of interleaving is already the
+     bug, no schedule enumeration needed.
+
+Traces come from :mod:`flinkml_tpu.parallel.dispatch` observers (live
+runs) or from JSON files (recorded fixtures); both are host-side only, so
+the checker runs device-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from flinkml_tpu.analysis.findings import Finding
+
+#: jaxpr primitives that rendezvous across devices.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmin", "pmax", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in program order: primitive name + mesh axes."""
+
+    primitive: str
+    axes: Tuple[str, ...] = ()
+
+    def to_map(self) -> dict:
+        return {"primitive": self.primitive, "axes": list(self.axes)}
+
+    @staticmethod
+    def from_map(m: Mapping) -> "CollectiveOp":
+        return CollectiveOp(str(m["primitive"]),
+                            tuple(str(a) for a in m.get("axes", ())))
+
+
+def _axes_of(params: Mapping[str, Any]) -> Tuple[str, ...]:
+    for key in ("axes", "axis_name", "axis_index_groups_axis"):
+        if key in params and params[key] is not None:
+            v = params[key]
+            if isinstance(v, (tuple, list)):
+                return tuple(str(a) for a in v)
+            return (str(v),)
+    return ()
+
+
+def _walk_jaxpr(jaxpr, out: List[CollectiveOp]) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMITIVES:
+            out.append(CollectiveOp(name, _axes_of(eqn.params)))
+        for v in eqn.params.values():
+            _walk_param(v, out)
+
+
+def _walk_param(v: Any, out: List[CollectiveOp]) -> None:
+    # Sub-jaxprs hide under many param names (jaxpr/call_jaxpr/branches/
+    # cond_jaxpr/body_jaxpr/...); duck-type on having .eqns.
+    if hasattr(v, "eqns"):
+        _walk_jaxpr(v, out)
+    elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # ClosedJaxpr
+        _walk_jaxpr(v.jaxpr, out)
+    elif isinstance(v, (tuple, list)):
+        for item in v:
+            _walk_param(item, out)
+
+
+def extract_collectives(fn, *example_args, **example_kwargs
+                        ) -> Tuple[CollectiveOp, ...]:
+    """The ordered collective sequence of ``fn``'s jaxpr, traced
+    abstractly against the example arguments (shapes/dtypes only — no
+    compile, no dispatch, no device). Loop bodies contribute their
+    per-iteration sequence once: every device runs the same trip count in
+    SPMD, so static order equality is what rendezvous consistency needs."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args, **example_kwargs)
+    out: List[CollectiveOp] = []
+    _walk_jaxpr(closed.jaxpr, out)
+    return tuple(out)
+
+
+def check_rank_order(
+    sequences: Mapping[Any, Sequence[CollectiveOp]],
+    program: str = "program",
+) -> List[Finding]:
+    """FML301 when the per-rank collective sequences are not identical."""
+    items = list(sequences.items())
+    if len(items) < 2:
+        return []
+    ref_rank, ref = items[0]
+    findings: List[Finding] = []
+    for rank, seq in items[1:]:
+        if tuple(seq) == tuple(ref):
+            continue
+        # Locate the first divergence for the message.
+        i = 0
+        while i < min(len(ref), len(seq)) and ref[i] == seq[i]:
+            i += 1
+        a = ref[i].primitive if i < len(ref) else "<end>"
+        b = seq[i].primitive if i < len(seq) else "<end>"
+        findings.append(Finding(
+            "FML301",
+            f"{program}: rank {rank} diverges from rank {ref_rank} at "
+            f"collective #{i} ({b} vs {a}) — rendezvous mismatch deadlocks "
+            "the mesh",
+            stage=str(program),
+            fix_hint="all ranks must execute one SPMD program; remove "
+                     "rank-dependent branching around collectives",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Dispatch traces (cross-thread ordering)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DispatchEvent:
+    """One host-side dispatch of a (possibly collective) program.
+
+    ``devices`` are the device ids the program's collectives span;
+    ``locks`` are the tokens of the tracked locks the dispatching thread
+    held (see ``parallel.dispatch.local_execution_lock``).
+    """
+
+    thread: str
+    program: str
+    devices: Tuple[int, ...] = ()
+    collectives: Tuple[CollectiveOp, ...] = ()
+    locks: Tuple[str, ...] = ()
+
+    def to_map(self) -> dict:
+        return {
+            "thread": self.thread,
+            "program": self.program,
+            "devices": list(self.devices),
+            "collectives": [c.to_map() for c in self.collectives],
+            "locks": list(self.locks),
+        }
+
+    @staticmethod
+    def from_map(m: Mapping) -> "DispatchEvent":
+        return DispatchEvent(
+            thread=str(m["thread"]),
+            program=str(m.get("program", "?")),
+            devices=tuple(int(d) for d in m.get("devices", ())),
+            collectives=tuple(
+                CollectiveOp.from_map(c) for c in m.get("collectives", ())
+            ),
+            locks=tuple(str(t) for t in m.get("locks", ())),
+        )
+
+
+def load_trace(path: str) -> List[DispatchEvent]:
+    """Load a recorded dispatch trace (JSON list of event maps)."""
+    with open(path, "r") as fh:
+        data = json.load(fh)
+    events = data["events"] if isinstance(data, Mapping) else data
+    return [DispatchEvent.from_map(m) for m in events]
+
+
+def check_dispatch_trace(events: Iterable[DispatchEvent],
+                         location: Optional[str] = None) -> List[Finding]:
+    """FML302 for every pair of threads that dispatched multi-device
+    collective programs over intersecting device sets without a common
+    lock token. One finding per (thread pair, program pair) shape, not
+    per event occurrence."""
+    multi = [e for e in events if len(e.devices) > 1]
+    findings: List[Finding] = []
+    reported = set()
+    for i, a in enumerate(multi):
+        for b in multi[i + 1:]:
+            if a.thread == b.thread:
+                continue
+            if not (set(a.devices) & set(b.devices)):
+                continue
+            if set(a.locks) & set(b.locks):
+                continue
+            key = frozenset(((a.thread, a.program), (b.thread, b.program)))
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                "FML302",
+                f"threads {a.thread!r} and {b.thread!r} dispatch collective "
+                f"programs ({a.program!r}, {b.program!r}) over shared "
+                "devices with no common lock — per-device collective "
+                "enqueue order may interleave and deadlock the rendezvous",
+                stage=f"{a.program} / {b.program}", location=location,
+                fix_hint="hold parallel.dispatch.local_execution_lock(mesh) "
+                         "around every host-driven loop that dispatches "
+                         "multi-device collective programs",
+            ))
+    return findings

@@ -1,0 +1,128 @@
+"""Structured findings — the common currency of every analysis pass.
+
+Each pass (graph validator, collective-order checker, transfer/retrace
+guard) reports :class:`Finding`s: a stable rule id from :data:`RULES`, a
+severity, the stage/column the finding anchors to, and a fix hint. A
+:class:`Report` aggregates findings, applies suppressions, and renders
+them for humans (CLI) or machines (``--json``).
+
+Rule ids are permanent: a released id is never reused for a different
+check, so suppression lists stay meaningful across versions. Add new
+rules at the end of their band (1xx schema, 2xx graph wiring, 3xx
+collectives, 4xx transfer/retrace).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, List, Optional, Sequence
+
+ERROR = "error"
+WARNING = "warning"
+
+#: Rule catalog: id -> (default severity, one-line description).
+RULES = {
+    # -- 1xx: schema / pipeline validation ---------------------------------
+    "FML101": (ERROR, "stage reads a column absent from its input schema"),
+    "FML102": (WARNING, "stage output column silently overwrites an existing column"),
+    "FML103": (ERROR, "stage kernel fails abstract evaluation (shape/dtype mismatch)"),
+    "FML104": (WARNING, "non-fusable stage breaks a kernel chain into separate programs"),
+    "FML105": (ERROR, "transform_kernel fingerprint is not stable across calls"),
+    "FML106": (WARNING, "silent dtype promotion: output column is wider than every input"),
+    "FML107": (ERROR, "stage consumes a column that only a later stage produces"),
+    # -- 2xx: graph wiring -------------------------------------------------
+    "FML201": (ERROR, "graph node input TableId is never produced (cycle or missing input)"),
+    "FML202": (ERROR, "graph output TableId is never produced by any node"),
+    "FML203": (ERROR, "two graph nodes claim the same output TableId"),
+    # -- 3xx: collectives --------------------------------------------------
+    "FML301": (ERROR, "cross-rank collective sequences diverge (rendezvous mismatch)"),
+    "FML302": (ERROR, "concurrent multi-device collective dispatch without a common lock"),
+    # -- 4xx: transfer / retrace guard -------------------------------------
+    "FML401": (ERROR, "host<->device transfer beyond the declared budget in a guarded region"),
+    "FML402": (ERROR, "compile-cache miss beyond the declared bucket policy in a guarded region"),
+    "FML403": (ERROR, "two compiles share input specs and bucket but differ in chain fingerprint"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer result, anchored to a rule and (optionally) a stage."""
+
+    rule: str
+    message: str
+    stage: Optional[str] = None
+    column: Optional[str] = None
+    fix_hint: Optional[str] = None
+    location: Optional[str] = None
+
+    @property
+    def severity(self) -> str:
+        return RULES.get(self.rule, (ERROR, ""))[0]
+
+    def render(self) -> str:
+        where = " @ ".join(p for p in (self.location, self.stage) if p)
+        head = f"{self.rule} [{self.severity}]"
+        if where:
+            head += f" {where}"
+        if self.column:
+            head += f" (column {self.column!r})"
+        out = f"{head}: {self.message}"
+        if self.fix_hint:
+            out += f"\n    fix: {self.fix_hint}"
+        return out
+
+    def to_map(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "stage": self.stage,
+            "column": self.column,
+            "fixHint": self.fix_hint,
+            "location": self.location,
+        }
+
+
+class Report:
+    """An ordered collection of findings with suppression filtering."""
+
+    def __init__(self, findings: Iterable[Finding] = ()):
+        self.findings: List[Finding] = list(findings)
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings: Iterable[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def suppress(self, rules: Sequence[str]) -> "Report":
+        dropped = set(rules)
+        return Report(f for f in self.findings if f.rule not in dropped)
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == WARNING]
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __bool__(self) -> bool:
+        return bool(self.findings)
+
+    def render(self) -> str:
+        if not self.findings:
+            return "no findings"
+        lines = [f.render() for f in self.findings]
+        lines.append(
+            f"{len(self.errors())} error(s), {len(self.warnings())} warning(s)"
+        )
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps([f.to_map() for f in self.findings], indent=2)

@@ -1,0 +1,458 @@
+"""Pass 1 — ahead-of-time pipeline/graph validation.
+
+Validates :class:`~flinkml_tpu.pipeline.Pipeline` /
+:class:`~flinkml_tpu.pipeline.PipelineModel` stage chains and
+:class:`~flinkml_tpu.graph.Graph` DAGs **before** any device dispatch:
+
+  - schema flow: every column a stage reads must exist in its input
+    schema (FML101), reads of columns only a later stage produces are
+    ordering errors (FML107), and outputs that overwrite existing
+    columns are flagged (FML102);
+  - kernel abstract evaluation: kernel-capable stages are traced with
+    ``jax.eval_shape`` over :class:`ColumnSpec`s — shape/dtype
+    mismatches between stages surface as FML103 without touching a
+    device, and the resulting output specs feed the next stage's check;
+  - fusion topology: a non-kernel stage sandwiched between kernel-capable
+    neighbours splits one fused program into two (FML104);
+  - kernel contract: ``transform_kernel`` must return a stable, hashable
+    fingerprint across calls (FML105 — an unstable fingerprint defeats
+    the fused compile cache, retracing on every transform);
+  - dtype hygiene: an output column wider than every input it was
+    computed from is a silent float64 promotion (FML106).
+
+Everything here is abstract — ``jax.eval_shape`` never allocates a
+buffer, so validation runs identically under ``JAX_PLATFORMS=cpu`` on a
+machine with no accelerator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flinkml_tpu.analysis.findings import Finding, Report
+
+#: Abstract-eval row count. Any value works (shapes are row-polymorphic in
+#: the validator's eyes); 8 matches the executor's MIN_ROW_BUCKET.
+EVAL_ROWS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnSpec:
+    """Abstract column type: dtype + trailing (per-row) shape.
+
+    ``dtype None`` means unknown — produced by stages the validator cannot
+    abstract-evaluate; checks that need the spec are skipped rather than
+    guessed.
+    """
+
+    dtype: Optional[np.dtype] = None
+    tail: Optional[Tuple[int, ...]] = None
+
+    @property
+    def known(self) -> bool:
+        # Object (ragged/row-wise Vector) columns have a dtype but no
+        # abstract-evaluable type: the runtime fuser skips them per-table
+        # (``_dense_in_table``), so the validator must not feed them to
+        # jax.eval_shape either.
+        return (self.dtype is not None and self.tail is not None
+                and self.dtype.kind != "O")
+
+
+UNKNOWN = ColumnSpec()
+
+#: TableSchema: column name -> ColumnSpec.
+TableSchema = Dict[str, ColumnSpec]
+
+
+def schema_of(table) -> TableSchema:
+    """The :class:`ColumnSpec` schema of a live Table (device columns
+    included — no materialization happens)."""
+    out: TableSchema = {}
+    for name in table.column_names:
+        col = table._raw_column(name)
+        out[name] = ColumnSpec(np.dtype(col.dtype), tuple(col.shape[1:]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stage I/O introspection (param-based; works on any WithParams stage)
+# ---------------------------------------------------------------------------
+
+_INPUT_COL_PARAMS = {"inputCol", "featuresCol", "labelCol", "weightCol"}
+_INPUT_COLS_PARAMS = {"inputCols"}
+_OUTPUT_COL_PARAMS = {"outputCol", "predictionCol", "rawPredictionCol"}
+_OUTPUT_COLS_PARAMS = {"outputCols"}
+
+
+@dataclasses.dataclass(frozen=True)
+class StageIO:
+    """Columns a stage reads/writes, derived from its Has*Col params.
+
+    ``opaque``: the stage declares no recognized column params — its
+    reads/writes are unknowable, so schema tracking goes open after it.
+    ``resets``: the stage replaces the table wholesale (evaluators emit a
+    metrics table) — downstream schema is unknown.
+    """
+
+    inputs: Tuple[str, ...]
+    outputs: Tuple[str, ...]
+    opaque: bool = False
+    resets: bool = False
+
+
+def stage_io(stage) -> StageIO:
+    """Derive :class:`StageIO` from a stage's params.
+
+    Evaluator-family stages (class name contains ``Evaluator``) consume
+    their prediction/rawPrediction columns rather than producing them, and
+    replace the table with a metrics table.
+    """
+    is_eval = "Evaluator" in type(stage).__name__
+    inputs: List[str] = []
+    outputs: List[str] = []
+    recognized = False
+    try:
+        params = type(stage).params()
+    except Exception:
+        return StageIO((), (), opaque=True)
+    for p in params:
+        name = getattr(p, "name", None)
+        try:
+            v = stage.get(p)
+        except Exception:
+            continue
+        if v is None:
+            continue
+        if name in _INPUT_COL_PARAMS:
+            inputs.append(v)
+            recognized = True
+        elif name in _INPUT_COLS_PARAMS:
+            inputs.extend(v)
+            recognized = True
+        elif name in _OUTPUT_COL_PARAMS or name in _OUTPUT_COLS_PARAMS:
+            vals = list(v) if name in _OUTPUT_COLS_PARAMS else [v]
+            (inputs if is_eval else outputs).extend(vals)
+            recognized = True
+    return StageIO(
+        tuple(dict.fromkeys(inputs)),
+        tuple(dict.fromkeys(outputs)),
+        opaque=not recognized,
+        resets=is_eval,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel abstract evaluation
+# ---------------------------------------------------------------------------
+
+def kernel_output_specs(kernel, schema: TableSchema,
+                        rows: int = EVAL_ROWS) -> TableSchema:
+    """Abstract-evaluate a :class:`ColumnKernel` over ``schema`` via
+    ``jax.eval_shape`` (no device, no compile) in the fused executor's
+    trace context (x64 enabled, float32 validity mask). Raises whatever
+    the kernel's math raises on incompatible shapes/dtypes."""
+    import jax
+
+    cols = {}
+    for c in kernel.input_cols:
+        spec = schema[c]
+        cols[c] = jax.ShapeDtypeStruct((rows,) + spec.tail, spec.dtype)
+    consts = {
+        k: jax.ShapeDtypeStruct(np.asarray(v).shape, np.asarray(v).dtype)
+        for k, v in kernel.constants.items()
+    }
+    valid = jax.ShapeDtypeStruct((rows,), np.float32)
+    with jax.experimental.enable_x64(True):
+        out = jax.eval_shape(kernel.fn, cols, consts, valid)
+    return {
+        name: ColumnSpec(np.dtype(s.dtype), tuple(s.shape[1:]))
+        for name, s in out.items()
+    }
+
+
+def _stable_kernel(stage):
+    """Fetch a stage's kernel twice; returns ``(kernel, finding_or_None)``
+    covering the FML105 contract (equal, hashable fingerprints)."""
+    label = type(stage).__name__
+    try:
+        k1 = stage.transform_kernel()
+        k2 = stage.transform_kernel()
+    except Exception as e:  # a raising gate is itself a contract breach
+        return None, Finding(
+            "FML105", f"transform_kernel raised: {e!r}", stage=label,
+            fix_hint="gate unfusable configurations by returning None, "
+                     "not by raising",
+        )
+    if k1 is None:
+        return None, None
+    try:
+        hash(k1.fingerprint)
+    except TypeError:
+        return k1, Finding(
+            "FML105",
+            f"kernel fingerprint {k1.fingerprint!r} is unhashable",
+            stage=label,
+            fix_hint="fingerprints must be hashable tuples of static "
+                     "config (they key the fused compile cache)",
+        )
+    if k2 is not None and k1.fingerprint != k2.fingerprint:
+        return k1, Finding(
+            "FML105",
+            "fingerprint differs between two transform_kernel() calls "
+            f"({k1.fingerprint!r} != {k2.fingerprint!r})",
+            stage=label,
+            fix_hint="derive the fingerprint from stage config only — an "
+                     "unstable fingerprint retraces the fused program on "
+                     "every transform",
+        )
+    return k1, None
+
+
+_WIDE_FLOATS = (np.dtype(np.float64),)
+
+
+def _promotion_findings(stage_label, in_specs, out_specs) -> List[Finding]:
+    """FML106: every known input is a narrow float but an output came back
+    float64 — the widening happened inside the stage, silently."""
+    known_in = [s.dtype for s in in_specs if s.known]
+    if not known_in or any(d.kind != "f" or d.itemsize >= 8 for d in known_in):
+        return []
+    out: List[Finding] = []
+    for name, spec in out_specs.items():
+        if spec.known and spec.dtype in _WIDE_FLOATS:
+            out.append(Finding(
+                "FML106",
+                f"inputs are {', '.join(str(d) for d in known_in)} but "
+                f"output {name!r} is float64 (silent promotion)",
+                stage=stage_label, column=name,
+                fix_hint="cast explicitly or preserve the input dtype; "
+                         "float64 on the CPU fallback path doubles "
+                         "bandwidth and memory",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pipeline chain validation
+# ---------------------------------------------------------------------------
+
+def analyze_pipeline(pipeline, schema: Optional[TableSchema] = None,
+                     location: Optional[str] = None) -> Report:
+    """Validate a Pipeline / PipelineModel / stage sequence against an
+    input :data:`TableSchema` (``schema_of(table)``), or against an *open*
+    schema (``None`` — any column may pre-exist; only ordering and
+    collision checks apply)."""
+    from flinkml_tpu.api import AlgoOperator
+
+    stages = list(getattr(pipeline, "stages", pipeline))
+    report = Report()
+    closed = schema is not None
+    current: TableSchema = dict(schema) if schema else {}
+    external: set = set(current)
+    produced_at: Dict[str, int] = {}
+    pending_reads: List[Tuple[int, str, str]] = []  # (stage idx, label, col)
+    kernel_capable: List[bool] = []
+
+    for i, stage in enumerate(stages):
+        label = f"[{i}] {type(stage).__name__}"
+        kernel = None
+        if isinstance(stage, AlgoOperator):
+            kernel, f = _stable_kernel(stage)
+            if f is not None:
+                report.add(dataclasses.replace(f, stage=label,
+                                               location=location))
+        kernel_capable.append(kernel is not None)
+
+        io = None
+        if kernel is not None:
+            reads, writes = kernel.input_cols, kernel.output_cols
+        else:
+            io = stage_io(stage)
+            reads, writes = io.inputs, io.outputs
+
+        # -- reads ---------------------------------------------------------
+        for c in reads:
+            if c in current:
+                continue
+            if closed:
+                report.add(Finding(
+                    "FML101",
+                    f"reads column {c!r} which is not in the schema "
+                    f"(available: {sorted(current)})",
+                    stage=label, column=c, location=location,
+                    fix_hint="rename the column param or add an upstream "
+                             "stage producing it",
+                ))
+            else:
+                # Open schema: assume external unless a later stage turns
+                # out to be the producer (FML107, resolved after the walk).
+                pending_reads.append((i, label, c))
+                external.add(c)
+                current[c] = UNKNOWN
+
+        # -- writes / collisions -------------------------------------------
+        for c in writes:
+            if c in current:
+                if c in reads:
+                    msg = f"overwrites its own input column {c!r} in place"
+                    hint = ("in-place overwrite loses the pre-stage values "
+                            "for every later stage; use a distinct output "
+                            "column name")
+                elif c in external:
+                    msg = (f"output column {c!r} silently overwrites a "
+                           "source-data column")
+                    hint = "pick an output column name absent from the input"
+                else:
+                    prev = produced_at.get(c)
+                    msg = (f"output column {c!r} collides with the output "
+                           f"of stage {prev}" if prev is not None else
+                           f"output column {c!r} overwrites an existing column")
+                    hint = "give each stage a distinct output column name"
+                report.add(Finding("FML102", msg, stage=label, column=c,
+                                   location=location, fix_hint=hint))
+            produced_at[c] = i
+
+        # -- abstract evaluation / schema update ---------------------------
+        in_specs = [current.get(c, UNKNOWN) for c in reads]
+        if kernel is not None and all(s.known for s in in_specs):
+            try:
+                out_specs = kernel_output_specs(kernel, current)
+            except Exception as e:
+                report.add(Finding(
+                    "FML103",
+                    f"kernel abstract evaluation failed: {e}",
+                    stage=label, location=location,
+                    fix_hint="the stage's kernel cannot consume the "
+                             "upstream schema — fix the column shapes/"
+                             "dtypes or the stage wiring",
+                ))
+                out_specs = {c: UNKNOWN for c in writes}
+            else:
+                for f in _promotion_findings(label, in_specs, out_specs):
+                    report.add(dataclasses.replace(f, location=location))
+            current.update(out_specs)
+        else:
+            if kernel is None:
+                # A kernel-capable stage's writes are exact (from the
+                # kernel) even when specs are unknown; only kernel-less
+                # stages can reset or open the schema.
+                if io.resets:
+                    # Evaluator: the output table is a fresh metrics table.
+                    current = {}
+                    external = set()
+                    closed = False
+                elif io.opaque:
+                    # Unknown stage: it may add/drop anything.
+                    closed = False
+            for c in writes:
+                current[c] = UNKNOWN
+
+    # FML107: open-schema reads whose producer turned out to be later.
+    for idx, label, c in pending_reads:
+        j = produced_at.get(c)
+        if j is not None and j > idx:
+            report.add(Finding(
+                "FML107",
+                f"reads column {c!r} which only stage {j} produces "
+                "(stage ordering error)",
+                stage=label, column=c, location=location,
+                fix_hint="reorder the stages so producers precede consumers",
+            ))
+
+    # FML104: a non-kernel stage strictly between kernel-capable stages.
+    stages_list = list(stages)
+    for i in range(1, len(kernel_capable) - 1):
+        if (not kernel_capable[i]) and kernel_capable[i - 1] \
+                and kernel_capable[i + 1]:
+            report.add(Finding(
+                "FML104",
+                "non-fusable stage splits a kernel chain into two fused "
+                "programs (extra dispatch + device round-trip)",
+                stage=f"[{i}] {type(stages_list[i]).__name__}",
+                location=location,
+                fix_hint="implement transform_kernel for this stage or "
+                         "move it to the edge of the chain",
+            ))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Graph wiring validation
+# ---------------------------------------------------------------------------
+
+def analyze_graph(graph, location: Optional[str] = None) -> Report:
+    """Static executability of a Graph / GraphModel DAG: every node's
+    inputs must be producible (FML201), graph outputs must be produced
+    (FML202), and no two nodes may claim one output id (FML203) — the
+    checks ``_execute_nodes`` performs at runtime, moved to build time."""
+    report = Report()
+    nodes = list(graph._nodes)
+
+    if hasattr(graph, "_estimator_input_ids"):  # Graph (estimator)
+        given = set(t.id for t in graph._estimator_input_ids)
+        given |= set(t.id for t in graph._model_input_ids)
+    else:  # GraphModel
+        given = set(t.id for t in graph._input_ids)
+    if getattr(graph, "_input_model_data_ids", None):
+        given |= set(t.id for t in graph._input_model_data_ids)
+
+    claimed: Dict[int, int] = {}
+    for node in nodes:
+        out_ids = [t.id for t in node.output_ids]
+        if node.output_model_data_ids:
+            out_ids += [t.id for t in node.output_model_data_ids]
+        for tid in out_ids:
+            if tid in claimed and claimed[tid] != node.node_id:
+                report.add(Finding(
+                    "FML203",
+                    f"TableId({tid}) is claimed by nodes "
+                    f"{claimed[tid]} and {node.node_id}",
+                    stage=f"node {node.node_id}", location=location,
+                    fix_hint="every output TableId must have exactly one "
+                             "producing node",
+                ))
+            claimed.setdefault(tid, node.node_id)
+
+    # Fixed-point readiness — the static analog of runtime execution.
+    available = set(given)
+    pending = list(nodes)
+    progress = True
+    while progress:
+        progress = False
+        for node in list(pending):
+            if all(t.id in available for t in node.all_input_ids()):
+                pending.remove(node)
+                available.update(t.id for t in node.output_ids)
+                if node.output_model_data_ids:
+                    available.update(
+                        t.id for t in node.output_model_data_ids
+                    )
+                progress = True
+    for node in pending:
+        missing = [t.id for t in node.all_input_ids()
+                   if t.id not in available]
+        report.add(Finding(
+            "FML201",
+            f"node {node.node_id} "
+            f"({type(node.stage).__name__ if node.stage else '?'}) waits "
+            f"on TableId(s) {missing} which no node produces "
+            "(cycle or missing input table)",
+            stage=f"node {node.node_id}", location=location,
+            fix_hint="wire the missing TableIds to a producing stage or "
+                     "to the graph inputs",
+        ))
+
+    out_ids = getattr(graph, "_output_ids", [])
+    for t in out_ids:
+        if t.id not in available:
+            report.add(Finding(
+                "FML202",
+                f"graph output TableId({t.id}) is never produced",
+                location=location,
+                fix_hint="graph outputs must be outputs of some node (or "
+                         "graph inputs)",
+            ))
+    return report

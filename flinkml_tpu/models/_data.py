@@ -16,18 +16,27 @@ from flinkml_tpu.linalg import SparseVector, Vector, stack_vectors
 from flinkml_tpu.table import Table
 
 
-def features_matrix(table: Table, features_col: str) -> np.ndarray:
+def features_matrix(
+    table: Table, features_col: str, dtype=np.float64
+) -> np.ndarray:
     """Densify a features column to float [n, d].
 
     Accepts 2-D numeric columns (native layout) or object columns of
     ``Vector`` / array-likes (row-wise user data).
+
+    ``dtype=None`` preserves a floating input dtype (float32 stays
+    float32 — elementwise stages then move half the bytes on the CPU
+    fallback path; flagged as FML106 by ``flinkml_tpu.analysis`` when
+    promoted silently) and promotes non-float inputs to float64.
     """
     col = table.column(features_col)
     if col.dtype == object:
         return stack_vectors(col)
+    if dtype is None:
+        dtype = col.dtype if col.dtype.kind == "f" else np.float64
     if col.ndim == 1:
-        return col.astype(np.float64).reshape(-1, 1)
-    return np.ascontiguousarray(col, dtype=np.float64)
+        return col.astype(dtype).reshape(-1, 1)
+    return np.ascontiguousarray(col, dtype=dtype)
 
 
 def labeled_data(

@@ -574,14 +574,22 @@ def train_kmeans_stream(
             # reference's shuffled selection (KMeans.java:314-335).
             centroids = sample[rng.permutation(sample.shape[0])[:k]]
 
+    from flinkml_tpu.parallel import dispatch as _dispatch
     from flinkml_tpu.parallel.dispatch import DispatchGuard, local_execution_lock
 
     guard = DispatchGuard()  # multi-process backpressure (no-op single)
     cent_dev = jnp.asarray(centroids)
-    # Serialize vs. concurrent fits from other host threads: interleaved
-    # multi-device collective dispatch deadlocks (see local_execution_lock).
-    with local_execution_lock():
+    mesh_device_ids = tuple(d.id for d in mesh.mesh.devices.flatten())
+    # Serialize vs. concurrent fits from other host threads over this
+    # mesh's devices: interleaved multi-device collective dispatch
+    # deadlocks (see local_execution_lock; the analyzer's FML302 check
+    # verifies this exact program shape via the dispatch trace below).
+    with local_execution_lock(mesh):
         for epoch in range(start_epoch, max_iter):
+            if _dispatch.has_dispatch_observers():
+                _dispatch.record_collective_dispatch(
+                    "kmeans.lloyd_epoch", mesh_device_ids
+                )
             sums = None
             counts = None
             if multi:

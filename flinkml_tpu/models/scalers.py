@@ -38,11 +38,18 @@ class _HasInputOutputCol(HasInputCol, HasOutputCol):
 def _scaler_kernel(model, name, consts, apply, extra_static=()):
     """Shared :class:`ColumnKernel` scaffold for the four scaler models.
 
-    ``apply(x, consts)`` is the stage's elementwise math on a float64
+    ``apply(x, consts)`` is the stage's elementwise math on a float
     ``[n, d]`` block — the same op sequence as the host transform, so the
-    fused output is bit-identical (float64 elementwise ops are exactly
+    fused output is bit-identical (float elementwise ops are exactly
     rounded in both numpy and XLA). The fitted statistics travel as traced
     constants; only the flag configuration is baked into the fingerprint.
+
+    Dtype contract (matches :func:`_scaler_compute_dtype` on the host
+    path): floating inputs keep their dtype — the fitted float64
+    statistics are cast down to the input dtype, NOT the input up —
+    and non-float inputs promote to float64. A float32 pipeline stays
+    float32 end to end instead of silently doubling its bandwidth
+    (analysis rule FML106).
     """
     in_col = model.get(model.INPUT_COL)
     out_col = model.get(model.OUTPUT_COL)
@@ -51,7 +58,9 @@ def _scaler_kernel(model, name, consts, apply, extra_static=()):
         x = cols[in_col]
         if x.ndim == 1:
             x = x.reshape(-1, 1)
-        return {out_col: apply(x.astype(jnp.float64), c)}
+        dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float64
+        c = {k: v.astype(dt) for k, v in c.items()}
+        return {out_col: apply(x.astype(dt), c)}
 
     return ColumnKernel(
         input_cols=(in_col,),
@@ -182,13 +191,19 @@ class StandardScalerModel(_HasInputOutputCol, Model):
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require()
-        x = features_matrix(table, self.get(self.INPUT_COL))
+        # dtype=None + casting the statistics DOWN: a float32 column stays
+        # float32 (same op order as the fused kernel, so fused == host
+        # bitwise at every float width).
+        x = features_matrix(table, self.get(self.INPUT_COL), dtype=None)
         out = x
         if self.get(self.WITH_MEAN):
-            out = out - self._mean
+            out = out - self._mean.astype(x.dtype, copy=False)
         if self.get(self.WITH_STD):
-            safe = np.where(self._std > 0, self._std, 1.0)
-            out = out / safe
+            # Guard AFTER the downcast: a float64 std that underflows to
+            # 0.0 in float32 must hit the constant-feature branch, not
+            # divide by zero.
+            std = self._std.astype(x.dtype, copy=False)
+            out = out / np.where(std > 0, std, 1.0)
         return (table.with_column(self.get(self.OUTPUT_COL), out),)
 
     def transform_kernel(self):
@@ -202,12 +217,14 @@ class StandardScalerModel(_HasInputOutputCol, Model):
             if with_mean:
                 out = out - c["mean"]
             if with_std:
-                out = out / c["safe"]
+                # Same order as the host path: the constants arrive cast
+                # to the compute dtype, THEN the zero guard applies.
+                out = out / jnp.where(c["std"] > 0, c["std"], 1.0)
             return out
 
         return _scaler_kernel(
             self, "StandardScalerModel",
-            {"mean": self._mean, "safe": np.where(self._std > 0, self._std, 1.0)},
+            {"mean": self._mean, "std": self._std},
             apply, (with_mean, with_std),
         )
 
@@ -283,12 +300,13 @@ class MinMaxScalerModel(_HasInputOutputCol, Model):
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require()
-        x = features_matrix(table, self.get(self.INPUT_COL))
-        span = self._data_max - self._data_min
+        x = features_matrix(table, self.get(self.INPUT_COL), dtype=None)
+        dmin = self._data_min.astype(x.dtype, copy=False)
+        span = self._data_max.astype(x.dtype, copy=False) - dmin
         # Constant features map to the middle of the output range (the
         # Flink ML / sklearn convention of avoiding division by zero).
         safe = np.where(span > 0, span, 1.0)
-        unit = np.where(span > 0, (x - self._data_min) / safe, 0.5)
+        unit = np.where(span > 0, (x - dmin) / safe, 0.5)
         lo, hi = self.get(self.MIN), self.get(self.MAX)
         return (
             table.with_column(self.get(self.OUTPUT_COL), unit * (hi - lo) + lo),
@@ -372,17 +390,22 @@ class MaxAbsScalerModel(_HasInputOutputCol, Model):
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require()
-        x = features_matrix(table, self.get(self.INPUT_COL))
-        safe = np.where(self._max_abs > 0, self._max_abs, 1.0)
-        return (table.with_column(self.get(self.OUTPUT_COL), x / safe),)
+        x = features_matrix(table, self.get(self.INPUT_COL), dtype=None)
+        # Guard after the downcast (see StandardScalerModel.transform).
+        ma = self._max_abs.astype(x.dtype, copy=False)
+        return (
+            table.with_column(
+                self.get(self.OUTPUT_COL), x / np.where(ma > 0, ma, 1.0)
+            ),
+        )
 
     def transform_kernel(self):
         if self._max_abs is None:
             return None
         return _scaler_kernel(
             self, "MaxAbsScalerModel",
-            {"safe": np.where(self._max_abs > 0, self._max_abs, 1.0)},
-            lambda x, c: x / c["safe"],
+            {"maxAbs": self._max_abs},
+            lambda x, c: x / jnp.where(c["maxAbs"] > 0, c["maxAbs"], 1.0),
         )
 
     def save(self, path: str) -> None:
@@ -468,13 +491,14 @@ class RobustScalerModel(_HasInputOutputCol, Model):
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
         self._require()
-        x = features_matrix(table, self.get(self.INPUT_COL))
+        x = features_matrix(table, self.get(self.INPUT_COL), dtype=None)
         out = x
         if self.get(self.WITH_CENTERING):
-            out = out - self._median
+            out = out - self._median.astype(x.dtype, copy=False)
         if self.get(self.WITH_SCALING):
-            safe = np.where(self._range > 0, self._range, 1.0)
-            out = out / safe
+            # Guard after the downcast (see StandardScalerModel.transform).
+            rng = self._range.astype(x.dtype, copy=False)
+            out = out / np.where(rng > 0, rng, 1.0)
         return (table.with_column(self.get(self.OUTPUT_COL), out),)
 
     def transform_kernel(self):
@@ -488,13 +512,12 @@ class RobustScalerModel(_HasInputOutputCol, Model):
             if centering:
                 out = out - c["median"]
             if scaling:
-                out = out / c["safe"]
+                out = out / jnp.where(c["range"] > 0, c["range"], 1.0)
             return out
 
         return _scaler_kernel(
             self, "RobustScalerModel",
-            {"median": self._median,
-             "safe": np.where(self._range > 0, self._range, 1.0)},
+            {"median": self._median, "range": self._range},
             apply, (centering, scaling),
         )
 

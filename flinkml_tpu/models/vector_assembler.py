@@ -36,13 +36,24 @@ class VectorAssembler(HasInputCols, HasHandleInvalid, AlgoOperator):
         def fn(colvals, consts, valid):
             import jax.numpy as jnp
 
+            # Floating parts keep their dtype; non-float parts promote to
+            # float64. Concatenation promotes to the widest part — the
+            # same result_type rule as the host path, so an all-float32
+            # assembly stays float32 (analysis rule FML106).
             parts = []
             for c in cols:
                 p = colvals[c]
                 if p.ndim == 1:
                     p = p.reshape(-1, 1)
-                parts.append(p.astype(jnp.float64))
-            return {out_col: jnp.concatenate(parts, axis=1)}
+                if not jnp.issubdtype(p.dtype, jnp.floating):
+                    p = p.astype(jnp.float64)
+                parts.append(p)
+            dt = jnp.result_type(*(p.dtype for p in parts))
+            return {
+                out_col: jnp.concatenate(
+                    [p.astype(dt) for p in parts], axis=1
+                )
+            }
 
         return ColumnKernel(
             input_cols=cols, output_cols=(out_col,), fn=fn,
@@ -54,14 +65,20 @@ class VectorAssembler(HasInputCols, HasHandleInvalid, AlgoOperator):
         cols = self.get(self.INPUT_COLS)
         if not cols:
             raise ValueError("inputCols must be set")
-        parts: List[np.ndarray] = [features_matrix(table, c) for c in cols]
+        # dtype=None: floating columns keep their dtype, non-float promote
+        # to float64; concatenation promotes to the widest part (matches
+        # the fused kernel, so an all-float32 assembly stays float32).
+        parts: List[np.ndarray] = [
+            features_matrix(table, c, dtype=None) for c in cols
+        ]
         n = parts[0].shape[0]
         for c, p in zip(cols, parts):
             if p.shape[0] != n:
                 raise ValueError(
                     f"column {c!r} has {p.shape[0]} rows, expected {n}"
                 )
-        out = np.concatenate(parts, axis=1)
+        dt = np.result_type(*(p.dtype for p in parts))
+        out = np.concatenate([p.astype(dt, copy=False) for p in parts], axis=1)
         mode = self.get(self.HANDLE_INVALID)
         bad = ~np.isfinite(out).all(axis=1)
         if mode == "error":

@@ -29,26 +29,221 @@ import jax
 _ENV_INTERVAL = "FLINKML_SYNC_INTERVAL"
 _DEFAULT_MULTIPROCESS_INTERVAL = 8
 
-#: Process-wide mutex for whole training loops launched from concurrent
-#: host THREADS over this process's devices. Two multi-device SPMD
-#: programs dispatched concurrently from different threads interleave
-#: their per-device collective enqueues in different orders on different
-#: devices — on the CPU backend that deadlocks the collective rendezvous
-#: outright (observed: two threaded `train_kmeans_stream` calls over an
-#: 8-virtual-device mesh wedge with every thread asleep); on real fabrics
-#: it is undefined dispatch-order territory. Concurrent fits time-share
-#: the mesh by serializing here: correctness over parallelism (the
-#: devices are one shared resource either way). Reentrant so nested
-#: training loops (e.g. a fit inside a tuning fold) self-compose.
-_LOCAL_EXECUTION_LOCK = threading.RLock()
+# -- collective-dispatch locking -------------------------------------------
+#
+# Mutexes for whole training loops launched from concurrent host THREADS
+# over this process's devices. Two multi-device SPMD programs dispatched
+# concurrently from different threads interleave their per-device
+# collective enqueues in different orders on different devices — on the
+# CPU backend that deadlocks the collective rendezvous outright (observed:
+# two threaded `train_kmeans_stream` calls over an 8-virtual-device mesh
+# wedge with every thread asleep); on real fabrics it is undefined
+# dispatch-order territory. Concurrent fits time-share a mesh by
+# serializing here: correctness over parallelism (the devices are one
+# shared resource either way). Reentrant so nested training loops (e.g. a
+# fit inside a tuning fold) self-compose.
+#
+# PR 1 shipped this as one process-wide lock. It is now *per device set*:
+# fits over disjoint meshes proceed concurrently, and every acquisition is
+# tracked so `flinkml_tpu.analysis.collectives.check_dispatch_trace` can
+# statically verify that no two threads dispatch collective programs over
+# shared devices without a common lock (rule FML302) — the lock is
+# analyzer-verified, not just hoped-for.
+
+_HELD_LOCKS = threading.local()  # per-thread list of held lock tokens
 
 
-def local_execution_lock() -> threading.RLock:
-    """The process-wide collective-dispatch mutex (see above). Hold it
-    (``with local_execution_lock():``) around any host-driven loop that
-    dispatches multi-device collective programs and may legally be called
-    from concurrent threads."""
-    return _LOCAL_EXECUTION_LOCK
+def _held_list():
+    lst = getattr(_HELD_LOCKS, "tokens", None)
+    if lst is None:
+        lst = _HELD_LOCKS.tokens = []
+    return lst
+
+
+class TrackedRLock:
+    """An RLock that records, per thread, that it is held — so dispatch
+    trace events can carry the lock tokens the dispatching thread holds."""
+
+    def __init__(self, token: str):
+        self.token = token
+        self._lock = threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _held_list().append(self.token)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        held = _held_list()
+        # Remove ONE entry (reentrant acquisitions push one token each).
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self.token:
+                del held[i]
+                break
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def held_lock_tokens() -> tuple:
+    """Tokens of every tracked lock the calling thread currently holds."""
+    return tuple(dict.fromkeys(_held_list()))
+
+
+class _CompositeLock:
+    """Acquires several :class:`TrackedRLock`s in canonical (token-sorted)
+    order — the mutex for a device set that overlaps other registered
+    sets. Global ordering makes nested/concurrent composites
+    deadlock-free, and sharing at least one component lock with every
+    overlapping fit gives mutual exclusion: a later-registered overlapping
+    set's composite always includes the earlier set's lock."""
+
+    def __init__(self, locks):
+        self._locks = sorted(locks, key=lambda l: l.token)
+
+    def acquire(self) -> bool:
+        for lock in self._locks:
+            lock.acquire()
+        return True
+
+    def release(self) -> None:
+        for lock in reversed(self._locks):
+            lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _GlobalLock:
+    """The ``mesh=None`` mutex: the process lock plus EVERY registered
+    mesh lock. The mesh-lock snapshot is taken *after* the process lock is
+    held — new device sets register under the process lock, so no mesh
+    lock can appear between the snapshot and the acquisition: nothing
+    slips past a global holder."""
+
+    def acquire(self) -> bool:
+        _PROCESS_LOCK.acquire()
+        with _MESH_LOCKS_GUARD:
+            held = sorted(_MESH_LOCKS.values(), key=lambda l: l.token)
+        for lock in held:
+            lock.acquire()
+        # Stack of per-acquire snapshots: reentrant acquires may see more
+        # registered locks than the outer one.
+        self._held_stack = getattr(self, "_held_stack", [])
+        self._held_stack.append(held)
+        return True
+
+    def release(self) -> None:
+        for lock in reversed(self._held_stack.pop()):
+            lock.release()
+        _PROCESS_LOCK.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+_PROCESS_LOCK = TrackedRLock("lock:process")
+_MESH_LOCKS: dict = {}  # frozenset(device ids) -> TrackedRLock
+_MESH_LOCKS_GUARD = threading.Lock()
+
+
+def local_execution_lock(mesh=None):
+    """The collective-dispatch mutex for ``mesh``'s device set (see
+    above). Hold it (``with local_execution_lock(mesh):``) around any
+    host-driven loop that dispatches multi-device collective programs and
+    may legally be called from concurrent threads.
+
+    ``mesh=None`` is globally exclusive (the conservative PR 1
+    behaviour): it acquires the process lock plus every registered mesh
+    lock, so it serializes against every mesh-keyed fit — and new mesh
+    locks cannot register while it is held (registration synchronizes on
+    the process lock), so no fit can slip past it. With a mesh,
+    identical device sets share one tracked lock, disjoint sets get
+    independent locks (concurrent fits over disjoint meshes proceed in
+    parallel), and a set that overlaps other registered sets gets a
+    composite acquiring every intersecting lock in canonical order —
+    overlapping fits always share at least one component lock, so the
+    rendezvous-interleaving hazard cannot occur (and the shared token is
+    visible to the analyzer's FML302 check).
+    """
+    if mesh is None:
+        return _GlobalLock()
+    devices = getattr(mesh, "mesh", mesh).devices
+    key = frozenset(d.id for d in devices.flatten())
+    with _MESH_LOCKS_GUARD:
+        lock = _MESH_LOCKS.get(key)
+    if lock is None:
+        # First sighting of this device set: registering under the
+        # process lock means a process-wide (mesh=None) holder — whose
+        # composite predates this lock and so cannot contain it —
+        # finishes before any fit over the new set can start. Lock order
+        # is PROCESS then GUARD everywhere, never the reverse.
+        with _PROCESS_LOCK:
+            with _MESH_LOCKS_GUARD:
+                lock = _MESH_LOCKS.get(key)
+                if lock is None:
+                    lock = _MESH_LOCKS[key] = TrackedRLock(
+                        "lock:mesh:" + ",".join(str(i) for i in sorted(key))
+                    )
+    with _MESH_LOCKS_GUARD:
+        overlapping = [
+            l for k, l in _MESH_LOCKS.items() if k != key and (k & key)
+        ]
+    if overlapping:
+        return _CompositeLock([lock] + overlapping)
+    return lock
+
+
+# -- dispatch trace observers ----------------------------------------------
+#
+# Training loops report their collective dispatches here (cheap: a list
+# check when no observer is installed). Observers receive plain event
+# dicts in the `analysis.collectives.DispatchEvent` schema, so the
+# analyzer can audit real runs and tests can assert on the program shape.
+
+_DISPATCH_OBSERVERS: list = []
+
+
+def add_dispatch_observer(callback) -> None:
+    """Register ``callback(event_dict)`` for collective dispatch events."""
+    _DISPATCH_OBSERVERS.append(callback)
+
+
+def remove_dispatch_observer(callback) -> None:
+    _DISPATCH_OBSERVERS.remove(callback)
+
+
+def has_dispatch_observers() -> bool:
+    return bool(_DISPATCH_OBSERVERS)
+
+
+def record_collective_dispatch(program: str, devices, collectives=()) -> None:
+    """Report one host-driven dispatch of a collective program. ``devices``
+    is an iterable of ``jax.Device`` or integer device ids; the event
+    carries the calling thread and the tracked locks it holds."""
+    if not _DISPATCH_OBSERVERS:
+        return
+    ids = tuple(
+        d if isinstance(d, int) else d.id for d in devices
+    )
+    t = threading.current_thread()
+    event = {
+        "thread": f"{t.name}({t.ident})",
+        "program": program,
+        "devices": ids,
+        "collectives": list(collectives),
+        "locks": held_lock_tokens(),
+    }
+    for cb in list(_DISPATCH_OBSERVERS):
+        cb(event)
 
 
 def default_sync_interval() -> int:

@@ -17,6 +17,12 @@ per-stage transforms. Runs of stages that expose a
 device-resident intermediates and a shape-bucketed compile cache — see
 :mod:`flinkml_tpu.pipeline_fusion` and ``docs/operators/pipeline_fusion.md``
 for the protocol, the bucketing policy, and how to make a stage fusable.
+
+Chains can be validated BEFORE any dispatch:
+``flinkml_tpu.analysis.analyze_pipeline(model, schema_of(table))``
+abstract-evaluates the whole chain (schema flow, kernel shape/dtype
+compatibility, fusion topology, fingerprint stability) device-free — see
+``docs/development/static_analysis.md``.
 """
 
 from __future__ import annotations

@@ -345,9 +345,15 @@ def execute_kernel_chain(table: Table, kernels: Sequence[ColumnKernel]) -> Table
             tuple(jnp.asarray(k.constants[c]) for c in sorted(k.constants))
             for k in kernels
         )
+        # weak_type is part of the spec: a python-scalar constant
+        # (float64 weak) and an array constant (float64 strong) promote
+        # DIFFERENTLY inside the program (weak * f32 -> f32, strong * f32
+        # -> f64), so two chains differing only there must not alias one
+        # cached executable.
         const_specs = tuple(
             tuple(
-                (c, str(v.dtype), tuple(v.shape))
+                (c, str(v.dtype), bool(getattr(v, "weak_type", False)),
+                 tuple(v.shape))
                 for c, v in zip(sorted(k.constants), cv)
             )
             for k, cv in zip(kernels, const_vals)
@@ -406,10 +412,21 @@ def execute_kernel_chain(table: Table, kernels: Sequence[ColumnKernel]) -> Table
         shape, dtype = specs[name]
 
         def thunk(name=name):
-            return _run_program(
-                kernels, ext, _closure_outputs(kernels, (name,)),
-                ext_specs, const_specs, ext_vals, const_vals, bucket, n,
-            )[name]
+            try:
+                return _run_program(
+                    kernels, ext, _closure_outputs(kernels, (name,)),
+                    ext_specs, const_specs, ext_vals, const_vals, bucket, n,
+                )[name]
+            except RuntimeError as e:
+                if "deleted" in str(e).lower() or "donat" in str(e).lower():
+                    raise RuntimeError(
+                        f"lazy intermediate column {name!r} cannot be "
+                        "materialized: a source device buffer was donated "
+                        "or freed before its first read. Read the column "
+                        "(table.column(name)) before donating/deleting the "
+                        "buffers the fused program captured."
+                    ) from e
+                raise
 
         result = result.with_column(
             name, LazyDeviceColumn(thunk, n, shape, dtype)

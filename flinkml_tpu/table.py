@@ -110,6 +110,16 @@ class LazyDeviceColumn(PaddedDeviceColumn):
         if self._buf is None:
             self._buf = self._thunk()
             self._thunk = None
+        elif getattr(self._buf, "is_deleted", None) is not None \
+                and self._buf.is_deleted():
+            # A materialized buffer later donated/freed must fail loudly,
+            # not hand jax's cryptic deleted-array error (or stale data)
+            # to whoever touches the column next.
+            raise RuntimeError(
+                "lazy device column buffer has been donated or freed "
+                "after materialization; re-run the producing transform "
+                "to recompute it"
+            )
         return self._buf
 
     @property

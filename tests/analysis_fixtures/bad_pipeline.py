@@ -1,0 +1,43 @@
+"""Seeded-findings fixture for the analysis CLI gate.
+
+NOT a runnable example — this file exists so
+``python -m flinkml_tpu.analysis tests/analysis_fixtures/ --fail-on-findings``
+has known-bad input to flag (the CI gate asserts a non-zero exit here and
+a zero exit on ``examples/``). Every pipeline below carries a deliberate
+defect; the expected rule is noted inline.
+"""
+
+from flinkml_tpu.models import (
+    MaxAbsScaler,
+    MinMaxScaler,
+    StandardScaler,
+    VectorAssembler,
+)
+from flinkml_tpu.pipeline import Pipeline
+
+# FML107: the first scaler reads "scaled", which only the SECOND stage
+# produces — consumers ordered before their producer.
+pipe_misordered = Pipeline([
+    MinMaxScaler().set(MinMaxScaler.INPUT_COL, "scaled")
+                  .set(MinMaxScaler.OUTPUT_COL, "unit"),
+    StandardScaler().set(StandardScaler.INPUT_COL, "features")
+                    .set(StandardScaler.OUTPUT_COL, "scaled"),
+])
+
+# FML102: the assembler emits a column named "features" — colliding with
+# the source-data column it just read (silent overwrite of user data).
+pipe_collision = Pipeline([
+    VectorAssembler().set_input_cols(["features", "extra"])
+                     .set(VectorAssembler.HANDLE_INVALID, "keep")
+                     .set(VectorAssembler.OUTPUT_COL, "features"),
+    MaxAbsScaler().set(MaxAbsScaler.INPUT_COL, "features")
+                  .set(MaxAbsScaler.OUTPUT_COL, "norm"),
+])
+
+# FML102 (in-place overwrite): output column equals the input column.
+pipe_inplace = Pipeline([
+    StandardScaler().set(StandardScaler.INPUT_COL, "x")
+                    .set(StandardScaler.OUTPUT_COL, "x"),
+    MinMaxScaler().set(MinMaxScaler.INPUT_COL, "x")
+                  .set(MinMaxScaler.OUTPUT_COL, "y"),
+])

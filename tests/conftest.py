@@ -34,6 +34,32 @@ import numpy as np
 import pytest
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """``@pytest.mark.no_retrace`` wraps the test body in the analysis
+    transfer/retrace guard: compile-cache misses beyond the bucket policy
+    (and any declared transfer budgets) fail the test. Marker kwargs pass
+    through to ``TransferRetraceGuard`` — e.g.
+    ``@pytest.mark.no_retrace(allow_compiles=1)`` to budget the warmup
+    compile inside the test itself."""
+    marker = item.get_closest_marker("no_retrace")
+    if marker is None:
+        yield
+        return
+    from flinkml_tpu.analysis.guard import TransferRetraceGuard
+
+    kwargs = dict(marker.kwargs)
+    kwargs.setdefault("location", item.nodeid)
+    guard = TransferRetraceGuard(**kwargs)
+    guard.__enter__()
+    outcome = yield
+    # Only enforce the budget when the test body itself passed (a failing
+    # test's own error is the more useful signal).
+    guard.__exit__(
+        None if outcome.excinfo is None else outcome.excinfo[0], None, None
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(2024)

@@ -1,0 +1,424 @@
+"""Pass 1 (graph validator) tests: schema flow, abstract kernel eval,
+fingerprint stability, dtype hygiene, graph wiring, and the AST lint —
+plus the regression tests for the two real findings the validator
+surfaced on the shipped stages (silent float64 promotion in the scalers
+and VectorAssembler; see FML106).
+"""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu import pipeline_fusion
+from flinkml_tpu.analysis import (
+    analyze_graph,
+    analyze_pipeline,
+    lint_paths,
+    lint_source,
+    schema_of,
+)
+from flinkml_tpu.graph import GraphBuilder
+from flinkml_tpu.models.kmeans import KMeans, KMeansModel
+from flinkml_tpu.models.logistic_regression import LogisticRegression
+from flinkml_tpu.models.one_hot_encoder import OneHotEncoder
+from flinkml_tpu.models.scalers import (
+    MaxAbsScaler,
+    MinMaxScaler,
+    RobustScaler,
+    StandardScaler,
+)
+from flinkml_tpu.models.vector_assembler import VectorAssembler
+from flinkml_tpu.pipeline import PipelineModel
+from flinkml_tpu.table import Table
+
+
+def _rules(report):
+    return [f.rule for f in report]
+
+
+def _data(n=40, d=5, seed=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(dtype)
+    y = (x @ rng.normal(size=d).astype(dtype) > 0).astype(dtype)
+    return Table({"features": x, "label": y})
+
+
+def _scaler(cls, t, in_col, out_col):
+    return cls().set(cls.INPUT_COL, in_col).set(cls.OUTPUT_COL, out_col).fit(t)
+
+
+def _fitted_chain(t):
+    stages = []
+    cur = t
+    prev = "features"
+    for i, cls in enumerate(
+        (StandardScaler, MinMaxScaler, MaxAbsScaler, RobustScaler), start=1
+    ):
+        m = _scaler(cls, cur, prev, f"s{i}")
+        (cur,) = m.transform(cur)
+        prev = f"s{i}"
+        stages.append(m)
+    lr = (
+        LogisticRegression()
+        .set(LogisticRegression.FEATURES_COL, prev)
+        .set(LogisticRegression.LABEL_COL, "label")
+        .fit(cur)
+    )
+    stages.append(lr)
+    return stages
+
+
+# ---------------------------------------------------------------------------
+# schema flow
+# ---------------------------------------------------------------------------
+
+def test_clean_chain_has_no_findings():
+    t = _data()
+    report = analyze_pipeline(PipelineModel(_fitted_chain(t)), schema_of(t))
+    assert not report.findings, report.render()
+
+
+def test_missing_input_column_fml101():
+    t = _data()
+    m = _scaler(StandardScaler, t, "features", "out")
+    m.set(StandardScaler.INPUT_COL, "nope")
+    report = analyze_pipeline(PipelineModel([m]), schema_of(t))
+    assert "FML101" in _rules(report)
+    (f,) = [f for f in report if f.rule == "FML101"]
+    assert f.column == "nope" and "features" in f.message
+
+
+def test_output_collision_fml102():
+    t = _data()
+    a = _scaler(StandardScaler, t, "features", "out")
+    b = _scaler(MaxAbsScaler, a.transform(t)[0], "out", "out")  # in-place
+    report = analyze_pipeline(PipelineModel([a, b]), schema_of(t))
+    assert "FML102" in _rules(report)
+    # Overwriting source data is also a collision.
+    c = _scaler(MinMaxScaler, t, "features", "label")
+    report2 = analyze_pipeline(PipelineModel([c]), schema_of(t))
+    assert "FML102" in _rules(report2)
+
+
+def test_shape_mismatch_fml103():
+    t = _data(d=4)
+    s = _scaler(StandardScaler, t, "features", "scaled")
+    km = KMeansModel().set(KMeansModel.FEATURES_COL, "scaled")
+    km.set_model_data(
+        Table({"centroids": np.zeros((1, 3, 7))})  # d=7 vs features d=4
+    )
+    report = analyze_pipeline(PipelineModel([s, km]), schema_of(t))
+    assert "FML103" in _rules(report)
+
+
+def test_fusion_break_fml104():
+    from flinkml_tpu.api import AlgoOperator
+
+    class HostStage(AlgoOperator):
+        def transform(self, *inputs):
+            return inputs
+
+    t = _data()
+    a = _scaler(StandardScaler, t, "features", "a")
+    b = _scaler(MaxAbsScaler, a.transform(t)[0], "a", "b")
+    report = analyze_pipeline(
+        PipelineModel([a, HostStage(), b]), schema_of(t)
+    )
+    assert "FML104" in _rules(report)
+
+
+def test_unstable_fingerprint_fml105():
+    t = _data()
+    base = _scaler(StandardScaler, t, "features", "a")
+    b = _scaler(MaxAbsScaler, base.transform(t)[0], "a", "b")
+
+    class Unstable(type(base)):
+        _tick = [0]
+
+        def transform_kernel(self):
+            k = super().transform_kernel()
+            self._tick[0] += 1
+            import dataclasses
+            return dataclasses.replace(
+                k, fingerprint=k.fingerprint + (self._tick[0],)
+            )
+
+    u = Unstable()
+    u.copy_params_from(base)
+    u._mean, u._std = base._mean, base._std
+    report = analyze_pipeline(PipelineModel([u, b]), schema_of(t))
+    assert "FML105" in _rules(report)
+
+
+def test_ordering_error_fml107_open_schema():
+    # Open schema (AST-lint mode): consumer before producer is an error.
+    t = _data()
+    producer = _scaler(StandardScaler, t, "features", "scaled")
+    consumer = _scaler(MaxAbsScaler, producer.transform(t)[0], "scaled", "z")
+    report = analyze_pipeline([consumer, producer], schema=None)
+    assert "FML107" in _rules(report)
+
+
+# ---------------------------------------------------------------------------
+# shipped models: kernel contract sweep + FML106 regressions
+# ---------------------------------------------------------------------------
+
+def test_every_shipped_kernel_validates_clean():
+    """The full kernel-capable stage set flows through the validator with
+    zero findings on its canonical wiring — the 'run the validator over
+    every shipped model' gate."""
+    t = _data()
+    stages = _fitted_chain(t)
+    km = (
+        KMeans()
+        .set(KMeans.FEATURES_COL, "features")
+        .set(KMeans.K, 2)
+        .set(KMeans.PREDICTION_COL, "cluster")
+        .fit(t)
+    )
+    enc_train = Table({"c1": np.array([0.0, 1.0, 2.0])})
+    enc = (
+        OneHotEncoder()
+        .set_input_cols(["c1"])
+        .set_output_cols(["o1"])
+        .set_handle_invalid("keep")
+        .fit(enc_train)
+    )
+    t2 = t.with_column("c1", np.zeros(len(t)))
+    report = analyze_pipeline(
+        PipelineModel(stages + [km, enc]), schema_of(t2)
+    )
+    assert not report.findings, report.render()
+
+
+def test_float32_scaler_chain_no_promotion():
+    """Regression (real finding #1): scalers promoted float32 input to
+    float64 on the CPU fallback path. They now preserve the input float
+    dtype — validator-clean and bitwise fused==host at float32."""
+    t = _data(dtype=np.float32)
+    stages = _fitted_chain(t)[:4]  # the four scalers
+    pm = PipelineModel(stages)
+    report = analyze_pipeline(pm, schema_of(t))
+    assert "FML106" not in _rules(report), report.render()
+
+    pipeline_fusion.set_enabled(False)
+    (host,) = pm.transform(t)
+    pipeline_fusion.set_enabled(True)
+    pipeline_fusion.reset_cache()
+    (fused,) = pm.transform(t)
+    for c in ("s1", "s2", "s3", "s4"):
+        assert host.column(c).dtype == np.float32
+        assert fused.column(c).dtype == np.float32
+        np.testing.assert_array_equal(host.column(c), fused.column(c))
+
+
+def test_float32_assembler_no_promotion():
+    """Regression (real finding #2): VectorAssembler promoted every part
+    to float64. All-float32 parts now assemble to float32 (host and
+    fused, bitwise-equal); mixed width still promotes to the widest."""
+    rng = np.random.default_rng(3)
+    t = Table({
+        "a": rng.normal(size=(20, 3)).astype(np.float32),
+        "b": rng.normal(size=20).astype(np.float32),
+    })
+    va = (
+        VectorAssembler()
+        .set(VectorAssembler.INPUT_COLS, ["a", "b"])
+        .set(VectorAssembler.HANDLE_INVALID, "keep")
+        .set(VectorAssembler.OUTPUT_COL, "asm")
+    )
+    report = analyze_pipeline([va], schema_of(t))
+    assert "FML106" not in _rules(report), report.render()
+    (host,) = va.transform(t)
+    assert host.column("asm").dtype == np.float32
+
+    kernel = va.transform_kernel()
+    fused = pipeline_fusion.execute_kernel_chain(t, [kernel])
+    assert fused.column("asm").dtype == np.float32
+    np.testing.assert_array_equal(host.column("asm"), fused.column("asm"))
+
+    t64 = t.with_column("c", rng.normal(size=20))  # float64 part
+    va64 = (
+        VectorAssembler()
+        .set(VectorAssembler.INPUT_COLS, ["a", "b", "c"])
+        .set(VectorAssembler.HANDLE_INVALID, "keep")
+        .set(VectorAssembler.OUTPUT_COL, "asm")
+    )
+    assert va64.transform(t64)[0].column("asm").dtype == np.float64
+
+
+def test_object_vector_column_not_abstract_evaluated():
+    """Row-wise Vector (object) feature columns are valid pipeline input
+    — the host path densifies them and the runtime fuser skips them — so
+    the validator must skip kernel abstract evaluation instead of
+    reporting a false FML103."""
+    from flinkml_tpu.linalg import DenseVector
+
+    rng = np.random.default_rng(0)
+    col = np.empty(10, dtype=object)
+    for i in range(10):
+        col[i] = DenseVector(rng.normal(size=3))
+    t = Table({"features": col})
+    dense = Table({"features": rng.normal(size=(10, 3))})
+    m = _scaler(StandardScaler, dense, "features", "out")
+    (expected,) = m.transform(t)  # the host path genuinely works
+    assert expected.column("out").shape == (10, 3)
+    report = analyze_pipeline(PipelineModel([m]), schema_of(t))
+    assert "FML103" not in _rules(report), report.render()
+
+
+def test_analyze_pipeline_accepts_iterator():
+    t = _data()
+    stages = _fitted_chain(t)
+    report = analyze_pipeline(iter(stages), schema_of(t))
+    assert not report.findings, report.render()
+
+
+def test_float32_scaler_zero_guard_after_downcast():
+    """Regression: with dtype-preserving transforms, a float64 fitted std
+    that is positive but underflows to 0.0 in float32 must take the
+    constant-feature branch (divide by 1), not divide by zero. The guard
+    is applied AFTER the downcast, identically on host and fused paths."""
+    from flinkml_tpu.models.scalers import StandardScalerModel
+
+    m = (
+        StandardScalerModel()
+        .set(StandardScalerModel.INPUT_COL, "x")
+        .set(StandardScalerModel.OUTPUT_COL, "out")
+        .set(StandardScalerModel.WITH_MEAN, False)
+    )
+    # 5e-46 > 0 in float64, but rounds to 0.0 in float32.
+    m.set_model_data(Table({
+        "mean": np.zeros((1, 2)), "std": np.array([[5e-46, 1.0]]),
+    }))
+    t = Table({"x": np.ones((8, 2), dtype=np.float32)})
+    (host,) = m.transform(t)
+    assert np.isfinite(host.column("out")).all(), host.column("out")
+    np.testing.assert_array_equal(host.column("out")[:, 0], 1.0)
+
+    fused = pipeline_fusion.execute_kernel_chain(t, [m.transform_kernel()])
+    assert host.column("out").dtype == fused.column("out").dtype == np.float32
+    np.testing.assert_array_equal(host.column("out"), fused.column("out"))
+
+
+def test_float64_promotion_still_flagged_fml106():
+    """The rule itself keeps teeth: a kernel that hard-casts to float64
+    over float32 input is flagged."""
+    from flinkml_tpu.api import AlgoOperator, ColumnKernel
+
+    class Promoter(AlgoOperator):
+        def transform(self, *inputs):
+            (t,) = inputs
+            return (t.with_column("wide", t.column("x").astype(np.float64)),)
+
+        def transform_kernel(self):
+            import jax.numpy as jnp
+
+            def fn(cols, consts, valid):
+                return {"wide": cols["x"].astype(jnp.float64)}
+
+            return ColumnKernel(("x",), ("wide",), fn,
+                                fingerprint=("Promoter",))
+
+    t = Table({"x": np.ones(8, dtype=np.float32)})
+    report = analyze_pipeline([Promoter()], schema_of(t))
+    assert "FML106" in _rules(report)
+
+
+# ---------------------------------------------------------------------------
+# graph wiring
+# ---------------------------------------------------------------------------
+
+def test_graph_wiring_clean_and_broken():
+    t = _data()
+
+    def build(missing_input):
+        builder = GraphBuilder().set_max_output_table_num(1)
+        src = builder.create_table_id()
+        dangling = builder.create_table_id()  # never produced
+        s = StandardScaler().set(StandardScaler.INPUT_COL, "features").set(
+            StandardScaler.OUTPUT_COL, "scaled"
+        )
+        outs = builder.add_estimator(
+            s, dangling if missing_input else src
+        )
+        return builder.build_estimator([src], outs)
+
+    assert not analyze_graph(build(False)).findings
+    report = analyze_graph(build(True))
+    assert "FML201" in _rules(report)
+
+
+def test_graph_unproduced_output_fml202():
+    builder = GraphBuilder().set_max_output_table_num(1)
+    src = builder.create_table_id()
+    s = StandardScaler()
+    builder.add_estimator(s, src)
+    bogus = builder.create_table_id()
+    g = builder.build_estimator([src], [bogus])
+    assert "FML202" in _rules(analyze_graph(g))
+
+
+# ---------------------------------------------------------------------------
+# AST lint
+# ---------------------------------------------------------------------------
+
+def test_lint_shipped_examples_clean():
+    report = lint_paths(["examples/"])
+    assert not report.findings, report.render()
+
+
+def test_lint_fixture_findings():
+    report = lint_paths(["tests/analysis_fixtures/"])
+    rules = _rules(report)
+    assert "FML107" in rules and "FML102" in rules, report.render()
+
+
+def test_lint_resolves_defaults_and_comprehensions():
+    src = """
+from flinkml_tpu.models import VectorAssembler, StandardScaler
+from flinkml_tpu.pipeline import Pipeline
+d = 3
+pipe = Pipeline([
+    VectorAssembler().set_input_cols([f"f{i}" for i in range(d)])
+                     .set(VectorAssembler.OUTPUT_COL, "input"),
+    StandardScaler(),  # default input -> output wiring
+])
+"""
+    report = lint_source(src, "inline.py")
+    assert not report.findings, report.render()
+
+    # Breaking the default wiring is caught: assembler writes "xx", the
+    # scaler's default input "input" is then produced by nobody — but in
+    # open-schema mode that is only an ordering question, so instead break
+    # ordering explicitly.
+    src_bad = """
+from flinkml_tpu.models import VectorAssembler, StandardScaler
+from flinkml_tpu.pipeline import Pipeline
+pipe = Pipeline([
+    StandardScaler(),                      # reads "input"...
+    VectorAssembler().set_input_cols(["a"])
+                     .set(VectorAssembler.OUTPUT_COL, "input"),  # ...produced later
+])
+"""
+    report_bad = lint_source(src_bad, "inline.py")
+    assert "FML107" in _rules(report_bad), report_bad.render()
+
+
+def test_cli_exit_codes():
+    import subprocess
+    import sys
+
+    ok = subprocess.run(
+        [sys.executable, "-m", "flinkml_tpu.analysis", "examples/",
+         "--fail-on-findings", "--no-selfcheck"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        [sys.executable, "-m", "flinkml_tpu.analysis",
+         "tests/analysis_fixtures/", "--fail-on-findings",
+         "--no-selfcheck"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "FML302" in bad.stdout  # the PR 1 deadlock fixture

@@ -32,6 +32,21 @@ stage() {  # stage <name> <cmd...>
 stage "lint (compileall)" python -m compileall -q \
     flinkml_tpu tests tools examples bench.py __graft_entry__.py
 
+# Ahead-of-time analysis gate (docs/development/static_analysis.md):
+# examples must lint clean (all three passes, device-free), and the
+# seeded fixtures must FAIL — proving the gate has teeth.
+stage "analysis gate (examples clean)" env JAX_PLATFORMS=cpu \
+    python -m flinkml_tpu.analysis examples/ --fail-on-findings
+analysis_fixture_gate() {
+    if env JAX_PLATFORMS=cpu python -m flinkml_tpu.analysis \
+        tests/analysis_fixtures/ --no-selfcheck --fail-on-findings; then
+        echo "analysis gate passed the seeded-findings fixtures (it must flag them)"
+        return 1
+    fi
+    return 0
+}
+stage "analysis gate (fixtures flagged)" analysis_fixture_gate
+
 if [ "${CI_FAST:-0}" != 1 ]; then
     stage "full suite" python -m pytest tests/ -x -q
 fi
