@@ -558,17 +558,12 @@ def _inner_word2vec() -> float:
     return local_bs * mesh.axis_size() * steps / elapsed
 
 
-def _pipeline_fused_stage(n=100_000, d=32, reps=5) -> dict:
-    """Stage: fused pipeline inference throughput — a 5-stage all-kernel
-    chain (StandardScaler → MinMaxScaler → MaxAbsScaler → RobustScaler →
-    LogisticRegressionModel) through ``PipelineModel.transform``, fused
-    (one XLA program, device-resident intermediates, shape-bucketed
-    compile cache) vs unfused (the per-stage path: N host↔device round
-    trips and four host numpy scaler passes). Metric:
-    ``pipeline_transform_rows_per_sec`` for both executions, plus the
-    speedup — the per-stage-materialization overhead the fused executor
-    (flinkml_tpu/pipeline_fusion.py) exists to delete."""
-    from flinkml_tpu import pipeline_fusion
+def _five_stage_model(n=100_000, d=32, seed=0):
+    """The bench's canonical all-kernel chain (StandardScaler →
+    MinMaxScaler → MaxAbsScaler → RobustScaler → LogisticRegressionModel),
+    fitted on seeded data; shared by the pipeline_fused and serving
+    stages so both measure the same program. Returns
+    ``(pipeline_model, x)``."""
     from flinkml_tpu.models.logistic_regression import LogisticRegression
     from flinkml_tpu.models.scalers import (
         MaxAbsScaler, MinMaxScaler, RobustScaler, StandardScaler,
@@ -576,7 +571,7 @@ def _pipeline_fused_stage(n=100_000, d=32, reps=5) -> dict:
     from flinkml_tpu.pipeline import PipelineModel
     from flinkml_tpu.table import Table
 
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     x = rng.normal(size=(n, d))
     y = (x @ rng.normal(size=d) > 0).astype(np.float64)
     train = Table({"features": x, "label": y})
@@ -597,8 +592,24 @@ def _pipeline_fused_stage(n=100_000, d=32, reps=5) -> dict:
         .fit(cur)
     )
     stages.append(lr)
-    pipeline_model = PipelineModel(stages)
-    apply_table = train.select("features")
+    return PipelineModel(stages), x
+
+
+def _pipeline_fused_stage(n=100_000, d=32, reps=5) -> dict:
+    """Stage: fused pipeline inference throughput — a 5-stage all-kernel
+    chain (StandardScaler → MinMaxScaler → MaxAbsScaler → RobustScaler →
+    LogisticRegressionModel) through ``PipelineModel.transform``, fused
+    (one XLA program, device-resident intermediates, shape-bucketed
+    compile cache) vs unfused (the per-stage path: N host↔device round
+    trips and four host numpy scaler passes). Metric:
+    ``pipeline_transform_rows_per_sec`` for both executions, plus the
+    speedup — the per-stage-materialization overhead the fused executor
+    (flinkml_tpu/pipeline_fusion.py) exists to delete."""
+    from flinkml_tpu import pipeline_fusion
+    from flinkml_tpu.table import Table
+
+    pipeline_model, x = _five_stage_model(n, d)
+    apply_table = Table({"features": x})
 
     def rows_per_sec():
         # Warm-up covers compiles on both paths; each timed call ends by
@@ -642,6 +653,94 @@ def _inner_pipeline_fused_cpu() -> dict:
     speedup; device numbers ride the device phase when healthy)."""
     _force_cpu()
     return _pipeline_fused_stage()
+
+
+def _serving_stage(n_clients=8, duration_s=4.0, max_batch_rows=256,
+                   n=50_000, d=32) -> dict:
+    """Stage: online serving throughput/latency — synthetic closed-loop
+    clients (each thread issues its next request the moment the previous
+    response lands) against the 5-stage fused chain behind a
+    ``ServingEngine``: adaptive micro-batching into the fused compile
+    cache's row buckets, per-bucket warmup, zero steady-state retraces.
+    Metrics: ``serving_rows_per_sec`` (aggregate served rows),
+    ``serving_p50_ms`` / ``serving_p99_ms`` (per-request latency,
+    enqueue→complete), and mean batch occupancy (rows / bucket rows —
+    padding waste of the bucketing policy under this load)."""
+    import threading
+
+    from flinkml_tpu.serving import ServingConfig, ServingEngine
+    from flinkml_tpu.table import Table
+
+    model, x = _five_stage_model(n, d)
+    engine = ServingEngine(
+        model,
+        example=Table({"features": x[:4]}),
+        config=ServingConfig(max_batch_rows=max_batch_rows,
+                             max_wait_ms=1.0),
+        output_cols=("prediction",),
+        name="bench",
+    ).start()
+
+    stop = threading.Event()
+    served_rows = [0] * n_clients
+    errors = []
+
+    def client(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            while not stop.is_set():
+                rows = int(rng.integers(1, 33))
+                lo = int(rng.integers(0, n - rows))
+                engine.predict({"features": x[lo:lo + rows]})
+                served_rows[tid] += rows
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n_clients)
+    ]
+    _log(f"serving: {n_clients} closed-loop clients for {duration_s}s ...")
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    elapsed = time.perf_counter() - start
+    stats = engine.stats()
+    engine.stop()
+    if errors:
+        raise errors[0]
+    counters = stats["counters"]
+    occupancy = (
+        counters["batch_rows"] / counters["batch_padded_rows"]
+        if counters.get("batch_padded_rows") else 0.0
+    )
+    return {
+        "serving_rows_per_sec": round(sum(served_rows) / elapsed, 1),
+        "serving_p50_ms": round(stats["gauges"]["p50_ms"], 3),
+        "serving_p99_ms": round(stats["gauges"]["p99_ms"], 3),
+        "serving_batch_occupancy": round(occupancy, 3),
+        "requests": int(counters["requests"]),
+        "batches": int(counters["batches"]),
+        "clients": n_clients,
+        "stages": 5,
+    }
+
+
+def _inner_serving() -> dict:
+    _setup_jax_cache()
+    return _serving_stage()
+
+
+def _inner_serving_cpu() -> dict:
+    """The serving measurement pinned to the host CPU backend —
+    tunnel-immune (runs under JAX_PLATFORMS=cpu / CI), so the serving
+    trajectory is always observable; device numbers ride the device
+    phase when healthy."""
+    _force_cpu()
+    return _serving_stage()
 
 
 def _inner_feed_overlap(n_batches=32, bs=8_192, dim=128, k=512,
@@ -821,6 +920,8 @@ _INNER_STAGES = {
     "kmeans_mnist": _inner_kmeans_mnist,
     "pipeline_fused": _inner_pipeline_fused,
     "pipeline_fused_cpu": _inner_pipeline_fused_cpu,
+    "serving": _inner_serving,
+    "serving_cpu": _inner_serving_cpu,
     "feed_overlap": _inner_feed_overlap,
     "converge": _inner_converge,
     "converge_cpu": _inner_converge_cpu,
@@ -968,7 +1069,7 @@ def main():
         # converge_cpu is pinned to the host backend and never touches
         # the tunnel, so it must not contend for the single-tenant lock
         # (it runs while a watcher capture may hold the device).
-        if inner in ("converge_cpu", "pipeline_fused_cpu"):
+        if inner in ("converge_cpu", "pipeline_fused_cpu", "serving_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():

@@ -1,0 +1,166 @@
+"""End-to-end online serving: fit → publish v1 → serve concurrent clients
+→ publish v2+ from a STILL-RUNNING unbounded training stream → hot-swap
+with zero dropped or mis-versioned responses and zero steady-state
+retraces (guard-verified).
+
+Runs on TPU, or on a virtual CPU mesh with:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/serve_pipeline.py
+"""
+
+# Runnable standalone from any cwd: put the repo root on sys.path when
+# flinkml_tpu isn't already importable (pip-installed or PYTHONPATH set).
+import os as _os
+import sys as _sys
+
+try:
+    import flinkml_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(
+        0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    )
+
+# Honor JAX_PLATFORMS even on images whose TPU plugin overrides it at
+# import time (the documented CPU-mesh invocation must actually run on
+# CPU): re-pin the platform from the env var explicitly.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import functools
+import tempfile
+import threading
+
+import numpy as np
+
+from flinkml_tpu.analysis.guard import TransferRetraceGuard
+from flinkml_tpu.models import KMeans, KMeansModel, StandardScaler
+from flinkml_tpu.models.kmeans import train_kmeans_stream
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.pipeline import Pipeline, PipelineModel
+from flinkml_tpu.serving import (
+    ModelRegistry,
+    ServingConfig,
+    ServingEngine,
+    SnapshotPublisher,
+)
+from flinkml_tpu.table import Table
+
+# --- Synthesize clustered data -------------------------------------------
+rng = np.random.default_rng(0)
+n, d, k = 4_000, 8, 4
+x = rng.normal(size=(n, d)) + rng.integers(0, k, size=(n, 1)) * 3.0
+train = Table({"features": x})
+
+# --- Fit v1: scale → cluster (both stages fuse into one XLA program) -----
+pipe = Pipeline([
+    StandardScaler().set(StandardScaler.INPUT_COL, "features")
+                    .set(StandardScaler.OUTPUT_COL, "scaled"),
+    KMeans().set(KMeans.FEATURES_COL, "scaled").set(KMeans.K, k)
+            .set(KMeans.MAX_ITER, 3).set(KMeans.SEED, 7),
+])
+model_v1 = pipe.fit(train)
+scaler = model_v1.stages[0]
+
+# --- Publish v1 into a versioned registry --------------------------------
+registry = ModelRegistry(tempfile.mkdtemp(prefix="flinkml_registry_"))
+v1 = registry.publish(model_v1)
+print(f"published v{v1}; registry versions: {registry.versions()}")
+
+# --- Serve: engine warms every row bucket at load, then follows the
+# registry (each publish hot-swaps with zero downtime) --------------------
+engine = ServingEngine(
+    registry,
+    example=Table({"features": x[:4]}),
+    config=ServingConfig(max_batch_rows=64, max_wait_ms=1.0),
+    output_cols=("prediction",),
+    name="example",
+).start().follow_registry()
+
+
+@functools.lru_cache(maxsize=16)
+def reference_model(version):
+    """The fingerprint-verified registry copy of a version (for parity)."""
+    return registry.get(version)[1]
+
+
+stop = threading.Event()
+errors, versions_seen = [], set()
+completed = [0] * 6
+
+
+def client(tid):
+    crng = np.random.default_rng(tid)
+    try:
+        while not stop.is_set():
+            rows = int(crng.integers(1, 9))
+            lo = int(crng.integers(0, n - rows))
+            req = x[lo:lo + rows]
+            resp = engine.predict({"features": req})
+            versions_seen.add(resp.version)
+            # Bitwise parity against the version that claims the response.
+            (ref,) = reference_model(resp.version).transform(
+                Table({"features": req})
+            )
+            np.testing.assert_array_equal(
+                ref.column("prediction"), resp.column("prediction")
+            )
+            completed[tid] += 1
+    except BaseException as e:  # noqa: BLE001 — reported by the main thread
+        errors.append(e)
+
+
+# --- Mid-stream publication: an unbounded Lloyd loop emits a versioned
+# snapshot every 3 epochs WITHOUT stopping; the engine swaps live --------
+(scaled_train,) = scaler.transform(train)
+sx = np.asarray(scaled_train.column("scaled"), np.float32)
+stream_batches = [{"x": sx[i::8]} for i in range(8)]
+
+
+def make_model(centroids):
+    m = KMeansModel().set(KMeansModel.FEATURES_COL, "scaled") \
+                     .set(KMeansModel.K, k)
+    m.set_model_data(
+        Table({"centroids": np.asarray(centroids, np.float64)[None]})
+    )
+    return PipelineModel([scaler, m])
+
+
+publisher = SnapshotPublisher(registry, make_model, every_n_epochs=3)
+
+# Steady state must be retrace-free: after the engine's load-time warmup,
+# client traffic AND hot swaps compile nothing (same-shape model data
+# reuses the compiled programs — constants are traced arguments).
+with TransferRetraceGuard(allow_compiles=0, location="serve_pipeline"):
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    final_centroids = train_kmeans_stream(
+        stream_batches, k=k, mesh=DeviceMesh(), max_iter=9, seed=7,
+        listeners=[publisher],
+    )
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+
+assert not any(t.is_alive() for t in threads), "client threads hung"
+assert not errors, errors[:3]
+assert len(versions_seen) >= 2, (
+    f"clients never observed a hot swap: {versions_seen}"
+)
+print(f"mid-stream published versions: {[v for _, v in publisher.published]}")
+print(f"clients served {sum(completed)} requests across model versions "
+      f"{sorted(versions_seen)} — zero dropped, zero mis-versioned, "
+      "zero steady-state retraces")
+
+stats = engine.stats()
+print(f"p50={stats['gauges']['p50_ms']:.2f}ms "
+      f"p99={stats['gauges']['p99_ms']:.2f}ms "
+      f"batches={stats['counters']['batches']:.0f} "
+      f"avg_occupancy="
+      f"{stats['counters']['batch_rows'] / stats['counters']['batch_padded_rows']:.2f}")
+engine.stop()
+assert registry.current_version() == registry.versions()[-1]
+print("serving example OK")

@@ -140,20 +140,42 @@ class Model(Transformer):
 
     # -- shared persistence scaffold ---------------------------------------
     def _save_with_arrays(self, path: str, arrays, extra=None) -> None:
-        """Standard model layout: metadata JSON + named arrays under data/."""
+        """Standard model layout: metadata JSON + named arrays under data/.
+
+        The metadata records a sha256 content fingerprint of the arrays +
+        param map; load verifies it, so a tampered/truncated/mixed-up
+        model directory fails loudly
+        (:class:`~flinkml_tpu.io.read_write.ModelIntegrityError`) instead
+        of serving corrupt predictions."""
+        extra = dict(extra or {})
+        extra[read_write.FINGERPRINT_KEY] = read_write.content_fingerprint(
+            arrays, self.get_param_map_json()
+        )
         read_write.save_metadata(self, path, extra=extra)
         read_write.save_model_arrays(path, arrays)
 
     @classmethod
     def _load_with_arrays(cls, path: str):
         """Counterpart of ``_save_with_arrays``: class-checked metadata,
-        params restored; returns ``(model, arrays, metadata)``."""
+        fingerprint-verified arrays, params restored; returns
+        ``(model, arrays, metadata)``."""
         meta = read_write.load_metadata(
             path, expected_class_name=f"{cls.__module__}.{cls.__qualname__}"
         )
         model = cls()
         model.load_param_map_json(meta["paramMap"])
-        return model, read_write.load_model_arrays(path), meta
+        arrays = read_write.load_model_arrays(path)
+        recorded = meta.get(read_write.FINGERPRINT_KEY)
+        if recorded is not None:
+            actual = read_write.content_fingerprint(arrays, meta["paramMap"])
+            if actual != recorded:
+                raise read_write.ModelIntegrityError(
+                    f"model data at {path} does not match its recorded "
+                    f"content fingerprint (recorded {recorded[:12]}..., "
+                    f"actual {actual[:12]}...): the persisted arrays or "
+                    "params were modified after save"
+                )
+        return model, arrays, meta
 
 
 class Estimator(Stage):

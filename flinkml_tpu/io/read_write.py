@@ -16,6 +16,7 @@ class names are Python dotted paths.
 
 from __future__ import annotations
 
+import hashlib
 import importlib
 import json
 import os
@@ -26,6 +27,58 @@ import numpy as np
 
 METADATA_FILE = "metadata"
 MODEL_DATA_DIR = "data"
+FINGERPRINT_KEY = "contentFingerprint"
+
+
+class ModelIntegrityError(ValueError):
+    """A stage's persisted model data does not match the content
+    fingerprint recorded in its metadata — the files were tampered with,
+    truncated, or mixed between saves. Raised on load; the serving
+    :class:`~flinkml_tpu.serving.ModelRegistry` relies on this check to
+    never hot-swap a corrupt snapshot into a live engine."""
+
+
+def content_fingerprint(
+    arrays: Mapping[str, Any],
+    param_map_json: Optional[Mapping[str, Any]] = None,
+) -> str:
+    """Deterministic sha256 over named model arrays (+ optionally the
+    stage's param map): names, dtypes, shapes, and raw bytes all
+    contribute, so any bit flip in the persisted model changes the
+    fingerprint."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(np.asarray(arrays[name]))
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    if param_map_json is not None:
+        h.update(json.dumps(dict(param_map_json), sort_keys=True,
+                            default=str).encode())
+    return h.hexdigest()
+
+
+def verify_fingerprint(path: str, meta: Optional[Mapping[str, Any]] = None) -> Optional[str]:
+    """Check the stage at ``path`` against its recorded content
+    fingerprint, if it has one (stages saved before fingerprinting, and
+    stages without model arrays, pass trivially). Returns the verified
+    fingerprint or None; raises :class:`ModelIntegrityError` on mismatch.
+    """
+    if meta is None:
+        meta = load_metadata(path)
+    recorded = meta.get(FINGERPRINT_KEY)
+    if recorded is None:
+        return None
+    actual = content_fingerprint(load_model_arrays(path), meta.get("paramMap"))
+    if actual != recorded:
+        raise ModelIntegrityError(
+            f"model data at {path} does not match its recorded content "
+            f"fingerprint (recorded {recorded[:12]}..., actual "
+            f"{actual[:12]}...): the persisted arrays or params were "
+            "modified after save"
+        )
+    return recorded
 
 
 def stage_path(parent: str, stage_idx: int) -> str:

@@ -6,6 +6,7 @@ from flinkml_tpu.iteration.runtime import (
     TerminateOnMaxIter,
     TerminateOnMaxIterOrTol,
     iterate,
+    notify_epoch_listeners,
 )
 from flinkml_tpu.iteration.device_loop import device_iterate
 from flinkml_tpu.iteration.checkpoint import CheckpointManager
@@ -27,6 +28,7 @@ __all__ = [
     "TerminateOnMaxIter",
     "TerminateOnMaxIterOrTol",
     "iterate",
+    "notify_epoch_listeners",
     "ForwardInputsOfLastRound",
     "device_iterate",
     "CheckpointManager",

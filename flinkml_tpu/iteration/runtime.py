@@ -103,13 +103,60 @@ class IterationListener:
 
     Callbacks run on the host between epochs (where the reference invoked
     them inside wrapped operators when the epoch watermark advanced).
+
+    A listener that publishes or persists the state mid-stream (e.g.
+    :class:`flinkml_tpu.serving.SnapshotPublisher`) sets the class
+    attribute ``needs_materialized_state = True``: the runtime then
+    blocks on the loop carry before the epoch callbacks fire, so the
+    listener sees a *consistent, fully computed* snapshot rather than
+    in-flight async dispatch futures — the mid-stream model-emission
+    hook the reference's unbounded ``Iterations`` gets from per-round
+    model emission.
     """
+
+    #: Set True when epoch callbacks must observe a fully computed state
+    #: (the runtime calls ``jax.block_until_ready`` on the carry first).
+    #: Listeners that only act on SOME epochs should also implement
+    #: ``wants_epoch_state(epoch) -> bool`` so idle epochs keep the
+    #: async-dispatch pipeline intact (no per-epoch device sync).
+    needs_materialized_state = False
+
+    def wants_epoch_state(self, epoch: int) -> bool:
+        """Whether this listener will actually consume a materialized
+        state at ``epoch`` (only consulted when
+        ``needs_materialized_state`` is set)."""
+        return True
 
     def on_epoch_watermark_incremented(self, epoch: int, state: Any) -> None:
         ...
 
     def on_iteration_terminated(self, state: Any) -> None:
         ...
+
+
+def notify_epoch_listeners(
+    listeners: Sequence["IterationListener"], epoch: int, state: Any
+) -> Any:
+    """Fire ``on_epoch_watermark_incremented`` on every listener,
+    materializing ``state`` once first if any listener declares
+    ``needs_materialized_state`` AND will act this epoch
+    (``wants_epoch_state``; see :class:`IterationListener`) — a
+    publisher on a 10-epoch cadence costs a device sync once per
+    publish, not per epoch. Returns the (possibly materialized) state.
+    Shared by :func:`iterate` and the hand-rolled ``train_*_stream``
+    epoch loops, so mid-stream snapshot publication behaves identically
+    in both."""
+    if listeners and any(
+        getattr(l, "needs_materialized_state", False)
+        and getattr(l, "wants_epoch_state", lambda e: True)(epoch)
+        for l in listeners
+    ):
+        import jax
+
+        state = jax.block_until_ready(state)
+    for listener in listeners:
+        listener.on_epoch_watermark_incremented(epoch, state)
+    return state
 
 
 class ForwardInputsOfLastRound(IterationListener):
@@ -294,8 +341,7 @@ def iterate(
             guard.after_dispatch(state)
         criteria_history.append(criteria_value)
 
-        for listener in listeners:
-            listener.on_epoch_watermark_incremented(epoch, state)
+        state = notify_epoch_listeners(listeners, epoch, state)
 
         terminated = config.termination.should_terminate(epoch, criteria_value)
         epoch += 1

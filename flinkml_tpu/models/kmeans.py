@@ -360,6 +360,7 @@ def train_kmeans_stream(
     checkpoint_manager=None,
     checkpoint_interval: int = 0,
     resume: bool = False,
+    listeners=(),
 ) -> np.ndarray:
     """Out-of-core Lloyd: train from a one-shot stream of batch dicts (or
     a sealed :class:`DataCache`) with bounded HBM residency.
@@ -384,6 +385,15 @@ def train_kmeans_stream(
     bit-exact with the uninterrupted run, because each epoch is a pure
     function of (centroids, cache). Resume requires the same durable
     cache (or re-fed identical stream) the crashed run trained from.
+
+    ``listeners`` (:class:`~flinkml_tpu.iteration.IterationListener`)
+    fire at every Lloyd epoch boundary with the current centroids and at
+    termination — the mid-stream model-emission hook
+    (``iteration.runtime.notify_epoch_listeners``): a
+    :class:`flinkml_tpu.serving.SnapshotPublisher` attached here
+    publishes a consistent versioned model snapshot every N epochs into
+    a registry *without stopping the stream*, matching the reference's
+    unbounded ``Iterations`` per-round model emission.
     """
     from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
     from flinkml_tpu.iteration.datacache import (
@@ -584,8 +594,17 @@ def train_kmeans_stream(
     # mesh's devices: interleaved multi-device collective dispatch
     # deadlocks (see local_execution_lock; the analyzer's FML302 check
     # verifies this exact program shape via the dispatch trace below).
-    with local_execution_lock(mesh):
-        for epoch in range(start_epoch, max_iter):
+    # The lock scopes one EPOCH, not the whole loop: every collective
+    # dispatch of an epoch (including the guard flush and the
+    # checkpoint's multi-process gather) completes under the lock, and
+    # the only cross-release in-flight work (the centroid update) is
+    # elementwise on replicated arrays — no rendezvous to interleave.
+    # Releasing at epoch boundaries keeps listener callbacks (snapshot
+    # publication: disk writes, a following engine's warmup compiles)
+    # from stalling concurrent fits on overlapping devices.
+    epoch_lock = local_execution_lock(mesh)
+    for epoch in range(start_epoch, max_iter):
+        with epoch_lock:
             if _dispatch.has_dispatch_observers():
                 _dispatch.record_collective_dispatch(
                     "kmeans.lloyd_epoch", mesh_device_ids
@@ -629,7 +648,13 @@ def train_kmeans_stream(
                     )
                 else:
                     checkpoint_manager.save(np.asarray(cent_dev), epoch + 1)
-        jax.block_until_ready(cent_dev)
+        if listeners:
+            from flinkml_tpu.iteration.runtime import notify_epoch_listeners
+
+            cent_dev = notify_epoch_listeners(listeners, epoch, cent_dev)
+    jax.block_until_ready(cent_dev)
+    for listener in listeners:
+        listener.on_iteration_terminated(cent_dev)
     return np.asarray(cent_dev)
 
 

@@ -120,6 +120,47 @@ def row_bucket(n: int) -> int:
     return max(MIN_ROW_BUCKET, next_pow2(n))
 
 
+def warmup_transform(
+    model,
+    example: Table,
+    row_counts: Sequence[int],
+    output_cols: Sequence[str] = (),
+) -> Tuple[List[int], Tuple[str, ...]]:
+    """Precompile ``model.transform``'s fused programs for every row
+    bucket covering ``row_counts``, so a latency-sensitive caller (the
+    serving engine's load path) pays every compile up front and steady
+    state is zero-retrace.
+
+    ``example`` supplies the input schema: its host columns are tiled
+    row-cyclically to each bucket's exact row count and pushed through
+    the real ``transform`` path — the same cache keys production traffic
+    will hit (same column specs, same constant specs, same requested
+    outputs). ``output_cols`` (default: every column ``transform`` adds)
+    are materialized to host afterwards, forcing any lazy-column program
+    the caller will read. Returns ``(buckets, read_cols)`` — the sorted
+    buckets warmed and the output columns read (the requested ones, or
+    the discovered added columns: callers that defaulted ``output_cols``
+    learn the schema without paying another transform).
+    """
+    buckets = sorted({row_bucket(int(n)) for n in row_counts})
+    host_cols = {name: np.asarray(example.column(name))
+                 for name in example.column_names}
+    read = tuple(output_cols)
+    for bucket in buckets:
+        tiled = Table({
+            name: np.resize(col, (bucket,) + col.shape[1:])
+            for name, col in host_cols.items()
+        })
+        (out,) = model.transform(tiled)
+        if not read:
+            read = tuple(
+                c for c in out.column_names if c not in example.column_names
+            )
+        for c in read:
+            out.column(c)
+    return buckets, read
+
+
 def _dense_in_table(table: Table, name: str) -> bool:
     """Whether ``name`` is a column the executor can place on device."""
     if name not in table:

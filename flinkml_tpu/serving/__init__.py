@@ -1,0 +1,62 @@
+"""flinkml_tpu.serving — the online inference runtime.
+
+The layer between the train/transform framework and "heavy traffic from
+millions of users" (ROADMAP north star): a request path in front of the
+fused pipeline executor, versioned model publication, and zero-downtime
+model rollout. Four pieces:
+
+- :class:`ServingEngine` — thread-safe ``predict()`` with **adaptive
+  micro-batching**: concurrent requests coalesce into the power-of-two
+  row buckets the fused compile cache already owns (per-bucket warmup at
+  load, so steady state is zero-retrace), with bounded-queue admission
+  control, per-request deadlines, and host-path load shedding.
+- :class:`ModelRegistry` — versioned, fingerprint-verified model store
+  with an atomic "current" pointer; ``publish`` / ``get`` / ``rollback``.
+- :class:`SnapshotPublisher` — an ``IterationListener`` that turns a
+  *running* training stream into registry versions every N epochs
+  (mid-stream model emission, the reference's unbounded-``Iterations``
+  capability).
+- typed errors (:mod:`flinkml_tpu.serving.errors`) for every rejection
+  the online path can produce.
+
+See ``docs/operators/serving.md`` for lifecycle, knobs, and semantics,
+and ``examples/serve_pipeline.py`` for the end-to-end
+fit → publish → serve → hot-swap flow.
+"""
+
+from flinkml_tpu.serving.batcher import AdaptiveMicroBatcher, ServingRequest
+from flinkml_tpu.serving.engine import (
+    ServingConfig,
+    ServingEngine,
+    ServingResponse,
+)
+from flinkml_tpu.serving.errors import (
+    EngineStoppedError,
+    ModelIntegrityError,
+    ModelVersionNotFoundError,
+    RegistryError,
+    ServingError,
+    ServingOverloadError,
+    ServingSchemaError,
+    ServingTimeoutError,
+)
+from flinkml_tpu.serving.publisher import SnapshotPublisher
+from flinkml_tpu.serving.registry import ModelRegistry
+
+__all__ = [
+    "AdaptiveMicroBatcher",
+    "EngineStoppedError",
+    "ModelIntegrityError",
+    "ModelRegistry",
+    "ModelVersionNotFoundError",
+    "RegistryError",
+    "ServingConfig",
+    "ServingEngine",
+    "ServingError",
+    "ServingOverloadError",
+    "ServingRequest",
+    "ServingResponse",
+    "ServingSchemaError",
+    "ServingTimeoutError",
+    "SnapshotPublisher",
+]

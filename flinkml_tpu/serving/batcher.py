@@ -1,0 +1,214 @@
+"""Adaptive micro-batching: coalesce concurrent requests into shape buckets.
+
+The fused pipeline executor (:mod:`flinkml_tpu.pipeline_fusion`) compiles
+one program per power-of-two row bucket and serves any row count within a
+bucket with zero retraces — so the *only* cost of batching requests
+together is padding waste inside the bucket, and the only cost of not
+batching is per-dispatch overhead. The policy here (in the adaptive-
+batching tradition of Clipper, Crankshaw et al., NSDI'17) exploits that
+structure directly:
+
+  - a request that arrives alone waits at most ``max_wait_s`` for company
+    (the latency the operator is willing to trade for occupancy);
+  - the window closes EARLY the moment the queued rows exactly fill their
+    power-of-two bucket (occupancy 1.0 — waiting longer buys nothing the
+    compile cache doesn't already give a later batch) or reach
+    ``max_batch_rows``;
+  - admission is bounded: past ``max_queue_rows`` queued rows,
+    :meth:`offer` refuses and the engine sheds or rejects — queueing
+    theory does the rest of the argument (an unbounded queue under
+    saturation has unbounded latency).
+
+Requests are never split across batches; batches pop FIFO, so the oldest
+request's deadline governs the window. Thread-safe; one consumer (the
+engine's dispatcher thread) and any number of producers.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flinkml_tpu.pipeline_fusion import row_bucket
+from flinkml_tpu.serving.errors import EngineStoppedError
+
+
+@dataclasses.dataclass
+class ServingRequest:
+    """One in-flight ``predict`` call: host input columns plus a
+    completion event the calling thread waits on."""
+
+    columns: Dict[str, np.ndarray]
+    rows: int
+    enqueued_at: float
+    deadline: Optional[float] = None  # absolute, time.monotonic() clock
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: Optional[Dict[str, np.ndarray]] = None
+    error: Optional[BaseException] = None
+    version: Optional[int] = None
+    shed: bool = False
+    #: Set by whichever side (client wait-expiry or dispatcher in-queue
+    #: expiry) counts the timeout first, so one request never increments
+    #: the 'timeouts' counter twice. Guarded by ``_count_lock`` — use
+    #: :meth:`claim_timeout_count`.
+    timeout_counted: bool = False
+    _count_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock
+    )
+
+    def claim_timeout_count(self) -> bool:
+        """Atomic test-and-set: True for exactly one caller (the client's
+        wait-expiry and the dispatcher's in-queue expiry can race)."""
+        with self._count_lock:
+            if self.timeout_counted:
+                return False
+            self.timeout_counted = True
+            return True
+
+    def complete(self, result: Dict[str, np.ndarray],
+                 version: Optional[int], shed: bool = False) -> None:
+        self.result = result
+        self.version = version
+        self.shed = shed
+        self.done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.done.set()
+
+
+class AdaptiveMicroBatcher:
+    """Bounded thread-safe request queue + the coalescing policy above."""
+
+    def __init__(
+        self,
+        max_batch_rows: int = 1024,
+        max_wait_s: float = 0.002,
+        max_queue_rows: int = 8192,
+    ):
+        if max_batch_rows < 1:
+            raise ValueError(f"max_batch_rows must be >= 1, got {max_batch_rows}")
+        if max_queue_rows < max_batch_rows:
+            raise ValueError(
+                f"max_queue_rows ({max_queue_rows}) must be >= "
+                f"max_batch_rows ({max_batch_rows})"
+            )
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue_rows = int(max_queue_rows)
+        self._cond = threading.Condition()
+        self._queue: Deque[ServingRequest] = collections.deque()
+        self._queued_rows = 0
+        self._stopped = False
+
+    # -- producer side -----------------------------------------------------
+    def offer(self, request: ServingRequest) -> bool:
+        """Admit ``request``; False when the bounded queue is full (the
+        engine decides between shedding and a typed rejection). Raises
+        :class:`EngineStoppedError` after :meth:`stop`."""
+        with self._cond:
+            if self._stopped:
+                raise EngineStoppedError("serving engine is stopped")
+            if self._queued_rows + request.rows > self.max_queue_rows:
+                return False
+            self._queue.append(request)
+            self._queued_rows += request.rows
+            self._cond.notify_all()
+            return True
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def queued_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    # -- consumer side (the dispatcher thread) -----------------------------
+    def next_batch(
+        self, poll_s: float = 0.05
+    ) -> Tuple[List[ServingRequest], List[ServingRequest]]:
+        """Block up to ``poll_s`` for work, then apply the batching window;
+        returns ``(batch, expired)`` — either may be empty. ``expired``
+        are requests whose deadline passed while queued (the caller fails
+        them with the timeout error); they never occupy batch rows."""
+        with self._cond:
+            if not self._queue and not self._stopped:
+                self._cond.wait(poll_s)
+            expired = self._drop_expired()
+            if not self._queue:
+                return [], expired
+            # Batching window, anchored to the OLDEST queued request — but
+            # never waiting past any queued request's deadline: a request
+            # whose deadline falls inside the window closes it early (less
+            # a small margin) so it dispatches in time instead of being
+            # expired by the very wait that was supposed to batch it.
+            window_end = self._queue[0].enqueued_at + self.max_wait_s
+            while not self._stopped:
+                rows = self._queued_rows
+                if rows >= self.max_batch_rows:
+                    break
+                if rows == row_bucket(rows):
+                    break  # bucket exactly full: occupancy 1.0, go now
+                deadlines = [
+                    r.deadline for r in self._queue if r.deadline is not None
+                ]
+                close_at = window_end
+                if deadlines:
+                    close_at = min(close_at, min(deadlines) - 0.005)
+                remaining = close_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            # No re-expiry after the window: a deadline that lapsed DURING
+            # the window (bounded by max_wait_s) rides the batch — the
+            # caller's completion wait carries a grace margin, and
+            # dispatching beats wasting the rows. Requests overdue before
+            # the window (queued behind a busy dispatcher) were dropped
+            # above.
+            batch: List[ServingRequest] = []
+            rows = 0
+            while self._queue:
+                req = self._queue[0]
+                if batch and rows + req.rows > self.max_batch_rows:
+                    break
+                self._queue.popleft()
+                self._queued_rows -= req.rows
+                batch.append(req)
+                rows += req.rows
+                if rows >= self.max_batch_rows:
+                    break
+            return batch, expired
+
+    def _drop_expired(self) -> List[ServingRequest]:
+        now = time.monotonic()
+        expired = [
+            r for r in self._queue if r.deadline is not None and r.deadline <= now
+        ]
+        for r in expired:
+            self._queue.remove(r)
+            self._queued_rows -= r.rows
+        return expired
+
+    # -- shutdown ----------------------------------------------------------
+    def stop(self) -> None:
+        """Refuse new offers; the consumer may keep draining."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    def drain_pending(self) -> List[ServingRequest]:
+        """Pop every queued request (shutdown without drain: the engine
+        fails them with :class:`EngineStoppedError`)."""
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+            return pending

@@ -1,0 +1,609 @@
+"""Online inference engine: micro-batched, hot-swappable, admission-controlled.
+
+The request path, end to end:
+
+  1. ``predict()`` validates the request against the input schema fixed
+     at load time (names, trailing shapes; values are cast to the schema
+     dtypes, so every packed batch hits the SAME fused-cache keys) and
+     offers it to the :class:`~flinkml_tpu.serving.batcher
+     .AdaptiveMicroBatcher`'s bounded queue.
+  2. The dispatcher thread coalesces queued requests into one
+     :class:`~flinkml_tpu.table.Table` and runs the ACTIVE model's
+     ``transform`` — the fused executor compiles per power-of-two row
+     bucket, and the engine precompiled every bucket up to
+     ``max_batch_rows`` at load, so steady state is **zero retraces**
+     (guard-verifiable with
+     :class:`~flinkml_tpu.analysis.guard.TransferRetraceGuard`).
+  3. Output columns are materialized to host once per batch and sliced
+     back per request; each response carries the model **version** that
+     served it.
+
+Hot swap: :meth:`swap_to` loads + warms the new version OFF the serving
+path, then atomically replaces the active-model reference. In-flight
+batches finish on the executable they snapshotted; every later batch
+routes to the new version — zero downtime, zero dropped or mis-versioned
+responses. Same-shape model data reuses the compiled programs outright
+(constants are traced arguments), so a swap costs no steady-state
+recompiles.
+
+Graceful degradation: a full queue either rejects with the typed
+:class:`~flinkml_tpu.serving.errors.ServingOverloadError` or, with
+``shed_on_overload`` (default), serves the request in the CALLER's
+thread through the per-stage host path — slower, but it keeps absorbing
+load without growing the device queue. Requests carry deadlines;
+expiry while queued or in flight raises
+:class:`~flinkml_tpu.serving.errors.ServingTimeoutError`.
+
+Coexistence with training: serving programs are single-device (the fused
+executor is not SPMD today), which cannot interleave a multi-device
+collective rendezvous, so by default the engine dispatches without any
+cross-thread device lock and lives happily beside an in-progress
+``train_*_stream`` on overlapping devices. A model whose transform IS a
+multi-device collective program must be given ``config.mesh``; the
+engine then wraps every batch in
+``parallel.dispatch.local_execution_lock(mesh)`` and time-shares with
+training the same way concurrent fits do (analyzer-verified, FML302).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flinkml_tpu import pipeline_fusion
+from flinkml_tpu.serving.batcher import AdaptiveMicroBatcher, ServingRequest
+from flinkml_tpu.serving.errors import (
+    EngineStoppedError,
+    RegistryError,
+    ServingOverloadError,
+    ServingSchemaError,
+    ServingTimeoutError,
+)
+from flinkml_tpu.serving.registry import ModelRegistry
+from flinkml_tpu.table import Table
+from flinkml_tpu.utils.metrics import metrics
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Engine knobs (see module docstring for the policies they drive).
+
+    ``warmup_row_counts=None`` precompiles every bucket from the minimum
+    up to ``row_bucket(max_batch_rows)`` — full zero-retrace coverage.
+    Pass an explicit tuple to warm fewer (new buckets still compile
+    lazily on first use; the retrace guard's default policy allows
+    new-bucket compiles of a known chain).
+    """
+
+    max_batch_rows: int = 1024
+    max_wait_ms: float = 2.0
+    max_queue_rows: int = 8192
+    default_timeout_ms: Optional[float] = None
+    shed_on_overload: bool = True
+    warmup_row_counts: Optional[Sequence[int]] = None
+    mesh: Optional[Any] = None  # DeviceMesh for SPMD-serving models
+    latency_window: int = 2048  # ring size backing the p50/p99 gauges
+
+
+@dataclasses.dataclass
+class ServingResponse:
+    """One ``predict`` result: output columns (row-sliced to the request),
+    the model version that produced them, and the request's latency."""
+
+    columns: Dict[str, np.ndarray]
+    version: Optional[int]
+    latency_ms: float
+    shed: bool = False
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+
+@dataclasses.dataclass
+class _ActiveModel:
+    version: Optional[int]
+    model: Any
+
+
+class ServingEngine:
+    """See module docstring.
+
+    ``source`` is a :class:`~flinkml_tpu.serving.registry.ModelRegistry`
+    (versioned serving with hot swap) or a fixed transformer stage
+    (registry-less; responses carry ``version=None``). ``example`` fixes
+    the request schema: a small host Table holding exactly the columns
+    clients will send (its rows are tiled for warmup, so make them
+    representative). ``output_cols`` defaults to every column
+    ``transform`` adds to the example.
+    """
+
+    def __init__(
+        self,
+        source: Union[ModelRegistry, Any],
+        example: Table,
+        config: Optional[ServingConfig] = None,
+        output_cols: Optional[Sequence[str]] = None,
+        name: str = "default",
+    ):
+        self.config = config or ServingConfig()
+        self.name = name
+        self._registry = source if isinstance(source, ModelRegistry) else None
+        self._fixed_model = None if self._registry is not None else source
+        self._schema = {
+            n: (np.asarray(example.column(n)).dtype,
+                np.asarray(example.column(n)).shape[1:])
+            for n in example.column_names
+        }
+        self._example = Table({
+            n: np.asarray(example.column(n)) for n in example.column_names
+        })
+        self._output_cols: Optional[Tuple[str, ...]] = (
+            tuple(output_cols) if output_cols is not None else None
+        )
+        self._metrics = metrics.group(f"serving.{name}")
+        self._batcher = AdaptiveMicroBatcher(
+            max_batch_rows=self.config.max_batch_rows,
+            max_wait_s=self.config.max_wait_ms / 1000.0,
+            max_queue_rows=self.config.max_queue_rows,
+        )
+        self._active: Optional[_ActiveModel] = None
+        self._swap_lock = threading.Lock()
+        # Serializes pointer-FOLLOWING swaps (listener delivery + the
+        # follow_registry catch-up): each re-reads CURRENT under this
+        # lock, so racing swap threads converge on the newest pointer
+        # instead of flipping the active model out of order.
+        self._follow_swap_lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self._latencies: collections.deque = collections.deque(
+            maxlen=self.config.latency_window
+        )
+        # Appended by the dispatcher AND by shedding caller threads;
+        # iterating a deque during a concurrent append raises, so both
+        # sides go through _record_latency/_update_latency_gauges.
+        self._lat_lock = threading.Lock()
+        self._following = False       # listener currently registered
+        self._follow_requested = False  # survives stop(): restart re-follows
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def active_version(self) -> Optional[int]:
+        active = self._active
+        return active.version if active else None
+
+    def start(self) -> "ServingEngine":
+        """Load the model (registry: current version), precompile every
+        warmup bucket, and start the dispatcher thread. Returns self."""
+        if self.running:
+            return self
+        if self._batcher._stopped:  # restart after stop(): fresh queue
+            self._batcher = AdaptiveMicroBatcher(
+                max_batch_rows=self.config.max_batch_rows,
+                max_wait_s=self.config.max_wait_ms / 1000.0,
+                max_queue_rows=self.config.max_queue_rows,
+            )
+        if self._registry is not None:
+            version, model = self._registry.get()
+        else:
+            version, model = None, self._fixed_model
+        self._install(version, model)
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._dispatch_loop,
+            name=f"serving-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+        if self._follow_requested:  # re-follow across a stop()/start() cycle
+            self.follow_registry()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop accepting requests; with ``drain`` (default) the
+        dispatcher finishes everything already queued, otherwise queued
+        requests fail with :class:`EngineStoppedError`."""
+        self._batcher.stop()
+        if not drain:
+            for req in self._batcher.drain_pending():
+                req.fail(EngineStoppedError("serving engine stopped"))
+        self._stop_event.set()
+        # Unfollow BEFORE the join (safe regardless of its outcome): a
+        # stopped engine must not keep paying load+warmup in publishing
+        # threads on every registry event.
+        if self._following and self._registry is not None:
+            self._registry.remove_listener(self._on_registry_change)
+            self._following = False
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                # join timed out mid-batch: keep the reference so running
+                # stays True and start() cannot spawn a second dispatcher
+                # over the same batcher while the orphan drains.
+                return
+            self._thread = None
+
+    # -- hot swap ----------------------------------------------------------
+    def swap_to(self, version: Optional[int] = None) -> int:
+        """Load ``version`` (default: the registry's current) and swap it
+        in with zero downtime: the load + per-bucket warmup run in the
+        calling thread while the dispatcher keeps serving the old model;
+        only the final reference flip is atomic. Returns the version."""
+        if self._registry is None:
+            raise RegistryError(
+                "swap_to requires a ModelRegistry-backed engine"
+            )
+        v, model = self._registry.get(version)
+        self._install(v, model)
+        return v
+
+    def follow_registry(self) -> "ServingEngine":
+        """Auto-swap on every registry publish/rollback (the swap —
+        including warmup — runs in the publishing thread)."""
+        if self._registry is None:
+            raise RegistryError(
+                "follow_registry requires a ModelRegistry-backed engine"
+            )
+        self._follow_requested = True
+        if not self._following:
+            self._registry.add_listener(self._on_registry_change)
+            self._following = True
+        # Catch-up swap: a publish that landed between our load and the
+        # listener registration would otherwise never be delivered.
+        self._swap_to_current()
+        return self
+
+    def _on_registry_change(self, version: int) -> None:
+        self._swap_to_current()
+
+    def _swap_to_current(self) -> None:
+        """Install whatever CURRENT points at right now (no-op when it is
+        already active). Re-reading the pointer under the serialization
+        lock makes concurrent deliveries converge on the newest version —
+        a slow catch-up swap cannot overwrite a newer listener swap."""
+        with self._follow_swap_lock:
+            current = self._registry.current_version()
+            if current is None:
+                return
+            active = self._active
+            if active is not None and active.version == current:
+                return
+            v, model = self._registry.get(current)
+            self._install(v, model)
+
+    def _install(self, version: Optional[int], model: Any) -> None:
+        # Warmup dispatches real transforms: SPMD engines (config.mesh)
+        # must hold the mesh lock here too, or the load/swap path would
+        # interleave collective rendezvous with a concurrent trainer —
+        # the same hazard _serve_batch guards against. Single-device
+        # engines get a nullcontext.
+        with self._dispatch_guard():
+            buckets = self._warmup(model)
+        with self._swap_lock:
+            first = self._active is None
+            self._active = _ActiveModel(version, model)
+        if not first:
+            self._metrics.counter("swaps")
+        if version is not None:
+            self._metrics.gauge("active_version", version)
+        self._metrics.gauge("warmed_buckets", float(len(buckets)))
+
+    def _warmup(self, model: Any) -> List[int]:
+        cfg = self.config
+        row_counts = (
+            cfg.warmup_row_counts
+            if cfg.warmup_row_counts is not None
+            else _all_buckets_up_to(cfg.max_batch_rows)
+        )
+        buckets, read = pipeline_fusion.warmup_transform(
+            model, self._example, row_counts,
+            output_cols=self._output_cols or (),
+        )
+        if self._output_cols is None:
+            if not read:  # warmup disabled (empty row_counts): discover
+                (out,) = model.transform(self._example)
+                read = tuple(
+                    c for c in out.column_names
+                    if c not in self._example.column_names
+                )
+            if not read:
+                # A model that only overwrites its input columns in place
+                # defeats added-column discovery — silent empty responses
+                # would be far worse than failing the load.
+                raise ServingSchemaError(
+                    "could not infer output columns: transform adds no new "
+                    "columns to the example (in-place overwrite?); pass "
+                    "output_cols= explicitly"
+                )
+            self._output_cols = read  # discovered during warmup, for free
+        return buckets
+
+    # -- request path ------------------------------------------------------
+    def predict(
+        self,
+        features: Union[Table, Mapping[str, Any]],
+        timeout_ms: Optional[float] = None,
+    ) -> ServingResponse:
+        """Synchronous prediction: enqueue, micro-batch, return the
+        request's slice of the batch output. Thread-safe; call it from as
+        many client threads as you like."""
+        self._check_running()
+        columns, rows = self._normalize(features)
+        t0 = time.monotonic()
+        timeout = (
+            timeout_ms if timeout_ms is not None
+            else self.config.default_timeout_ms
+        )
+        deadline = t0 + timeout / 1000.0 if timeout is not None else None
+        req = ServingRequest(
+            columns=columns, rows=rows, enqueued_at=t0, deadline=deadline
+        )
+        self._metrics.counter("requests")
+        self._metrics.counter("rows", float(rows))
+        if not self._batcher.offer(req):
+            return self._overloaded(req, t0)
+        self._metrics.gauge("queue_depth", self._batcher.queue_depth)
+        remaining = None if deadline is None else max(
+            0.0, deadline - time.monotonic()
+        )
+        # Grace on top of the deadline: the dispatcher expires queued
+        # requests itself; in-flight batches get a moment to finish.
+        if not req.done.wait(None if remaining is None else remaining + 0.25):
+            if req.claim_timeout_count():
+                self._metrics.counter("timeouts")
+            raise ServingTimeoutError(
+                f"request did not complete within {timeout}ms"
+            )
+        if req.error is not None:
+            raise req.error
+        latency_ms = (time.monotonic() - t0) * 1000.0
+        return ServingResponse(
+            columns=req.result, version=req.version,
+            latency_ms=latency_ms, shed=req.shed,
+        )
+
+    def _overloaded(self, req: ServingRequest, t0: float) -> ServingResponse:
+        """Queue-full policy: shed to the per-stage host path in the
+        caller's thread, or reject with the typed overload error. The
+        deadline contract survives shedding: an already-expired request
+        times out instead of blocking the caller on the slower path."""
+        if not self.config.shed_on_overload:
+            self._metrics.counter("rejected")
+            raise ServingOverloadError(
+                f"serving queue full ({self._batcher.max_queue_rows} rows); "
+                "retry with backoff"
+            )
+        if req.deadline is not None and req.deadline <= time.monotonic():
+            if req.claim_timeout_count():
+                self._metrics.counter("timeouts")
+            raise ServingTimeoutError(
+                "request deadline expired at admission (queue saturated)"
+            )
+        self._metrics.counter("shed_requests")
+        active = self._active
+        # Same locking discipline as _serve_batch/_install: an SPMD
+        # engine's per-stage transform still dispatches multi-device
+        # programs, so shedding must not bypass the mesh lock (and the
+        # dispatch stays visible to the FML302 trace audit).
+        with self._dispatch_guard():
+            from flinkml_tpu.parallel import dispatch as _dispatch
+
+            if _dispatch.has_dispatch_observers():
+                _dispatch.record_collective_dispatch(
+                    "serving.shed", self._device_ids()
+                )
+            table = _transform_per_stage(active.model, Table(req.columns))
+            result = {
+                c: np.asarray(table.column(c)) for c in self._output_cols
+            }
+        latency_ms = (time.monotonic() - t0) * 1000.0
+        self._record_latency(latency_ms)
+        return ServingResponse(
+            columns=result, version=active.version,
+            latency_ms=latency_ms, shed=True,
+        )
+
+    def _normalize(
+        self, features: Union[Table, Mapping[str, Any]]
+    ) -> Tuple[Dict[str, np.ndarray], int]:
+        if isinstance(features, Table):
+            features = {n: features.column(n) for n in features.column_names}
+        if set(features.keys()) != set(self._schema.keys()):
+            raise ServingSchemaError(
+                f"request columns {sorted(features.keys())} != schema "
+                f"columns {sorted(self._schema.keys())}"
+            )
+        out: Dict[str, np.ndarray] = {}
+        rows: Optional[int] = None
+        for name, (dtype, trailing) in self._schema.items():
+            a = np.asarray(features[name], dtype=dtype)
+            if a.ndim == len(trailing):  # single row, leading axis omitted
+                a = a[None]
+            if a.shape[1:] != trailing:
+                raise ServingSchemaError(
+                    f"column {name!r} has trailing shape {a.shape[1:]}, "
+                    f"schema expects {trailing}"
+                )
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise ServingSchemaError(
+                    f"column {name!r} has {a.shape[0]} rows, others have "
+                    f"{rows}"
+                )
+            out[name] = a
+        if not rows:
+            raise ServingSchemaError("empty request (zero rows)")
+        if rows > self.config.max_batch_rows:
+            raise ServingSchemaError(
+                f"request has {rows} rows > max_batch_rows "
+                f"{self.config.max_batch_rows}; split it client-side"
+            )
+        return out, rows
+
+    # -- dispatcher --------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch, expired = self._batcher.next_batch(poll_s=0.02)
+            for req in expired:
+                if req.claim_timeout_count():
+                    self._metrics.counter("timeouts")
+                req.fail(ServingTimeoutError(
+                    "request expired while queued (deadline passed before "
+                    "dispatch)"
+                ))
+            if batch:
+                self._serve_batch(batch)
+            elif self._stop_event.is_set() and self._batcher.queue_depth == 0:
+                return
+            self._metrics.gauge("queue_depth", self._batcher.queue_depth)
+
+    def _serve_batch(self, batch: List[ServingRequest]) -> None:
+        active = self._active  # snapshot: in-flight work stays on it
+        rows = sum(r.rows for r in batch)
+        packed = {
+            name: (
+                np.concatenate([r.columns[name] for r in batch])
+                if len(batch) > 1 else batch[0].columns[name]
+            )
+            for name in self._schema
+        }
+        try:
+            table = Table(packed)
+            with self._dispatch_guard():
+                from flinkml_tpu.parallel import dispatch as _dispatch
+
+                if _dispatch.has_dispatch_observers():
+                    # The event carries the lock tokens this thread holds,
+                    # so analysis.collectives.check_dispatch_trace can
+                    # audit serving+training runs (FML302).
+                    _dispatch.record_collective_dispatch(
+                        "serving.batch", self._device_ids()
+                    )
+                (out,) = active.model.transform(table)
+                host = {
+                    c: np.asarray(out.column(c)) for c in self._output_cols
+                }
+        except BaseException as e:  # noqa: BLE001 — fail the batch, not the loop
+            self._metrics.counter("errors")
+            for req in batch:
+                req.fail(e)
+            return
+        bucket = pipeline_fusion.row_bucket(rows)
+        self._metrics.counter("batches")
+        self._metrics.counter("batch_rows", float(rows))
+        self._metrics.counter("batch_padded_rows", float(bucket))
+        self._metrics.gauge("last_batch_occupancy", rows / bucket)
+        now = time.monotonic()
+        offset = 0
+        completions = []
+        for req in batch:
+            # Copies, not views: responses to different clients must not
+            # alias one batch buffer (a client post-processing its arrays
+            # in place would corrupt its batchmates' results).
+            sliced = {
+                c: host[c][offset:offset + req.rows].copy() for c in host
+            }
+            offset += req.rows
+            completions.append((req, sliced))
+        with self._lat_lock:  # one acquisition for the whole batch
+            self._latencies.extend(
+                (now - req.enqueued_at) * 1000.0 for req in batch
+            )
+        # Gauges first, completions second: a client reading stats right
+        # after its predict() returns sees its own request reflected.
+        self._update_latency_gauges()
+        for req, sliced in completions:
+            req.complete(sliced, active.version)
+
+    def _dispatch_guard(self):
+        """Multi-device serving programs time-share devices with training
+        via the mesh lock; single-device programs (the fused executor's
+        output) need no cross-thread lock — see module docstring."""
+        if self.config.mesh is None:
+            return contextlib.nullcontext()
+        from flinkml_tpu.parallel.dispatch import local_execution_lock
+
+        return local_execution_lock(self.config.mesh)
+
+    def _device_ids(self) -> Tuple[int, ...]:
+        if self.config.mesh is not None:
+            mesh = getattr(self.config.mesh, "mesh", self.config.mesh)
+            return tuple(d.id for d in mesh.devices.flatten())
+        import jax
+
+        return (jax.devices()[0].id,)
+
+    def _record_latency(self, latency_ms: float) -> None:
+        with self._lat_lock:
+            self._latencies.append(latency_ms)
+        self._update_latency_gauges()
+
+    def _update_latency_gauges(self) -> None:
+        with self._lat_lock:
+            if not self._latencies:
+                return
+            arr = np.asarray(self._latencies)
+        p50, p99 = np.percentile(arr, [50, 99])  # one sort for both
+        self._metrics.gauge("p50_ms", float(p50))
+        self._metrics.gauge("p99_ms", float(p99))
+
+    def _check_running(self) -> None:
+        if not self.running:
+            raise EngineStoppedError(
+                "serving engine is not running; call start()"
+            )
+
+    # -- observability -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Point-in-time operational snapshot (the stats-endpoint dump)."""
+        snap = self._metrics.snapshot()
+        return {
+            "name": self.name,
+            "running": self.running,
+            "active_version": self.active_version,
+            "queue_depth": self._batcher.queue_depth,
+            "queued_rows": self._batcher.queued_rows,
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+        }
+
+    def stats_text(self) -> str:
+        """Prometheus-style exposition of the whole process registry
+        (:meth:`flinkml_tpu.utils.metrics.MetricsRegistry.render_text`)."""
+        from flinkml_tpu.utils.metrics import default_registry
+
+        return default_registry().render_text()
+
+
+def _all_buckets_up_to(max_rows: int) -> List[int]:
+    buckets = []
+    b = pipeline_fusion.MIN_ROW_BUCKET
+    top = pipeline_fusion.row_bucket(max_rows)
+    while b <= top:
+        buckets.append(b)
+        b *= 2
+    return buckets
+
+
+def _transform_per_stage(model: Any, table: Table) -> Table:
+    """The host (unfused) path: chain each stage's own ``transform``.
+    Identical semantics to ``PipelineModel.transform`` with fusion
+    disabled, without touching the process-wide fusion switch (other
+    threads may be mid-fused-dispatch)."""
+    stages = getattr(model, "stages", None)
+    if stages is None:
+        (out,) = model.transform(table)
+        return out
+    for stage in stages:
+        (table,) = stage.transform(table)
+    return table

@@ -1,0 +1,60 @@
+"""Typed serving errors — the admission-control and registry contract.
+
+Every rejection the online path can hand a client is a *named* error, so
+callers can branch on failure mode (retry on overload, surface timeouts,
+page on integrity failures) instead of parsing messages. The model-data
+integrity error lives with the persistence layer
+(:class:`flinkml_tpu.io.read_write.ModelIntegrityError`) and is re-exported
+here because the registry is where operators meet it.
+"""
+
+from __future__ import annotations
+
+from flinkml_tpu.io.read_write import ModelIntegrityError  # noqa: F401
+
+
+class ServingError(RuntimeError):
+    """Base class of every serving-runtime error."""
+
+
+class ServingOverloadError(ServingError):
+    """The request was rejected at admission: the bounded request queue
+    is full and shedding to the host path is disabled
+    (``ServingConfig.shed_on_overload=False``). Back off and retry."""
+
+
+class ServingTimeoutError(ServingError, TimeoutError):
+    """The request's deadline expired before a result was produced —
+    either while queued (the dispatcher rejects expired requests at
+    batch formation) or while waiting on an in-flight batch."""
+
+
+class EngineStoppedError(ServingError):
+    """The engine is not running (never started, or stopped); queued
+    requests are failed with this at shutdown rather than left hanging."""
+
+
+class ServingSchemaError(ServingError, ValueError):
+    """A request's columns do not match the engine's input schema (names,
+    trailing shapes) fixed by the warmup example at load time."""
+
+
+class RegistryError(RuntimeError):
+    """Base class of model-registry errors."""
+
+
+class ModelVersionNotFoundError(RegistryError, KeyError):
+    """The requested model version does not exist in the registry (or the
+    registry has no published versions yet)."""
+
+
+__all__ = [
+    "ModelIntegrityError",
+    "ServingError",
+    "ServingOverloadError",
+    "ServingTimeoutError",
+    "EngineStoppedError",
+    "ServingSchemaError",
+    "RegistryError",
+    "ModelVersionNotFoundError",
+]

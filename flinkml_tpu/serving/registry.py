@@ -1,0 +1,238 @@
+"""Versioned model registry with an atomic "current" pointer.
+
+Layout (on top of the stage persistence format of
+:mod:`flinkml_tpu.io.read_write` — any save/load-able Stage publishes,
+including whole :class:`~flinkml_tpu.pipeline.PipelineModel` chains)::
+
+    <root>/
+      versions/
+        000001/           # a saved stage directory (metadata + data/)
+        000002/
+      CURRENT             # JSON {"version": 2, "timestamp": ...}
+
+Publication is crash-safe in two steps: the stage saves into a hidden
+temp directory that is ``os.rename``d to its final numbered home (a
+half-written save can never be listed as a version), then ``CURRENT`` is
+replaced atomically (``os.replace`` of a temp file — the symlink-swap
+idiom without symlinks, portable to filesystems that lack them). Readers
+therefore always observe either the old or the new pointer, never a torn
+state — the property the serving engine's zero-downtime hot swap rests
+on.
+
+Integrity: every model saved through ``Model._save_with_arrays`` records
+a sha256 content fingerprint in its metadata, and :meth:`ModelRegistry.get`
+loads through the standard stage loader, which verifies it — a corrupt or
+tampered snapshot raises
+:class:`~flinkml_tpu.io.read_write.ModelIntegrityError` instead of being
+swapped into a live engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import warnings
+from typing import Any, Callable, List, Optional, Tuple
+
+from flinkml_tpu.io import read_write
+from flinkml_tpu.serving.errors import (
+    ModelVersionNotFoundError,
+    RegistryError,
+)
+from flinkml_tpu.utils.metrics import metrics
+
+CURRENT_FILE = "CURRENT"
+VERSIONS_DIR = "versions"
+_TMP_PREFIX = ".tmp-"
+
+
+class ModelRegistry:
+    """Thread-safe versioned store of published models.
+
+    ``publish`` assigns monotonically increasing integer versions (or
+    honors an explicit one), ``get`` loads the current (or a pinned)
+    version, ``rollback`` repoints ``CURRENT`` at an existing older
+    version without touching its files. Listeners registered via
+    :meth:`add_listener` are invoked with the new current version after
+    every successful publish/rollback — the serving engine's auto-swap
+    hook.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._versions_root = os.path.join(root, VERSIONS_DIR)
+        os.makedirs(self._versions_root, exist_ok=True)
+        self._lock = threading.RLock()
+        self._notify_lock = threading.Lock()
+        self._listeners: List[Callable[[int], None]] = []
+        self._metrics = metrics.group("serving.registry")
+
+    # -- introspection -----------------------------------------------------
+    def versions(self) -> List[int]:
+        """Sorted list of published version numbers (complete saves only:
+        a version exists once its directory has stage metadata)."""
+        out = []
+        for name in os.listdir(self._versions_root):
+            if name.startswith(_TMP_PREFIX) or not name.isdigit():
+                continue
+            if os.path.exists(os.path.join(
+                    self._versions_root, name, read_write.METADATA_FILE)):
+                out.append(int(name))
+        return sorted(out)
+
+    def current_version(self) -> Optional[int]:
+        """The version ``CURRENT`` points at, or None before any publish."""
+        try:
+            with open(os.path.join(self.root, CURRENT_FILE)) as f:
+                return int(json.load(f)["version"])
+        except FileNotFoundError:
+            return None
+
+    def path_of(self, version: int) -> str:
+        return os.path.join(self._versions_root, f"{int(version):06d}")
+
+    # -- writes ------------------------------------------------------------
+    def publish(self, stage: Any, version: Optional[int] = None) -> int:
+        """Save ``stage`` as a new version and repoint ``CURRENT`` at it.
+
+        Returns the assigned version. The version number is claimed by an
+        atomic ``mkdir`` of the final directory — safe against concurrent
+        publishers in other THREADS and other PROCESSES sharing the
+        registry root (e.g. per-rank SnapshotPublishers): a taken number
+        bumps to the next free one. The save lands in a temp directory
+        renamed over the (empty) claimed directory, so readers never see
+        a partial version; the pointer flip is atomic (concurrent
+        cross-process publishes leave CURRENT at whichever publish
+        flipped it last). Raises :class:`RegistryError` when an explicit
+        ``version`` already exists."""
+        with self._lock:
+            v = None if version is None else int(version)
+            candidate = v
+            if candidate is None:
+                existing = self.versions()
+                candidate = existing[-1] + 1 if existing else 1
+            while True:
+                final = self.path_of(candidate)
+                try:
+                    os.mkdir(final)  # atomic cross-process claim
+                    break
+                except FileExistsError:
+                    if v is not None:
+                        raise RegistryError(
+                            f"version {v} already exists in registry "
+                            f"{self.root}"
+                        )
+                    candidate += 1
+            v = candidate
+            tmp = os.path.join(self._versions_root, f"{_TMP_PREFIX}{v:06d}")
+            if os.path.exists(tmp):  # leftover of a crashed publish
+                shutil.rmtree(tmp)
+            try:
+                stage.save(tmp)
+                # POSIX rename onto an existing EMPTY directory: the
+                # claimed placeholder becomes the complete save in one
+                # atomic step.
+                os.rename(tmp, final)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                try:
+                    os.rmdir(final)  # release the claim
+                except OSError:
+                    pass  # surface the original failure, not the cleanup's
+                raise
+            self._set_current(v)
+            self._metrics.counter("publishes")
+            self._metrics.gauge("current_version", v)
+        self._notify()
+        return v
+
+    def rollback(self, version: int) -> int:
+        """Repoint ``CURRENT`` at an existing ``version`` (no files are
+        deleted — rolling forward again is another rollback)."""
+        with self._lock:
+            v = int(version)
+            if v not in self.versions():
+                raise ModelVersionNotFoundError(
+                    f"version {v} not in registry {self.root} "
+                    f"(has {self.versions()})"
+                )
+            self._set_current(v)
+            self._metrics.counter("rollbacks")
+            self._metrics.gauge("current_version", v)
+        self._notify()
+        return v
+
+    # -- reads -------------------------------------------------------------
+    def get(self, version: Optional[int] = None) -> Tuple[int, Any]:
+        """Load ``(version, stage)`` — the current version by default.
+
+        Loading goes through the standard reflective stage loader, so
+        every model with a recorded content fingerprint is verified
+        (:class:`~flinkml_tpu.io.read_write.ModelIntegrityError` on
+        mismatch)."""
+        with self._lock:
+            v = int(version) if version is not None else self.current_version()
+            if v is None:
+                raise ModelVersionNotFoundError(
+                    f"registry {self.root} has no published versions"
+                )
+            path = self.path_of(v)
+            if not os.path.exists(os.path.join(path,
+                                               read_write.METADATA_FILE)):
+                raise ModelVersionNotFoundError(
+                    f"version {v} not in registry {self.root} "
+                    f"(has {self.versions()})"
+                )
+        stage = read_write.load_stage(path)
+        self._metrics.counter("loads")
+        return v, stage
+
+    # -- change notification -----------------------------------------------
+    def add_listener(self, callback: Callable[[int], None]) -> None:
+        """Register ``callback(current_version)`` for publish/rollback
+        events. Delivery is serialized and reads the CURRENT pointer at
+        delivery time (concurrent publishes may coalesce into repeated
+        notifications of the latest version, but a stale version can
+        never be delivered after a newer one). Callbacks run in the
+        publishing thread; an exception in one callback is reported as a
+        warning (and a ``listener_errors`` counter) rather than unwinding
+        into the publisher — the registry state is already committed."""
+        self._listeners.append(callback)
+
+    def remove_listener(self, callback: Callable[[int], None]) -> None:
+        self._listeners.remove(callback)
+
+    def _notify(self) -> None:
+        with self._notify_lock:
+            # Read the pointer INSIDE the delivery lock: every delivery
+            # happens-after its read, so the last delivery in lock order
+            # carries the newest pointer — out-of-order publish threads
+            # cannot leave a follower on a stale version.
+            version = self.current_version()
+            for cb in list(self._listeners):
+                try:
+                    cb(version)
+                except Exception as e:  # noqa: BLE001 — isolate listeners
+                    self._metrics.counter("listener_errors")
+                    warnings.warn(
+                        f"registry listener {cb!r} failed for version "
+                        f"{version}: {e!r} (registry state is committed; "
+                        "the publishing thread continues)",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+
+    def _set_current(self, version: int) -> None:
+        tmp = os.path.join(self.root, CURRENT_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(
+                {"version": int(version),
+                 "timestamp": int(time.time() * 1000)},
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, CURRENT_FILE))

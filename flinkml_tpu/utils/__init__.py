@@ -14,6 +14,7 @@ from flinkml_tpu.utils.metrics import (
     Meter,
     MetricGroup,
     MetricsRegistry,
+    default_registry,
     metrics,
 )
 from flinkml_tpu.utils.profiling import (
@@ -27,6 +28,7 @@ __all__ = [
     "Meter",
     "MetricGroup",
     "MetricsRegistry",
+    "default_registry",
     "metrics",
     "StepTimer",
     "annotate",

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import collections
 import json
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -117,13 +118,92 @@ class MetricsRegistry:
     def dump_json(self) -> str:
         return json.dumps(self.snapshot(), default=str, sort_keys=True)
 
+    def render_text(self) -> str:
+        """Prometheus-style text exposition of every group's counters,
+        numeric gauges, and meter rates — one sample line per metric with
+        the group as a label, e.g.::
+
+            # TYPE flinkml_requests counter
+            flinkml_requests{group="serving.default"} 128
+
+        Counters render as ``counter``, gauges and meter rates as
+        ``gauge`` (rates under ``<name>_rate``). Non-numeric gauges and
+        histories are skipped (histories are unbounded series — scrape
+        :meth:`snapshot` for those). Output is sorted, so diffs are
+        stable. This backs the serving engine's stats dump; wire it to
+        an HTTP endpoint for a real scrape target.
+        """
+        with self._lock:
+            groups = dict(self._groups)
+        # metric name -> (prom type, [(group label, value)])
+        samples: Dict[str, Any] = {}
+
+        def add(name: str, kind: str, group: str, value: float) -> None:
+            # A Prometheus metric family has ONE type: the same name used
+            # as a counter in one group and a gauge in another would emit
+            # a mistyped series — the later kind moves to a kind-suffixed
+            # family instead (deterministic: groups are visited sorted).
+            entry = samples.get(name)
+            if entry is not None and entry[0] != kind:
+                name = f"{name}_{kind}"
+                entry = samples.get(name)
+            if entry is None:
+                entry = samples.setdefault(name, (kind, []))
+            entry[1].append((group, value))
+
+        for gname, g in sorted(groups.items()):
+            snap = g.snapshot()
+            for k, v in snap["counters"].items():
+                add(f"flinkml_{_sanitize(k)}", "counter", gname, v)
+            for k, v in snap["gauges"].items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                add(f"flinkml_{_sanitize(k)}", "gauge", gname, v)
+            for k, rate in snap["meters"].items():
+                add(f"flinkml_{_sanitize(k)}_rate", "gauge", gname, rate)
+        lines: List[str] = []
+        for name in sorted(samples):
+            kind, values = samples[name]
+            lines.append(f"# TYPE {name} {kind}")
+            for group, value in sorted(values):
+                label = _escape_label(group)
+                # Full precision: '%g' would truncate counters past 6
+                # significant digits (1_234_567 -> 1.23457e+06).
+                rendered = (
+                    str(int(value)) if float(value).is_integer()
+                    else repr(float(value))
+                )
+                lines.append(f'{name}{{group="{label}"}} {rendered}')
+        return "\n".join(lines) + ("\n" if lines else "")
+
     def reset(self) -> None:
         with self._lock:
             self._groups.clear()
 
 
+def _sanitize(name: str) -> str:
+    """Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-VALUE escaping: backslash, double quote, newline."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 #: Default process-wide registry (import-and-use, like Flink's).
 metrics = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide :data:`metrics` registry — the scrape root for
+    exposition (``default_registry().render_text()``)."""
+    return metrics
 
 
 class EpochMetricsListener(IterationListener):

@@ -73,3 +73,64 @@ def test_model_arrays_round_trip(tmp_path):
     back = read_write.load_model_arrays(p)
     assert np.array_equal(back["coef"], arrays["coef"])
     assert back["intercept"][0] == 1.5
+
+
+def test_content_fingerprint_deterministic_and_sensitive():
+    a = {"coef": np.arange(5.0), "b": np.array([1.5])}
+    fp = read_write.content_fingerprint(a, {"p": 1})
+    assert fp == read_write.content_fingerprint(
+        {"b": np.array([1.5]), "coef": np.arange(5.0)}, {"p": 1}
+    )  # name order irrelevant
+    assert fp != read_write.content_fingerprint(a, {"p": 2})  # params count
+    tampered = {"coef": np.arange(5.0), "b": np.array([1.5000001])}
+    assert fp != read_write.content_fingerprint(tampered, {"p": 1})
+    # dtype/shape changes with identical bytes still change the hash
+    assert fp != read_write.content_fingerprint(
+        {"coef": np.arange(5.0).reshape(5, 1), "b": np.array([1.5])}, {"p": 1}
+    )
+
+
+def test_save_tamper_load_raises_integrity_error(tmp_path):
+    """save → tamper → load: models persisted via _save_with_arrays record
+    a content fingerprint; a bit flip in the arrays fails the load with
+    the named error (the serving registry's integrity guarantee)."""
+    from flinkml_tpu.models.kmeans import KMeansModel
+    from flinkml_tpu.table import Table
+
+    m = KMeansModel().set(KMeansModel.FEATURES_COL, "f")
+    m.set_model_data(Table({"centroids": np.ones((1, 3, 2))}))
+    p = str(tmp_path / "model")
+    m.save(p)
+    meta = read_write.load_metadata(p)
+    assert read_write.FINGERPRINT_KEY in meta
+    assert KMeansModel.load(p).centroids.shape == (3, 2)  # clean load OK
+    assert read_write.verify_fingerprint(p) == meta[read_write.FINGERPRINT_KEY]
+
+    arrays = read_write.load_model_arrays(p)
+    arrays["centroids"][0, 0] += 1.0
+    os.remove(os.path.join(p, read_write.MODEL_DATA_DIR, "model.npz"))
+    read_write.save_model_arrays(p, arrays)
+    with pytest.raises(read_write.ModelIntegrityError):
+        KMeansModel.load(p)
+    with pytest.raises(read_write.ModelIntegrityError):
+        read_write.verify_fingerprint(p)
+
+
+def test_pre_fingerprint_saves_still_load(tmp_path):
+    """Metadata without a recorded fingerprint (older saves) loads
+    without verification — forward compatibility, not a hard break."""
+    from flinkml_tpu.models.kmeans import KMeansModel
+    from flinkml_tpu.table import Table
+
+    m = KMeansModel().set(KMeansModel.FEATURES_COL, "f")
+    m.set_model_data(Table({"centroids": np.ones((1, 2, 2))}))
+    p = str(tmp_path / "model")
+    m.save(p)
+    meta_path = os.path.join(p, read_write.METADATA_FILE)
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta[read_write.FINGERPRINT_KEY]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    assert KMeansModel.load(p).centroids.shape == (2, 2)
+    assert read_write.verify_fingerprint(p) is None

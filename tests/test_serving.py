@@ -1,0 +1,517 @@
+"""Serving subsystem units: registry, batcher, engine, publisher.
+
+The contracts under test:
+
+  1. ModelRegistry: monotone versions, atomic CURRENT pointer,
+     publish/get/rollback, listener notification, and fingerprint-verified
+     loads (save → tamper → load raises ModelIntegrityError).
+  2. AdaptiveMicroBatcher: coalescing up to the bucket / max-wait window,
+     FIFO whole-request batches, bounded admission, deadline expiry.
+  3. ServingEngine: responses bitwise-equal to direct transform, version
+     tagging, schema validation, hot swap (old in-flight batches finish on
+     the old version), warmup precompilation, stats exposition.
+  4. SnapshotPublisher: mid-stream publication cadence from iterate()'s
+     unbounded mode and from train_kmeans_stream's listener hook.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flinkml_tpu import pipeline_fusion
+from flinkml_tpu.io import read_write
+from flinkml_tpu.models.kmeans import KMeansModel
+from flinkml_tpu.models.logistic_regression import LogisticRegression
+from flinkml_tpu.models.scalers import StandardScaler
+from flinkml_tpu.pipeline import PipelineModel
+from flinkml_tpu.serving import (
+    AdaptiveMicroBatcher,
+    EngineStoppedError,
+    ModelIntegrityError,
+    ModelRegistry,
+    ModelVersionNotFoundError,
+    RegistryError,
+    ServingConfig,
+    ServingEngine,
+    ServingRequest,
+    ServingSchemaError,
+    SnapshotPublisher,
+)
+from flinkml_tpu.table import Table
+
+
+def _data(n=120, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    return x, y
+
+
+def _fitted_pipeline(x, y):
+    train = Table({"features": x, "label": y})
+    sc = (
+        StandardScaler()
+        .set(StandardScaler.INPUT_COL, "features")
+        .set(StandardScaler.OUTPUT_COL, "scaled")
+        .fit(train)
+    )
+    (t2,) = sc.transform(train)
+    lr = (
+        LogisticRegression()
+        .set(LogisticRegression.FEATURES_COL, "scaled")
+        .set(LogisticRegression.LABEL_COL, "label")
+        .set_max_iter(3)
+        .fit(t2)
+    )
+    return PipelineModel([sc, lr])
+
+
+@pytest.fixture
+def pipeline_and_data():
+    x, y = _data()
+    return _fitted_pipeline(x, y), x
+
+
+def _engine(source, x, **cfg):
+    config = ServingConfig(**{
+        "max_batch_rows": 64,
+        "max_queue_rows": 256,
+        "warmup_row_counts": (1, 64),
+        **cfg,
+    })
+    return ServingEngine(
+        source, Table({"features": x[:4]}), config,
+        output_cols=("prediction", "rawPrediction"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. ModelRegistry
+# ---------------------------------------------------------------------------
+
+def test_registry_publish_get_rollback(tmp_path, pipeline_and_data):
+    pm, x = pipeline_and_data
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    assert reg.current_version() is None
+    assert reg.versions() == []
+    with pytest.raises(ModelVersionNotFoundError):
+        reg.get()
+
+    v1 = reg.publish(pm)
+    assert (v1, reg.current_version(), reg.versions()) == (1, 1, [1])
+    v2 = reg.publish(pm)
+    assert (v2, reg.current_version(), reg.versions()) == (2, 2, [1, 2])
+
+    got_v, loaded = reg.get()
+    assert got_v == 2
+    t = Table({"features": x[:7]})
+    np.testing.assert_array_equal(
+        pm.transform(t)[0].column("prediction"),
+        loaded.transform(t)[0].column("prediction"),
+    )
+
+    assert reg.rollback(1) == 1
+    assert reg.current_version() == 1
+    assert reg.versions() == [1, 2]  # rollback deletes nothing
+    with pytest.raises(ModelVersionNotFoundError):
+        reg.rollback(99)
+    with pytest.raises(RegistryError):
+        reg.publish(pm, version=2)  # explicit collision
+
+
+def test_registry_notifies_listeners(tmp_path, pipeline_and_data):
+    pm, _ = pipeline_and_data
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    seen = []
+    reg.add_listener(seen.append)
+    reg.publish(pm)
+    reg.publish(pm)
+    reg.rollback(1)
+    assert seen == [1, 2, 1]
+    reg.remove_listener(seen.append)
+    reg.publish(pm)
+    assert seen == [1, 2, 1]
+
+
+def test_registry_listener_exception_does_not_break_publish(
+    tmp_path, pipeline_and_data
+):
+    """A failing follower (e.g. an engine whose swap raises) must not
+    unwind into the publishing/training thread: the publish is already
+    committed; the failure surfaces as a warning + counter, and every
+    other listener still fires."""
+    pm, _ = pipeline_and_data
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    seen = []
+
+    def bad(version):
+        raise RuntimeError("boom")
+
+    reg.add_listener(bad)
+    reg.add_listener(seen.append)
+    with pytest.warns(RuntimeWarning, match="boom"):
+        assert reg.publish(pm) == 1
+    assert seen == [1]
+    assert reg.current_version() == 1
+
+
+def test_registry_tampered_model_fails_load(tmp_path, pipeline_and_data):
+    """save → tamper → load: a bit flip in any stage's persisted model
+    arrays must surface as ModelIntegrityError, not silent corruption."""
+    pm, _ = pipeline_and_data
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v = reg.publish(pm)
+    # Rewrite stage 0's (the scaler's) model data with altered values.
+    stage_dir = read_write.stage_path(reg.path_of(v), 0)
+    arrays = read_write.load_model_arrays(stage_dir)
+    arrays["mean"] = arrays["mean"] + 1.0
+    import os
+    os.remove(os.path.join(stage_dir, read_write.MODEL_DATA_DIR, "model.npz"))
+    read_write.save_model_arrays(stage_dir, arrays)
+    with pytest.raises(ModelIntegrityError):
+        reg.get(v)
+
+
+# ---------------------------------------------------------------------------
+# 2. AdaptiveMicroBatcher
+# ---------------------------------------------------------------------------
+
+def _req(rows, deadline=None):
+    return ServingRequest(
+        columns={"x": np.zeros((rows, 2))},
+        rows=rows,
+        enqueued_at=time.monotonic(),
+        deadline=deadline,
+    )
+
+
+def test_batcher_coalesces_within_window():
+    b = AdaptiveMicroBatcher(max_batch_rows=64, max_wait_s=0.2,
+                             max_queue_rows=256)
+    for _ in range(3):
+        assert b.offer(_req(2))
+    batch, expired = b.next_batch(poll_s=0.01)
+    # 6 rows < bucket 8: the window waits max_wait for company, then
+    # dispatches all three together.
+    assert [r.rows for r in batch] == [2, 2, 2]
+    assert expired == []
+
+
+def test_batcher_dispatches_early_when_bucket_fills():
+    b = AdaptiveMicroBatcher(max_batch_rows=64, max_wait_s=30.0,
+                             max_queue_rows=256)
+    b.offer(_req(5))
+    b.offer(_req(3))  # 8 rows == bucket(8): occupancy 1.0
+    t0 = time.monotonic()
+    batch, _ = b.next_batch(poll_s=0.01)
+    assert [r.rows for r in batch] == [5, 3]
+    assert time.monotonic() - t0 < 5.0  # did NOT wait the 30s window
+
+
+def test_batcher_never_splits_and_respects_max_rows():
+    b = AdaptiveMicroBatcher(max_batch_rows=8, max_wait_s=0.0,
+                             max_queue_rows=64)
+    b.offer(_req(5))
+    b.offer(_req(5))  # would overflow max_batch_rows together
+    batch, _ = b.next_batch()
+    assert [r.rows for r in batch] == [5]
+    batch, _ = b.next_batch()
+    assert [r.rows for r in batch] == [5]
+
+
+def test_batcher_bounded_admission_and_stop():
+    b = AdaptiveMicroBatcher(max_batch_rows=8, max_wait_s=0.0,
+                             max_queue_rows=8)
+    assert b.offer(_req(8))
+    assert not b.offer(_req(1))  # full
+    b.stop()
+    with pytest.raises(EngineStoppedError):
+        b.offer(_req(1))
+    assert [r.rows for r in b.drain_pending()] == [8]
+    assert b.queue_depth == 0
+
+
+def test_batcher_window_closes_before_queued_deadline():
+    """A lone request whose deadline falls INSIDE the max-wait window must
+    be dispatched in time, not expired by the very wait that was supposed
+    to batch it."""
+    b = AdaptiveMicroBatcher(max_batch_rows=64, max_wait_s=5.0,
+                             max_queue_rows=256)
+    b.offer(_req(2, deadline=time.monotonic() + 0.05))
+    t0 = time.monotonic()
+    batch, expired = b.next_batch(poll_s=0.01)
+    assert [r.rows for r in batch] == [2]
+    assert expired == []
+    assert time.monotonic() - t0 < 2.0  # closed at the deadline, not 5s
+
+
+def test_batcher_expires_overdue_requests():
+    b = AdaptiveMicroBatcher(max_batch_rows=8, max_wait_s=0.0,
+                             max_queue_rows=64)
+    b.offer(_req(2, deadline=time.monotonic() - 1.0))  # already expired
+    b.offer(_req(3))
+    batch, expired = b.next_batch(poll_s=0.01)
+    assert [r.rows for r in expired] == [2]
+    assert [r.rows for r in batch] == [3]
+
+
+# ---------------------------------------------------------------------------
+# 3. ServingEngine
+# ---------------------------------------------------------------------------
+
+def test_engine_parity_and_response_shape(pipeline_and_data):
+    pm, x = pipeline_and_data
+    eng = _engine(pm, x).start()
+    try:
+        (ref,) = pm.transform(Table({"features": x[:9]}))
+        resp = eng.predict({"features": x[:9]})
+        assert resp.version is None  # fixed-model engine: unversioned
+        for c in ("prediction", "rawPrediction"):
+            np.testing.assert_array_equal(ref.column(c), resp.column(c))
+        # Single row with the leading axis omitted.
+        one = eng.predict({"features": x[0]})
+        np.testing.assert_array_equal(
+            ref.column("prediction")[:1], one.column("prediction")
+        )
+        assert one.latency_ms >= 0.0
+    finally:
+        eng.stop()
+
+
+def test_engine_schema_validation(pipeline_and_data):
+    pm, x = pipeline_and_data
+    eng = _engine(pm, x).start()
+    try:
+        with pytest.raises(ServingSchemaError):
+            eng.predict({"wrong": x[:2]})
+        with pytest.raises(ServingSchemaError):
+            eng.predict({"features": x[:2, :3]})  # wrong trailing dim
+        with pytest.raises(ServingSchemaError):
+            eng.predict({"features": x[:0]})  # empty
+        with pytest.raises(ServingSchemaError):
+            eng.predict({"features": np.zeros((65, x.shape[1]))})  # > max
+    finally:
+        eng.stop()
+
+
+def test_engine_serves_deadline_inside_batch_window(pipeline_and_data):
+    """Idle server, long batching window, short request deadline: the
+    window must close early and serve the request before it expires."""
+    pm, x = pipeline_and_data
+    eng = _engine(pm, x, max_wait_ms=5000.0).start()
+    try:
+        resp = eng.predict({"features": x[:2]}, timeout_ms=500)
+        assert resp.columns["prediction"].shape == (2,)
+    finally:
+        eng.stop()
+
+
+def test_engine_rejects_undiscoverable_output_cols():
+    """In-place overwrite (OUTPUT_COL == INPUT_COL) defeats added-column
+    discovery; the engine must fail the load, not serve empty responses."""
+    x, y = _data()
+    train = Table({"features": x})
+    sc = (
+        StandardScaler()
+        .set(StandardScaler.INPUT_COL, "features")
+        .set(StandardScaler.OUTPUT_COL, "features")
+        .fit(train)
+    )
+    eng = ServingEngine(
+        sc, Table({"features": x[:4]}),
+        ServingConfig(max_batch_rows=64, warmup_row_counts=(1,)),
+    )
+    with pytest.raises(ServingSchemaError, match="output columns"):
+        eng.start()
+
+
+def test_engine_follow_registry_catches_up(tmp_path):
+    """A publish landing before follow_registry() is delivered by the
+    registration-time catch-up swap, not lost."""
+    x, y = _data()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(_fitted_pipeline(x, y))
+    eng = _engine(reg, x).start()          # loads v1
+    try:
+        reg.publish(_fitted_pipeline(x, -y + 1))  # lands unobserved
+        assert eng.active_version == 1
+        eng.follow_registry()              # catch-up swap to v2
+        assert eng.active_version == 2
+    finally:
+        eng.stop()
+
+
+def test_engine_requires_start(pipeline_and_data):
+    pm, x = pipeline_and_data
+    eng = _engine(pm, x)
+    with pytest.raises(EngineStoppedError):
+        eng.predict({"features": x[:2]})
+
+
+def test_engine_warmup_precompiles_buckets(pipeline_and_data):
+    """After start(), serving row counts within warmed buckets compiles
+    nothing: the engine paid every compile at load."""
+    pm, x = pipeline_and_data
+    pipeline_fusion.reset_cache()
+    eng = _engine(pm, x, warmup_row_counts=None).start()  # all buckets
+    try:
+        compiled = []
+        pipeline_fusion.on_compile.append(compiled.append)
+        try:
+            for rows in (1, 3, 8, 9, 17, 33, 64):
+                eng.predict({"features": np.resize(x, (rows, x.shape[1]))})
+        finally:
+            pipeline_fusion.on_compile.remove(compiled.append)
+        assert compiled == []
+    finally:
+        eng.stop()
+
+
+def test_engine_hot_swap_routes_new_requests(tmp_path):
+    x, y = _data()
+    pm1 = _fitted_pipeline(x, y)
+    pm2 = _fitted_pipeline(x, -y + 1)  # different fit, same shapes
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(pm1)
+    eng = _engine(reg, x).start()
+    try:
+        r1 = eng.predict({"features": x[:5]})
+        assert r1.version == 1
+        v2 = reg.publish(pm2)
+        assert eng.active_version == 1  # not following: explicit swap
+        assert eng.swap_to() == v2
+        r2 = eng.predict({"features": x[:5]})
+        assert r2.version == 2
+        np.testing.assert_array_equal(
+            pm2.transform(Table({"features": x[:5]}))[0].column("prediction"),
+            r2.column("prediction"),
+        )
+    finally:
+        eng.stop()
+
+
+def test_engine_follow_registry_auto_swaps(tmp_path):
+    x, y = _data()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(_fitted_pipeline(x, y))
+    eng = _engine(reg, x).start().follow_registry()
+    try:
+        reg.publish(_fitted_pipeline(x, -y + 1))
+        assert eng.active_version == 2
+        assert eng.predict({"features": x[:3]}).version == 2
+        reg.rollback(1)
+        assert eng.active_version == 1
+        # Following survives a stop()/start() cycle.
+        eng.stop()
+        eng.start()
+        reg.rollback(2)
+        assert eng.active_version == 2
+    finally:
+        eng.stop()
+
+
+def test_engine_stop_drains_and_rejects(pipeline_and_data):
+    pm, x = pipeline_and_data
+    eng = _engine(pm, x).start()
+    eng.stop()
+    with pytest.raises(EngineStoppedError):
+        eng.predict({"features": x[:2]})
+    # Restartable: a stopped engine can come back with a fresh queue.
+    eng.start()
+    try:
+        assert eng.predict({"features": x[:2]}).columns
+    finally:
+        eng.stop()
+
+
+def test_engine_stats_and_exposition(pipeline_and_data):
+    pm, x = pipeline_and_data
+    eng = ServingEngine(
+        pm, Table({"features": x[:4]}),
+        ServingConfig(max_batch_rows=64, warmup_row_counts=(1,)),
+        output_cols=("prediction",), name="statstest",
+    ).start()
+    try:
+        eng.predict({"features": x[:6]})
+        stats = eng.stats()
+        assert stats["counters"]["requests"] >= 1
+        assert stats["counters"]["batches"] >= 1
+        assert "p50_ms" in stats["gauges"]
+        text = eng.stats_text()
+        assert "# TYPE flinkml_requests counter" in text
+        assert 'flinkml_requests{group="serving.statstest"}' in text
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. SnapshotPublisher
+# ---------------------------------------------------------------------------
+
+def _kmeans_model(centroids):
+    m = KMeansModel().set(KMeansModel.FEATURES_COL, "features")
+    m.set_model_data(
+        Table({"centroids": np.asarray(centroids, np.float64)[None]})
+    )
+    return m
+
+
+def test_publisher_cadence_in_unbounded_iterate(tmp_path):
+    from flinkml_tpu.iteration import Iterations
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    pub = SnapshotPublisher(
+        reg, _kmeans_model, every_n_epochs=2, publish_on_terminate=True
+    )
+
+    def step(state, batch, epoch):
+        return state + batch, None
+
+    stream = [np.ones((3, 2)) * i for i in range(5)]  # 5 epochs
+    Iterations.iterate_unbounded_streams(
+        step, np.zeros((3, 2)), stream, listeners=[pub]
+    )
+    # Epochs 1 and 3 publish on cadence; epoch 4 (final) on terminate.
+    assert [e for e, _ in pub.published] == [1, 3, 4]
+    assert reg.versions() == [1, 2, 3]
+    assert reg.current_version() == 3
+
+
+def test_publisher_skips_duplicate_terminal_snapshot(tmp_path):
+    from flinkml_tpu.iteration import Iterations
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    pub = SnapshotPublisher(reg, _kmeans_model, every_n_epochs=2)
+
+    def step(state, batch, epoch):
+        return state + batch, None
+
+    stream = [np.ones((2, 2))] * 4  # 4 epochs: epoch 3 publishes on cadence
+    Iterations.iterate_unbounded_streams(
+        step, np.zeros((2, 2)), stream, listeners=[pub]
+    )
+    assert [e for e, _ in pub.published] == [1, 3]  # no duplicate terminal
+
+
+def test_publisher_from_kmeans_stream(tmp_path):
+    """The train_*_stream hook: a live Lloyd loop emits registry versions
+    mid-stream, and the published centroids match the run's trajectory."""
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+    from flinkml_tpu.parallel import DeviceMesh
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    batches = [{"x": x[i::4]} for i in range(4)]
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    pub = SnapshotPublisher(reg, _kmeans_model, every_n_epochs=2)
+    final = train_kmeans_stream(
+        batches, k=3, mesh=DeviceMesh(), max_iter=4, seed=0,
+        listeners=[pub],
+    )
+    assert [e for e, _ in pub.published] == [1, 3]
+    assert reg.versions() == [1, 2]
+    _, last = reg.get()
+    np.testing.assert_array_equal(np.asarray(last.centroids, np.float32),
+                                  final)

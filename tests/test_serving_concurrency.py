@@ -1,0 +1,381 @@
+"""Serving engine under concurrency: parity, retraces, liveness, overload.
+
+The acceptance contract of the serving subsystem:
+
+  1. ≥8 concurrent client threads get responses bitwise-identical to a
+     single-request direct ``transform`` — micro-batch packing, bucket
+     padding, and per-request slicing are invisible to clients.
+  2. Steady state is zero-retrace: after the engine's load-time warmup,
+     no fused-cache compile happens no matter how requests are packed
+     (``no_retrace`` marker + TransferRetraceGuard).
+  3. Serving coexists with a concurrently running ``train_kmeans_stream``
+     over overlapping devices — no deadlock, and the recorded dispatch
+     trace passes the analyzer's FML302 collective-interleaving check.
+  4. Saturation degrades gracefully: a full bounded queue either sheds to
+     the host path (correct results, ``shed=True``) or rejects with the
+     typed overload error; deadlines produce ServingTimeoutError.
+  5. Hot swap mid-traffic: every response carries the version that served
+     it, and responses verify bitwise against THAT version's model — no
+     dropped and no mis-versioned responses across the swap.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flinkml_tpu import pipeline_fusion
+from flinkml_tpu.api import AlgoOperator
+from flinkml_tpu.models.logistic_regression import LogisticRegression
+from flinkml_tpu.models.scalers import MinMaxScaler, StandardScaler
+from flinkml_tpu.pipeline import PipelineModel
+from flinkml_tpu.serving import (
+    ModelRegistry,
+    ServingConfig,
+    ServingEngine,
+    ServingOverloadError,
+    ServingTimeoutError,
+)
+from flinkml_tpu.table import Table
+
+
+def _data(n=200, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    return x, y
+
+
+def _three_stage_chain(x, y):
+    """features -> scaled -> squashed -> prediction, all kernel-capable
+    (fuses into one program per bucket)."""
+    train = Table({"features": x, "label": y})
+    sc = (
+        StandardScaler()
+        .set(StandardScaler.INPUT_COL, "features")
+        .set(StandardScaler.OUTPUT_COL, "scaled")
+        .fit(train)
+    )
+    (t2,) = sc.transform(train)
+    mm = (
+        MinMaxScaler()
+        .set(MinMaxScaler.INPUT_COL, "scaled")
+        .set(MinMaxScaler.OUTPUT_COL, "squashed")
+        .fit(t2)
+    )
+    (t3,) = mm.transform(t2)
+    lr = (
+        LogisticRegression()
+        .set(LogisticRegression.FEATURES_COL, "squashed")
+        .set(LogisticRegression.LABEL_COL, "label")
+        .set_max_iter(3)
+        .fit(t3)
+    )
+    return PipelineModel([sc, mm, lr])
+
+
+def _engine(source, x, name="default", **cfg):
+    config = ServingConfig(**{
+        "max_batch_rows": 64,
+        "max_queue_rows": 512,
+        "warmup_row_counts": None,  # every bucket up to max_batch_rows
+        **cfg,
+    })
+    return ServingEngine(
+        source, Table({"features": x[:4]}), config,
+        output_cols=("prediction", "rawPrediction"),
+        name=name,
+    )
+
+
+@pytest.mark.no_retrace(allow_compiles=1)
+def test_eight_thread_parity_zero_retrace():
+    """8 client threads, mixed row counts, vs single-request transform —
+    bitwise. The whole test (warmup included) budgets ONE counted fused
+    compile: the chain's first compile; every other bucket is a policy-
+    allowed new-bucket compile, and steady state compiles nothing."""
+    x, y = _data()
+    pm = _three_stage_chain(x, y)
+    pipeline_fusion.reset_cache()
+    # A dedicated metrics-group name: the process-wide registry
+    # accumulates across tests, and this test asserts EXACT counters.
+    eng = _engine(pm, x, name="parity8").start()
+    compiled_after_warmup = []
+    pipeline_fusion.on_compile.append(compiled_after_warmup.append)
+    errors = []
+
+    def client(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            for _ in range(25):
+                rows = int(rng.integers(1, 13))
+                lo = int(rng.integers(0, x.shape[0] - rows))
+                sl = x[lo:lo + rows]
+                resp = eng.predict({"features": sl})
+                (ref,) = pm.transform(Table({"features": sl}))
+                for c in ("prediction", "rawPrediction"):
+                    ev, av = ref.column(c), resp.column(c)
+                    assert ev.dtype == av.dtype
+                    np.testing.assert_array_equal(ev, av)
+        except BaseException as e:  # noqa: BLE001 — surface to the main thread
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "client threads hung"
+        assert not errors, errors[:3]
+        # Zero steady-state retraces: the reference transforms above run
+        # at row counts inside warmed buckets, so even they compile
+        # nothing new.
+        assert compiled_after_warmup == []
+        stats = eng.stats()
+        assert stats["counters"]["requests"] == 200
+        assert stats["counters"]["rows"] == stats["counters"]["batch_rows"]
+    finally:
+        pipeline_fusion.on_compile.remove(compiled_after_warmup.append)
+        eng.stop()
+
+
+def test_serving_coexists_with_kmeans_stream():
+    """Liveness: 4 serving client threads while train_kmeans_stream runs
+    its whole Lloyd loop (holding the mesh lock) on overlapping devices.
+    Single-device serving programs cannot interleave the multi-device
+    collective rendezvous, so both must make progress; the recorded
+    dispatch trace must pass the analyzer's FML302 check."""
+    from flinkml_tpu.analysis.collectives import (
+        DispatchEvent,
+        check_dispatch_trace,
+    )
+    from flinkml_tpu.models.kmeans import train_kmeans_stream
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.parallel import dispatch as _dispatch
+
+    x, y = _data(n=240)
+    pm = _three_stage_chain(x, y)
+    eng = _engine(pm, x).start()
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(512, 4)).astype(np.float32)
+    batches = [{"x": xs[i::4]} for i in range(4)]
+    mesh = DeviceMesh()
+
+    events = []
+    _dispatch.add_dispatch_observer(events.append)
+    stop = threading.Event()
+    errors = []
+    served = [0]
+
+    def client(tid):
+        try:
+            while not stop.is_set():
+                rows = 1 + (tid % 4)
+                resp = eng.predict({"features": x[tid * 3:tid * 3 + rows]})
+                assert resp.columns["prediction"].shape == (rows,)
+                served[0] += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    trainer_out = []
+
+    def trainer():
+        trainer_out.append(train_kmeans_stream(
+            batches, k=3, mesh=mesh, max_iter=6, seed=0,
+        ))
+
+    try:
+        clients = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        tt = threading.Thread(target=trainer)
+        for t in clients:
+            t.start()
+        tt.start()
+        tt.join(timeout=300)
+        assert not tt.is_alive(), "training deadlocked against serving"
+        time.sleep(0.2)
+        stop.set()
+        for t in clients:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in clients), "serving starved"
+        assert not errors, errors[:3]
+        assert trainer_out and trainer_out[0].shape == (3, 4)
+        assert served[0] > 0
+        # Analyzer audit of the real interleaving we just produced.
+        trace = [
+            DispatchEvent(
+                thread=e["thread"], program=e["program"],
+                devices=tuple(e["devices"]),
+                collectives=tuple(e["collectives"]),
+                locks=tuple(e["locks"]),
+            )
+            for e in events
+        ]
+        assert {e.program for e in trace} >= {
+            "serving.batch", "kmeans.lloyd_epoch"
+        }
+        assert check_dispatch_trace(trace) == []
+    finally:
+        _dispatch.remove_dispatch_observer(events.append)
+        eng.stop()
+
+
+class _GatedStage(AlgoOperator):
+    """Host stage that BLOCKS the dispatcher thread until released —
+    deterministic queue saturation (no sleep races). Caller threads (the
+    shed path, reference transforms) pass through untouched."""
+
+    def __init__(self):
+        super().__init__()
+        self.entered = threading.Event()  # dispatcher is inside transform
+        self.release = threading.Event()
+
+    def transform(self, *inputs):
+        if threading.current_thread().name.startswith("serving-"):
+            self.entered.set()
+            assert self.release.wait(timeout=120)
+        return inputs
+
+
+def _gated_engine(x, y, **cfg):
+    pm = _three_stage_chain(x, y)
+    gate = _GatedStage()
+    gated = PipelineModel([gate, *pm.stages])
+    eng = _engine(
+        gated, x, max_batch_rows=8, max_queue_rows=8,
+        warmup_row_counts=(1,), **cfg,
+    )
+    return eng, gate, gated
+
+
+def _background_predict(eng, features):
+    """Fire-and-forget client; shutdown errors are expected and muted."""
+
+    def run():
+        try:
+            eng.predict(features)
+        except Exception:  # noqa: BLE001 — rejected at shutdown, by design
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _saturate(eng, gate, x):
+    """Park the dispatcher inside the gate, then fill the bounded queue
+    to exactly max_queue_rows with a background request."""
+    t1 = _background_predict(eng, {"features": x[:1]})
+    assert gate.entered.wait(timeout=60)  # dispatcher blocked in-flight
+    t2 = _background_predict(eng, {"features": x[:8]})
+    deadline = time.monotonic() + 60
+    while eng.stats()["queued_rows"] < 8:  # the 8-row filler is queued
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    return t1, t2
+
+
+def test_overload_rejects_with_typed_error():
+    x, y = _data()
+    eng, gate, _ = _gated_engine(x, y, shed_on_overload=False)
+    eng.start()
+    try:
+        _saturate(eng, gate, x)
+        with pytest.raises(ServingOverloadError):
+            eng.predict({"features": x[:1]})
+        assert eng.stats()["counters"]["rejected"] >= 1
+    finally:
+        gate.release.set()
+        eng.stop(drain=False)
+
+
+def test_overload_sheds_to_host_path_with_parity():
+    x, y = _data()
+    eng, gate, gated = _gated_engine(x, y, shed_on_overload=True)
+    eng.start()
+    try:
+        _saturate(eng, gate, x)
+        resp = eng.predict({"features": x[:5]})
+        assert resp.shed
+        (ref,) = gated.transform(Table({"features": x[:5]}))
+        np.testing.assert_array_equal(
+            ref.column("prediction"), resp.column("prediction")
+        )
+        assert eng.stats()["counters"]["shed_requests"] >= 1
+    finally:
+        gate.release.set()
+        eng.stop(drain=False)
+
+
+def test_deadline_expiry_raises_timeout():
+    x, y = _data()
+    eng, gate, _ = _gated_engine(x, y, shed_on_overload=False)
+    eng.start()
+    try:
+        # Park the dispatcher; the next request cannot be dispatched and
+        # must fail by deadline — whether expired in-queue or while
+        # waiting on the in-flight batch.
+        _background_predict(eng, {"features": x[:1]})
+        assert gate.entered.wait(timeout=60)
+        with pytest.raises(ServingTimeoutError):
+            eng.predict({"features": x[:1]}, timeout_ms=20.0)
+        assert eng.stats()["counters"]["timeouts"] >= 1
+    finally:
+        gate.release.set()
+        eng.stop(drain=False)
+
+
+def test_hot_swap_mid_traffic_no_misversioned_responses(tmp_path):
+    """Swap under load: every response verifies bitwise against the model
+    of the version it claims, and nothing is dropped."""
+    x, y = _data()
+    pm1 = _three_stage_chain(x, y)
+    pm2 = _three_stage_chain(x, -y + 1)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(pm1)
+    models = {1: pm1, 2: pm2}
+    eng = _engine(reg, x).start()
+    errors = []
+    versions_seen = set()
+    done = [0]
+
+    def client(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            for _ in range(30):
+                rows = int(rng.integers(1, 9))
+                lo = int(rng.integers(0, x.shape[0] - rows))
+                sl = x[lo:lo + rows]
+                resp = eng.predict({"features": sl})
+                versions_seen.add(resp.version)
+                ref_model = models[resp.version]
+                (ref,) = ref_model.transform(Table({"features": sl}))
+                np.testing.assert_array_equal(
+                    ref.column("prediction"), resp.column("prediction")
+                )
+                done[0] += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        reg.publish(pm2)
+        eng.swap_to(2)
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+        assert not errors, errors[:3]
+        assert done[0] == 180  # zero dropped
+        assert versions_seen == {1, 2}
+    finally:
+        eng.stop()

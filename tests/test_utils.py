@@ -114,3 +114,62 @@ def test_row_reservoir_uniform_and_deterministic():
     # Uniformity: the sample mean of row ids is near the stream mean.
     mean = float(np.mean(run(2)))
     assert abs(mean - 2499.5) < 600, mean
+
+
+def test_render_text_prometheus_exposition():
+    reg = MetricsRegistry()
+    g = reg.group("serving.demo")
+    g.counter("requests", 3)
+    g.gauge("queue_depth", 2)
+    g.gauge("label", "not-a-number")  # skipped: non-numeric
+    m = g.meter("rows")
+    m.mark(100, now=0.0)
+    m.mark(100, now=1.0)
+    reg.group("train.lr").counter("requests", 7)  # same metric, 2nd group
+    text = reg.render_text()
+    lines = text.splitlines()
+    assert "# TYPE flinkml_requests counter" in lines
+    assert 'flinkml_requests{group="serving.demo"} 3' in lines
+    assert 'flinkml_requests{group="train.lr"} 7' in lines
+    assert "# TYPE flinkml_queue_depth gauge" in lines
+    assert 'flinkml_queue_depth{group="serving.demo"} 2' in lines
+    assert any(l.startswith('flinkml_rows_rate{group="serving.demo"}')
+               for l in lines)
+    assert "not-a-number" not in text
+    # TYPE lines precede their samples; output is deterministic.
+    assert text == reg.render_text()
+    assert reg.render_text().endswith("\n")
+
+
+def test_render_text_sanitizes_names_and_default_registry():
+    from flinkml_tpu.utils import default_registry, metrics
+
+    assert default_registry() is metrics
+    reg = MetricsRegistry()
+    reg.group("g").counter("weird name-1.x", 1)
+    text = reg.render_text()
+    assert "flinkml_weird_name_1_x" in text
+    assert reg.render_text() == text
+    assert MetricsRegistry().render_text() == ""
+    # Label VALUES escape quotes/backslashes/newlines (exposition format).
+    reg2 = MetricsRegistry()
+    reg2.group('serving.a"b\\c').counter("requests", 1)
+    assert '{group="serving.a\\"b\\\\c"}' in reg2.render_text()
+
+
+def test_render_text_full_precision_and_type_collisions():
+    # Counters keep full precision (no %g truncation past 6 sig digits).
+    reg = MetricsRegistry()
+    reg.group("g").counter("requests", 1_234_567)
+    assert 'flinkml_requests{group="g"} 1234567' in reg.render_text()
+    # The same metric name as counter in one group, gauge in another:
+    # one family per type (the later kind gets a kind-suffixed family),
+    # never a mistyped sample under a single TYPE line.
+    reg2 = MetricsRegistry()
+    reg2.group("a").counter("depth", 2)
+    reg2.group("b").gauge("depth", 5)
+    text = reg2.render_text()
+    assert "# TYPE flinkml_depth counter" in text
+    assert 'flinkml_depth{group="a"} 2' in text
+    assert "# TYPE flinkml_depth_gauge gauge" in text
+    assert 'flinkml_depth_gauge{group="b"} 5' in text

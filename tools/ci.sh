@@ -74,6 +74,29 @@ print('bench smoke: parseable result line:', rec['metric'], rec['value'])
 }
 stage "bench smoke (CPU, no tunnel)" bench_smoke
 
+# End-to-end serving demo (ISSUE 3 acceptance): fit → publish v1 → serve
+# concurrent clients with bitwise parity → publish v2+ from a running
+# unbounded training stream → hot-swap with zero dropped/mis-versioned
+# responses and zero steady-state retraces (guard-verified in-script).
+serving_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        timeout 420 python examples/serve_pipeline.py || return 1
+    local out
+    out=$(_FLINKML_BENCH_INNER=serving_cpu timeout 420 python bench.py) \
+        || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+assert {'serving_rows_per_sec', 'serving_p50_ms', 'serving_p99_ms',
+        'serving_batch_occupancy'} <= set(rec), rec
+print('serving smoke: rows/s', rec['serving_rows_per_sec'],
+      'p50', rec['serving_p50_ms'], 'p99', rec['serving_p99_ms'],
+      'occupancy', rec['serving_batch_occupancy'])
+"
+}
+stage "serving smoke (CPU)" serving_smoke
+
 example_smoke() {
     local ex
     for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
