@@ -1,0 +1,355 @@
+"""Deterministic fault injection — scripted failures for recovery proofs.
+
+The reference *proves* its checkpoint protocol with fault-injecting
+integration tests (``UnboundedStreamIterationITCase``, the
+failoverCount-parameterized ``BoundedAllRoundCheckpointITCase``): a job
+is killed on script, restarted, and the result compared against the
+uninterrupted run. This module is that capability as a first-class
+layer: a :class:`FaultPlan` of scripted faults, armed process-wide, that
+fires at named **seam sites** threaded through the runtime:
+
+========================  ====================================================
+site                      where it fires
+========================  ====================================================
+``iteration.epoch``       top of every :func:`flinkml_tpu.iteration.iterate`
+                          epoch, before that epoch's batch is consumed
+``checkpoint.write``      inside ``CheckpointManager._write``, after the
+                          arrays/manifest are serialized but BEFORE the
+                          atomic rename (a raise here is a torn write: the
+                          snapshot is never committed)
+``checkpoint.committed``  right after a checkpoint's atomic rename (a raise
+                          here is a kill-after-commit; the context carries
+                          the committed directory so a fault can corrupt it)
+``dispatch.transfer``     every ``DispatchGuard.after_dispatch`` — the
+                          host↔device synchronization seam
+``registry.publish``      top of ``ModelRegistry.publish``, before any file
+                          is written (a raise drops the publish)
+========================  ====================================================
+
+Arming is explicit and scoped (:func:`armed`); with **no plan armed the
+hooks are a single module-attribute ``None`` check** at each seam —
+nothing is allocated, no callable is invoked, so production paths pay
+nothing. All triggers are counter/epoch based: a plan replays
+identically run after run, which is what lets tests assert bit-exact
+recovery (kill at epoch k, corrupt the newest snapshot, resume, compare
+against the uninterrupted run — see ``tests/test_online_resume.py`` and
+the chaos stage in ``tools/ci.sh``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("faults")
+
+
+class FaultInjected(RuntimeError):
+    """The scripted failure raised by injected faults — catch this (and
+    only this) in recovery tests to distinguish the injection from a real
+    bug in the code under test."""
+
+
+class Fault:
+    """One scripted fault. Subclasses set ``site`` and implement
+    :meth:`should_fire` (pure decision — called for every event at the
+    site) and :meth:`apply` (the effect: raise, delay, corrupt)."""
+
+    site: str = ""
+
+    def should_fire(self, ctx: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def apply(self, ctx: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class RaiseAtEpoch(Fault):
+    """Raise :class:`FaultInjected` at the top of epoch ``epoch`` —
+    the scripted mid-stream crash. The epoch's batch has NOT been
+    consumed when this fires."""
+
+    site = "iteration.epoch"
+
+    def __init__(self, epoch: int, message: str = "injected crash"):
+        self.epoch = int(epoch)
+        self.message = message
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return not self.fired and ctx.get("epoch") == self.epoch
+
+    def apply(self, ctx):
+        self.fired = True
+        raise FaultInjected(f"{self.message} (epoch {self.epoch})")
+
+    def describe(self):
+        return f"RaiseAtEpoch({self.epoch})"
+
+
+class KillAfterCheckpoint(Fault):
+    """Raise :class:`FaultInjected` immediately after the first checkpoint
+    of epoch >= ``min_epoch`` commits — the snapshot IS durable, the
+    process dies before training past it (the classic preemption shape)."""
+
+    site = "checkpoint.committed"
+
+    def __init__(self, min_epoch: int = 0):
+        self.min_epoch = int(min_epoch)
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return not self.fired and ctx.get("epoch", -1) >= self.min_epoch
+
+    def apply(self, ctx):
+        self.fired = True
+        raise FaultInjected(
+            f"injected kill after checkpoint commit (epoch {ctx.get('epoch')})"
+        )
+
+    def describe(self):
+        return f"KillAfterCheckpoint(min_epoch={self.min_epoch})"
+
+
+class CorruptSnapshot(Fault):
+    """Corrupt the just-committed snapshot (arrays bit-flip, manifest
+    mangle, or truncation — see :func:`corrupt_checkpoint`) the first time
+    a checkpoint of epoch >= ``min_epoch`` commits. Does not raise; pair
+    it with :class:`KillAfterCheckpoint` (listed AFTER it in the plan) for
+    the kill-with-corrupt-latest scenario."""
+
+    site = "checkpoint.committed"
+
+    def __init__(self, min_epoch: int = 0, target: str = "arrays"):
+        self.min_epoch = int(min_epoch)
+        self.target = target
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return not self.fired and ctx.get("epoch", -1) >= self.min_epoch
+
+    def apply(self, ctx):
+        self.fired = True
+        corrupt_checkpoint(ctx["path"], target=self.target)
+
+    def describe(self):
+        return f"CorruptSnapshot(min_epoch={self.min_epoch}, {self.target})"
+
+
+class TornWrite(Fault):
+    """Raise inside the checkpoint write of epoch ``epoch``, after
+    serialization but before the atomic rename — the commit never
+    happens, exactly like a kill mid-write. The previous snapshot must
+    remain the restore point."""
+
+    site = "checkpoint.write"
+
+    def __init__(self, epoch: int):
+        self.epoch = int(epoch)
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return not self.fired and ctx.get("epoch") == self.epoch
+
+    def apply(self, ctx):
+        self.fired = True
+        raise FaultInjected(
+            f"injected torn checkpoint write (epoch {self.epoch})"
+        )
+
+    def describe(self):
+        return f"TornWrite({self.epoch})"
+
+
+class TransferFault(Fault):
+    """Delay (``mode='delay'``) or fail (``mode='fail'``) the N-th
+    host↔device transfer seam event after arming (1-based)."""
+
+    site = "dispatch.transfer"
+
+    def __init__(self, at_count: int = 1, mode: str = "fail",
+                 delay_s: float = 0.05):
+        if mode not in ("fail", "delay"):
+            raise ValueError(f"mode must be 'fail' or 'delay', got {mode!r}")
+        self.at_count = int(at_count)
+        self.mode = mode
+        self.delay_s = float(delay_s)
+        self._seen = 0
+        self.fired = False
+
+    def should_fire(self, ctx):
+        self._seen += 1
+        return not self.fired and self._seen == self.at_count
+
+    def apply(self, ctx):
+        self.fired = True
+        if self.mode == "delay":
+            time.sleep(self.delay_s)
+            return
+        raise FaultInjected(
+            f"injected transfer failure (transfer #{self.at_count})"
+        )
+
+    def describe(self):
+        return f"TransferFault(#{self.at_count}, {self.mode})"
+
+
+class DropPublish(Fault):
+    """Fail the N-th registry publish after arming (1-based) before any
+    file is written — the publish is dropped as if the publisher crashed
+    on entry; the registry is untouched."""
+
+    site = "registry.publish"
+
+    def __init__(self, at_publish: int = 1):
+        self.at_publish = int(at_publish)
+        self._seen = 0
+        self.fired = False
+
+    def should_fire(self, ctx):
+        self._seen += 1
+        return not self.fired and self._seen == self.at_publish
+
+    def apply(self, ctx):
+        self.fired = True
+        raise FaultInjected(
+            f"injected dropped publish (publish #{self.at_publish})"
+        )
+
+    def describe(self):
+        return f"DropPublish(#{self.at_publish})"
+
+
+class FaultPlan:
+    """An ordered script of :class:`Fault`s. ``fire`` runs every matching
+    fault in plan order (so ``[CorruptSnapshot(...), KillAfterCheckpoint
+    (...)]`` corrupts the snapshot and THEN kills at the same commit).
+    ``log`` records every firing — ``(site, description, ctx-summary)``
+    tuples — for assertions and postmortems."""
+
+    def __init__(self, *faults: Fault):
+        self.faults: Tuple[Fault, ...] = tuple(faults)
+        self.log: List[Tuple[str, str, Dict[str, Any]]] = []
+
+    def fire(self, site: str, **ctx: Any) -> None:
+        for fault in self.faults:
+            if fault.site == site and fault.should_fire(ctx):
+                summary = {
+                    k: v for k, v in ctx.items()
+                    if isinstance(v, (int, float, str, bool))
+                }
+                self.log.append((site, fault.describe(), summary))
+                _log.warning(
+                    "fault fired at %s: %s %s", site, fault.describe(), summary
+                )
+                fault.apply(ctx)
+
+
+# -- arming ------------------------------------------------------------------
+#
+# Seam hooks read this module attribute and bail on None; that read is the
+# ENTIRE disarmed cost. Hooks call the module-level fire() only after the
+# None check, so the armed path stays one indirection away.
+
+ACTIVE: Optional[FaultPlan] = None
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    """Arm ``plan`` process-wide (one plan at a time; arming replaces)."""
+    global ACTIVE
+    ACTIVE = plan
+    _log.warning("fault plan armed: %s",
+                 [f.describe() for f in plan.faults])
+    return plan
+
+
+def disarm() -> None:
+    global ACTIVE
+    if ACTIVE is not None:
+        _log.warning("fault plan disarmed")
+    ACTIVE = None
+
+
+@contextlib.contextmanager
+def armed(plan: FaultPlan):
+    """``with faults.armed(FaultPlan(...)) as plan:`` — scoped arming;
+    always disarms, even when the injected fault propagates."""
+    arm(plan)
+    try:
+        yield plan
+    finally:
+        disarm()
+
+
+def fire(site: str, **ctx: Any) -> None:
+    """Fire the active plan at ``site`` (no-op when disarmed). Seam code
+    should guard with ``if faults.ACTIVE is not None`` first so the
+    disarmed cost is one attribute read."""
+    plan = ACTIVE
+    if plan is not None:
+        plan.fire(site, **ctx)
+
+
+# -- snapshot corruption helpers --------------------------------------------
+#
+# Used by CorruptSnapshot and directly by tests/operators to simulate disk
+# rot on committed checkpoints (layout: <dir>/ckpt-<epoch>/{arrays.npz,
+# meta.json} — iteration/checkpoint.py).
+
+
+def corrupt_checkpoint(ckpt_dir: str, target: str = "arrays") -> str:
+    """Deterministically damage the committed checkpoint at ``ckpt_dir``:
+
+    - ``arrays``: flip bits in the middle of ``arrays.npz`` (payload
+      corruption — the manifest stays valid, only integrity verification
+      can catch it);
+    - ``manifest``: overwrite ``meta.json`` with non-JSON garbage;
+    - ``truncate``: cut ``arrays.npz`` to half its length (torn disk
+      state).
+
+    Returns the path it damaged.
+    """
+    if target == "manifest":
+        path = os.path.join(ckpt_dir, "meta.json")
+        with open(path, "w") as f:
+            f.write('{"epoch": CORRUPTED')
+        _log.warning("corrupted checkpoint manifest: %s", path)
+        return path
+    path = os.path.join(ckpt_dir, "arrays.npz")
+    size = os.path.getsize(path)
+    if target == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        _log.warning("truncated checkpoint arrays: %s", path)
+        return path
+    if target != "arrays":
+        raise ValueError(
+            f"target must be 'arrays', 'manifest' or 'truncate', got {target!r}"
+        )
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(16)
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    _log.warning("corrupted checkpoint arrays: %s", path)
+    return path
+
+
+def corrupt_latest(manager: Any, target: str = "arrays") -> int:
+    """Damage the newest committed checkpoint of ``manager`` (a
+    :class:`~flinkml_tpu.iteration.CheckpointManager`); returns the epoch
+    it damaged. Raises when the manager holds no checkpoints."""
+    epoch = manager.latest_epoch()
+    if epoch is None:
+        raise ValueError(f"no checkpoints under {manager.directory}")
+    corrupt_checkpoint(
+        os.path.join(manager.directory, f"ckpt-{epoch}"), target=target
+    )
+    return epoch

@@ -9,7 +9,10 @@ from flinkml_tpu.iteration.runtime import (
     notify_epoch_listeners,
 )
 from flinkml_tpu.iteration.device_loop import device_iterate
-from flinkml_tpu.iteration.checkpoint import CheckpointManager
+from flinkml_tpu.iteration.checkpoint import (
+    CheckpointIntegrityError,
+    CheckpointManager,
+)
 from flinkml_tpu.iteration.datacache import (
     DataCache,
     DataCacheReader,
@@ -31,6 +34,7 @@ __all__ = [
     "notify_epoch_listeners",
     "ForwardInputsOfLastRound",
     "device_iterate",
+    "CheckpointIntegrityError",
     "CheckpointManager",
     "DataCache",
     "DataCacheReader",

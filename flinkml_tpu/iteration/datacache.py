@@ -32,6 +32,10 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("datacache")
+
 Batch = Dict[str, np.ndarray]
 
 _MAGIC = b"FMLTSEG1"
@@ -168,7 +172,14 @@ class DataCacheWriter:
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, f"segment-{self._num_spilled:06d}.bin")
         self._num_spilled += 1
-        self._entries.append(_write_segment(path, batch))
+        segment = _write_segment(path, batch)
+        _log.info(
+            "datacache spill: %d in-RAM bytes over the %d-byte budget; "
+            "segment %s (%d rows, %d bytes) spilled to disk",
+            self._mem_bytes, self.memory_budget_bytes, path,
+            segment.num_rows, segment.nbytes,
+        )
+        self._entries.append(segment)
 
     def finish(self) -> "DataCache":
         """Seal the cache; no further appends. Returns the readable cache."""

@@ -213,6 +213,11 @@ class IterationConfig:
     #               positioned at "now" (online learning) — consume from
     #               the front; skipping would silently DROP real data.
     stream_resume: str = "replay"
+    # Preemption watchdog polled at every epoch boundary; None uses the
+    # ambient installed one (utils.preemption.active()). On preemption the
+    # loop stops cleanly, commits one final checkpoint (manager permitting)
+    # and drains the watchdog's registered serving engines.
+    watchdog: Optional[Any] = None
 
     def __post_init__(self):
         if self.stream_resume not in ("replay", "continue"):
@@ -228,6 +233,9 @@ class IterationResult:
     epochs: int
     criteria_history: List[Optional[float]]
     outputs: List[Any]
+    # True when a PreemptionWatchdog stopped the loop early; the final
+    # state was checkpointed (manager permitting) and resumes cleanly.
+    preempted: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +298,8 @@ def iterate(
     if resume:
         if config.checkpoint_manager is None:
             raise ValueError("resume=True requires config.checkpoint_manager")
+        # restore_latest verifies integrity and falls back past torn or
+        # corrupt snapshots to the newest valid one (checkpoint.py).
         restored = config.checkpoint_manager.restore_latest(like=init_state)
         if restored is not None:
             state, start_epoch = restored
@@ -312,14 +322,34 @@ def iterate(
     outputs: List[Any] = []
     epoch = start_epoch
     terminated = False
+    preempted = False
+    # The last epoch a snapshot committed for (resume counts: the restored
+    # epoch IS on disk) — lets the terminal save skip redundant rewrites.
+    last_saved = start_epoch if (resume and start_epoch > 0) else None
+    from flinkml_tpu.utils import preemption
+
+    watchdog = (
+        config.watchdog if config.watchdog is not None else preemption.active()
+    )
     # Criteria-less loops never touch host values, so nothing would bound
     # in-flight dispatch on a multi-process mesh — the guard is the
     # framework backpressure policy (no-op single-process). Loops that
     # return a criteria already sync via float(criteria) each epoch.
+    # (Lazy imports: utils.metrics imports this module for
+    # IterationListener, so runtime's own utils/faults imports cannot be
+    # top-level without a cycle.)
+    import flinkml_tpu.faults as faults
     from flinkml_tpu.parallel.dispatch import DispatchGuard
 
     guard = DispatchGuard()
     while not terminated:
+        if faults.ACTIVE is not None:  # scripted-crash seam (pre-batch)
+            faults.fire("iteration.epoch", epoch=epoch)
+        if watchdog is not None and watchdog.requested:
+            # Epoch boundaries are the globally consistent points in SPMD
+            # lockstep — stop here, snapshot below, drain, hand back.
+            preempted = True
+            break
         batch, exhausted = _epoch_data(data, epoch, data_iter)
         if exhausted:
             break
@@ -349,11 +379,21 @@ def iterate(
         if (
             config.checkpoint_interval > 0
             and config.checkpoint_manager is not None
-            and (terminated or epoch % config.checkpoint_interval == 0)
+            and epoch % config.checkpoint_interval == 0
         ):
             config.checkpoint_manager.save(state, epoch)
+            last_saved = epoch
 
     guard.flush(state)  # back-to-back phases must not stack in-flight work
+    if config.checkpoint_manager is not None and last_saved != epoch:
+        # Terminal snapshot — at termination, stream exhaustion, or
+        # preemption, whenever a manager is configured (even with
+        # checkpoint_interval=0): a finished run always leaves its final
+        # state durable, so resume is a no-op and a preempted run loses
+        # nothing (the "one final agreed checkpoint" of the preemption
+        # contract; single-process commit — the hand-rolled multi-process
+        # loops go through checkpoint.save_agreed instead).
+        config.checkpoint_manager.save(state, epoch)
     if config.checkpoint_manager is not None and hasattr(
         config.checkpoint_manager, "wait"
     ):
@@ -362,12 +402,16 @@ def iterate(
         config.checkpoint_manager.wait()
     for listener in listeners:
         listener.on_iteration_terminated(state)
+    if preempted and watchdog is not None:
+        # Only AFTER the final snapshot is durable: drain serving engines.
+        watchdog.finalize()
 
     return IterationResult(
         state=state,
         epochs=epoch - start_epoch,
         criteria_history=criteria_history,
         outputs=outputs,
+        preempted=preempted,
     )
 
 

@@ -46,6 +46,9 @@ from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.iteration.datacache import DataCache, Segment
 from flinkml_tpu.parallel.mesh import DeviceMesh
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("stream_sync")
 
 
 @functools.lru_cache(maxsize=128)
@@ -114,10 +117,15 @@ def agree_all_ok(ok: bool, mesh: Optional[DeviceMesh], what: str) -> None:
         failed = _device_agree(0 if ok else 1, mesh, "max") != 0
     if failed:
         suffix = "" if ok else " (failed on this process)"
+        _log.error("agreed abort: %s failed on at least one process%s",
+                   what, suffix)
         raise ValueError(
             f"{what} failed on at least one process{suffix}; "
             "all ranks abort together to avoid a distributed hang"
         )
+    elif jax.process_count() > 1:
+        _log.info("rendezvous ok: %s agreed on all %d processes",
+                  what, jax.process_count())
 
 
 class DeferredValidation:

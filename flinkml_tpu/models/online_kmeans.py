@@ -19,6 +19,7 @@ or, if unset, from ``k`` random rows of the first batch.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Iterable, List, Optional, Tuple
 
 import jax
@@ -35,8 +36,8 @@ from flinkml_tpu.common_params import (
 )
 from flinkml_tpu.iteration import (
     IterationConfig,
-    Iterations,
     TerminateOnMaxIter,
+    iterate,
 )
 from flinkml_tpu.models._data import features_matrix
 from flinkml_tpu.ops import blas
@@ -109,8 +110,25 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         batch_size = self.get(self.GLOBAL_BATCH_SIZE)
         return self.fit_stream(table.batches(batch_size))
 
-    def fit_stream(self, batches: Iterable[Table]) -> "OnlineKMeansModel":
+    def fit_stream(
+        self,
+        batches: Iterable[Table],
+        *,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+        stream_resume: str = "replay",
+    ) -> "OnlineKMeansModel":
         """One decayed centroid update per arriving batch.
+
+        Crash safety (ISSUE 4): ``checkpoint_manager`` +
+        ``checkpoint_interval`` snapshot the carry (centroids, decayed
+        weights, model version) every N consumed batches; ``resume=True``
+        continues bit-exactly from the newest valid snapshot (corrupt
+        ones are verified and skipped). ``stream_resume='replay'`` skips
+        the already-consumed prefix of a restartable source;
+        ``'continue'`` consumes a live stream from the front. See
+        ``docs/development/fault_tolerance.md``.
 
         Multi-process (round 4): each process feeds its OWN arriving
         stream partition; every update is one psum'd global assignment
@@ -123,31 +141,58 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         features_col = self.get(self.FEATURES_COL)
         rng = np.random.default_rng(self.get_seed())
         if jax.process_count() > 1:
+            if checkpoint_manager is not None or resume:
+                raise NotImplementedError(
+                    "checkpoint/resume for the multi-process online stream "
+                    "path is not wired yet; run the checkpointing fit "
+                    "single-process"
+                )
             return self._fit_stream_multiprocess(
                 batches, k, decay, features_col, rng
             )
 
+        from flinkml_tpu.iteration.checkpoint import begin_resume
+
+        restore_epoch = begin_resume(checkpoint_manager, resume, world_size=1)
+
+        # Peek the first batch: initial centroids draw from it (when no
+        # initial model data was given) and it fixes the carry structure
+        # for checkpointing; it is then re-presented as epoch 0's data.
+        it = iter(batches)
+        try:
+            first = next(it)
+        except StopIteration:
+            empty = self._model_from_empty_stream(
+                checkpoint_manager, restore_epoch
+            )
+            if empty is not None:
+                return empty
+            raise ValueError("training stream is empty") from None
+        x0 = features_matrix(first, features_col).astype(np.float64)
+        if restore_epoch is not None:
+            # A committed snapshot will overwrite the init state: skip the
+            # draw (and its rows >= k validation — a resumed live stream's
+            # first batch is NOT the draw batch); only the pytree
+            # structure of the placeholder matters for restore.
+            centroids0 = jnp.zeros((k, x0.shape[1]))
+        elif self._initial_centroids is not None:
+            centroids0 = jnp.asarray(self._initial_centroids)
+        else:
+            if x0.shape[0] < k:
+                raise ValueError(
+                    f"first batch has {x0.shape[0]} rows < k={k}; "
+                    "increase globalBatchSize or provide initial model data"
+                )
+            idx = rng.choice(x0.shape[0], size=k, replace=False)
+            centroids0 = jnp.asarray(x0[idx])
         state = {
-            "centroids": self._initial_centroids,
-            "weights": None,
+            "centroids": centroids0,
+            "weights": jnp.zeros(k, dtype=jnp.result_type(float)),
             "version": 0,
         }
 
         def step(carry, batch_table, epoch):
             x = features_matrix(batch_table, features_col).astype(np.float64)
-            if carry["centroids"] is None:
-                if x.shape[0] < k:
-                    raise ValueError(
-                        f"first batch has {x.shape[0]} rows < k={k}; "
-                        "increase globalBatchSize or provide initial model data"
-                    )
-                idx = rng.choice(x.shape[0], size=k, replace=False)
-                carry["centroids"] = jnp.asarray(x[idx])
-                carry["weights"] = jnp.zeros(k, dtype=jnp.result_type(float))
-            elif carry["weights"] is None:
-                carry["centroids"] = jnp.asarray(carry["centroids"])
-                carry["weights"] = jnp.zeros(k, dtype=jnp.result_type(float))
-
             sums, counts = _batch_stats(jnp.asarray(x), carry["centroids"])
             old_w = carry["weights"] * decay
             new_w = old_w + counts
@@ -157,20 +202,52 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
                 new_w[:, None] > 0, updated, carry["centroids"]
             )
             carry["weights"] = new_w
-            carry["version"] += 1
+            carry["version"] = int(carry["version"]) + 1
             return carry, None
 
-        result = Iterations.iterate_unbounded_streams(
-            step, state, batches, IterationConfig(TerminateOnMaxIter(2**31 - 1))
+        result = iterate(
+            step, state, itertools.chain([first], it),
+            IterationConfig(
+                TerminateOnMaxIter(2**31 - 1),
+                checkpoint_interval=checkpoint_interval,
+                checkpoint_manager=checkpoint_manager,
+                stream_resume=stream_resume,
+            ),
+            resume=resume,
         )
         final = result.state
-        if final["centroids"] is None:
-            raise ValueError("training stream is empty")
         model = OnlineKMeansModel()
         model.copy_params_from(self)
         model._centroids = np.asarray(final["centroids"])
-        model._model_version = final["version"]
+        model._model_version = int(final["version"])
         return model
+
+    def _model_from_empty_stream(
+        self, manager, restore_epoch
+    ) -> Optional["OnlineKMeansModel"]:
+        """The zero-batch cases that are NOT errors: a resumed run whose
+        stream is already exhausted returns the checkpointed model
+        (resume-as-noop on a fully consumed 'continue' tail), and a
+        warm-started run returns the initial model data at version 0
+        (the pre-ISSUE-4 contract). Returns None when the empty stream is
+        a genuine error."""
+        if restore_epoch is not None and manager is not None:
+            # Leaf VALUES in `like` are irrelevant — only the structure.
+            state, _ = manager.restore_latest(
+                like={"centroids": 0, "weights": 0, "version": 0}
+            )
+            model = OnlineKMeansModel()
+            model.copy_params_from(self)
+            model._centroids = np.asarray(state["centroids"])
+            model._model_version = int(state["version"])
+            return model
+        if self._initial_centroids is not None:
+            model = OnlineKMeansModel()
+            model.copy_params_from(self)
+            model._centroids = np.asarray(self._initial_centroids)
+            model._model_version = 0
+            return model
+        return None
 
     def _fit_stream_multiprocess(
         self, batches, k, decay, features_col, rng

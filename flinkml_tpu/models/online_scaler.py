@@ -16,6 +16,7 @@ other online models. The fitted model transforms exactly like
 
 from __future__ import annotations
 
+import itertools
 from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -26,6 +27,7 @@ from flinkml_tpu.iteration import (
     IterationConfig,
     Iterations,
     TerminateOnMaxIter,
+    iterate,
 )
 from flinkml_tpu.models._data import features_matrix
 from flinkml_tpu.models.scalers import StandardScalerModel, _HasInputOutputCol
@@ -49,8 +51,25 @@ class OnlineStandardScaler(
             table.batches(self.get(self.GLOBAL_BATCH_SIZE))
         )
 
-    def fit_stream(self, batches: Iterable[Table]) -> "OnlineStandardScalerModel":
+    def fit_stream(
+        self,
+        batches: Iterable[Table],
+        *,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+        stream_resume: str = "replay",
+    ) -> "OnlineStandardScalerModel":
         """One exact Chan-merge per arriving batch.
+
+        Crash safety (ISSUE 4, single-process): ``checkpoint_manager`` +
+        ``checkpoint_interval`` snapshot the moment carry (n, mean, M2,
+        model version) every N consumed batches; ``resume=True``
+        continues bit-exactly from the newest valid snapshot (corrupt
+        ones are verified and skipped); ``stream_resume`` picks the
+        resumed-stream cursor contract ('replay' skips the consumed
+        prefix of a restartable source, 'continue' reads a live stream
+        from the front).
 
         Multi-process (round 4): moment merging is associative and
         exact, so each process consumes its OWN stream partition
@@ -60,8 +79,6 @@ class OnlineStandardScaler(
         host computes the identical model. A rank-local failure is held
         and agreed before the merge (no stranded peers)."""
         input_col = self.get(self.INPUT_COL)
-
-        state = {"n": 0.0, "mean": None, "m2": None, "version": 0}
 
         def step(carry, batch_table, epoch):
             x = features_matrix(batch_table, input_col).astype(np.float64)
@@ -75,7 +92,10 @@ class OnlineStandardScaler(
                 carry["m2"] = m2b
                 carry["n"] = nb
             else:
-                # Chan et al. pairwise merge: exact for any batch split.
+                # Chan et al. pairwise merge: exact for any batch split
+                # (and bitwise-exact from the zero-initialized carry of
+                # the single-process path: na=0 gives mean = mb exactly
+                # and a zero correction term).
                 na = carry["n"]
                 delta = mb - carry["mean"]
                 n = na + nb
@@ -84,44 +104,90 @@ class OnlineStandardScaler(
                     carry["m2"] + m2b + delta * delta * (na * nb / n)
                 )
                 carry["n"] = n
-            carry["version"] += 1
+            carry["version"] = int(carry["version"]) + 1
             return carry, None
 
         import jax
 
         multi = jax.process_count() > 1
-        # Multi-process, the local pass's failures are HELD: a rank-local
-        # raise would strand the peers in the final merge collective.
-        final = state
-        err = None
-        try:
-            final = Iterations.iterate_unbounded_streams(
-                step, state, batches,
-                IterationConfig(TerminateOnMaxIter(2**31 - 1)),
-            ).state
-        except Exception as e:  # noqa: BLE001 — agreed below (multi)
-            err = e
         if multi:
+            if checkpoint_manager is not None or resume:
+                raise NotImplementedError(
+                    "checkpoint/resume for the multi-process online stream "
+                    "path is not wired yet; run the checkpointing fit "
+                    "single-process"
+                )
+            # The local pass's failures are HELD: a rank-local raise would
+            # strand the peers in the final merge collective.
+            state = {"n": 0.0, "mean": None, "m2": None, "version": 0}
+            final = state
+            err = None
+            try:
+                final = Iterations.iterate_unbounded_streams(
+                    step, state, batches,
+                    IterationConfig(TerminateOnMaxIter(2**31 - 1)),
+                ).state
+            except Exception as e:  # noqa: BLE001 — agreed below
+                err = e
             from flinkml_tpu.iteration.stream_sync import DeferredValidation
 
             dv = DeferredValidation()
             dv.err = err
             dv.rendezvous(self.mesh, "online scaler stream")
             final = self._merge_across_processes(final, self.mesh)
-        elif err is not None:
-            raise err
-        if final["mean"] is None:
-            raise ValueError(
-                "training stream is empty"
-                + (" on every process" if multi else "")
+            if final["mean"] is None:
+                raise ValueError("training stream is empty on every process")
+        else:
+            from flinkml_tpu.iteration.checkpoint import begin_resume
+
+            restore_epoch = begin_resume(
+                checkpoint_manager, resume, world_size=1
             )
+            # Peek the first batch to fix the feature dim: the carry is a
+            # full array pytree from epoch 0 (the checkpointable
+            # structure); zero-initialized moments Chan-merge exactly.
+            it = iter(batches)
+            try:
+                first = next(it)
+            except StopIteration:
+                if restore_epoch is not None:
+                    # Resume-as-noop on an already-exhausted stream: the
+                    # checkpointed moments ARE the model (`like` leaf
+                    # values are irrelevant — only the structure).
+                    final, _ = checkpoint_manager.restore_latest(
+                        like={"n": 0, "mean": 0, "m2": 0, "version": 0}
+                    )
+                    return self._model_from_final(final)
+                raise ValueError("training stream is empty") from None
+            d = features_matrix(first, input_col).shape[1]
+            state = {
+                "n": 0.0,
+                "mean": np.zeros(d),
+                "m2": np.zeros(d),
+                "version": 0,
+            }
+            final = iterate(
+                step, state, itertools.chain([first], it),
+                IterationConfig(
+                    TerminateOnMaxIter(2**31 - 1),
+                    checkpoint_interval=checkpoint_interval,
+                    checkpoint_manager=checkpoint_manager,
+                    stream_resume=stream_resume,
+                ),
+                resume=resume,
+            ).state
+            if float(final["n"]) == 0.0:
+                raise ValueError("training stream is empty")
+        return self._model_from_final(final)
+
+    def _model_from_final(self, final) -> "OnlineStandardScalerModel":
         model = OnlineStandardScalerModel()
         model.copy_params_from(self)
         model.set_model_data(Table({
-            "mean": final["mean"][None, :],
-            "std": np.sqrt(final["m2"] / final["n"])[None, :],
+            "mean": np.asarray(final["mean"])[None, :],
+            "std": np.sqrt(np.asarray(final["m2"]) / float(final["n"]))[None, :],
         }))
-        model._model_version = final["version"]
+        model._model_version = int(final["version"])
         return model
 
     @staticmethod

@@ -26,6 +26,8 @@ from typing import Any, Callable, Optional
 
 import jax
 
+import flinkml_tpu.faults as faults
+
 _ENV_INTERVAL = "FLINKML_SYNC_INTERVAL"
 _DEFAULT_MULTIPROCESS_INTERVAL = 8
 
@@ -286,6 +288,8 @@ class DispatchGuard:
         self._since_sync = 0
 
     def after_dispatch(self, carry: Any) -> Any:
+        if faults.ACTIVE is not None:  # host↔device transfer seam
+            faults.fire("dispatch.transfer", count=self._since_sync + 1)
         self._since_sync += 1
         if self.interval and self._since_sync >= self.interval:
             jax.block_until_ready(carry)
@@ -294,6 +298,8 @@ class DispatchGuard:
 
     def flush(self, carry: Any) -> Any:
         """Force a synchronization point (end of a training phase)."""
+        if faults.ACTIVE is not None:
+            faults.fire("dispatch.transfer", count=self._since_sync)
         if self._since_sync:
             jax.block_until_ready(carry)
             self._since_sync = 0

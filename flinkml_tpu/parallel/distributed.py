@@ -23,6 +23,7 @@ script runs unchanged from a laptop CPU mesh to a multi-host pod slice.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Tuple
 
 import flinkml_tpu._jax_compat  # noqa: F401  (jax version shims; install before first jax use)
@@ -30,11 +31,38 @@ import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from flinkml_tpu.utils import logging as flog
+
+_log = flog.get_logger("distributed")
+
+# Substrings that mark a rendezvous failure as TRANSIENT (worth retrying:
+# the coordinator is still coming up, DNS lag, a dropped TCP handshake).
+# Anything else — bad address, auth, rank mismatch — fails fast.
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline",
+    "timed out",
+    "timeout",
+    "connection refused",
+    "connection reset",
+    "failed to connect",
+    "connect failed",
+    "temporarily",
+    "barrier",
+)
+
+
+def _is_transient_rendezvous_error(err: BaseException) -> bool:
+    msg = str(err).lower()
+    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+
 
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    max_attempts: int = 3,
+    backoff_s: float = 1.0,
 ) -> Tuple[int, int]:
     """Join the jax.distributed coordination service (DCN control plane).
 
@@ -43,6 +71,13 @@ def init_distributed(
     (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
     ``JAX_PROCESS_ID``, as set by most TPU launchers); with no coordinator
     configured this is a single-process no-op.
+
+    Transient rendezvous failures (coordinator still booting, dropped
+    connections, deadline overruns — the normal churn of a pod slice
+    coming up host by host) are retried up to ``max_attempts`` times with
+    exponential backoff (``backoff_s * 2**attempt``), each attempt
+    logged; non-transient errors (bad address, rank mismatch) fail fast
+    on the first occurrence.
 
     Returns ``(process_index, process_count)``.
     """
@@ -65,13 +100,45 @@ def init_distributed(
         and num_processes > 1
         and not jax.distributed.is_initialized()
     ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
         _enable_cpu_collectives()
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    return jax.process_index(), jax.process_count()
+        for attempt in range(1, max_attempts + 1):
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+                _log.info(
+                    "rendezvous with %s succeeded (attempt %d/%d, "
+                    "process %d of %d)", coordinator_address, attempt,
+                    max_attempts, process_id, num_processes,
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — classified below
+                if (
+                    attempt == max_attempts
+                    or not _is_transient_rendezvous_error(e)
+                ):
+                    _log.error(
+                        "rendezvous with %s failed %s (attempt %d/%d): %r",
+                        coordinator_address,
+                        "permanently" if attempt == max_attempts
+                        else "fast (non-transient)",
+                        attempt, max_attempts, e,
+                    )
+                    raise
+                delay = backoff_s * (2 ** (attempt - 1))
+                _log.warning(
+                    "transient rendezvous failure with %s (attempt %d/%d), "
+                    "retrying in %.1fs: %r", coordinator_address, attempt,
+                    max_attempts, delay, e,
+                )
+                time.sleep(delay)
+    index, count = jax.process_index(), jax.process_count()
+    flog.set_rank(index, count)  # pin the log tag to the real rank
+    return index, count
 
 
 def _enable_cpu_collectives() -> None:
@@ -155,6 +222,8 @@ def require_single_controller(what: str) -> None:
     ingest (``examples/multihost_pod.py``).
     """
     if jax.process_count() > 1:
+        _log.error("%s rejected under a multi-process mesh "
+                   "(single-controller only)", what)
         raise RuntimeError(
             f"{what} is single-controller: it places full global batches "
             "from one process, which cannot address a multi-process "

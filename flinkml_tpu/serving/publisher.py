@@ -51,6 +51,15 @@ class SnapshotPublisher(IterationListener):
             ``follow_registry()``s this registry; use one or the other.
 
     ``published`` records ``(epoch, version)`` pairs, newest last.
+
+    Publication is **idempotent across restarts**: each publish carries a
+    dedupe key of ``epoch`` + the content fingerprint of the
+    (materialized) loop state, recorded atomically with the version. A
+    trainer that crashes after publishing epoch E and resumes from the
+    epoch-E checkpoint will re-reach the same publish point with the
+    same state — the registry returns the already-committed version
+    instead of growing a duplicate (see ``ModelRegistry.publish``'s
+    ``dedupe_key``).
     """
 
     needs_materialized_state = True
@@ -97,11 +106,44 @@ class SnapshotPublisher(IterationListener):
         self._publish(max(last_epoch, 0), state)
 
     def _publish(self, epoch: int, state: Any) -> None:
+        key = self._dedupe_key(epoch, state)
+        if key is not None:
+            existing = self.registry.find_dedupe(key)
+            if existing is not None:
+                # Resume re-reached an already-published epoch: record it,
+                # skip make_model + save — but an attached engine must
+                # still land on this version (it may be serving whatever
+                # predated the restart).
+                self.published.append((epoch, existing))
+                self._last_published_epoch = epoch
+                self._metrics.counter("snapshots_deduped")
+                if self.engine is not None:
+                    self.engine.swap_to(existing)
+                return
         model = self.make_model(state)
-        version = self.registry.publish(model)
+        version = self.registry.publish(model, dedupe_key=key)
         self.published.append((epoch, version))
         self._last_published_epoch = epoch
         self._metrics.counter("snapshots_published")
         self._metrics.gauge("last_published_version", version)
         if self.engine is not None:
             self.engine.swap_to(version)
+
+    @staticmethod
+    def _dedupe_key(epoch: int, state: Any) -> Optional[str]:
+        """``epoch`` + content fingerprint of the loop state — identical
+        on a resumed run that re-reaches the same publish point. None
+        (publish unconditionally) for states that cannot be fingerprinted
+        (non-array leaves)."""
+        import jax
+
+        from flinkml_tpu.io.read_write import content_fingerprint
+
+        try:
+            leaves = jax.tree_util.tree_flatten(state)[0]
+            fp = content_fingerprint(
+                {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
+            )
+        except Exception:  # noqa: BLE001 — dedupe is best-effort
+            return None
+        return f"epoch={epoch}:fp={fp}"

@@ -37,15 +37,20 @@ import time
 import warnings
 from typing import Any, Callable, List, Optional, Tuple
 
+import flinkml_tpu.faults as faults
 from flinkml_tpu.io import read_write
 from flinkml_tpu.serving.errors import (
     ModelVersionNotFoundError,
     RegistryError,
 )
+from flinkml_tpu.utils.logging import get_logger
 from flinkml_tpu.utils.metrics import metrics
+
+_log = get_logger("serving.registry")
 
 CURRENT_FILE = "CURRENT"
 VERSIONS_DIR = "versions"
+PUBLISH_TAG_FILE = "PUBLISH_TAG"
 _TMP_PREFIX = ".tmp-"
 
 
@@ -69,6 +74,11 @@ class ModelRegistry:
         self._notify_lock = threading.Lock()
         self._listeners: List[Callable[[int], None]] = []
         self._metrics = metrics.group("serving.registry")
+        # dedupe-key index: version -> key for scanned versions (lazily
+        # extended; a fresh instance after a restart rescans from disk, so
+        # idempotence survives the process that published dying).
+        self._dedupe_keys: dict = {}
+        self._dedupe_scanned: set = set()
 
     # -- introspection -----------------------------------------------------
     def versions(self) -> List[int]:
@@ -94,8 +104,31 @@ class ModelRegistry:
     def path_of(self, version: int) -> str:
         return os.path.join(self._versions_root, f"{int(version):06d}")
 
+    def find_dedupe(self, dedupe_key: str) -> Optional[int]:
+        """The version already published under ``dedupe_key``, or None.
+
+        Keys are recorded atomically with the version's files (the tag
+        file rides the same rename), so a restarted publisher — even a
+        fresh process — sees exactly the publishes that committed."""
+        with self._lock:
+            for v in self.versions():
+                if v in self._dedupe_scanned:
+                    continue
+                self._dedupe_scanned.add(v)
+                tag = os.path.join(self.path_of(v), PUBLISH_TAG_FILE)
+                try:
+                    with open(tag) as f:
+                        self._dedupe_keys[v] = json.load(f)["dedupeKey"]
+                except (OSError, ValueError, KeyError):
+                    continue  # untagged (or pre-dedupe) version
+            for v, key in self._dedupe_keys.items():
+                if key == dedupe_key:
+                    return v
+        return None
+
     # -- writes ------------------------------------------------------------
-    def publish(self, stage: Any, version: Optional[int] = None) -> int:
+    def publish(self, stage: Any, version: Optional[int] = None,
+                dedupe_key: Optional[str] = None) -> int:
         """Save ``stage`` as a new version and repoint ``CURRENT`` at it.
 
         Returns the assigned version. The version number is claimed by an
@@ -107,8 +140,27 @@ class ModelRegistry:
         a partial version; the pointer flip is atomic (concurrent
         cross-process publishes leave CURRENT at whichever publish
         flipped it last). Raises :class:`RegistryError` when an explicit
-        ``version`` already exists."""
+        ``version`` already exists.
+
+        ``dedupe_key`` makes publication idempotent: when a committed
+        version already carries the key (same epoch + content
+        fingerprint — see :class:`~flinkml_tpu.serving.publisher.
+        SnapshotPublisher`), that version is returned and NOTHING is
+        written — the resume-then-republish path cannot grow duplicate
+        versions."""
         with self._lock:
+            if faults.ACTIVE is not None:  # dropped-publish seam
+                faults.fire("registry.publish", root=self.root,
+                            version=-1 if version is None else int(version))
+            if dedupe_key is not None:
+                existing = self.find_dedupe(dedupe_key)
+                if existing is not None:
+                    self._metrics.counter("publishes_deduped")
+                    _log.info(
+                        "publish deduplicated: key %r already committed as "
+                        "version %d", dedupe_key, existing,
+                    )
+                    return existing
             v = None if version is None else int(version)
             candidate = v
             if candidate is None:
@@ -132,6 +184,11 @@ class ModelRegistry:
                 shutil.rmtree(tmp)
             try:
                 stage.save(tmp)
+                if dedupe_key is not None:
+                    # Written INSIDE the temp dir: the tag commits in the
+                    # same atomic rename as the version itself.
+                    with open(os.path.join(tmp, PUBLISH_TAG_FILE), "w") as f:
+                        json.dump({"dedupeKey": dedupe_key}, f)
                 # POSIX rename onto an existing EMPTY directory: the
                 # claimed placeholder becomes the complete save in one
                 # atomic step.
@@ -143,9 +200,14 @@ class ModelRegistry:
                 except OSError:
                     pass  # surface the original failure, not the cleanup's
                 raise
+            if dedupe_key is not None:
+                self._dedupe_keys[v] = dedupe_key
+                self._dedupe_scanned.add(v)
             self._set_current(v)
             self._metrics.counter("publishes")
             self._metrics.gauge("current_version", v)
+            _log.info("published version %d to %s%s", v, self.root,
+                      f" (key {dedupe_key!r})" if dedupe_key else "")
         self._notify()
         return v
 

@@ -9,6 +9,7 @@ per-step timers (:mod:`flinkml_tpu.utils.metrics`) and ``jax.profiler``
 integration (:mod:`flinkml_tpu.utils.profiling`).
 """
 
+from flinkml_tpu.utils.logging import enable_console, get_logger, rank_tag
 from flinkml_tpu.utils.metrics import (
     EpochMetricsListener,
     Meter,
@@ -17,6 +18,7 @@ from flinkml_tpu.utils.metrics import (
     default_registry,
     metrics,
 )
+from flinkml_tpu.utils.preemption import PreemptionWatchdog
 from flinkml_tpu.utils.profiling import (
     StepTimer,
     annotate,
@@ -33,4 +35,8 @@ __all__ = [
     "StepTimer",
     "annotate",
     "trace",
+    "enable_console",
+    "get_logger",
+    "rank_tag",
+    "PreemptionWatchdog",
 ]

@@ -1,0 +1,91 @@
+"""Rank-tagged operational logging (VERDICT Missing #4).
+
+The reference inherits Flink's log4j plumbing — every operator logs
+through the TaskManager with its subtask index in the MDC, so a
+multi-node failure can be reconstructed from interleaved logs. This is
+the TPU-pod equivalent: one process-wide logger namespace
+(``flinkml_tpu.*``) whose records carry a ``[rank i/n]`` tag, so logs
+aggregated across the hosts of a pod slice stay attributable.
+
+Library stance: a ``NullHandler`` is installed on the package root
+logger, so embedding applications stay silent unless they configure
+handlers themselves; :func:`enable_console` is the one-liner for
+operators (and the recovery runbook,
+``docs/development/fault_tolerance.md``).
+
+The rank tag is resolved WITHOUT touching jax (``jax.process_index()``
+initializes the XLA backend, which must not happen as an import side
+effect): it reads the standard launcher environment
+(``JAX_PROCESS_ID`` / ``JAX_NUM_PROCESSES``) until
+:func:`set_rank` is called — ``init_distributed`` pins the real values
+right after the rendezvous succeeds.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+ROOT_NAME = "flinkml_tpu"
+
+logging.getLogger(ROOT_NAME).addHandler(logging.NullHandler())
+
+# (process_index, process_count) once known; None = fall back to env.
+_RANK: Optional[Tuple[int, int]] = None
+
+
+def set_rank(process_index: int, process_count: int) -> None:
+    """Pin the rank tag (called by ``init_distributed`` after the
+    rendezvous; safe to call again on re-init)."""
+    global _RANK
+    _RANK = (int(process_index), int(process_count))
+
+
+def rank_tag() -> str:
+    """``[rank i/n]`` — from :func:`set_rank` when pinned, else from the
+    launcher environment (single-process default ``[rank 0/1]``)."""
+    if _RANK is not None:
+        i, n = _RANK
+    else:
+        i = int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+        n = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+    return f"[rank {i}/{n}]"
+
+
+class _RankAdapter(logging.LoggerAdapter):
+    def process(self, msg, kwargs):
+        return f"{rank_tag()} {msg}", kwargs
+
+
+def get_logger(name: str = ROOT_NAME) -> logging.LoggerAdapter:
+    """A rank-tagged logger under the ``flinkml_tpu`` namespace.
+
+    ``name`` may be a dotted suffix (``"checkpoint"``) or a full module
+    path; either way the logger lands under the package root so one
+    handler/level setting controls the whole library.
+    """
+    if not name.startswith(ROOT_NAME):
+        name = f"{ROOT_NAME}.{name}"
+    return _RankAdapter(logging.getLogger(name), {})
+
+
+def enable_console(level: int = logging.INFO) -> logging.Handler:
+    """Attach a stderr handler to the package root (idempotent — reuses
+    an existing console handler) and set its level. Returns the handler."""
+    root = logging.getLogger(ROOT_NAME)
+    for h in root.handlers:
+        if isinstance(h, logging.StreamHandler) and not isinstance(
+            h, logging.NullHandler
+        ):
+            handler = h
+            break
+    else:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        root.addHandler(handler)
+    root.setLevel(level)
+    handler.setLevel(level)
+    return handler

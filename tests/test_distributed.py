@@ -24,6 +24,63 @@ def test_init_distributed_single_process_noop():
     assert (idx, count) == (0, 1)
 
 
+# -- rendezvous retry-with-backoff (ISSUE 4 satellite) ----------------------
+
+def _patch_rendezvous(monkeypatch, outcomes, sleeps):
+    """Route the initialize/is_initialized pair through a script:
+    ``outcomes`` is a list of exceptions to raise (None = succeed)."""
+    calls = []
+
+    def fake_initialize(**kwargs):
+        calls.append(kwargs)
+        outcome = outcomes[len(calls) - 1]
+        if outcome is not None:
+            raise outcome
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    import flinkml_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(dist.time, "sleep", lambda s: sleeps.append(s))
+    return calls
+
+
+def test_init_distributed_retries_transient_rendezvous(monkeypatch):
+    sleeps = []
+    calls = _patch_rendezvous(monkeypatch, [
+        RuntimeError("DEADLINE_EXCEEDED: barrier timed out"),
+        RuntimeError("UNAVAILABLE: failed to connect to coordinator"),
+        None,
+    ], sleeps)
+    idx, count = init_distributed("10.0.0.1:8476", 2, 0,
+                                  max_attempts=3, backoff_s=0.5)
+    assert len(calls) == 3
+    assert sleeps == [0.5, 1.0]  # exponential backoff
+    # The real backend is still the single local process.
+    assert (idx, count) == (jax.process_index(), jax.process_count())
+
+
+def test_init_distributed_fails_fast_on_non_transient(monkeypatch):
+    sleeps = []
+    calls = _patch_rendezvous(monkeypatch, [
+        RuntimeError("INVALID_ARGUMENT: process id 7 out of range"),
+        None,
+    ], sleeps)
+    with pytest.raises(RuntimeError, match="INVALID_ARGUMENT"):
+        init_distributed("10.0.0.1:8476", 2, 0, max_attempts=5)
+    assert len(calls) == 1 and sleeps == []
+
+
+def test_init_distributed_exhausts_attempts(monkeypatch):
+    sleeps = []
+    err = RuntimeError("connection refused")
+    calls = _patch_rendezvous(monkeypatch, [err, err], sleeps)
+    with pytest.raises(RuntimeError, match="connection refused"):
+        init_distributed("10.0.0.1:8476", 2, 0,
+                         max_attempts=2, backoff_s=0.25)
+    assert len(calls) == 2 and sleeps == [0.25]
+
+
 def test_host_barrier_sums_over_all_devices():
     mesh = DeviceMesh()
     assert host_barrier(mesh, tag=1) == mesh.axis_size()

@@ -1,0 +1,324 @@
+"""Crash-safe online training (ISSUE 4): kill/resume bit-parity for the
+online trio — OnlineLogisticRegression, OnlineKMeans,
+OnlineStandardScaler.
+
+Acceptance contract: a ``fit_stream`` killed by an injected fault at
+epoch k, with its NEWEST checkpoint deliberately corrupted, resumes from
+the prior valid snapshot and produces a final model bit-identical to the
+uninterrupted run. Also covered: replay-vs-continue stream cursor
+semantics, resume-as-noop after completion, and the SIGTERM watchdog
+(final checkpoint + serving drain + resume-to-parity).
+"""
+
+import numpy as np
+import pytest
+
+from flinkml_tpu import faults
+from flinkml_tpu.iteration import CheckpointManager
+from flinkml_tpu.models import (
+    OnlineKMeans,
+    OnlineLogisticRegression,
+)
+from flinkml_tpu.models.online_scaler import OnlineStandardScaler
+from flinkml_tpu.table import Table
+from flinkml_tpu.utils.preemption import PreemptionWatchdog
+
+N_BATCHES = 12
+CRASH_EPOCH = 7
+INTERVAL = 2
+
+
+def lr_batches(seed=0, n=N_BATCHES, rows=48, dim=5):
+    rng = np.random.default_rng(seed)
+    true = rng.normal(size=dim) * 2
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(rows, dim))
+        out.append(Table({"features": x,
+                          "label": (x @ true > 0).astype(np.float64)}))
+    return out
+
+
+def km_batches(seed=1, n=N_BATCHES, rows=40, dim=4):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-8, 8, size=(3, dim))
+    out = []
+    for _ in range(n):
+        assign = rng.integers(0, 3, size=rows)
+        x = centers[assign] + rng.normal(scale=0.4, size=(rows, dim))
+        out.append(Table({"features": x}))
+    return out
+
+
+def sc_batches(seed=2, n=N_BATCHES, rows=32, dim=6):
+    rng = np.random.default_rng(seed)
+    return [Table({"input": rng.normal(size=(rows, dim)) * (1 + i)})
+            for i in range(n)]
+
+
+def _lr():
+    return OnlineLogisticRegression().set_alpha(0.5).set_reg(0.01)
+
+
+def _km():
+    return OnlineKMeans().set_k(3).set_seed(11).set_decay_factor(0.9)
+
+
+def _sc():
+    return OnlineStandardScaler()
+
+
+def _crash_and_corrupt(est_factory, batches, mgr, corrupt="arrays"):
+    """Run the acceptance scenario's failure half: injected crash at
+    CRASH_EPOCH, then damage the newest committed snapshot."""
+    with faults.armed(faults.FaultPlan(faults.RaiseAtEpoch(CRASH_EPOCH))):
+        with pytest.raises(faults.FaultInjected):
+            est_factory().fit_stream(batches, checkpoint_manager=mgr,
+                                     checkpoint_interval=INTERVAL)
+    assert mgr.latest_epoch() == CRASH_EPOCH - 1  # 6, the interval commit
+    corrupted = faults.corrupt_latest(mgr, target=corrupt)
+    return corrupted
+
+
+# ---------------------------------------------------------------------------
+# The acceptance criterion, per trainer
+# ---------------------------------------------------------------------------
+
+def test_online_lr_kill_corrupt_resume_bit_exact(tmp_path):
+    batches = lr_batches()
+    golden = _lr().fit_stream(batches)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    corrupted = _crash_and_corrupt(_lr, batches, mgr)
+    assert corrupted == 6
+
+    recovered = _lr().fit_stream(batches, checkpoint_manager=mgr,
+                                 checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(recovered.coefficient, golden.coefficient)
+    assert recovered.model_version == golden.model_version == N_BATCHES
+
+
+def test_online_kmeans_kill_corrupt_resume_bit_exact(tmp_path):
+    batches = km_batches()
+    golden = _km().fit_stream(batches)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    _crash_and_corrupt(_km, batches, mgr, corrupt="manifest")
+
+    recovered = _km().fit_stream(batches, checkpoint_manager=mgr,
+                                 checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(recovered.centroids, golden.centroids)
+    assert recovered.model_version == golden.model_version == N_BATCHES
+
+
+def test_online_scaler_kill_corrupt_resume_bit_exact(tmp_path):
+    batches = sc_batches()
+    golden = _sc().fit_stream(batches)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    _crash_and_corrupt(_sc, batches, mgr, corrupt="truncate")
+
+    recovered = _sc().fit_stream(batches, checkpoint_manager=mgr,
+                                 checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(recovered._mean, golden._mean)
+    np.testing.assert_array_equal(recovered._std, golden._std)
+    assert recovered.model_version == golden.model_version == N_BATCHES
+
+
+# ---------------------------------------------------------------------------
+# Stream cursor semantics
+# ---------------------------------------------------------------------------
+
+def test_replay_vs_continue_cursor(tmp_path):
+    """'replay' re-presents the stream from the start (the trainer skips
+    the consumed prefix); 'continue' consumes a live stream positioned at
+    'now' — the caller hands over only the unconsumed tail."""
+    batches = lr_batches(seed=3)
+    golden = _lr().fit_stream(batches)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    with faults.armed(faults.FaultPlan(faults.RaiseAtEpoch(CRASH_EPOCH))):
+        with pytest.raises(faults.FaultInjected):
+            _lr().fit_stream(iter(batches), checkpoint_manager=mgr,
+                             checkpoint_interval=INTERVAL)
+    ckpt_epoch = mgr.latest_epoch()
+    assert ckpt_epoch == 6
+
+    # continue: the live stream's unconsumed tail starts at the restored
+    # epoch (batches 0..5 are in the snapshot's state already).
+    recovered = _lr().fit_stream(
+        iter(batches[ckpt_epoch:]), checkpoint_manager=mgr,
+        checkpoint_interval=INTERVAL, resume=True, stream_resume="continue",
+    )
+    np.testing.assert_array_equal(recovered.coefficient, golden.coefficient)
+    assert recovered.model_version == N_BATCHES
+
+    # replay on a restartable source reaches the same model.
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt2"), max_to_keep=10)
+    with faults.armed(faults.FaultPlan(faults.RaiseAtEpoch(CRASH_EPOCH))):
+        with pytest.raises(faults.FaultInjected):
+            _lr().fit_stream(batches, checkpoint_manager=mgr2,
+                             checkpoint_interval=INTERVAL)
+    replayed = _lr().fit_stream(batches, checkpoint_manager=mgr2,
+                                checkpoint_interval=INTERVAL, resume=True,
+                                stream_resume="replay")
+    np.testing.assert_array_equal(replayed.coefficient, golden.coefficient)
+
+
+def test_resume_after_completion_is_noop(tmp_path):
+    """A finished run leaves a terminal snapshot; resuming re-runs zero
+    epochs and returns the identical model."""
+    batches = km_batches(seed=9)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    done = _km().fit_stream(batches, checkpoint_manager=mgr,
+                            checkpoint_interval=INTERVAL)
+    assert mgr.latest_epoch() == N_BATCHES  # terminal snapshot
+    again = _km().fit_stream(batches, checkpoint_manager=mgr,
+                             checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(again.centroids, done.centroids)
+    assert again.model_version == done.model_version
+
+
+def test_kmeans_resume_skips_initial_draw_validation(tmp_path):
+    """A resumed run's first batch is NOT the centroid-draw batch: a
+    small-first-batch live tail must resume fine (the rows >= k check
+    applies only to a genuine fresh start)."""
+    batches = km_batches(seed=21)  # 40 rows per batch
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    golden = _km().fit_stream(batches, checkpoint_manager=mgr,
+                              checkpoint_interval=4)
+    # Tail whose first batch has 2 rows < k=3; with stream_resume=
+    # 'continue' the restored centroids make the draw irrelevant.
+    small_tail = [Table({"features": np.asarray(
+        batches[-1].column("features"))[:2]})]
+    resumed = _km().fit_stream(small_tail, checkpoint_manager=mgr,
+                               checkpoint_interval=4, resume=True,
+                               stream_resume="continue")
+    assert resumed.model_version == golden.model_version + 1
+
+
+def test_resume_with_exhausted_stream_returns_checkpointed_model(tmp_path):
+    """'continue' resume where the live tail is already empty (crash at
+    stream end): the checkpointed model comes back, no error."""
+    batches = lr_batches(seed=23)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    done = _lr().fit_stream(batches, checkpoint_manager=mgr,
+                            checkpoint_interval=2)
+    again = _lr().fit_stream(iter([]), checkpoint_manager=mgr,
+                             checkpoint_interval=2, resume=True,
+                             stream_resume="continue")
+    np.testing.assert_array_equal(again.coefficient, done.coefficient)
+    assert again.model_version == done.model_version
+
+    sc_mgr = CheckpointManager(str(tmp_path / "sc"), max_to_keep=10)
+    sc_done = _sc().fit_stream(sc_batches(seed=24),
+                               checkpoint_manager=sc_mgr,
+                               checkpoint_interval=2)
+    sc_again = _sc().fit_stream(iter([]), checkpoint_manager=sc_mgr,
+                                resume=True, stream_resume="continue")
+    np.testing.assert_array_equal(sc_again._mean, sc_done._mean)
+    assert sc_again.model_version == sc_done.model_version
+
+
+def test_empty_stream_with_warm_start_returns_initial_model():
+    """Pre-ISSUE-4 contract preserved: a warm-started trainer fed an
+    empty stream returns the initial model data at version 0."""
+    init = np.array([1.0, -2.0, 3.0])
+    est = OnlineLogisticRegression()
+    est._initial_coefficient = init
+    model = est.fit_stream(iter([]))
+    np.testing.assert_array_equal(model.coefficient, init)
+    assert model.model_version == 0
+
+    centroids = np.arange(6.0).reshape(3, 2)
+    km = OnlineKMeans().set_k(3)
+    km._initial_centroids = centroids
+    kmodel = km.fit_stream(iter([]))
+    np.testing.assert_array_equal(kmodel.centroids, centroids)
+    assert kmodel.model_version == 0
+
+
+def test_resume_without_manager_rejected():
+    with pytest.raises(ValueError, match="requires a checkpoint_manager"):
+        _lr().fit_stream(lr_batches(n=2), resume=True)
+
+
+def test_double_failure_recovery(tmp_path):
+    """Two crashes at different epochs, resume each time — still
+    bit-exact (the reference's failoverCount-parameterized ITCases)."""
+    batches = lr_batches(seed=5)
+    golden = _lr().fit_stream(batches)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    for crash_at in (4, 9):
+        with faults.armed(faults.FaultPlan(faults.RaiseAtEpoch(crash_at))):
+            with pytest.raises(faults.FaultInjected):
+                _lr().fit_stream(batches, checkpoint_manager=mgr,
+                                 checkpoint_interval=1, resume=True)
+        assert mgr.latest_epoch() == crash_at
+    final = _lr().fit_stream(batches, checkpoint_manager=mgr,
+                             checkpoint_interval=1, resume=True)
+    np.testing.assert_array_equal(final.coefficient, golden.coefficient)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM watchdog
+# ---------------------------------------------------------------------------
+
+class _DrainRecorder:
+    def __init__(self):
+        self.stopped = []
+
+    def stop(self, drain=True, timeout=None):
+        self.stopped.append(drain)
+
+
+def test_watchdog_preempts_online_fit_and_resumes(tmp_path):
+    """Preemption mid-fit_stream: the ambient watchdog stops the loop at
+    an epoch boundary, a final checkpoint commits, registered engines
+    drain, and a later resume converges to the uninterrupted model."""
+    batches = lr_batches(seed=7)
+    golden = _lr().fit_stream(batches)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    engine = _DrainRecorder()
+    wd = PreemptionWatchdog(signals=())
+    wd.register_engine(engine)
+
+    # Deterministic trigger: request preemption when epoch 5's transfer
+    # seam fires (the fit is mid-stream).
+    class _RequestAt(faults.Fault):
+        site = "iteration.epoch"
+
+        def should_fire(self, ctx):
+            return ctx.get("epoch") == 5
+
+        def apply(self, ctx):
+            wd.request("scripted preemption")
+
+    with wd:
+        with faults.armed(faults.FaultPlan(_RequestAt())):
+            preempted_model = _lr().fit_stream(
+                batches, checkpoint_manager=mgr, checkpoint_interval=INTERVAL,
+            )
+    # The loop stopped at the epoch-5 boundary with a terminal snapshot
+    # and drained the engine; the partial model is the epoch-5 state.
+    assert mgr.latest_epoch() == 5
+    assert engine.stopped == [True]
+    assert preempted_model.model_version == 5
+
+    resumed = _lr().fit_stream(batches, checkpoint_manager=mgr,
+                               checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(resumed.coefficient, golden.coefficient)
+    assert resumed.model_version == N_BATCHES
+
+
+def test_multiprocess_checkpoint_rejected_cleanly(tmp_path, monkeypatch):
+    """The multi-process online path declares checkpoint support not
+    wired rather than failing deep inside the synced stream."""
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(NotImplementedError, match="multi-process"):
+        _lr().fit_stream(lr_batches(n=2),
+                         checkpoint_manager=CheckpointManager(
+                             str(tmp_path / "c")))

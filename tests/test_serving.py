@@ -495,6 +495,87 @@ def test_publisher_skips_duplicate_terminal_snapshot(tmp_path):
     assert [e for e, _ in pub.published] == [1, 3]  # no duplicate terminal
 
 
+def test_publisher_restart_then_republish_is_idempotent(tmp_path):
+    """ISSUE 4 satellite: a trainer that crashes after publishing epoch E
+    and resumes from the epoch-E checkpoint re-reaches the same publish
+    point — the registry must NOT grow a duplicate version (dedupe keyed
+    on epoch + state fingerprint, committed atomically with the
+    version)."""
+    from flinkml_tpu.iteration import Iterations
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+
+    def step(state, batch, epoch):
+        return state + batch, None
+
+    stream = [np.ones((3, 2)) * i for i in range(5)]
+    pub = SnapshotPublisher(reg, _kmeans_model, every_n_epochs=2,
+                            publish_on_terminate=False)
+    Iterations.iterate_unbounded_streams(
+        step, np.zeros((3, 2)), stream, listeners=[pub]
+    )
+    assert [e for e, _ in pub.published] == [1, 3]
+    assert reg.versions() == [1, 2]
+
+    # "Restart": a FRESH publisher (and fresh registry handle, as a new
+    # process would construct) replays the run from the start — every
+    # publish re-reaches an (epoch, state) the registry already holds.
+    reg2 = ModelRegistry(str(tmp_path / "reg"))
+    pub2 = SnapshotPublisher(reg2, _kmeans_model, every_n_epochs=2,
+                             publish_on_terminate=False)
+    Iterations.iterate_unbounded_streams(
+        step, np.zeros((3, 2)), stream, listeners=[pub2]
+    )
+    # The replayed publishes resolved to the EXISTING versions.
+    assert [v for _, v in pub2.published] == [1, 2]
+    assert reg2.versions() == [1, 2]  # no growth
+    assert reg2.current_version() == 2
+
+    # A genuinely new state still publishes a new version.
+    pub3 = SnapshotPublisher(reg2, _kmeans_model, every_n_epochs=2,
+                             publish_on_terminate=False)
+    Iterations.iterate_unbounded_streams(
+        step, np.ones((3, 2)) * 100, stream, listeners=[pub3]
+    )
+    assert reg2.versions() == [1, 2, 3, 4]
+
+
+def test_publisher_dedupe_hit_still_swaps_engine(tmp_path):
+    """An attached engine may be serving a pre-restart version: a publish
+    that resolves via dedupe must still hot-swap the engine to the
+    resolved version."""
+    from flinkml_tpu.iteration import Iterations
+
+    class SwapRecorder:
+        def __init__(self):
+            self.swaps = []
+
+        def swap_to(self, version):
+            self.swaps.append(version)
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+
+    def step(state, batch, epoch):
+        return state + batch, None
+
+    stream = [np.ones((3, 2))] * 4  # publishes at epochs 1 and 3
+    pub = SnapshotPublisher(reg, _kmeans_model, every_n_epochs=2,
+                            publish_on_terminate=False)
+    Iterations.iterate_unbounded_streams(
+        step, np.zeros((3, 2)), stream, listeners=[pub]
+    )
+    assert reg.versions() == [1, 2]
+
+    eng = SwapRecorder()
+    pub2 = SnapshotPublisher(reg, _kmeans_model, every_n_epochs=2,
+                             publish_on_terminate=False, engine=eng)
+    Iterations.iterate_unbounded_streams(
+        step, np.zeros((3, 2)), stream, listeners=[pub2]
+    )
+    assert reg.versions() == [1, 2]  # all publishes resolved via dedupe
+    assert eng.swaps == [1, 2]       # ...and the engine still swapped
+
+
 def test_publisher_from_kmeans_stream(tmp_path):
     """The train_*_stream hook: a live Lloyd loop emits registry versions
     mid-stream, and the published centroids match the run's trajectory."""

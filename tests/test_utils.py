@@ -173,3 +173,59 @@ def test_render_text_full_precision_and_type_collisions():
     assert 'flinkml_depth{group="a"} 2' in text
     assert "# TYPE flinkml_depth_gauge gauge" in text
     assert 'flinkml_depth_gauge{group="b"} 5' in text
+
+
+# -- rank-tagged logging (ISSUE 4 satellite) ---------------------------------
+
+def test_rank_tagged_logger(caplog):
+    import logging
+
+    from flinkml_tpu.utils import logging as flog
+
+    log = flog.get_logger("testrank")
+    with caplog.at_level(logging.INFO, logger="flinkml_tpu.testrank"):
+        log.info("hello %s", "world")
+    assert caplog.records[-1].getMessage() == "[rank 0/1] hello world"
+    # Pinning the rank changes the tag; restore for other tests.
+    try:
+        flog.set_rank(3, 8)
+        assert flog.rank_tag() == "[rank 3/8]"
+    finally:
+        flog._RANK = None
+    assert flog.rank_tag() == "[rank 0/1]"
+
+
+def test_logger_namespace_and_console_handler_idempotent():
+    import logging
+
+    from flinkml_tpu.utils import logging as flog
+
+    assert flog.get_logger("x").logger.name == "flinkml_tpu.x"
+    assert flog.get_logger("flinkml_tpu.y").logger.name == "flinkml_tpu.y"
+    root = logging.getLogger("flinkml_tpu")
+    before = list(root.handlers)
+    try:
+        h1 = flog.enable_console(logging.WARNING)
+        h2 = flog.enable_console(logging.INFO)
+        assert h1 is h2  # reused, not stacked
+        assert h2.level == logging.INFO
+    finally:
+        root.handlers = before
+        root.setLevel(logging.NOTSET)
+
+
+def test_checkpoint_operations_emit_logs(tmp_path, caplog):
+    import logging
+
+    import numpy as np
+
+    from flinkml_tpu.iteration import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=1)
+    with caplog.at_level(logging.INFO, logger="flinkml_tpu.checkpoint"):
+        mgr.save({"w": np.ones(2)}, 1)
+        mgr.save({"w": np.ones(2)}, 2)  # prunes epoch 1
+    text = " ".join(r.getMessage() for r in caplog.records)
+    assert "checkpoint committed: epoch 1" in text
+    assert "pruning checkpoint epoch 1" in text
+    assert "[rank 0/1]" in text

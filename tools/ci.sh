@@ -97,6 +97,56 @@ print('serving smoke: rows/s', rec['serving_rows_per_sec'],
 }
 stage "serving smoke (CPU)" serving_smoke
 
+# Chaos smoke (ISSUE 4 acceptance): kill an online LR fit under a
+# scripted fault plan, corrupt the newest committed snapshot, resume from
+# the prior valid one, and require the final model bit-identical to the
+# uninterrupted run. Device-free (JAX_PLATFORMS=cpu).
+chaos_smoke() {
+    JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import tempfile
+
+import numpy as np
+
+from flinkml_tpu import faults
+from flinkml_tpu.iteration import CheckpointManager
+from flinkml_tpu.models import OnlineLogisticRegression
+from flinkml_tpu.table import Table
+
+rng = np.random.default_rng(0)
+true = rng.normal(size=6) * 2
+batches = []
+for _ in range(12):
+    x = rng.normal(size=(64, 6))
+    batches.append(Table({"features": x,
+                          "label": (x @ true > 0).astype(np.float64)}))
+
+def fit(**kw):
+    return OnlineLogisticRegression().set_alpha(0.5).fit_stream(batches, **kw)
+
+golden = fit()
+
+with tempfile.TemporaryDirectory() as td:
+    mgr = CheckpointManager(td, max_to_keep=10)
+    plan = faults.FaultPlan(faults.RaiseAtEpoch(7))
+    try:
+        with faults.armed(plan):
+            fit(checkpoint_manager=mgr, checkpoint_interval=2)
+        raise SystemExit("injected crash did not fire")
+    except faults.FaultInjected:
+        pass
+    assert mgr.latest_epoch() == 6, mgr.all_epochs()
+    corrupted = faults.corrupt_latest(mgr, target="arrays")
+    recovered = fit(checkpoint_manager=mgr, checkpoint_interval=2,
+                    resume=True)
+    assert np.array_equal(recovered.coefficient, golden.coefficient), \
+        "resumed model != uninterrupted model"
+    assert recovered.model_version == golden.model_version == 12
+    print("chaos smoke: killed at epoch 7, corrupted snapshot", corrupted,
+          "-> resumed from epoch 4, bit-exact parity")
+EOF
+}
+stage "chaos smoke (kill+corrupt+resume)" chaos_smoke
+
 example_smoke() {
     local ex
     for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
