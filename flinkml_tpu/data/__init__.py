@@ -1,0 +1,60 @@
+"""flinkml_tpu.data — streaming input pipelines with checkpointable
+cursors and async device prefetch.
+
+The fifth subsystem (ISSUE 5): the reference's DataStream layer gives
+every trainer a uniform, replayable, backpressured record feed; this
+package is that feed in the tf.data mold, TPU-shaped —
+
+    source → map/filter/rebatch/window → shuffle → prefetch-to-device
+
+built from sharded :mod:`~flinkml_tpu.data.source` heads, composable
+deterministic :mod:`~flinkml_tpu.data.ops`, a bucket-padding
+:class:`DevicePrefetcher` tail that feeds the fused executor with zero
+retraces, and a :class:`Cursor` that rides
+:class:`~flinkml_tpu.iteration.CheckpointManager` snapshots so a killed
+and resumed pipeline replays the exact uninterrupted batch sequence
+(shuffle order included). See ``docs/operators/data.md``.
+"""
+
+from flinkml_tpu.data.dataset import Dataset, DatasetIterator
+from flinkml_tpu.data.ops import (
+    FilterOp,
+    MapOp,
+    Op,
+    RebatchOp,
+    ShuffleOp,
+    WindowOp,
+)
+from flinkml_tpu.data.prefetch import DevicePrefetcher, pad_place_table
+from flinkml_tpu.data.source import (
+    ArraySource,
+    CSVSource,
+    LibSVMSource,
+    Source,
+    SourceIterator,
+    SyntheticSource,
+    resolve_shard,
+)
+from flinkml_tpu.data.state import Cursor, rng_state_dict
+
+__all__ = [
+    "Dataset",
+    "DatasetIterator",
+    "Cursor",
+    "rng_state_dict",
+    "Source",
+    "SourceIterator",
+    "ArraySource",
+    "CSVSource",
+    "LibSVMSource",
+    "SyntheticSource",
+    "resolve_shard",
+    "Op",
+    "MapOp",
+    "FilterOp",
+    "RebatchOp",
+    "WindowOp",
+    "ShuffleOp",
+    "DevicePrefetcher",
+    "pad_place_table",
+]

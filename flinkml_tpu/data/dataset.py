@@ -1,0 +1,314 @@
+"""The Dataset chain: source → transforms → shuffle → prefetch-to-device.
+
+The reference hands every trainer a uniform, replayable, backpressured
+record feed through its DataStream layer; this class is that feed,
+TPU-shaped: a declarative chain over a sharded
+:class:`~flinkml_tpu.data.source.Source`, composable
+:mod:`~flinkml_tpu.data.ops` transforms, and an optional
+:class:`~flinkml_tpu.data.prefetch.DevicePrefetcher` tail. A Dataset is
+an iterable of :class:`~flinkml_tpu.table.Table` batches, so it drops
+in anywhere a batch iterable is accepted today — ``fit_stream`` of the
+online trio, the streamed ``fit`` families, ``iterate`` — and the
+iteration runtime additionally recognizes it to checkpoint and restore
+its :class:`~flinkml_tpu.data.state.Cursor` (see
+``docs/operators/data.md``).
+
+Datasets are immutable: every combinator returns a new chain sharing
+the source. Iteration state lives entirely in the
+:class:`DatasetIterator`, so concurrent iterations never interfere.
+
+Resume model: every stage is deterministic, so position ``k`` ⇒ "the
+batch sequence's k-th element". ``iterate(cursor)`` restores by
+fast-forwarding — pushed down to the source in O(1)/O(parse) when the
+chain is skip-transparent (no cardinality-changing op), or by replaying
+the chain and dropping the consumed prefix otherwise (shuffle included:
+the seeded buffer regenerates the identical order). Either way the
+resumed consumer sees the exact uninterrupted sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flinkml_tpu.data.ops import (
+    FilterOp,
+    MapOp,
+    Op,
+    RebatchOp,
+    ShuffleOp,
+    WindowOp,
+)
+from flinkml_tpu.data.source import (
+    ArraySource,
+    CSVSource,
+    LibSVMSource,
+    Source,
+    SourceIterator,
+    SyntheticSource,
+)
+from flinkml_tpu.data.state import Cursor, rng_state_dict
+from flinkml_tpu.table import Table
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("data")
+
+
+class Dataset:
+    """An immutable source → ops → prefetch chain of Table batches."""
+
+    def __init__(self, source: Source, ops: Sequence[Op] = (),
+                 prefetch_spec: Optional[dict] = None):
+        if not isinstance(source, Source):
+            raise TypeError(
+                f"Dataset requires a data.Source head, got {type(source)!r}"
+            )
+        self._source = source
+        self._ops: Tuple[Op, ...] = tuple(ops)
+        self._prefetch = prefetch_spec
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_source(source: Source) -> "Dataset":
+        return Dataset(source)
+
+    @staticmethod
+    def from_arrays(data, batch_size: int, shard=None, mesh=None) -> "Dataset":
+        """In-memory Table / column-dict source (see :class:`ArraySource`)."""
+        return Dataset(ArraySource(data, batch_size, shard=shard, mesh=mesh))
+
+    @staticmethod
+    def from_csv(pattern, batch_size: int, delimiter: str = ",",
+                 header="auto", shard=None, mesh=None) -> "Dataset":
+        """Numeric-CSV file glob source (see :class:`CSVSource`)."""
+        return Dataset(CSVSource(pattern, batch_size, delimiter=delimiter,
+                                 header=header, shard=shard, mesh=mesh))
+
+    @staticmethod
+    def from_libsvm(pattern, batch_size: int, n_features: int,
+                    shard=None, mesh=None, **kw) -> "Dataset":
+        """LibSVM file glob source (see :class:`LibSVMSource`)."""
+        return Dataset(LibSVMSource(pattern, batch_size, n_features,
+                                    shard=shard, mesh=mesh, **kw))
+
+    @staticmethod
+    def synthetic(make_batch: Callable[[int, np.random.Generator], Table],
+                  num_batches: int, seed: int = 0, shard=None,
+                  mesh=None) -> "Dataset":
+        """Seeded generator source (see :class:`SyntheticSource`)."""
+        return Dataset(SyntheticSource(make_batch, num_batches, seed=seed,
+                                       shard=shard, mesh=mesh))
+
+    # -- combinators --------------------------------------------------------
+    def _with_op(self, op: Op) -> "Dataset":
+        if self._prefetch is not None:
+            raise ValueError(
+                "prefetch() must be the LAST stage of a Dataset chain "
+                "(its output lives on device; host transforms cannot "
+                "follow it)"
+            )
+        return Dataset(self._source, self._ops + (op,), None)
+
+    def map(self, fn: Callable[[Table], Table]) -> "Dataset":
+        return self._with_op(MapOp(fn))
+
+    def filter(self, pred: Callable[[Table], np.ndarray]) -> "Dataset":
+        return self._with_op(FilterOp(pred))
+
+    def rebatch(self, batch_size: int,
+                drop_remainder: bool = False) -> "Dataset":
+        return self._with_op(RebatchOp(batch_size, drop_remainder))
+
+    def window(self, size: int, stride: Optional[int] = None) -> "Dataset":
+        return self._with_op(WindowOp(size, stride))
+
+    def shuffle(self, buffer_batches: int, seed: int = 0) -> "Dataset":
+        return self._with_op(ShuffleOp(buffer_batches, seed))
+
+    def prefetch(self, depth: int = 2, place=None,
+                 metrics_group: str = "data.prefetch") -> "Dataset":
+        """Append the async host→device tail (see
+        :class:`~flinkml_tpu.data.prefetch.DevicePrefetcher`): batches
+        arrive as Tables of bucket-padded device-resident columns."""
+        if self._prefetch is not None:
+            raise ValueError("Dataset already has a prefetch stage")
+        return Dataset(self._source, self._ops, dict(
+            depth=depth, place=place, metrics_group=metrics_group,
+        ))
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def skip_transparent(self) -> bool:
+        """True when every op maps batches 1:1, so a resume's skip can
+        be pushed down to the source instead of replaying the chain."""
+        return all(op.skip_transparent for op in self._ops)
+
+    def describe(self) -> str:
+        parts = [type(self._source).__name__]
+        parts += [op.describe() for op in self._ops]
+        if self._prefetch is not None:
+            parts.append(f"prefetch(depth={self._prefetch['depth']})")
+        return " -> ".join(parts)
+
+    # -- iteration ----------------------------------------------------------
+    def iterate(self, cursor: Optional[Cursor] = None) -> "DatasetIterator":
+        """A fresh tracked iteration, optionally restored to ``cursor``
+        (the consumer's next batch is sequence element
+        ``cursor.emitted``)."""
+        return DatasetIterator(self, cursor)
+
+    def iterate_from(self, emitted: int) -> "DatasetIterator":
+        """Restore-by-watermark: equivalent to ``iterate(Cursor(emitted))``."""
+        return DatasetIterator(self, Cursor(emitted=int(emitted)))
+
+    def __iter__(self) -> "DatasetIterator":
+        return self.iterate()
+
+    def peek(self) -> Optional[Table]:
+        """The first batch (or None for an empty pipeline), produced by
+        a throwaway prefetch-free iteration — peeking must not leave a
+        worker thread behind or consume the real feed."""
+        ds = (self if self._prefetch is None
+              else Dataset(self._source, self._ops, None))
+        it = ds.iterate()
+        try:
+            return next(it)
+        except StopIteration:
+            return None
+        finally:
+            it.close()
+
+
+def _drop(it: Iterator[Table], n: int) -> Iterator[Table]:
+    for _ in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            return
+    for batch in it:
+        yield batch
+
+
+class _ChainState:
+    """State shared between the chain generators and the
+    DatasetIterator. A separate object on purpose: the prefetch worker
+    holds the chain, so the chain must NOT reference the DatasetIterator
+    (which owns the prefetcher) — that cycle would keep an abandoned
+    prefetcher reachable from the worker's own stack and defeat the
+    GC-finalizer thread cleanup."""
+
+    __slots__ = ("shuffle_rng",)
+
+    def __init__(self):
+        self.shuffle_rng: Optional[np.random.Generator] = None
+
+    def register_shuffle_probe(self, rng: np.random.Generator) -> None:
+        """Called by :class:`~flinkml_tpu.data.ops.ShuffleOp` so cursor
+        snapshots can record the buffer's RNG state."""
+        self.shuffle_rng = rng
+
+
+def _read_seam(src: "SourceIterator", shard_index: int) -> Iterator[Table]:
+    """Source reads through the ``data.read`` fault seam. Module-level
+    (not a DatasetIterator method) for the same no-back-reference reason
+    as :class:`_ChainState`."""
+    import flinkml_tpu.faults as faults
+
+    for batch in src:
+        if faults.ACTIVE is not None:  # scripted source-failure seam
+            faults.fire("data.read", read=src.batches_read,
+                        shard=shard_index)
+        yield batch
+
+
+class DatasetIterator:
+    """One tracked iteration of a :class:`Dataset`.
+
+    Tracks the delivered-batch watermark and the source/shuffle
+    positions for :meth:`cursor` snapshots; fires the ``data.read``
+    fault seam per source batch; owns (and closes) the prefetcher.
+    """
+
+    def __init__(self, dataset: Dataset, cursor: Optional[Cursor] = None):
+        self._dataset = dataset
+        skip = int(cursor.emitted) if cursor is not None else 0
+        fast = dataset.skip_transparent
+        if skip:
+            _log.info(
+                "dataset resume: fast-forwarding %d batches (%s skip) — %s",
+                skip, "source" if fast else "replay", dataset.describe(),
+            )
+        self._src = dataset._source.open(skip_batches=skip if fast else 0)
+        self._chain_state = _ChainState()
+        it: Iterator[Table] = _read_seam(
+            self._src, dataset._source.shard_index
+        )
+        for op in dataset._ops:
+            it = op.apply(it, self._chain_state)
+        if skip and not fast:
+            it = _drop(it, skip)
+        self._prefetcher = None
+        if dataset._prefetch is not None:
+            from flinkml_tpu.data.prefetch import DevicePrefetcher
+
+            self._prefetcher = DevicePrefetcher(it, **dataset._prefetch)
+            it = self._prefetcher
+        self._it = it
+        self._emitted = skip
+        self._closed = False
+
+    # -- iterator protocol --------------------------------------------------
+    def __iter__(self) -> "DatasetIterator":
+        return self
+
+    def __next__(self) -> Table:
+        if self._closed:
+            raise StopIteration
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self.close()
+            raise
+        self._emitted += 1
+        return batch
+
+    # -- cursor -------------------------------------------------------------
+    @property
+    def emitted(self) -> int:
+        return self._emitted
+
+    def cursor(self) -> Cursor:
+        """The current position: ``emitted`` is the replay watermark;
+        source/shuffle/in-flight record where the producer side stands
+        (ahead of the watermark by whatever sits in transform buffers
+        and the prefetch queue)."""
+        # batches_read counts source batches consumed on behalf of this
+        # iteration (a replay-resumed iterator's dropped prefix
+        # included — those outputs were consumed too, just internally),
+        # so reads minus deliveries IS the in-flight population on both
+        # the fast-skip and replay paths.
+        src_pos = self._src.position()
+        in_flight = max(0, src_pos["batches_read"] - self._emitted)
+        return Cursor(
+            emitted=self._emitted,
+            source=src_pos,
+            shuffle=(rng_state_dict(self._chain_state.shuffle_rng)
+                     if self._chain_state.shuffle_rng is not None else None),
+            in_flight=in_flight,
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        """Stop the prefetch worker (if any) and end the iteration.
+        Idempotent; always safe to call from a ``finally``."""
+        self._closed = True
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+
+    def __enter__(self) -> "DatasetIterator":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False

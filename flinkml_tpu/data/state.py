@@ -1,0 +1,104 @@
+"""Checkpointable input-pipeline cursors.
+
+The reference's DataStream sources carry their read position in operator
+state, so a restored job resumes the feed exactly where the checkpoint
+cut it (``DataCacheReader.java:35-135`` keeps the same contract for the
+iteration-internal cache). Here the position of a whole
+:class:`~flinkml_tpu.data.Dataset` chain — source shard/offset, shuffle
+RNG state, and the consumer's delivered-batch watermark — folds into one
+:class:`Cursor` that rides a checkpoint two ways:
+
+- **inside ``iterate``** (the online trainers' path): the runtime stores
+  the cursor in the snapshot's ``extra`` manifest field on every
+  checkpoint and re-opens the Dataset from it on resume, so a killed
+  and resumed pipeline replays the exact uninterrupted batch sequence —
+  shuffle order included (every stage of the chain is deterministic in
+  its seed, so position + replay ⇒ identical batches);
+- **standalone** (hand-rolled loops): :meth:`Cursor.to_state` returns a
+  one-leaf pytree (the JSON encoding as a uint8 array) that can ride
+  any :class:`~flinkml_tpu.iteration.CheckpointManager` snapshot next
+  to the model state; :meth:`Cursor.from_state` decodes it back.
+
+``emitted`` is the authoritative field — the number of output batches
+the CONSUMER has received. ``source``/``shuffle``/``in_flight`` record
+where the producer side stood at snapshot time (the prefetcher may have
+read ahead; ``in_flight`` is that watermark) — they make a cursor
+auditable and let a skip-transparent chain fast-forward at the source,
+but restore correctness never depends on them: a resumed Dataset
+re-derives everything from ``emitted`` plus its own seeds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Cursor:
+    """Position of a :class:`~flinkml_tpu.data.Dataset` iteration.
+
+    Fields:
+      emitted: output batches already delivered to the consumer — the
+        replay watermark (a restored iteration produces batch
+        ``emitted`` next).
+      source: the source's own position record (shard index, row/batch
+        offset, reads) at snapshot time; diagnostic + fast-skip aid.
+      shuffle: the shuffle buffer's RNG bit-generator state at snapshot
+        time (diagnostic — replay regenerates it from the seed).
+      in_flight: source batches read past the delivered watermark
+        (sitting in transform/prefetch stages when the snapshot cut).
+    """
+
+    emitted: int = 0
+    source: Optional[Dict[str, Any]] = None
+    shuffle: Optional[Dict[str, Any]] = None
+    in_flight: int = 0
+
+    # -- JSON (checkpoint ``extra`` transport) ------------------------------
+    def to_json_dict(self) -> Dict[str, Any]:
+        return {
+            "emitted": int(self.emitted),
+            "source": self.source,
+            "shuffle": self.shuffle,
+            "in_flight": int(self.in_flight),
+        }
+
+    @staticmethod
+    def from_json_dict(d: Dict[str, Any]) -> "Cursor":
+        return Cursor(
+            emitted=int(d.get("emitted", 0)),
+            source=d.get("source"),
+            shuffle=d.get("shuffle"),
+            in_flight=int(d.get("in_flight", 0)),
+        )
+
+    # -- pytree leaf (standalone CheckpointManager transport) ---------------
+    def to_state(self) -> Dict[str, np.ndarray]:
+        """A one-leaf pytree encoding for riding a CheckpointManager
+        snapshot next to model state (``{"cursor": <uint8 array>}``)."""
+        payload = json.dumps(self.to_json_dict(), sort_keys=True).encode()
+        return {"cursor": np.frombuffer(payload, dtype=np.uint8).copy()}
+
+    @staticmethod
+    def from_state(state: Dict[str, np.ndarray]) -> "Cursor":
+        payload = np.asarray(state["cursor"], dtype=np.uint8).tobytes()
+        return Cursor.from_json_dict(json.loads(payload.decode()))
+
+
+def rng_state_dict(rng: np.random.Generator) -> Dict[str, Any]:
+    """A JSON-safe copy of a numpy Generator's bit-generator state."""
+
+    def clean(x):
+        if isinstance(x, dict):
+            return {k: clean(v) for k, v in x.items()}
+        if isinstance(x, np.ndarray):
+            return [int(v) for v in x.tolist()]
+        if isinstance(x, (np.integer,)):
+            return int(x)
+        return x
+
+    return clean(rng.bit_generator.state)

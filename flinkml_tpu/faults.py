@@ -24,6 +24,15 @@ site                      where it fires
                           host↔device synchronization seam
 ``registry.publish``      top of ``ModelRegistry.publish``, before any file
                           is written (a raise drops the publish)
+``data.read``             every source-batch read of a
+                          :class:`flinkml_tpu.data.DatasetIterator`, after
+                          the batch left the source and before any
+                          transform touches it
+``data.prefetch``         inside the :class:`flinkml_tpu.data
+                          .DevicePrefetcher` worker, before each batch's
+                          pad + host→device placement (a raise propagates
+                          to the consumer's ``next()`` with the worker's
+                          traceback; a delay models a slow producer)
 ========================  ====================================================
 
 Arming is explicit and scoped (:func:`armed`); with **no plan armed the
@@ -225,6 +234,69 @@ class DropPublish(Fault):
 
     def describe(self):
         return f"DropPublish(#{self.at_publish})"
+
+
+class RaiseAtRead(Fault):
+    """Raise :class:`FaultInjected` at the N-th input-pipeline read
+    event after arming (1-based) — the scripted mid-stream SOURCE
+    failure (a vanished file, a dead upstream). ``site`` defaults to
+    ``data.read``; pass ``site='data.prefetch'`` to fail inside the
+    prefetch worker instead (exercising the worker→consumer exception
+    propagation path)."""
+
+    def __init__(self, at_read: int = 1, site: str = "data.read",
+                 message: str = "injected source failure"):
+        if site not in ("data.read", "data.prefetch"):
+            raise ValueError(
+                f"site must be 'data.read' or 'data.prefetch', got {site!r}"
+            )
+        self.site = site
+        self.at_read = int(at_read)
+        self.message = message
+        self._seen = 0
+        self.fired = False
+
+    def should_fire(self, ctx):
+        self._seen += 1
+        return not self.fired and self._seen == self.at_read
+
+    def apply(self, ctx):
+        self.fired = True
+        raise FaultInjected(f"{self.message} (read #{self.at_read})")
+
+    def describe(self):
+        return f"RaiseAtRead(#{self.at_read}, {self.site})"
+
+
+class DelayRead(Fault):
+    """Sleep ``delay_s`` on every input-pipeline read event (or only
+    the first ``first_n``) — the deterministic slow producer, used to
+    prove the prefetcher overlaps source latency with consumer compute.
+    Never raises."""
+
+    def __init__(self, delay_s: float = 0.01,
+                 first_n: Optional[int] = None, site: str = "data.read"):
+        if site not in ("data.read", "data.prefetch"):
+            raise ValueError(
+                f"site must be 'data.read' or 'data.prefetch', got {site!r}"
+            )
+        self.site = site
+        self.delay_s = float(delay_s)
+        self.first_n = None if first_n is None else int(first_n)
+        self._seen = 0
+        self.fired = False
+
+    def should_fire(self, ctx):
+        self._seen += 1
+        return self.first_n is None or self._seen <= self.first_n
+
+    def apply(self, ctx):
+        self.fired = True
+        time.sleep(self.delay_s)
+
+    def describe(self):
+        n = "*" if self.first_n is None else self.first_n
+        return f"DelayRead({self.delay_s}s, first_n={n}, {self.site})"
 
 
 class FaultPlan:

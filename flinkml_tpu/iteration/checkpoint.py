@@ -193,6 +193,11 @@ class CheckpointManager:
         self.async_write = async_write
         self._executor: Optional[ThreadPoolExecutor] = None
         self._pending: Optional[Future] = None
+        #: The ``extra`` manifest dict of the snapshot the most recent
+        #: successful :meth:`restore` returned (``{}`` before any
+        #: restore). The iteration runtime reads the input-pipeline
+        #: cursor (``data_cursor``) from here after ``restore_latest``.
+        self.last_restored_extra: dict = {}
         os.makedirs(directory, exist_ok=True)
 
     def _world_size(self) -> int:
@@ -375,6 +380,7 @@ class CheckpointManager:
                 f"structure has {treedef.num_leaves}"
             )
         state = jax.tree_util.tree_unflatten(treedef, host_leaves)
+        self.last_restored_extra = meta.get("extra") or {}
         return state, int(meta["epoch"])
 
     def restore_latest(self, like: Any) -> Optional[Tuple[Any, int]]:

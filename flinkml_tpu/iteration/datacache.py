@@ -334,6 +334,41 @@ def replay(cache: DataCache, num_epochs: Optional[int] = None) -> Iterator[Tuple
 # Prefetching device feed
 # ---------------------------------------------------------------------------
 
+_FEED_END = object()
+
+
+def _feed_worker(batches: Iterable[Any], place, q: "queue.Queue",
+                 stop: threading.Event, err_box: list) -> None:
+    """The feed's producer loop — a module-level function on purpose: it
+    must hold NO reference back to the feed object, so a consumer that
+    abandons iteration and drops its handle leaves the feed
+    garbage-collectable, and the feed's GC finalizer (which sets
+    ``stop``) releases this thread instead of leaking it."""
+
+    def put(item) -> bool:
+        # Abort-aware blocking put: must not be dropped when the queue is
+        # momentarily full (a consumer would then block forever), and must
+        # not block after close()/GC (the timed put re-checks ``stop``).
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        for b in batches:
+            if stop.is_set():
+                return  # abandoned/closed — don't pay the next transfer
+            if not put(place(b)):
+                return  # closed while blocked — drop and exit
+    except BaseException as e:  # surfaced (with traceback) on next()
+        err_box.append(e)
+    finally:
+        put(_FEED_END)
+
+
 class PrefetchingDeviceFeed:
     """Background host→device transfer pipeline over a batch iterator.
 
@@ -343,56 +378,51 @@ class PrefetchingDeviceFeed:
     in a queue. With ``depth>=2`` the next batch's PCIe/DMA transfer runs
     under the current step's compute — the TPU analog of the reference's
     credit-based network buffering, minus the network.
+
+    Lifecycle: the feed is a context manager; ``close()`` (idempotent)
+    stops the worker and drains the queue. A consumer that abandons
+    iteration WITHOUT closing does not leak the worker — the worker
+    holds no reference to the feed, so dropping the handle lets GC run a
+    finalizer that stops it. A raising producer parks its exception and
+    every subsequent ``next()`` re-raises it with the producer's
+    original traceback.
     """
 
-    _END = object()
+    _END = _FEED_END  # kept for callers/tests that referenced it
 
-    def __init__(self, batches: Iterable[Any], place=None, depth: int = 2):
+    def __init__(self, batches: Iterable[Any], place=None, depth: int = 2,
+                 thread_name: str = "device-feed"):
         import jax
+        import weakref
 
         self._place = place if place is not None else jax.device_put
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
-        self._err: Optional[BaseException] = None
+        self._err_box: list = []
         self._stop = threading.Event()
         self._done = False
 
-        def worker():
-            try:
-                for b in batches:
-                    if not self._put(self._place(b)):
-                        return  # closed while blocked — drop and exit
-            except BaseException as e:  # surfaced on next()
-                self._err = e
-            finally:
-                # Abort-aware blocking put: must not be dropped when the
-                # queue is momentarily full (a consumer would then block
-                # forever), and must not block after close() (_put aborts).
-                self._put(self._END)
-
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(
+            target=_feed_worker,
+            args=(batches, self._place, self._q, self._stop, self._err_box),
+            daemon=True,
+            name=thread_name,
+        )
         self._thread.start()
-
-    def _put(self, item) -> bool:
-        """Blocking put that aborts when the feed is closed; True if queued."""
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.05)
-                return True
-            except queue.Full:
-                continue
-        return False
+        self._finalizer = weakref.finalize(self, self._stop.set)
 
     def __iter__(self) -> "PrefetchingDeviceFeed":
         return self
 
     def __next__(self):
         if self._done:
+            if self._err_box:
+                raise self._err_box[0]
             raise StopIteration
         item = self._q.get()
-        if item is self._END:
+        if item is _FEED_END:
             self._done = True  # later next() must not block on an empty queue
-            if self._err is not None:
-                raise self._err
+            if self._err_box:
+                raise self._err_box[0]
             raise StopIteration
         return item
 
@@ -415,3 +445,10 @@ class PrefetchingDeviceFeed:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+
+    def __enter__(self) -> "PrefetchingDeviceFeed":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False

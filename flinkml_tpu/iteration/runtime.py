@@ -286,7 +286,12 @@ def iterate(
         data: None, a static pytree (bounded replay — every epoch sees the
             same data), a callable ``epoch -> batch`` (returns None to end —
             unbounded/online mode), or an iterable of per-epoch batches
-            (unbounded; termination when exhausted).
+            (unbounded; termination when exhausted). A
+            :class:`flinkml_tpu.data.Dataset` is accepted anywhere an
+            iterable is: the loop then checkpoints the pipeline's
+            :class:`~flinkml_tpu.data.Cursor` alongside the state (in the
+            snapshot's ``extra`` manifest) and a resumed run reopens the
+            Dataset at the exact batch the crash interrupted.
         config: termination + checkpointing.
         listeners: epoch-boundary callbacks.
         resume: restore (state, epoch) from ``config.checkpoint_manager``
@@ -305,18 +310,23 @@ def iterate(
             state, start_epoch = restored
 
     data_iter: Optional[Iterator] = None
+    dataset_iter = None  # tracked flinkml_tpu.data iterator (cursor owner)
     if data is not None and not callable(data) and _is_stream(data):
-        data_iter = iter(data)
-        if config.stream_resume == "replay":
-            # The iterable restarts from the beginning: fast-forward past
-            # the epochs the pre-failure run consumed. For a live one-shot
-            # stream this would drop real data — set
-            # stream_resume='continue' there.
-            for _ in range(start_epoch):
-                try:
-                    next(data_iter)
-                except StopIteration:
-                    break
+        dataset_iter = _open_dataset(data, start_epoch, config)
+        if dataset_iter is not None:
+            data_iter = dataset_iter
+        else:
+            data_iter = iter(data)
+            if config.stream_resume == "replay":
+                # The iterable restarts from the beginning: fast-forward
+                # past the epochs the pre-failure run consumed. For a live
+                # one-shot stream this would drop real data — set
+                # stream_resume='continue' there.
+                for _ in range(start_epoch):
+                    try:
+                        next(data_iter)
+                    except StopIteration:
+                        break
 
     criteria_history: List[Optional[float]] = []
     outputs: List[Any] = []
@@ -342,47 +352,59 @@ def iterate(
     from flinkml_tpu.parallel.dispatch import DispatchGuard
 
     guard = DispatchGuard()
-    while not terminated:
-        if faults.ACTIVE is not None:  # scripted-crash seam (pre-batch)
-            faults.fire("iteration.epoch", epoch=epoch)
-        if watchdog is not None and watchdog.requested:
-            # Epoch boundaries are the globally consistent points in SPMD
-            # lockstep — stop here, snapshot below, drain, hand back.
-            preempted = True
-            break
-        batch, exhausted = _epoch_data(data, epoch, data_iter)
-        if exhausted:
-            break
+    try:
+        while not terminated:
+            if faults.ACTIVE is not None:  # scripted-crash seam (pre-batch)
+                faults.fire("iteration.epoch", epoch=epoch)
+            if watchdog is not None and watchdog.requested:
+                # Epoch boundaries are the globally consistent points in
+                # SPMD lockstep — stop here, snapshot below, drain, hand
+                # back.
+                preempted = True
+                break
+            batch, exhausted = _epoch_data(data, epoch, data_iter)
+            if exhausted:
+                break
 
-        if data is None:
-            result = step_fn(state, epoch)
-        else:
-            result = step_fn(state, batch, epoch)
-        if not isinstance(result, tuple):
-            state, criteria = result, None
-        elif len(result) == 2:
-            state, criteria = result
-        else:
-            state, criteria, output = result
-            outputs.append(output)
+            if data is None:
+                result = step_fn(state, epoch)
+            else:
+                result = step_fn(state, batch, epoch)
+            if not isinstance(result, tuple):
+                state, criteria = result, None
+            elif len(result) == 2:
+                state, criteria = result
+            else:
+                state, criteria, output = result
+                outputs.append(output)
 
-        criteria_value = None if criteria is None else float(criteria)
-        if criteria_value is None:
-            guard.after_dispatch(state)
-        criteria_history.append(criteria_value)
+            criteria_value = None if criteria is None else float(criteria)
+            if criteria_value is None:
+                guard.after_dispatch(state)
+            criteria_history.append(criteria_value)
 
-        state = notify_epoch_listeners(listeners, epoch, state)
+            state = notify_epoch_listeners(listeners, epoch, state)
 
-        terminated = config.termination.should_terminate(epoch, criteria_value)
-        epoch += 1
+            terminated = config.termination.should_terminate(
+                epoch, criteria_value
+            )
+            epoch += 1
 
-        if (
-            config.checkpoint_interval > 0
-            and config.checkpoint_manager is not None
-            and epoch % config.checkpoint_interval == 0
-        ):
-            config.checkpoint_manager.save(state, epoch)
-            last_saved = epoch
+            if (
+                config.checkpoint_interval > 0
+                and config.checkpoint_manager is not None
+                and epoch % config.checkpoint_interval == 0
+            ):
+                config.checkpoint_manager.save(
+                    state, epoch, extra=_cursor_extra(dataset_iter)
+                )
+                last_saved = epoch
+    finally:
+        # A Dataset's prefetch stage runs a worker thread; an injected
+        # crash (or any raise) must not strand it. close() is idempotent
+        # and keeps the iterator's cursor readable for the terminal save.
+        if dataset_iter is not None:
+            dataset_iter.close()
 
     guard.flush(state)  # back-to-back phases must not stack in-flight work
     if config.checkpoint_manager is not None and last_saved != epoch:
@@ -393,7 +415,9 @@ def iterate(
         # nothing (the "one final agreed checkpoint" of the preemption
         # contract; single-process commit — the hand-rolled multi-process
         # loops go through checkpoint.save_agreed instead).
-        config.checkpoint_manager.save(state, epoch)
+        config.checkpoint_manager.save(
+            state, epoch, extra=_cursor_extra(dataset_iter)
+        )
     if config.checkpoint_manager is not None and hasattr(
         config.checkpoint_manager, "wait"
     ):
@@ -413,6 +437,51 @@ def iterate(
         outputs=outputs,
         preempted=preempted,
     )
+
+
+def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
+    """When ``data`` is a :class:`flinkml_tpu.data.Dataset`, open a
+    TRACKED iteration positioned at ``start_epoch`` and return it (else
+    None and the caller falls back to plain iteration).
+
+    A Dataset is restartable and deterministic, so resume is always the
+    'replay' contract regardless of ``stream_resume``: the chain
+    fast-forwards to the watermark (pushed down to the source when the
+    chain is skip-transparent) and the consumer sees the exact
+    uninterrupted sequence — shuffle order included. When the restored
+    snapshot recorded a cursor (``extra['data_cursor']``, written by the
+    checkpoint saves below), it seeds the reopen; the restored epoch
+    stays authoritative if the two disagree (the cursor may be from an
+    in-flight write the epoch superseded).
+    """
+    try:
+        from flinkml_tpu.data import Cursor, Dataset
+    except ImportError:  # pragma: no cover — data subsystem always ships
+        return None
+    if not isinstance(data, Dataset):
+        return None
+    cursor = None
+    if start_epoch > 0:
+        extra = getattr(
+            config.checkpoint_manager, "last_restored_extra", None
+        ) or {}
+        recorded = extra.get("data_cursor")
+        if recorded is not None:
+            cursor = Cursor.from_json_dict(recorded)
+            if cursor.emitted != start_epoch:
+                cursor = dataclasses.replace(cursor, emitted=start_epoch)
+        else:
+            cursor = Cursor(emitted=start_epoch)
+    return data.iterate(cursor)
+
+
+def _cursor_extra(dataset_iter) -> Optional[dict]:
+    """The checkpoint ``extra`` payload carrying the input-pipeline
+    cursor (None for non-Dataset streams — the manifest stays as
+    before)."""
+    if dataset_iter is None:
+        return None
+    return {"data_cursor": dataset_iter.cursor().to_json_dict()}
 
 
 def _is_stream(data: Any) -> bool:

@@ -12,7 +12,38 @@ with extra knobs (GBT's ``stream_reservoir_capacity``) override
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional, Tuple
+
+
+def peek_stream(batches) -> Tuple[Optional[Any], Any]:
+    """Peek the first batch of a training stream without losing it.
+
+    Returns ``(first_batch_or_None, stream_for_iterate)``. The online
+    trainers peek to fix the carry's array shapes before the loop; HOW
+    the peeked batch is re-presented depends on the stream kind:
+
+    - a :class:`flinkml_tpu.data.Dataset` is restartable and
+      cursor-tracked: it is peeked with a throwaway prefetch-free
+      iterator and handed to :func:`~flinkml_tpu.iteration.iterate`
+      WHOLE, so the runtime owns the skip/cursor machinery (chaining a
+      consumed iterator would hide the Dataset and break cursor
+      checkpoint/resume);
+    - a plain iterable is peeked destructively and re-chained.
+    """
+    try:
+        from flinkml_tpu.data import Dataset
+    except ImportError:  # pragma: no cover — data subsystem always ships
+        Dataset = None
+    if Dataset is not None and isinstance(batches, Dataset):
+        return batches.peek(), batches
+    import itertools
+
+    it = iter(batches)
+    try:
+        first = next(it)
+    except StopIteration:
+        return None, iter(())
+    return first, itertools.chain([first], it)
 
 
 class StreamingEstimatorMixin:

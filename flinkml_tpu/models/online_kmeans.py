@@ -19,7 +19,6 @@ or, if unset, from ``k`` random rows of the first batch.
 from __future__ import annotations
 
 import functools
-import itertools
 from typing import Iterable, List, Optional, Tuple
 
 import jax
@@ -157,17 +156,19 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
 
         # Peek the first batch: initial centroids draw from it (when no
         # initial model data was given) and it fixes the carry structure
-        # for checkpointing; it is then re-presented as epoch 0's data.
-        it = iter(batches)
-        try:
-            first = next(it)
-        except StopIteration:
+        # for checkpointing; it is then re-presented as epoch 0's data
+        # (a flinkml_tpu.data.Dataset re-presents it by restarting — and
+        # iterate() then owns its cursor checkpoint/resume).
+        from flinkml_tpu.models._streaming import peek_stream
+
+        first, stream = peek_stream(batches)
+        if first is None:
             empty = self._model_from_empty_stream(
                 checkpoint_manager, restore_epoch
             )
             if empty is not None:
                 return empty
-            raise ValueError("training stream is empty") from None
+            raise ValueError("training stream is empty")
         x0 = features_matrix(first, features_col).astype(np.float64)
         if restore_epoch is not None:
             # A committed snapshot will overwrite the init state: skip the
@@ -206,7 +207,7 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
             return carry, None
 
         result = iterate(
-            step, state, itertools.chain([first], it),
+            step, state, stream,
             IterationConfig(
                 TerminateOnMaxIter(2**31 - 1),
                 checkpoint_interval=checkpoint_interval,

@@ -25,7 +25,6 @@ with λ1 = reg·elasticNet, λ2 = reg·(1−elasticNet).
 from __future__ import annotations
 
 import functools
-import itertools
 from typing import Iterable, List, Optional, Tuple
 
 import jax
@@ -205,17 +204,19 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
 
         # Peek the first batch to fix the feature dim, so the loop carry is
         # a full array pytree from epoch 0 — the checkpointable structure
-        # (restore needs `like` to match the committed snapshots).
-        it = iter(batches)
-        try:
-            first = next(it)
-        except StopIteration:
+        # (restore needs `like` to match the committed snapshots). A
+        # flinkml_tpu.data.Dataset is handed to iterate() whole, so the
+        # runtime checkpoints/restores its cursor (docs/operators/data.md).
+        from flinkml_tpu.models._streaming import peek_stream
+
+        first, stream = peek_stream(batches)
+        if first is None:
             empty = self._model_from_empty_stream(
                 checkpoint_manager, restore_epoch
             )
             if empty is not None:
                 return empty
-            raise ValueError("training stream is empty") from None
+            raise ValueError("training stream is empty")
         x0, _, _ = labeled_data(first, fcol, lcol, wcol)
         dim = x0.shape[1]
         if self._initial_coefficient is None:
@@ -243,7 +244,7 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
             return carry, float(loss)
 
         result = iterate(
-            step, state, itertools.chain([first], it),
+            step, state, stream,
             IterationConfig(
                 TerminateOnMaxIter(2**31 - 1),
                 checkpoint_interval=checkpoint_interval,

@@ -16,7 +16,6 @@ other online models. The fitted model transforms exactly like
 
 from __future__ import annotations
 
-import itertools
 from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -145,11 +144,13 @@ class OnlineStandardScaler(
             )
             # Peek the first batch to fix the feature dim: the carry is a
             # full array pytree from epoch 0 (the checkpointable
-            # structure); zero-initialized moments Chan-merge exactly.
-            it = iter(batches)
-            try:
-                first = next(it)
-            except StopIteration:
+            # structure); zero-initialized moments Chan-merge exactly. A
+            # flinkml_tpu.data.Dataset goes to iterate() whole (cursor
+            # checkpoint/resume belongs to the runtime).
+            from flinkml_tpu.models._streaming import peek_stream
+
+            first, stream = peek_stream(batches)
+            if first is None:
                 if restore_epoch is not None:
                     # Resume-as-noop on an already-exhausted stream: the
                     # checkpointed moments ARE the model (`like` leaf
@@ -158,7 +159,7 @@ class OnlineStandardScaler(
                         like={"n": 0, "mean": 0, "m2": 0, "version": 0}
                     )
                     return self._model_from_final(final)
-                raise ValueError("training stream is empty") from None
+                raise ValueError("training stream is empty")
             d = features_matrix(first, input_col).shape[1]
             state = {
                 "n": 0.0,
@@ -167,7 +168,7 @@ class OnlineStandardScaler(
                 "version": 0,
             }
             final = iterate(
-                step, state, itertools.chain([first], it),
+                step, state, stream,
                 IterationConfig(
                     TerminateOnMaxIter(2**31 - 1),
                     checkpoint_interval=checkpoint_interval,

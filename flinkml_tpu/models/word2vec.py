@@ -133,6 +133,33 @@ def _agree_token_counts(tokens, counts, mesh) -> "Dict[str, int]":
     return merged
 
 
+def _w2v_accum() -> str:
+    """Embedding-gradient accumulation layout of the dense SGNS trainer
+    (the roofline audit's sort-class gap: XLA lowers the per-step row
+    scatters into ``[vocab, dim]`` through a sort, pinning the stage at
+    ~5% of its ~40M pairs/s bound — VERDICT Missing #3, probed by
+    ``tools/w2v_scatter_probe.py``). ``FLINKML_TPU_W2V_ACCUM`` selects,
+    mirroring the sparse-LR/GBT/ALS cumsum gates:
+
+    - ``scatter`` (default): ``.at[ids].add(rows)`` — the original
+      formulation;
+    - ``onehot``: ``one_hot(ids)^T @ rows`` as a fused einsum — a true
+      matrix-matrix product on the MXU IF XLA fuses the iota-compare
+      into the dot operand (the probe's question; flip the default only
+      on a measured win).
+
+    Numerics: both accumulate the same per-pair gradients; they differ
+    only in f32 summation order (pinned in ``tests/test_word2vec.py::
+    test_onehot_accum_matches_scatter``)."""
+    layout = os.environ.get("FLINKML_TPU_W2V_ACCUM", "scatter")
+    if layout not in ("scatter", "onehot"):
+        raise ValueError(
+            f"FLINKML_TPU_W2V_ACCUM={layout!r}: expected 'scatter' or "
+            "'onehot'"
+        )
+    return layout
+
+
 def _sgns_pair_grads(vc, uc, un, wb):
     """SGNS pair gradients from the gathered embedding rows — the ONE
     definition of the loss math, shared by the dense and vocab-sharded
@@ -150,9 +177,21 @@ def _sgns_pair_grads(vc, uc, un, wb):
 
 
 @functools.lru_cache(maxsize=8)
-def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
+def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int,
+                  accum: str = "scatter"):
     def local(centers, contexts, wl, pool, v0, u0, lr, n_steps, key):
         n_local = centers.shape[0]
+
+        def onehot_sum(table_like, ids, rows):
+            """``one_hot(ids)^T @ rows`` — the gated scatter-free
+            accumulation (:func:`_w2v_accum`); ``ids`` may be [bs] or
+            [bs, neg]."""
+            flat_ids = ids.reshape(-1)
+            flat_rows = rows.reshape(-1, rows.shape[-1])
+            oh = jax.nn.one_hot(
+                flat_ids, table_like.shape[0], dtype=flat_rows.dtype
+            )
+            return jnp.einsum("bv,bd->vd", oh, flat_rows)
 
         def body(state):
             step, v, u = state
@@ -169,13 +208,19 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int):
             uc = u[ctx]                    # [bs, d]
             un = u[neg]                    # [bs, neg, d]
             grad_vc, grad_uc, grad_un = _sgns_pair_grads(vc, uc, un, wb)
-            dv = jnp.zeros_like(v).at[c].add(grad_vc)
-            du = (
-                jnp.zeros_like(u).at[ctx].add(grad_uc)
-                .at[neg.reshape(-1)].add(
-                    grad_un.reshape(-1, grad_un.shape[-1])
+            if accum == "onehot":
+                dv = onehot_sum(v, c, grad_vc)
+                du = onehot_sum(u, ctx, grad_uc) + onehot_sum(
+                    u, neg, grad_un
                 )
-            )
+            else:
+                dv = jnp.zeros_like(v).at[c].add(grad_vc)
+                du = (
+                    jnp.zeros_like(u).at[ctx].add(grad_uc)
+                    .at[neg.reshape(-1)].add(
+                        grad_un.reshape(-1, grad_un.shape[-1])
+                    )
+                )
             # Device-invariant normalization: psum the per-device sums
             # and divide by the GLOBAL selected weight, so learningRate
             # means "step on the mean pair gradient" regardless of mesh
@@ -459,7 +504,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
         else:
             trainer = _sgns_trainer(
                 mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
-                self.get(self.NUM_NEGATIVES),
+                self.get(self.NUM_NEGATIVES), _w2v_accum(),
             )
             v, _u = trainer(
                 mesh.shard_batch(centers_p), mesh.shard_batch(contexts_p),
@@ -717,7 +762,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
         else:
             trainer = _sgns_trainer(
                 mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
-                self.get(self.NUM_NEGATIVES),
+                self.get(self.NUM_NEGATIVES), _w2v_accum(),
             )
         lr = jnp.asarray(self.get(self.LEARNING_RATE), jnp.float32)
         base_key = jax.random.PRNGKey(self.get_seed())

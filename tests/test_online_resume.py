@@ -261,6 +261,136 @@ def test_double_failure_recovery(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Dataset-fed trainers (ISSUE 5): cursor checkpoint/resume through the
+# input-pipeline subsystem, shuffle order preserved across the kill
+# ---------------------------------------------------------------------------
+
+def _lr_dataset(seed=0, shuffled=True):
+    """The lr_batches feed as a flinkml_tpu.data pipeline: one source
+    table, rebatched, with a seeded shuffle — the shape whose resume
+    parity only holds if the cursor machinery replays the exact
+    shuffled sequence."""
+    from flinkml_tpu.data import Dataset
+    from flinkml_tpu.table import Table as T
+
+    rows = np.concatenate([np.asarray(b.column("features"))
+                           for b in lr_batches(seed=seed)])
+    labels = np.concatenate([np.asarray(b.column("label"))
+                             for b in lr_batches(seed=seed)])
+    ds = Dataset.from_arrays(
+        T({"features": rows, "label": labels}), batch_size=48
+    )
+    return ds.shuffle(4, seed=13) if shuffled else ds
+
+
+def test_dataset_shuffled_kill_corrupt_resume_bit_exact(tmp_path):
+    """The ISSUE 5 acceptance criterion: a Dataset-fed
+    OnlineLogisticRegression.fit_stream with a SHUFFLED pipeline, killed
+    mid-stream (RaiseAtEpoch through the iteration seam), newest cursor
+    snapshot corrupted, resumed from the prior valid one — bit-identical
+    to the uninterrupted run, shuffle order preserved across the kill."""
+    golden = _lr().fit_stream(_lr_dataset())
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    with faults.armed(faults.FaultPlan(faults.RaiseAtEpoch(CRASH_EPOCH))):
+        with pytest.raises(faults.FaultInjected):
+            _lr().fit_stream(_lr_dataset(), checkpoint_manager=mgr,
+                             checkpoint_interval=INTERVAL)
+    assert mgr.latest_epoch() == CRASH_EPOCH - 1
+    faults.corrupt_latest(mgr, target="arrays")
+
+    recovered = _lr().fit_stream(_lr_dataset(), checkpoint_manager=mgr,
+                                 checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(recovered.coefficient, golden.coefficient)
+    assert recovered.model_version == golden.model_version == N_BATCHES
+    # The restored snapshot carried the pipeline cursor (epoch 4's
+    # commit — the newest valid one after corrupting epoch 6's).
+    cursor = mgr.last_restored_extra["data_cursor"]
+    assert cursor["emitted"] == 4
+    assert cursor["shuffle"] is not None
+
+
+def test_dataset_kill_at_read_seam_resume_bit_exact(tmp_path):
+    """Same parity with the crash at the NEW data.read seam — the
+    source itself dies mid-stream rather than the training loop.
+    (Unshuffled feed so the read count maps 1:1 to emitted batches:
+    the trainer's peek costs read #1, the fit re-reads from the start,
+    so read #10 kills after epoch 8 completed — past the epoch-8
+    interval commit.)"""
+    golden = _lr().fit_stream(_lr_dataset(seed=31, shuffled=False))
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    with faults.armed(faults.FaultPlan(faults.RaiseAtRead(at_read=10))):
+        with pytest.raises(faults.FaultInjected):
+            _lr().fit_stream(_lr_dataset(seed=31, shuffled=False),
+                             checkpoint_manager=mgr,
+                             checkpoint_interval=INTERVAL)
+    assert mgr.latest_epoch() == 8
+    recovered = _lr().fit_stream(_lr_dataset(seed=31, shuffled=False),
+                                 checkpoint_manager=mgr,
+                                 checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(recovered.coefficient, golden.coefficient)
+    assert recovered.model_version == golden.model_version
+
+
+def test_dataset_fed_kmeans_and_scaler_resume_bit_exact(tmp_path):
+    """The other two online trainers accept a Dataset anywhere an
+    iterator is accepted, with the same kill+resume parity."""
+    from flinkml_tpu.data import Dataset
+    from flinkml_tpu.table import Table as T
+
+    km_rows = np.concatenate([np.asarray(b.column("features"))
+                              for b in km_batches()])
+
+    def km_ds():
+        return Dataset.from_arrays(T({"features": km_rows}), batch_size=40)
+
+    golden = _km().fit_stream(km_ds())
+    mgr = CheckpointManager(str(tmp_path / "km"), max_to_keep=10)
+    with faults.armed(faults.FaultPlan(faults.RaiseAtEpoch(CRASH_EPOCH))):
+        with pytest.raises(faults.FaultInjected):
+            _km().fit_stream(km_ds(), checkpoint_manager=mgr,
+                             checkpoint_interval=INTERVAL)
+    faults.corrupt_latest(mgr, target="manifest")
+    recovered = _km().fit_stream(km_ds(), checkpoint_manager=mgr,
+                                 checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(recovered.centroids, golden.centroids)
+
+    sc_rows = np.concatenate([np.asarray(b.column("input"))
+                              for b in sc_batches()])
+
+    def sc_ds():
+        return Dataset.from_arrays(T({"input": sc_rows}), batch_size=32)
+
+    sc_golden = _sc().fit_stream(sc_ds())
+    sc_mgr = CheckpointManager(str(tmp_path / "sc"), max_to_keep=10)
+    with faults.armed(faults.FaultPlan(faults.RaiseAtEpoch(CRASH_EPOCH))):
+        with pytest.raises(faults.FaultInjected):
+            _sc().fit_stream(sc_ds(), checkpoint_manager=sc_mgr,
+                             checkpoint_interval=INTERVAL)
+    sc_rec = _sc().fit_stream(sc_ds(), checkpoint_manager=sc_mgr,
+                              checkpoint_interval=INTERVAL, resume=True)
+    np.testing.assert_array_equal(sc_rec._mean, sc_golden._mean)
+    np.testing.assert_array_equal(sc_rec._std, sc_golden._std)
+
+
+def test_dataset_fed_prefetched_fit_matches_plain(tmp_path):
+    """A prefetch tail (device-resident bucket-padded batches) changes
+    nothing about the fitted model — and the fit closes the worker."""
+    import threading
+
+    golden = _lr().fit_stream(_lr_dataset(seed=41, shuffled=False))
+    fed = _lr().fit_stream(
+        _lr_dataset(seed=41, shuffled=False).prefetch(depth=2)
+    )
+    np.testing.assert_array_equal(fed.coefficient, golden.coefficient)
+    assert not any(
+        t.name.startswith("data-prefetch") and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+# ---------------------------------------------------------------------------
 # SIGTERM watchdog
 # ---------------------------------------------------------------------------
 

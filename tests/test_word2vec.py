@@ -124,6 +124,37 @@ def test_sharded_trainer_matches_dense(monkeypatch):
     assert same > cross, (same, cross)
 
 
+def test_onehot_accum_matches_scatter(monkeypatch):
+    """FLINKML_TPU_W2V_ACCUM=onehot (the gated scatter-free one-hot
+    matmul accumulation — the sort-class candidate mirroring the
+    sparse-LR/GBT/ALS cumsum gates) follows the identical sampling
+    sequence as the default scatter layout; the vectors agree up to f32
+    summation order, and the embedding still carries the topic
+    structure. Pinned so a measured device winner can flip the default
+    without a numerics question."""
+    docs, animals, tools = _topic_corpus(seed=4)
+    scatter_model, _ = _fit(docs)
+    monkeypatch.setenv("FLINKML_TPU_W2V_ACCUM", "onehot")
+    onehot_model, _ = _fit(docs)
+    np.testing.assert_array_equal(
+        onehot_model.vocabulary, scatter_model.vocabulary
+    )
+    np.testing.assert_allclose(
+        onehot_model._vectors, scatter_model._vectors, rtol=2e-3, atol=2e-4
+    )
+    vec = {str(t): onehot_model._vectors[i]
+           for i, t in enumerate(onehot_model._vocab)}
+    assert _cos(vec["cat"], vec["dog"]) > _cos(vec["cat"], vec["hammer"])
+
+
+def test_w2v_accum_gate_rejects_unknown(monkeypatch):
+    from flinkml_tpu.models.word2vec import _w2v_accum
+
+    monkeypatch.setenv("FLINKML_TPU_W2V_ACCUM", "bogus")
+    with pytest.raises(ValueError, match="FLINKML_TPU_W2V_ACCUM"):
+        _w2v_accum()
+
+
 def test_streamed_fit_shards_above_vocab_threshold(monkeypatch):
     """Above the threshold, the single-process streamed fit switches to
     the vocab-sharded ring trainer (same SGD trajectory as the dense
