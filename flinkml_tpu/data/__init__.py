@@ -17,6 +17,7 @@ and resumed pipeline replays the exact uninterrupted batch sequence
 """
 
 from flinkml_tpu.data.dataset import Dataset, DatasetIterator
+from flinkml_tpu.data.elastic import ElasticFeed, ElasticFeedIterator
 from flinkml_tpu.data.ops import (
     FilterOp,
     MapOp,
@@ -34,14 +35,23 @@ from flinkml_tpu.data.source import (
     SourceIterator,
     SyntheticSource,
     resolve_shard,
+    round_robin_skip,
 )
-from flinkml_tpu.data.state import Cursor, rng_state_dict
+from flinkml_tpu.data.state import (
+    Cursor,
+    CursorShardMismatchError,
+    rng_state_dict,
+)
 
 __all__ = [
     "Dataset",
     "DatasetIterator",
+    "ElasticFeed",
+    "ElasticFeedIterator",
     "Cursor",
+    "CursorShardMismatchError",
     "rng_state_dict",
+    "round_robin_skip",
     "Source",
     "SourceIterator",
     "ArraySource",

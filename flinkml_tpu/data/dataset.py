@@ -28,7 +28,7 @@ resumed consumer sees the exact uninterrupted sequence.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -144,6 +144,26 @@ class Dataset:
         be pushed down to the source instead of replaying the chain."""
         return all(op.skip_transparent for op in self._ops)
 
+    @property
+    def num_shards(self) -> int:
+        """The source's shard count — the feed's world size (what the
+        checkpoint rescale guard pins, and what cursors record
+        authoritatively)."""
+        return self._source.num_shards
+
+    @property
+    def shard_index(self) -> int:
+        return self._source.shard_index
+
+    @property
+    def reshardable(self) -> bool:
+        """True when a cursor written at a DIFFERENT shard count can be
+        legally re-split into this chain: the source deals round-robin
+        over a canonical global order AND every op is skip-transparent
+        (a per-shard shuffle/rebatch entangles the output sequence with
+        the shard count)."""
+        return self._source.reshardable and self.skip_transparent
+
     def describe(self) -> str:
         parts = [type(self._source).__name__]
         parts += [op.describe() for op in self._ops]
@@ -222,44 +242,38 @@ def _read_seam(src: "SourceIterator", shard_index: int) -> Iterator[Table]:
         yield batch
 
 
-class DatasetIterator:
-    """One tracked iteration of a :class:`Dataset`.
+class _TrackedIterator:
+    """The assembly + iterator/lifecycle tail shared by
+    :class:`DatasetIterator` and :class:`~flinkml_tpu.data.elastic
+    .ElasticFeedIterator`: base iterator → ops (with a
+    :class:`_ChainState` for shuffle probes) → optional dropped replay
+    prefix → optional :class:`~flinkml_tpu.data.prefetch
+    .DevicePrefetcher`, plus the delivered-batch accounting and the
+    idempotent ``close`` the cursor machinery depends on. One
+    definition, so a fix to the tail (prefetcher shutdown, in-flight
+    accounting) can never diverge between the two feeds."""
 
-    Tracks the delivered-batch watermark and the source/shuffle
-    positions for :meth:`cursor` snapshots; fires the ``data.read``
-    fault seam per source batch; owns (and closes) the prefetcher.
-    """
-
-    def __init__(self, dataset: Dataset, cursor: Optional[Cursor] = None):
-        self._dataset = dataset
-        skip = int(cursor.emitted) if cursor is not None else 0
-        fast = dataset.skip_transparent
-        if skip:
-            _log.info(
-                "dataset resume: fast-forwarding %d batches (%s skip) — %s",
-                skip, "source" if fast else "replay", dataset.describe(),
-            )
-        self._src = dataset._source.open(skip_batches=skip if fast else 0)
+    def _assemble(self, base_it: Iterator[Table], ops: Sequence[Op],
+                  drop: int, prefetch_spec: Optional[dict],
+                  start: int) -> None:
         self._chain_state = _ChainState()
-        it: Iterator[Table] = _read_seam(
-            self._src, dataset._source.shard_index
-        )
-        for op in dataset._ops:
+        it = base_it
+        for op in ops:
             it = op.apply(it, self._chain_state)
-        if skip and not fast:
-            it = _drop(it, skip)
+        if drop:
+            it = _drop(it, drop)
         self._prefetcher = None
-        if dataset._prefetch is not None:
+        if prefetch_spec is not None:
             from flinkml_tpu.data.prefetch import DevicePrefetcher
 
-            self._prefetcher = DevicePrefetcher(it, **dataset._prefetch)
+            self._prefetcher = DevicePrefetcher(it, **prefetch_spec)
             it = self._prefetcher
         self._it = it
-        self._emitted = skip
+        self._emitted = int(start)
         self._closed = False
 
     # -- iterator protocol --------------------------------------------------
-    def __iter__(self) -> "DatasetIterator":
+    def __iter__(self):
         return self
 
     def __next__(self) -> Table:
@@ -273,10 +287,120 @@ class DatasetIterator:
         self._emitted += 1
         return batch
 
-    # -- cursor -------------------------------------------------------------
     @property
     def emitted(self) -> int:
         return self._emitted
+
+    def _shuffle_state(self) -> Optional[dict]:
+        return (rng_state_dict(self._chain_state.shuffle_rng)
+                if self._chain_state.shuffle_rng is not None else None)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        """Stop the prefetch worker (if any) and end the iteration.
+        Idempotent; always safe to call from a ``finally``."""
+        self._closed = True
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+        self._close_sources()
+
+    def _close_sources(self) -> None:
+        """Subclass hook: release reader-side resources on close."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
+
+
+class DatasetIterator(_TrackedIterator):
+    """One tracked iteration of a :class:`Dataset`.
+
+    Tracks the delivered-batch watermark and the source/shuffle
+    positions for :meth:`cursor` snapshots; fires the ``data.read``
+    fault seam per source batch; owns (and closes) the prefetcher.
+    """
+
+    def __init__(self, dataset: Dataset, cursor: Optional[Cursor] = None):
+        self._dataset = dataset
+        skip = int(cursor.emitted) if cursor is not None else 0
+        fast = dataset.skip_transparent
+        if (
+            cursor is not None
+            and cursor.num_shards is not None
+            and (cursor.shard_index is None
+                 or cursor.num_shards != dataset.num_shards)
+        ):
+            # The cursor's shard count is authoritative: a different
+            # count is either a LEGAL reshard (round-robin source +
+            # skip-transparent chain: re-derive this shard's skip from
+            # the global watermark) or a loud error — never a silent
+            # fast-forward to the wrong rows. A GLOBAL-order cursor
+            # (shard_index None) counts a different unit entirely, so it
+            # is refused even at a matching shard count.
+            from flinkml_tpu.data.state import CursorShardMismatchError
+
+            if cursor.shard_index is None:
+                raise CursorShardMismatchError(
+                    f"global-order cursor (world {cursor.num_shards}) "
+                    f"restored into a per-shard Dataset "
+                    f"({dataset.describe()}, shard "
+                    f"{dataset.shard_index}/{dataset.num_shards}); "
+                    "global cursors resume through an ElasticFeed"
+                )
+            if not dataset.reshardable:
+                raise CursorShardMismatchError(
+                    f"cursor was written by a {cursor.num_shards}-way "
+                    f"sharded feed but this chain is sharded "
+                    f"{dataset.num_shards}-way and cannot reshard "
+                    f"({dataset.describe()}: "
+                    + ("source deals are not round-robin"
+                       if not dataset._source.reshardable
+                       else "chain has non-skip-transparent ops")
+                    + "); resume at the original shard count"
+                )
+            skip = dataset._source.skip_for_global(cursor.global_emitted)
+            fast = True  # reshardable requires skip-transparency
+            _log.info(
+                "dataset reshard resume: world %d -> %d, global watermark "
+                "%d -> shard %d/%d skip %d — %s",
+                cursor.num_shards, dataset.num_shards,
+                cursor.global_emitted, dataset.shard_index,
+                dataset.num_shards, skip, dataset.describe(),
+            )
+        elif skip:
+            _log.info(
+                "dataset resume: fast-forwarding %d batches (%s skip) — %s",
+                skip, "source" if fast else "replay", dataset.describe(),
+            )
+        # The EXACT global watermark this iteration starts from: after a
+        # reshard the per-shard skips are uneven, so the lockstep
+        # product (emitted x num_shards) would drift — the cursor's
+        # recorded watermark (or the product, for pre-elastic cursors)
+        # anchors it, and every subsequent lockstep round advances it by
+        # num_shards (see :meth:`cursor`).
+        if cursor is None:
+            self._global_base = 0
+        elif cursor.num_shards is not None:
+            self._global_base = cursor.global_emitted
+        else:  # legacy cursor: per-shard emitted, never resharded
+            self._global_base = skip * dataset.num_shards
+        self._emitted_base = skip
+        self._src = dataset._source.open(skip_batches=skip if fast else 0)
+        self._assemble(
+            _read_seam(self._src, dataset._source.shard_index),
+            dataset._ops, drop=0 if fast else skip,
+            prefetch_spec=dataset._prefetch, start=skip,
+        )
+
+    # -- cursor -------------------------------------------------------------
+    def source_position(self) -> Dict[str, Any]:
+        """The underlying source iterator's position record (public:
+        an :class:`~flinkml_tpu.data.ElasticFeed`'s global cursor
+        aggregates its shard readers' positions through this)."""
+        return self._src.position()
 
     def cursor(self) -> Cursor:
         """The current position: ``emitted`` is the replay watermark;
@@ -288,27 +412,20 @@ class DatasetIterator:
         # included — those outputs were consumed too, just internally),
         # so reads minus deliveries IS the in-flight population on both
         # the fast-skip and replay paths.
-        src_pos = self._src.position()
+        src_pos = self.source_position()
         in_flight = max(0, src_pos["batches_read"] - self._emitted)
         return Cursor(
             emitted=self._emitted,
             source=src_pos,
-            shuffle=(rng_state_dict(self._chain_state.shuffle_rng)
-                     if self._chain_state.shuffle_rng is not None else None),
+            shuffle=self._shuffle_state(),
             in_flight=in_flight,
+            num_shards=self._dataset.num_shards,
+            shard_index=self._dataset.shard_index,
+            # Lockstep: each round past the resume point advanced the
+            # GLOBAL sequence by one batch per shard.
+            global_watermark=(
+                self._global_base
+                + (self._emitted - self._emitted_base)
+                * self._dataset.num_shards
+            ),
         )
-
-    # -- lifecycle ----------------------------------------------------------
-    def close(self) -> None:
-        """Stop the prefetch worker (if any) and end the iteration.
-        Idempotent; always safe to call from a ``finally``."""
-        self._closed = True
-        if self._prefetcher is not None:
-            self._prefetcher.close()
-
-    def __enter__(self) -> "DatasetIterator":
-        return self
-
-    def __exit__(self, exc_type, exc, tb) -> bool:
-        self.close()
-        return False

@@ -39,7 +39,15 @@ def resolve_shard(shard: Optional[Tuple[int, int]], mesh=None) -> Tuple[int, int
     """Normalize a shard assignment: explicit ``(index, count)`` wins;
     a :class:`~flinkml_tpu.parallel.DeviceMesh` assigns per-rank
     (process index/count — the reference's per-subtask stream split);
-    neither means the single unsharded feed."""
+    neither means the single unsharded feed.
+
+    Elastic resume re-derives each NEW rank's read position from a
+    restored global watermark one level up: the resolved shard's
+    :meth:`Source.skip_for_global` (round-robin deals,
+    :func:`round_robin_skip`) computes the fast-forward, and
+    :class:`~flinkml_tpu.data.Dataset`/:class:`~flinkml_tpu.data
+    .ElasticFeed` validate the shard-count change before any batch is
+    misread."""
     if shard is not None:
         index, count = int(shard[0]), int(shard[1])
     elif mesh is not None:
@@ -51,6 +59,18 @@ def resolve_shard(shard: Optional[Tuple[int, int]], mesh=None) -> Tuple[int, int
     if count < 1 or not (0 <= index < count):
         raise ValueError(f"invalid shard assignment ({index}, {count})")
     return index, count
+
+
+def round_robin_skip(shard_index: int, num_shards: int,
+                     global_batches: int) -> int:
+    """How many of shard ``shard_index``'s round-robin-dealt global
+    batch indices (``shard_index, shard_index + num_shards, ...``) fall
+    below ``global_batches`` — the per-shard fast-forward that lands a
+    resharded resume exactly at a restored global watermark."""
+    g = int(global_batches)
+    if g <= shard_index:
+        return 0
+    return (g - shard_index + num_shards - 1) // num_shards
 
 
 class SourceIterator:
@@ -82,6 +102,15 @@ class SourceIterator:
 class Source:
     """Base class: a replayable, shardable origin of Table batches."""
 
+    #: True when the shard deal is a pure round-robin over ONE canonical
+    #: global batch sequence (batch ``g`` belongs to shard ``g % n``),
+    #: so a cursor written at one shard count can be re-split across
+    #: another: the global order is identical at every world, only the
+    #: reading is parallelized. Contiguous-block deals (ArraySource) and
+    #: file-granularity deals (CSV/LibSVM globs) are NOT — their
+    #: mid-stream progress is entangled with the shard count.
+    reshardable = False
+
     def __init__(self, shard: Optional[Tuple[int, int]] = None, mesh=None):
         self.shard_index, self.num_shards = resolve_shard(shard, mesh)
 
@@ -90,6 +119,24 @@ class Source:
         ``skip_batches`` of the (deterministic) sequence."""
         return SourceIterator(
             self._batches(int(skip_batches)), self, int(skip_batches)
+        )
+
+    def skip_for_global(self, global_batches: int) -> int:
+        """This shard's fast-forward for a restored GLOBAL watermark:
+        the number of its own batches with global index below
+        ``global_batches``. Defined only for :attr:`reshardable`
+        sources — anything else raises
+        :class:`~flinkml_tpu.data.state.CursorShardMismatchError`
+        (loudly, before any row is misread)."""
+        from flinkml_tpu.data.state import CursorShardMismatchError
+
+        raise CursorShardMismatchError(
+            f"{type(self).__name__} deals shards "
+            f"({self.shard_index}/{self.num_shards}) without a canonical "
+            "round-robin global batch order, so a cursor cannot be "
+            "re-split across a different shard count; resume at the "
+            "original count, or feed through a reshardable source "
+            "(SyntheticSource, or an ElasticFeed over one)"
         )
 
     def __iter__(self) -> SourceIterator:
@@ -148,7 +195,12 @@ class SyntheticSource(Source):
     called with the GLOBAL batch index and a Generator keyed by
     ``(seed, index)`` — so batch ``i`` is identical no matter which rank
     draws it, in what order, or after how many skips. Sharding deals
-    global indices round-robin."""
+    global indices round-robin, which ALSO makes this the reshardable
+    source: the global sequence is canonical at every shard count, so an
+    elastic resume re-splits a restored watermark exactly
+    (:meth:`skip_for_global`)."""
+
+    reshardable = True
 
     def __init__(self, make_batch: Callable[[int, np.random.Generator], Table],
                  num_batches: int, seed: int = 0,
@@ -167,6 +219,12 @@ class SyntheticSource(Source):
     @property
     def num_batches(self) -> int:
         return len(self._global_indices())
+
+    def skip_for_global(self, global_batches: int) -> int:
+        return round_robin_skip(
+            self.shard_index, self.num_shards,
+            min(int(global_batches), self.num_batches_global),
+        )
 
     def _batches(self, skip: int) -> Iterator[Table]:
         for gi in list(self._global_indices())[skip:]:

@@ -37,6 +37,17 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 
+class CursorShardMismatchError(ValueError):
+    """A cursor written by one shard assignment was restored into a feed
+    with an INCOMPATIBLE one. A cursor from a 4-way-sharded source would
+    otherwise silently fast-forward a 2-way source to the wrong rows —
+    the shard count the cursor records is authoritative, so any mismatch
+    that is not a legal, reshardable world change is loud. Legal
+    reshards (round-robin-dealt sources with skip-transparent chains, or
+    an :class:`~flinkml_tpu.data.ElasticFeed`'s global-order cursor)
+    re-derive the new shard positions instead of raising."""
+
+
 @dataclasses.dataclass(frozen=True)
 class Cursor:
     """Position of a :class:`~flinkml_tpu.data.Dataset` iteration.
@@ -44,7 +55,17 @@ class Cursor:
     Fields:
       emitted: output batches already delivered to the consumer — the
         replay watermark (a restored iteration produces batch
-        ``emitted`` next).
+        ``emitted`` next). For a per-shard Dataset cursor this counts
+        THIS shard's batches; for an
+        :class:`~flinkml_tpu.data.ElasticFeed` cursor it counts GLOBAL
+        batches (``shard_index`` is None there).
+      num_shards: the shard count of the feed that wrote the cursor —
+        **authoritative**: restoring into a feed with a different count
+        is either a validated reshard (the new positions are re-derived
+        from the global watermark) or a
+        :class:`CursorShardMismatchError`, never a silent misread.
+      shard_index: the writing iterator's shard (None for a global-order
+        ElasticFeed cursor — the discriminator between the two scopes).
       source: the source's own position record (shard index, row/batch
         offset, reads) at snapshot time; diagnostic + fast-skip aid.
       shuffle: the shuffle buffer's RNG bit-generator state at snapshot
@@ -57,6 +78,32 @@ class Cursor:
     source: Optional[Dict[str, Any]] = None
     shuffle: Optional[Dict[str, Any]] = None
     in_flight: int = 0
+    num_shards: Optional[int] = None
+    shard_index: Optional[int] = None
+    #: The EXACT global watermark, recorded by iterators that know it
+    #: (always, since the elastic reshard landed). The lockstep product
+    #: below is only the fallback for cursors predating this field —
+    #: after a reshard whose watermark does not divide the new world,
+    #: per-shard skips are uneven and ``emitted * num_shards`` would
+    #: overestimate the global position (skipping real batches on the
+    #: NEXT reshard); the recorded value stays exact across any chain
+    #: of reshards.
+    global_watermark: Optional[int] = None
+
+    @property
+    def global_emitted(self) -> int:
+        """The delivered watermark in GLOBAL batches: the recorded
+        :attr:`global_watermark` when present; otherwise a global-order
+        cursor (``shard_index`` None) already counts globally, and a
+        per-shard cursor converts under the SPMD lockstep contract
+        (every shard delivers one batch per step, so per-shard progress
+        times the shard count approximates the global progress — exact
+        only when the feed never resharded)."""
+        if self.global_watermark is not None:
+            return int(self.global_watermark)
+        if self.shard_index is None or self.num_shards is None:
+            return int(self.emitted)
+        return int(self.emitted) * int(self.num_shards)
 
     # -- JSON (checkpoint ``extra`` transport) ------------------------------
     def to_json_dict(self) -> Dict[str, Any]:
@@ -65,15 +112,27 @@ class Cursor:
             "source": self.source,
             "shuffle": self.shuffle,
             "in_flight": int(self.in_flight),
+            "num_shards": (None if self.num_shards is None
+                           else int(self.num_shards)),
+            "shard_index": (None if self.shard_index is None
+                            else int(self.shard_index)),
+            "global_watermark": (None if self.global_watermark is None
+                                 else int(self.global_watermark)),
         }
 
     @staticmethod
     def from_json_dict(d: Dict[str, Any]) -> "Cursor":
+        num_shards = d.get("num_shards")
+        shard_index = d.get("shard_index")
+        watermark = d.get("global_watermark")
         return Cursor(
             emitted=int(d.get("emitted", 0)),
             source=d.get("source"),
             shuffle=d.get("shuffle"),
             in_flight=int(d.get("in_flight", 0)),
+            num_shards=None if num_shards is None else int(num_shards),
+            shard_index=None if shard_index is None else int(shard_index),
+            global_watermark=None if watermark is None else int(watermark),
         )
 
     # -- pytree leaf (standalone CheckpointManager transport) ---------------

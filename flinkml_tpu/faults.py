@@ -33,6 +33,17 @@ site                      where it fires
                           pad + host→device placement (a raise propagates
                           to the consumer's ``next()`` with the worker's
                           traceback; a delay models a slow producer)
+``rank.lost``             top of every ``iterate`` epoch (right after
+                          ``iteration.epoch``) — the elastic seam where a
+                          scripted :class:`RankLost` marks a peer host
+                          dead; with a watchdog in context the loss
+                          becomes a clean shrink-triggering preemption
+                          stop, without one it is a hard crash
+``rendezvous.rescale``    inside :func:`flinkml_tpu.parallel.distributed
+                          .agree_resume_epoch` — the survivors'
+                          agreement on the newest commonly-valid
+                          snapshot before an elastic resume (a raise
+                          models a failed shrink rendezvous)
 ========================  ====================================================
 
 Arming is explicit and scoped (:func:`armed`); with **no plan armed the
@@ -297,6 +308,72 @@ class DelayRead(Fault):
     def describe(self):
         n = "*" if self.first_n is None else self.first_n
         return f"DelayRead({self.delay_s}s, first_n={n}, {self.site})"
+
+
+class RankLost(Fault):
+    """Mark ``rank`` as LOST at the top of epoch ``epoch`` — the
+    scripted host/TPU-VM loss of a preemptible fleet. When the iteration
+    runs under a :class:`~flinkml_tpu.utils.preemption
+    .PreemptionWatchdog`, the loss is delivered through
+    ``watchdog.notify_rank_lost``: the loop stops cleanly at the epoch
+    boundary, commits its final checkpoint, and the survivors plan an
+    elastic resume at the shrunken world (the shrink-on-SIGTERM path).
+    Without a watchdog the loss is a hard crash
+    (:class:`FaultInjected`) — nobody was watching for it."""
+
+    site = "rank.lost"
+
+    def __init__(self, epoch: int, rank: int = 0):
+        self.epoch = int(epoch)
+        self.rank = int(rank)
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return not self.fired and ctx.get("epoch") == self.epoch
+
+    def apply(self, ctx):
+        self.fired = True
+        watchdog = ctx.get("watchdog")
+        if watchdog is not None and hasattr(watchdog, "notify_rank_lost"):
+            watchdog.notify_rank_lost(
+                self.rank, reason=f"injected rank loss (epoch {self.epoch})"
+            )
+            return
+        raise FaultInjected(
+            f"injected rank loss (rank {self.rank}, epoch {self.epoch}) "
+            "with no watchdog installed — hard crash"
+        )
+
+    def describe(self):
+        return f"RankLost(rank={self.rank}, epoch={self.epoch})"
+
+
+class FailRendezvous(Fault):
+    """Raise :class:`FaultInjected` at the N-th ``rendezvous.rescale``
+    seam event after arming (1-based) — the scripted failure of the
+    survivors' elastic-resume agreement (a shrink rendezvous that never
+    converges)."""
+
+    site = "rendezvous.rescale"
+
+    def __init__(self, at_count: int = 1):
+        self.at_count = int(at_count)
+        self._seen = 0
+        self.fired = False
+
+    def should_fire(self, ctx):
+        self._seen += 1
+        return not self.fired and self._seen == self.at_count
+
+    def apply(self, ctx):
+        self.fired = True
+        raise FaultInjected(
+            f"injected rescale-rendezvous failure (rendezvous "
+            f"#{self.at_count})"
+        )
+
+    def describe(self):
+        return f"FailRendezvous(#{self.at_count})"
 
 
 class FaultPlan:

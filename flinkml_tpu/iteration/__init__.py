@@ -12,6 +12,9 @@ from flinkml_tpu.iteration.device_loop import device_iterate
 from flinkml_tpu.iteration.checkpoint import (
     CheckpointIntegrityError,
     CheckpointManager,
+    RescaleError,
+    RescalePolicy,
+    reshard_rank_state,
 )
 from flinkml_tpu.iteration.datacache import (
     DataCache,
@@ -36,6 +39,9 @@ __all__ = [
     "device_iterate",
     "CheckpointIntegrityError",
     "CheckpointManager",
+    "RescaleError",
+    "RescalePolicy",
+    "reshard_rank_state",
     "DataCache",
     "DataCacheReader",
     "DataCacheSnapshot",

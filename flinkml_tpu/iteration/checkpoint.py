@@ -27,6 +27,7 @@ kill-after-commit deterministically.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import shutil
@@ -49,6 +50,121 @@ class CheckpointIntegrityError(ValueError):
     content fingerprint does not match. ``restore_latest`` treats this as
     "try the previous snapshot"; it surfaces only when every snapshot is
     damaged."""
+
+
+class RescaleError(ValueError):
+    """Restoring a snapshot under a different world size was refused —
+    either the manager's :class:`RescalePolicy` rejects rescaling
+    outright, or the policy permits resharding but a leaf is genuinely
+    rank-entangled (``per_rank`` layout, or a sharded extent that does
+    not divide across the new rank count). The message always names the
+    snapshot directory, the epoch, both world sizes, and the policy
+    outcome, so fleet-log triage never has to guess which snapshot of
+    which job refused to come back."""
+
+
+# -- per-leaf layout tags -----------------------------------------------------
+#
+# Snapshots record how each loop-carry leaf relates to the world size that
+# wrote it, which is what makes restore reshard-aware:
+#
+#   ``replicated``     identical on every rank (coefficients, centroids,
+#                      moments, versions) — restores at ANY world for free;
+#   ``sharded:<axis>`` the recorded array is the assembled GLOBAL value,
+#                      laid out in world-size chunks along <axis> — restore
+#                      at world M revalidates the chunking (and
+#                      :func:`reshard_rank_state` reassembles/resplits the
+#                      rank-scoped variant);
+#   ``per_rank``       rank-local state with no global assembly (GBT's
+#                      per-row margins on the rank owning the rows) —
+#                      genuinely rank-entangled; restore under a different
+#                      world raises :class:`RescaleError` under every
+#                      policy.
+
+LAYOUT_REPLICATED = "replicated"
+LAYOUT_PER_RANK = "per_rank"
+
+
+def sharded(axis: int = 0) -> str:
+    """The ``sharded:<axis>`` layout tag (see module layout notes)."""
+    return f"sharded:{int(axis)}"
+
+
+def _parse_layout(tag: str) -> Tuple[str, Optional[int]]:
+    """``tag -> (kind, axis)``; raises on an unknown tag."""
+    if tag == LAYOUT_REPLICATED:
+        return "replicated", None
+    if tag == LAYOUT_PER_RANK:
+        return "per_rank", None
+    if isinstance(tag, str) and tag.startswith("sharded:"):
+        try:
+            return "sharded", int(tag.split(":", 1)[1])
+        except ValueError:
+            pass
+    raise ValueError(
+        f"unknown checkpoint leaf layout tag {tag!r}; expected "
+        f"'{LAYOUT_REPLICATED}', '{LAYOUT_PER_RANK}', or 'sharded:<axis>'"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RescalePolicy:
+    """What :meth:`CheckpointManager.restore` does when the snapshot's
+    recorded world size differs from the restoring mesh's.
+
+    ``on_mismatch``:
+
+    - ``"reject"`` (default): raise :class:`RescaleError` — the
+      reference's recovery guard (``HeadOperator.java:130-146``), kept as
+      the safe default for state of unknown layout.
+    - ``"reshard"``: re-lay-out the carry per the snapshot's leaf layout
+      tags — ``replicated`` leaves restore for free, ``sharded:<axis>``
+      leaves are revalidated against (and re-split across) the new rank
+      count, and only genuinely rank-entangled ``per_rank`` leaves raise.
+      This is the elastic-resume policy: a snapshot committed at world N
+      resumes at world M.
+    - ``"allow"``: restore as-is with no validation (the legacy
+      ``allow_rescale=True`` escape hatch) — correct only when the caller
+      KNOWS every leaf is world-independent.
+    """
+
+    on_mismatch: str = "reject"
+
+    def __post_init__(self):
+        if self.on_mismatch not in ("reject", "allow", "reshard"):
+            raise ValueError(
+                "RescalePolicy.on_mismatch must be 'reject', 'allow' or "
+                f"'reshard', got {self.on_mismatch!r}"
+            )
+
+    @staticmethod
+    def reject() -> "RescalePolicy":
+        return RescalePolicy("reject")
+
+    @staticmethod
+    def allow() -> "RescalePolicy":
+        return RescalePolicy("allow")
+
+    @staticmethod
+    def reshard() -> "RescalePolicy":
+        return RescalePolicy("reshard")
+
+    @staticmethod
+    def coerce(value) -> "RescalePolicy":
+        """Normalize a policy spec: a :class:`RescalePolicy`, one of its
+        mode strings, a legacy bool (``allow_rescale``), or None
+        (reject)."""
+        if value is None:
+            return RescalePolicy.reject()
+        if isinstance(value, RescalePolicy):
+            return value
+        if isinstance(value, bool):
+            return RescalePolicy.allow() if value else RescalePolicy.reject()
+        if isinstance(value, str):
+            return RescalePolicy(value)
+        raise TypeError(
+            f"cannot interpret {value!r} as a RescalePolicy"
+        )
 
 
 def _leaves_fingerprint(host_leaves) -> str:
@@ -80,7 +196,7 @@ def begin_resume(manager: Optional["CheckpointManager"], resume: bool,
 
 def save_agreed(manager: "CheckpointManager", state: Any, epoch: int,
                 mesh=None, per_rank: bool = False,
-                extra: Optional[dict] = None) -> None:
+                extra: Optional[dict] = None, layouts=None) -> None:
     """Multi-process-safe checkpoint save with an agreed commit barrier.
 
     ``per_rank=False`` (replicated state — coefficients, centroids, EM
@@ -96,15 +212,18 @@ def save_agreed(manager: "CheckpointManager", state: Any, epoch: int,
     when the writing rank raises before reaching it. Single-process this
     is exactly ``manager.save`` (async write preserved; no barrier).
     """
+    # layouts is forwarded only when set: None already means replicated,
+    # and manager subclasses predating layout tags keep working.
+    kw = {} if layouts is None else {"layouts": layouts}
     if jax.process_count() == 1:
-        manager.save(state, epoch, extra=extra)
+        manager.save(state, epoch, extra=extra, **kw)
         return
     from flinkml_tpu.iteration.stream_sync import agree_all_ok
 
     err = None
     if per_rank or jax.process_index() == 0:
         try:
-            manager.save(state, epoch, extra=extra)
+            manager.save(state, epoch, extra=extra, **kw)
             manager.wait()  # durable before anyone trains past it
         except Exception as e:  # noqa: BLE001 — agreed below
             err = e
@@ -118,7 +237,8 @@ def save_agreed(manager: "CheckpointManager", state: Any, epoch: int,
 
 def save_replicated(manager: "CheckpointManager", state: Any, epoch: int,
                     mesh=None, extra: Optional[dict] = None) -> None:
-    """Rank-0-writes commit of a REPLICATED state (see :func:`save_agreed`)."""
+    """Rank-0-writes commit of a REPLICATED state (see :func:`save_agreed`).
+    The default layout tag already records every leaf as replicated."""
     save_agreed(manager, state, epoch, mesh, per_rank=False, extra=extra)
 
 
@@ -141,7 +261,7 @@ def rank_scoped(manager: "CheckpointManager") -> "CheckpointManager":
     return CheckpointManager(
         os.path.join(manager.directory, f"rank-{jax.process_index()}"),
         max_to_keep=max(manager.max_to_keep, 2),
-        allow_rescale=manager.allow_rescale,
+        rescale=manager.rescale_policy,
         world_size=manager.world_size,
         async_write=manager.async_write,
     )
@@ -167,12 +287,18 @@ def should_snapshot(manager: Optional["CheckpointManager"], interval: int,
 class CheckpointManager:
     """Numbered checkpoints of an arbitrary pytree under one directory.
 
-    Each checkpoint records the world size that wrote it; restoring under
-    a different world size raises unless ``allow_rescale=True`` — the
-    reference's recovery guard (``HeadOperator.java:130-146``
-    ``parallelismState``: rescaling an in-flight iteration is explicitly
-    rejected, because sharded loop carries and data shards are laid out
-    for a specific parallelism).
+    Each checkpoint records the world size that wrote it AND a per-leaf
+    layout tag (``replicated`` / ``sharded:<axis>`` / ``per_rank`` — see
+    the module layout notes); what happens when the restoring mesh's
+    world size differs is governed by ``rescale`` (a
+    :class:`RescalePolicy`): the default rejects with a typed
+    :class:`RescaleError` (the reference's recovery guard,
+    ``HeadOperator.java:130-146`` ``parallelismState``), while
+    ``rescale="reshard"`` re-lays-out the carry — replicated leaves
+    restore for free, sharded leaves revalidate against the new rank
+    count, and only genuinely rank-entangled ``per_rank`` leaves refuse.
+    ``allow_rescale=True`` remains as the legacy unvalidated escape
+    hatch (equivalent to ``rescale="allow"``).
 
     ``world_size`` should be the device count of the mesh the loop runs
     on; it defaults to ``jax.device_count()``, which over-counts when
@@ -185,10 +311,13 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  allow_rescale: bool = False,
                  world_size: Optional[int] = None,
-                 async_write: bool = False):
+                 async_write: bool = False,
+                 rescale=None):
         self.directory = directory
         self.max_to_keep = max_to_keep
-        self.allow_rescale = allow_rescale
+        self.rescale_policy = RescalePolicy.coerce(
+            rescale if rescale is not None else allow_rescale
+        )
         self.world_size = world_size
         self.async_write = async_write
         self._executor: Optional[ThreadPoolExecutor] = None
@@ -203,9 +332,39 @@ class CheckpointManager:
     def _world_size(self) -> int:
         return self.world_size if self.world_size is not None else jax.device_count()
 
+    @property
+    def allow_rescale(self) -> bool:
+        """Legacy view of the policy: True when a world-size mismatch
+        does not hard-reject (``allow`` or ``reshard``)."""
+        return self.rescale_policy.on_mismatch != "reject"
+
+    def _layout_list(self, layouts, num_leaves: int,
+                     treedef) -> List[str]:
+        """Normalize ``layouts`` (None | one tag for every leaf | a
+        pytree of tags matching ``state``) to a validated per-leaf
+        list. None means replicated — the dominant carry layout here
+        (and what pre-layout snapshots are interpreted as)."""
+        if layouts is None:
+            return [LAYOUT_REPLICATED] * num_leaves
+        if isinstance(layouts, str):
+            _parse_layout(layouts)
+            return [layouts] * num_leaves
+        tag_leaves, tag_def = jax.tree_util.tree_flatten(layouts)
+        if tag_def != treedef:
+            raise ValueError(
+                "layouts pytree structure does not match the state: "
+                f"{tag_def} vs {treedef}"
+            )
+        for tag in tag_leaves:
+            _parse_layout(tag)
+        return list(tag_leaves)
+
     # -- save --------------------------------------------------------------
-    def save(self, state: Any, epoch: int, extra: Optional[dict] = None) -> str:
-        """Snapshot ``state`` at ``epoch``.
+    def save(self, state: Any, epoch: int, extra: Optional[dict] = None,
+             layouts=None) -> str:
+        """Snapshot ``state`` at ``epoch``. ``layouts`` tags each leaf's
+        world-size relationship (see the module layout notes) — None
+        records every leaf as ``replicated``.
 
         With ``async_write=True`` the device→host transfer happens here
         (so the snapshot is consistent) but serialization + the atomic
@@ -228,6 +387,7 @@ class CheckpointManager:
             "num_leaves": len(host_leaves),
             "treedef": str(treedef),
             "world_size": self._world_size(),
+            "layouts": self._layout_list(layouts, len(host_leaves), treedef),
             "extra": extra or {},
         }
         final_dir = os.path.join(self.directory, f"ckpt-{epoch}")
@@ -312,20 +472,9 @@ class CheckpointManager:
         epochs = self.all_epochs()
         return epochs[-1] if epochs else None
 
-    def restore(self, epoch: int, like: Any) -> Tuple[Any, int]:
-        """Restore the checkpoint at ``epoch``; ``like`` provides the pytree
-        structure (e.g. the init state).
-
-        Restore is verified: an unreadable manifest, missing/unloadable
-        arrays, or a content-fingerprint mismatch raise
-        :class:`CheckpointIntegrityError` (the signal
-        :meth:`restore_latest` uses to fall back to an older snapshot).
-        A world-size mismatch stays a plain ``ValueError`` — that is a
-        configuration error, and silently restoring an OLDER epoch under
-        the wrong parallelism would be worse than failing.
-        """
-        self.wait()
-        ckpt_dir = os.path.join(self.directory, f"ckpt-{epoch}")
+    def _read_meta(self, ckpt_dir: str) -> dict:
+        """The verified manifest of a committed snapshot (raises
+        :class:`CheckpointIntegrityError` on damage)."""
         try:
             with open(os.path.join(ckpt_dir, "meta.json")) as f:
                 meta = json.load(f)
@@ -340,19 +489,11 @@ class CheckpointManager:
             raise CheckpointIntegrityError(
                 f"checkpoint manifest at {ckpt_dir} is unreadable: {e!r}"
             ) from e
-        saved_world = meta.get("world_size")
-        if (
-            saved_world is not None
-            and saved_world != self._world_size()
-            and not self.allow_rescale
-        ):
-            raise ValueError(
-                f"checkpoint was written with {saved_world} devices but "
-                f"{self._world_size()} are in the restoring mesh; rescaling an in-flight "
-                "iteration is rejected (reference parity: "
-                "HeadOperator.java:130-146). Pass allow_rescale=True only "
-                "if the loop carry is replicated/device-count-independent."
-            )
+        return meta
+
+    def _read_leaves(self, ckpt_dir: str, meta: dict) -> List[np.ndarray]:
+        """The fingerprint-verified host leaves of a committed snapshot
+        (raises :class:`CheckpointIntegrityError` on damage)."""
         try:
             with np.load(os.path.join(ckpt_dir, "arrays.npz")) as z:
                 host_leaves = [
@@ -373,6 +514,106 @@ class CheckpointManager:
                     f"{actual[:12]}...): the persisted arrays were modified "
                     "after commit"
                 )
+        return host_leaves
+
+    def _rescale_error(self, ckpt_dir: str, meta: dict, outcome: str
+                       ) -> RescaleError:
+        """Build (and log, rank-tagged) the triage-ready rescale
+        refusal: snapshot dir + epoch + both worlds + policy outcome."""
+        msg = (
+            f"cannot restore checkpoint {ckpt_dir} (epoch "
+            f"{meta.get('epoch')}): snapshot was written at world_size="
+            f"{meta.get('world_size')} but the restoring mesh has "
+            f"world_size={self._world_size()}; RescalePolicy("
+            f"{self.rescale_policy.on_mismatch!r}) outcome: {outcome}. "
+            "Pass rescale='reshard' for layout-tagged elastic resume, or "
+            "rescale='allow' only if every carry leaf is "
+            "world-independent (reference parity: "
+            "HeadOperator.java:130-146)."
+        )
+        _log.error("%s", msg)
+        return RescaleError(msg)
+
+    def _reshard_leaves(self, host_leaves: List[np.ndarray], meta: dict,
+                        ckpt_dir: str) -> List[np.ndarray]:
+        """The ``reshard`` policy's re-layout: replicated leaves pass
+        through untouched (world-independent by definition), sharded
+        leaves keep their assembled global value but are revalidated
+        against the new rank count (the re-split at placement must come
+        out even), and ``per_rank`` leaves — genuinely rank-entangled —
+        refuse. Rank-scoped per-rank snapshot FAMILIES reassemble via
+        :func:`reshard_rank_state` instead."""
+        new_world = self._world_size()
+        layouts = meta.get("layouts") or [LAYOUT_REPLICATED] * len(host_leaves)
+        counts = {"replicated": 0, "sharded": 0}
+        for i, (leaf, tag) in enumerate(zip(host_leaves, layouts)):
+            kind, axis = _parse_layout(tag)
+            if kind == "per_rank":
+                raise self._rescale_error(
+                    ckpt_dir, meta,
+                    f"leaf {i} is per_rank (rank-entangled state cannot "
+                    "be re-laid-out; reassemble the rank-scoped family "
+                    "with reshard_rank_state, or resume at the original "
+                    "world)",
+                )
+            if kind == "sharded":
+                extent = (np.asarray(leaf).shape[axis]
+                          if axis < np.asarray(leaf).ndim else -1)
+                if extent < 0 or extent % new_world != 0:
+                    raise self._rescale_error(
+                        ckpt_dir, meta,
+                        f"leaf {i} is sharded:{axis} with extent {extent}, "
+                        f"which does not divide across {new_world} ranks",
+                    )
+            counts[kind] += 1
+        _log.info(
+            "resharded restore: %s (epoch %s) world %s -> %s "
+            "(%d replicated, %d sharded leaves re-laid-out)",
+            ckpt_dir, meta.get("epoch"), meta.get("world_size"),
+            new_world, counts["replicated"], counts["sharded"],
+        )
+        return host_leaves
+
+    def restore(self, epoch: int, like: Any) -> Tuple[Any, int]:
+        """Restore the checkpoint at ``epoch``; ``like`` provides the pytree
+        structure (e.g. the init state).
+
+        Restore is verified: an unreadable manifest, missing/unloadable
+        arrays, or a content-fingerprint mismatch raise
+        :class:`CheckpointIntegrityError` (the signal
+        :meth:`restore_latest` uses to fall back to an older snapshot).
+        A world-size mismatch is governed by the manager's
+        :class:`RescalePolicy`: rejected with a typed
+        :class:`RescaleError` by default (a configuration error —
+        silently restoring an OLDER epoch under the wrong parallelism
+        would be worse), re-laid-out per the snapshot's leaf layout tags
+        under ``rescale="reshard"``, or passed through unvalidated under
+        the legacy ``rescale="allow"``.
+        """
+        self.wait()
+        ckpt_dir = os.path.join(self.directory, f"ckpt-{epoch}")
+        meta = self._read_meta(ckpt_dir)
+        saved_world = meta.get("world_size")
+        rescaling = (
+            saved_world is not None and saved_world != self._world_size()
+        )
+        if rescaling and self.rescale_policy.on_mismatch == "reject":
+            raise self._rescale_error(
+                ckpt_dir, meta, "rejected (rescaling an in-flight "
+                "iteration is refused by policy)",
+            )
+        host_leaves = self._read_leaves(ckpt_dir, meta)
+        if rescaling:
+            if self.rescale_policy.on_mismatch == "reshard":
+                host_leaves = self._reshard_leaves(host_leaves, meta,
+                                                   ckpt_dir)
+            else:  # "allow" — the legacy unvalidated escape hatch
+                _log.warning(
+                    "rescaled restore WITHOUT layout validation: %s "
+                    "(epoch %s) world %s -> %s (policy 'allow')",
+                    ckpt_dir, meta.get("epoch"), saved_world,
+                    self._world_size(),
+                )
         treedef = jax.tree_util.tree_structure(like)
         if treedef.num_leaves != len(host_leaves):
             raise ValueError(
@@ -382,6 +623,45 @@ class CheckpointManager:
         state = jax.tree_util.tree_unflatten(treedef, host_leaves)
         self.last_restored_extra = meta.get("extra") or {}
         return state, int(meta["epoch"])
+
+    def verify(self, epoch: int) -> bool:
+        """Integrity verification WITHOUT a restore: True when the
+        snapshot at ``epoch`` has a readable manifest, loadable arrays,
+        and a matching content fingerprint. Pytree-structure- and
+        world-size-independent — this is what elastic survivors use to
+        nominate snapshots before agreeing a resume point
+        (:func:`flinkml_tpu.parallel.distributed.agree_resume_epoch`)."""
+        self._drain_quietly()
+        ckpt_dir = os.path.join(self.directory, f"ckpt-{epoch}")
+        try:
+            meta = self._read_meta(ckpt_dir)
+            self._read_leaves(ckpt_dir, meta)
+        except CheckpointIntegrityError:
+            return False
+        return True
+
+    def newest_valid_epoch(self) -> Optional[int]:
+        """The newest epoch that passes :meth:`verify` (None when the
+        directory holds no valid snapshot)."""
+        self._drain_quietly()
+        for epoch in reversed(self.all_epochs()):
+            if self.verify(epoch):
+                return epoch
+        return None
+
+    def _drain_quietly(self) -> None:
+        """Drain a pending async write WITHOUT re-raising its failure —
+        a parked write error belongs to ``save()``, not to the
+        committed on-disk state the verification queries inspect (the
+        crash path they exist for is precisely "the last write died");
+        it is logged, and the queries report what IS on disk."""
+        try:
+            self.wait()
+        except Exception as e:  # noqa: BLE001 — the write's failure, logged
+            _log.warning(
+                "pending checkpoint write failed (%r); verifying the "
+                "committed snapshots anyway", e,
+            )
 
     def restore_latest(self, like: Any) -> Optional[Tuple[Any, int]]:
         """Restore the newest snapshot that passes integrity verification,
@@ -417,3 +697,129 @@ class CheckpointManager:
             shutil.rmtree(
                 os.path.join(self.directory, f"ckpt-{epoch}"), ignore_errors=True
             )
+
+
+# -- elastic re-layout of rank-scoped snapshot families ----------------------
+
+
+def _rank_dirs(directory: str) -> List[Tuple[int, str]]:
+    """The ``rank-<i>`` subdirectories of a shared checkpoint root (the
+    :func:`rank_scoped` layout), sorted by rank."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith("rank-"):
+            try:
+                out.append((int(name[len("rank-"):]),
+                            os.path.join(directory, name)))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def reshard_rank_state(directory: str, epoch: int, like: Any,
+                       new_shard: Tuple[int, int],
+                       layouts=None) -> Any:
+    """Reassemble-and-resplit a :func:`rank_scoped` snapshot family.
+
+    Reads every ``rank-<i>`` subdirectory's snapshot at ``epoch`` (the
+    old world = the number of rank directories), then re-lays-out each
+    leaf for ``new_shard = (new_rank, new_world)`` per its recorded
+    layout tag:
+
+    - ``replicated``: every rank must hold the identical value (verified
+      bit-exact); the reassembled value is that value;
+    - ``sharded:<axis>``: the per-rank chunks concatenate in rank order
+      into the global array, which is re-split into ``new_world`` equal
+      chunks along ``axis`` — ``new_rank``'s chunk is returned (the
+      global extent must divide ``new_world`` evenly, else
+      :class:`RescaleError`);
+    - ``per_rank``: genuinely rank-entangled — :class:`RescaleError`.
+
+    ``layouts`` overrides the tags recorded in the snapshots (same
+    pytree/str convention as :meth:`CheckpointManager.save`).
+
+    Returns the re-laid-out state pytree for the new rank. This is the
+    state-re-layout primitive the elastic shrink path composes with
+    :func:`~flinkml_tpu.parallel.distributed.agree_resume_epoch`.
+    """
+    new_rank, new_world = int(new_shard[0]), int(new_shard[1])
+    if new_world < 1 or not (0 <= new_rank < new_world):
+        raise ValueError(f"invalid new shard assignment {new_shard!r}")
+    ranks = _rank_dirs(directory)
+    if not ranks:
+        raise ValueError(
+            f"no rank-scoped snapshot family under {directory} "
+            "(expected rank-<i> subdirectories)"
+        )
+    if [r for r, _ in ranks] != list(range(len(ranks))):
+        raise RescaleError(
+            f"rank-scoped family under {directory} is not contiguous "
+            f"(found ranks {[r for r, _ in ranks]}); a missing rank's "
+            "shard cannot be reassembled"
+        )
+    old_world = len(ranks)
+    treedef = jax.tree_util.tree_structure(like)
+    per_rank_leaves: List[List[np.ndarray]] = []
+    metas = []
+    for _, rank_dir in ranks:
+        mgr = CheckpointManager(rank_dir, rescale="allow")
+        ckpt_dir = os.path.join(rank_dir, f"ckpt-{epoch}")
+        meta = mgr._read_meta(ckpt_dir)
+        leaves = mgr._read_leaves(ckpt_dir, meta)
+        if len(leaves) != treedef.num_leaves:
+            raise ValueError(
+                f"rank snapshot {ckpt_dir} has {len(leaves)} leaves but "
+                f"the provided structure has {treedef.num_leaves}"
+            )
+        per_rank_leaves.append(leaves)
+        metas.append(meta)
+    tags = (
+        CheckpointManager(directory, rescale="allow")._layout_list(
+            layouts, treedef.num_leaves, treedef
+        )
+        if layouts is not None
+        else (metas[0].get("layouts")
+              or [LAYOUT_REPLICATED] * treedef.num_leaves)
+    )
+    out_leaves: List[np.ndarray] = []
+    for i, tag in enumerate(tags):
+        kind, axis = _parse_layout(tag)
+        chunks = [np.asarray(leaves[i]) for leaves in per_rank_leaves]
+        if kind == "per_rank":
+            raise RescaleError(
+                f"leaf {i} of the family under {directory} (epoch "
+                f"{epoch}) is per_rank: rank-entangled state has no "
+                f"global assembly — world {old_world} -> {new_world} "
+                "resume must rebuild it from data"
+            )
+        if kind == "replicated":
+            for r, chunk in enumerate(chunks[1:], start=1):
+                if not np.array_equal(chunk, chunks[0]):
+                    raise RescaleError(
+                        f"replicated leaf {i} diverges between rank 0 "
+                        f"and rank {r} under {directory} (epoch {epoch}):"
+                        " the family is not a consistent snapshot"
+                    )
+            out_leaves.append(chunks[0])
+            continue
+        global_arr = np.concatenate(chunks, axis=axis)
+        extent = global_arr.shape[axis]
+        if extent % new_world != 0:
+            raise RescaleError(
+                f"sharded leaf {i} under {directory} (epoch {epoch}) has "
+                f"global extent {extent} along axis {axis}, which does "
+                f"not divide across {new_world} ranks"
+            )
+        out_leaves.append(
+            np.split(global_arr, new_world, axis=axis)[new_rank]
+        )
+    _log.info(
+        "reshard_rank_state: %s epoch %s world %d -> %d (rank %d), "
+        "%d leaves re-laid-out", directory, epoch, old_world, new_world,
+        new_rank, len(out_leaves),
+    )
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)

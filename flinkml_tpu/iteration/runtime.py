@@ -356,6 +356,10 @@ def iterate(
         while not terminated:
             if faults.ACTIVE is not None:  # scripted-crash seam (pre-batch)
                 faults.fire("iteration.epoch", epoch=epoch)
+                # Elastic seam: a scripted RankLost marks a peer dead at
+                # this epoch boundary; the watchdog (in ctx) converts it
+                # into a clean shrink-triggering preemption stop.
+                faults.fire("rank.lost", epoch=epoch, watchdog=watchdog)
             if watchdog is not None and watchdog.requested:
                 # Epoch boundaries are the globally consistent points in
                 # SPMD lockstep — stop here, snapshot below, drain, hand
@@ -440,9 +444,13 @@ def iterate(
 
 
 def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
-    """When ``data`` is a :class:`flinkml_tpu.data.Dataset`, open a
-    TRACKED iteration positioned at ``start_epoch`` and return it (else
-    None and the caller falls back to plain iteration).
+    """When ``data`` is a :class:`flinkml_tpu.data.Dataset` (or an
+    :class:`~flinkml_tpu.data.ElasticFeed` — the world-parallel
+    global-order feed), open a TRACKED iteration positioned at
+    ``start_epoch`` and return it (else None and the caller falls back
+    to plain iteration). An ElasticFeed's cursor records the world that
+    wrote it, so a resumed run at a DIFFERENT world re-splits the feed
+    across the new readers (the elastic-resume path).
 
     A Dataset is restartable and deterministic, so resume is always the
     'replay' contract regardless of ``stream_resume``: the chain
@@ -455,10 +463,10 @@ def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
     in-flight write the epoch superseded).
     """
     try:
-        from flinkml_tpu.data import Cursor, Dataset
+        from flinkml_tpu.data import Cursor, Dataset, ElasticFeed
     except ImportError:  # pragma: no cover — data subsystem always ships
         return None
-    if not isinstance(data, Dataset):
+    if not isinstance(data, (Dataset, ElasticFeed)):
         return None
     cursor = None
     if start_epoch > 0:
@@ -469,7 +477,19 @@ def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
         if recorded is not None:
             cursor = Cursor.from_json_dict(recorded)
             if cursor.emitted != start_epoch:
-                cursor = dataclasses.replace(cursor, emitted=start_epoch)
+                # The restored epoch stays authoritative; shift the
+                # recorded global watermark by the same number of
+                # lockstep rounds (one batch per shard per round; a
+                # global-order cursor advances one batch per round).
+                watermark = cursor.global_watermark
+                if watermark is not None:
+                    per_round = (cursor.num_shards
+                                 if cursor.shard_index is not None
+                                 and cursor.num_shards is not None else 1)
+                    watermark += (start_epoch - cursor.emitted) * per_round
+                cursor = dataclasses.replace(
+                    cursor, emitted=start_epoch, global_watermark=watermark
+                )
         else:
             cursor = Cursor(emitted=start_epoch)
     return data.iterate(cursor)

@@ -56,7 +56,8 @@ def _agree_fn(mesh, axis: str, op: str):
     """Compiled collective for :func:`_device_agree`, cached per
     (mesh, op) — a fresh closure per call would defeat the jit cache and
     recompile every agreement (a streamed fit performs ~10 of them)."""
-    red = {"max": jax.lax.pmax, "sum": jax.lax.psum}[op]
+    red = {"max": jax.lax.pmax, "sum": jax.lax.psum,
+           "min": jax.lax.pmin}[op]
 
     def _one(x):
         return red(x, axis)
@@ -97,6 +98,15 @@ def agree_max(value: int, mesh: Optional[DeviceMesh] = None) -> int:
     counts, use :func:`gather_vectors` (f64-exact transport) instead.
     """
     return _device_agree(value, mesh, "max")
+
+
+def agree_min(value: int, mesh: Optional[DeviceMesh] = None) -> int:
+    """Min of a per-process int across all processes — the agreement a
+    set of elastic survivors uses to pick the newest COMMONLY-valid
+    snapshot (each nominates its local newest; the min is the newest
+    every survivor can restore). Same int32 transport caveats as
+    :func:`agree_max`."""
+    return _device_agree(value, mesh, "min")
 
 
 def agree_all_ok(ok: bool, mesh: Optional[DeviceMesh], what: str) -> None:

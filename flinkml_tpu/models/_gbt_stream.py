@@ -554,10 +554,15 @@ def _build_forest(
             from flinkml_tpu.iteration.checkpoint import save_agreed
 
             # Rank-local state (pred): every rank writes its rank-scoped
-            # shard; the agreement is the commit barrier.
+            # shard; the agreement is the commit barrier. The layout
+            # tags make the snapshot reshard-aware: per-row margins are
+            # rank-entangled (a world change must refuse or reassemble
+            # them via reshard_rank_state), the tree arrays replicate.
             save_agreed(
                 checkpoint_manager,
                 (pred, feats_out, bins_out, gains_out, leaves_out),
                 t + 1, mesh, per_rank=True,
+                layouts=("per_rank", "replicated", "replicated",
+                         "replicated", "replicated"),
             )
     return feats_out, bins_out, gains_out, leaves_out, base, edges

@@ -28,13 +28,17 @@ def peek_stream(batches) -> Tuple[Optional[Any], Any]:
       WHOLE, so the runtime owns the skip/cursor machinery (chaining a
       consumed iterator would hide the Dataset and break cursor
       checkpoint/resume);
+    - an :class:`flinkml_tpu.data.ElasticFeed` (world-parallel
+      global-order feed) follows the Dataset contract — peeked with a
+      throwaway iteration, handed to ``iterate`` whole so its GLOBAL
+      cursor (and the elastic reshard on resume) belongs to the runtime;
     - a plain iterable is peeked destructively and re-chained.
     """
     try:
-        from flinkml_tpu.data import Dataset
+        from flinkml_tpu.data import Dataset, ElasticFeed
     except ImportError:  # pragma: no cover — data subsystem always ships
-        Dataset = None
-    if Dataset is not None and isinstance(batches, Dataset):
+        Dataset = ElasticFeed = None
+    if Dataset is not None and isinstance(batches, (Dataset, ElasticFeed)):
         return batches.peek(), batches
     import itertools
 
@@ -44,6 +48,22 @@ def peek_stream(batches) -> Tuple[Optional[Any], Any]:
     except StopIteration:
         return None, iter(())
     return first, itertools.chain([first], it)
+
+
+def feed_world_size(batches) -> int:
+    """The world size the checkpoint rescale guard should pin for a
+    training feed: a :class:`~flinkml_tpu.data.Dataset`'s shard count or
+    an :class:`~flinkml_tpu.data.ElasticFeed`'s world (both expose
+    ``num_shards``); 1 for plain iterables (a single-controller feed has
+    no data-plane parallelism to guard). This is what lifts the online
+    trainers' old ``world_size=1`` pin to mesh-aware resume: snapshots
+    record the feed's TRUE world, and a manager with
+    ``rescale="reshard"`` restores them at any other."""
+    world = getattr(batches, "num_shards", None)
+    try:
+        return max(1, int(world)) if world is not None else 1
+    except (TypeError, ValueError):
+        return 1
 
 
 class StreamingEstimatorMixin:

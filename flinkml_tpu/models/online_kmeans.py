@@ -151,8 +151,14 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
             )
 
         from flinkml_tpu.iteration.checkpoint import begin_resume
+        from flinkml_tpu.models._streaming import feed_world_size
 
-        restore_epoch = begin_resume(checkpoint_manager, resume, world_size=1)
+        # The rescale guard pins the FEED's world (Dataset shard count /
+        # ElasticFeed world; 1 for plain iterables); the centroid carry
+        # is replicated, so a rescale="reshard" manager resumes it at
+        # any world bit-exactly.
+        restore_epoch = begin_resume(checkpoint_manager, resume,
+                                     world_size=feed_world_size(batches))
 
         # Peek the first batch: initial centroids draw from it (when no
         # initial model data was given) and it fixes the carry structure

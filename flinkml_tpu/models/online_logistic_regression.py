@@ -192,11 +192,16 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
             return self._fit_stream_multiprocess(batches, alpha, beta, l1, l2)
 
         from flinkml_tpu.iteration.checkpoint import begin_resume
+        from flinkml_tpu.models._streaming import feed_world_size
 
-        # Single-controller online fit: the carry lives on one device, so
-        # the rescale guard is pinned to world size 1 (not the process
-        # device count).
-        restore_epoch = begin_resume(checkpoint_manager, resume, world_size=1)
+        # Single-controller online fit: the rescale guard pins the
+        # FEED's world (a Dataset's shard count / an ElasticFeed's
+        # world; 1 for plain iterables) — snapshots record the true
+        # data-plane parallelism, and a manager with rescale="reshard"
+        # restores them at any other world (the FTRL carry is
+        # replicated, so elastic resume is bit-exact).
+        restore_epoch = begin_resume(checkpoint_manager, resume,
+                                     world_size=feed_world_size(batches))
 
         fcol = self.get(_OnlineLogisticRegressionParams.FEATURES_COL)
         lcol = self.get(_OnlineLogisticRegressionParams.LABEL_COL)

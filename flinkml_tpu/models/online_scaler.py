@@ -138,9 +138,15 @@ class OnlineStandardScaler(
                 raise ValueError("training stream is empty on every process")
         else:
             from flinkml_tpu.iteration.checkpoint import begin_resume
+            from flinkml_tpu.models._streaming import feed_world_size
 
+            # The rescale guard pins the FEED's world (Dataset shard
+            # count / ElasticFeed world; 1 for plain iterables); the
+            # moment carry is replicated, so a rescale="reshard"
+            # manager resumes it at any world bit-exactly.
             restore_epoch = begin_resume(
-                checkpoint_manager, resume, world_size=1
+                checkpoint_manager, resume,
+                world_size=feed_world_size(batches)
             )
             # Peek the first batch to fix the feature dim: the carry is a
             # full array pytree from epoch 0 (the checkpointable

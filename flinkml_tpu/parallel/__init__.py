@@ -16,9 +16,12 @@ from flinkml_tpu.parallel.dispatch import (
     synced_loop,
 )
 from flinkml_tpu.parallel.distributed import (
+    agree_resume_epoch,
+    compact_rank,
     host_barrier,
     init_distributed,
     process_slice,
+    rescale_world,
 )
 from flinkml_tpu.parallel.ring import ring_attention, ulysses_attention
 from flinkml_tpu.parallel.tensor import (
@@ -42,9 +45,12 @@ __all__ = [
     "DispatchGuard",
     "default_sync_interval",
     "synced_loop",
+    "agree_resume_epoch",
+    "compact_rank",
     "host_barrier",
     "init_distributed",
     "process_slice",
+    "rescale_world",
     "ring_attention",
     "ulysses_attention",
     "expert_parallel_ffn",

@@ -206,6 +206,116 @@ def host_barrier(mesh=None, tag: int = 0) -> int:
     return int(np.asarray(summed.addressable_shards[0].data)[0])
 
 
+def agree_resume_epoch(manager, mesh=None, old_world: Optional[int] = None,
+                       new_world: Optional[int] = None) -> Optional[int]:
+    """The elastic survivors' rendezvous: agree the newest snapshot of
+    ``manager`` (a :class:`~flinkml_tpu.iteration.CheckpointManager`)
+    that EVERY remaining rank can restore.
+
+    Each rank nominates its local newest verified epoch
+    (``manager.newest_valid_epoch()`` — integrity-checked, so a rank
+    whose shared-FS view of the latest snapshot is torn nominates the
+    one before it); the agreement is then two existing rendezvous
+    primitives over the same ICI/DCN fabric as the data plane:
+
+    1. :func:`~flinkml_tpu.iteration.stream_sync.agree_all_ok` — any
+       rank with NO valid snapshot at all aborts every rank together
+       (resuming the others from epoch k while one starts fresh would
+       split-brain the fleet);
+    2. :func:`~flinkml_tpu.iteration.stream_sync.agree_min` over the
+       nominated epochs — the newest COMMONLY-valid snapshot.
+
+    Fires the ``rendezvous.rescale`` fault seam (with both worlds in
+    context) so tests can script a shrink rendezvous that fails.
+    Single-process this degrades to the local newest-valid epoch (None
+    when the directory holds no valid snapshot — a fresh start).
+    """
+    import flinkml_tpu.faults as faults
+
+    local = manager.newest_valid_epoch()
+    if faults.ACTIVE is not None:  # scripted shrink-rendezvous failure
+        faults.fire("rendezvous.rescale",
+                    local_epoch=-1 if local is None else int(local),
+                    old_world=old_world, new_world=new_world)
+    if jax.process_count() == 1:
+        _log.info(
+            "elastic resume rendezvous (single process): newest valid "
+            "epoch %s under %s", local, manager.directory,
+        )
+        return local
+    from flinkml_tpu.iteration.stream_sync import agree_all_ok, agree_min
+
+    agree_all_ok(
+        local is not None, mesh,
+        f"elastic resume: a valid snapshot under {manager.directory}",
+    )
+    agreed = agree_min(int(local), mesh)
+    # min-of-newest is only COMMONLY valid if every survivor still holds
+    # (and can verify) that epoch — a rank whose older snapshots were
+    # pruned (max_to_keep) or torn in its shared-FS view would otherwise
+    # discover the gap mid-restore and strand the peers in the training
+    # collectives: exactly the split-brain the rendezvous exists to
+    # prevent. Abort together instead.
+    agree_all_ok(
+        agreed == local or manager.verify(agreed), mesh,
+        f"elastic resume: agreed snapshot epoch {agreed} restorable on "
+        "every survivor",
+    )
+    _log.info(
+        "elastic resume rendezvous: local newest valid epoch %s, agreed "
+        "epoch %s (world %s -> %s)", local, agreed, old_world, new_world,
+    )
+    return agreed
+
+
+def compact_rank(old_rank: int, lost_ranks) -> Optional[int]:
+    """A survivor's process id in the shrunken world: its position among
+    the surviving old ranks (dense, order-preserving — old rank 3 with
+    rank 1 lost becomes new rank 2). None when ``old_rank`` is itself
+    lost. This is the id a survivor passes to :func:`rescale_world`."""
+    lost = set(int(r) for r in lost_ranks)
+    old_rank = int(old_rank)
+    if old_rank in lost:
+        return None
+    return old_rank - sum(1 for r in lost if r < old_rank)
+
+
+def rescale_world(new_world: int, new_rank: int,
+                  coordinator_address: Optional[str] = None,
+                  **init_kwargs) -> Tuple[int, int]:
+    """Re-join the coordination service at a NEW world size — the
+    control-plane half of an elastic shrink/grow: tear down the old
+    ``jax.distributed`` membership (if any) and rendezvous again as
+    process ``new_rank`` of ``new_world`` (survivor ranks compacted via
+    :func:`compact_rank`). Single-host (no coordinator configured, world
+    1) this is a no-op returning ``(0, 1)`` — the CPU test path.
+
+    The data-plane re-layout is NOT here: restore the carry through a
+    ``rescale="reshard"`` manager and re-split the feed via its cursor
+    (see ``docs/development/fault_tolerance.md``, "Elastic resume").
+    """
+    new_world, new_rank = int(new_world), int(new_rank)
+    if new_world < 1 or not (0 <= new_rank < new_world):
+        raise ValueError(
+            f"invalid rescaled assignment rank {new_rank} of {new_world}"
+        )
+    if jax.distributed.is_initialized():
+        _log.warning("leaving old world for rescale (rank %d of new %d)",
+                     new_rank, new_world)
+        jax.distributed.shutdown()
+    if new_world == 1 and not (
+        coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    ):
+        flog.set_rank(0, 1)
+        return 0, 1
+    return init_distributed(
+        coordinator_address=coordinator_address,
+        num_processes=new_world,
+        process_id=new_rank,
+        **init_kwargs,
+    )
+
+
 def require_single_controller(what: str) -> None:
     """Raise a clear error when ``what`` runs under a multi-process mesh.
 

@@ -65,6 +65,33 @@ class DeviceMesh:
     def axis_size(self, name: str = DATA_AXIS) -> int:
         return self.mesh.shape[name]
 
+    # -- elastic re-shaping ------------------------------------------------
+    def shrink(self, new_size: int, axis: str = DATA_AXIS) -> "DeviceMesh":
+        """A new mesh over a SUBSET of this mesh's devices: ``axis``
+        reduced to ``new_size`` (the leading ``new_size`` slots in mesh
+        order — survivors keep their relative order, matching
+        :func:`~flinkml_tpu.parallel.distributed.compact_rank`'s dense
+        renumbering). The elastic shrink's device-plane half: after the
+        survivors re-rendezvous at world M, the training mesh is
+        ``old_mesh.shrink(M * local_devices)`` — or simply a fresh
+        ``DeviceMesh()`` of the new world's devices."""
+        new_size = int(new_size)
+        old = self.axis_size(axis)
+        if not (1 <= new_size <= old):
+            raise ValueError(
+                f"cannot shrink axis {axis!r} from {old} to {new_size}"
+            )
+        shapes = {name: self.mesh.shape[name] for name in self.axis_names}
+        shapes[axis] = new_size
+        # Move the shrinking axis's index innermost-last so "the leading
+        # new_size slots along `axis`" selects device rows in mesh order.
+        idx = tuple(
+            slice(0, new_size) if name == axis else slice(None)
+            for name in self.axis_names
+        )
+        devices = self.mesh.devices[idx].reshape(-1)
+        return DeviceMesh(shapes, devices=list(devices))
+
     # -- shardings ---------------------------------------------------------
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))

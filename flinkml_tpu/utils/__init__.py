@@ -18,7 +18,7 @@ from flinkml_tpu.utils.metrics import (
     default_registry,
     metrics,
 )
-from flinkml_tpu.utils.preemption import PreemptionWatchdog
+from flinkml_tpu.utils.preemption import ElasticResumePlan, PreemptionWatchdog
 from flinkml_tpu.utils.profiling import (
     StepTimer,
     annotate,
@@ -39,4 +39,5 @@ __all__ = [
     "get_logger",
     "rank_tag",
     "PreemptionWatchdog",
+    "ElasticResumePlan",
 ]

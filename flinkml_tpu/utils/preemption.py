@@ -30,10 +30,33 @@ Use it scoped::
 Any ``iterate``-based loop inside the ``with`` observes the watchdog via
 :func:`active` — no per-trainer plumbing needed (an explicit
 ``IterationConfig.watchdog`` overrides the ambient one).
+
+**Shrink on SIGTERM / rank loss (elastic resume, ISSUE 6).** Losing a
+peer host mid-epoch is the same shape as losing this one: the watchdog
+additionally tracks LOST PEER RANKS (:meth:`PreemptionWatchdog
+.notify_rank_lost` — fed by the orchestrator's health channel, or by the
+scripted :class:`~flinkml_tpu.faults.RankLost` fault at the
+``rank.lost`` seam). A rank loss requests a clean stop exactly like
+SIGTERM — final checkpoint committed, engines drained — and the
+SURVIVORS then continue at the shrunken world:
+
+    with PreemptionWatchdog() as wd:
+        result = trainer.fit_stream(feed, checkpoint_manager=mgr, ...)
+    if wd.shrink_requested:
+        plan = wd.plan_elastic_resume(mgr, world=old_world)
+        # plan.new_world survivors agree on plan.epoch (the newest
+        # commonly-valid snapshot), re-init at world M, resume with a
+        # rescale="reshard" manager + an ElasticFeed at plan.new_world.
+
+The agreement rides :func:`flinkml_tpu.parallel.distributed
+.agree_resume_epoch` (the existing ``agree_all_ok`` rendezvous +
+device-mediated min), exercised by the ``rendezvous.rescale`` fault
+seam. See ``docs/development/fault_tolerance.md`` ("Elastic resume").
 """
 
 from __future__ import annotations
 
+import dataclasses
 import signal
 import threading
 from typing import Any, List, Optional, Sequence
@@ -41,6 +64,18 @@ from typing import Any, List, Optional, Sequence
 from flinkml_tpu.utils.logging import get_logger
 
 _log = get_logger("preemption")
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticResumePlan:
+    """The survivors' agreed shrink/grow decision: resume from snapshot
+    ``epoch`` (the newest commonly-valid one; None when no snapshot
+    exists anywhere — a fresh start at the new world), moving from
+    ``old_world`` ranks to ``new_world``."""
+
+    epoch: Optional[int]
+    old_world: int
+    new_world: int
 
 _ACTIVE: Optional["PreemptionWatchdog"] = None
 
@@ -72,6 +107,9 @@ class PreemptionWatchdog:
         self._timer: Optional[threading.Timer] = None
         self._finalized = False
         self.reason: Optional[str] = None
+        #: Peer ranks reported dead (see :meth:`notify_rank_lost`) —
+        #: what the elastic shrink path sizes the survivor world from.
+        self.lost_ranks: List[int] = []
 
     # -- lifecycle ---------------------------------------------------------
     def install(self) -> "PreemptionWatchdog":
@@ -132,6 +170,55 @@ class PreemptionWatchdog:
     @property
     def requested(self) -> bool:
         return self._event.is_set()
+
+    # -- elastic world changes ----------------------------------------------
+    def notify_rank_lost(self, rank: int, reason: Optional[str] = None) -> None:
+        """A peer host is gone (preempted VM, dead health check, the
+        scripted :class:`~flinkml_tpu.faults.RankLost` fault). Recorded
+        in :attr:`lost_ranks` and treated exactly like SIGTERM on this
+        host: the training loop stops cleanly at its next epoch
+        boundary with a final checkpoint — the survivors then agree an
+        elastic resume at the shrunken world
+        (:meth:`plan_elastic_resume`)."""
+        rank = int(rank)
+        if rank not in self.lost_ranks:
+            self.lost_ranks.append(rank)
+        self.request(reason or f"rank {rank} lost (shrink requested)")
+
+    @property
+    def shrink_requested(self) -> bool:
+        """True when at least one peer rank was reported lost — the
+        signal to resume at a smaller world rather than just restart."""
+        return bool(self.lost_ranks)
+
+    def survivor_world(self, old_world: int) -> int:
+        """The world size after dropping the lost ranks (floored at 1 —
+        this host is, by construction, still alive)."""
+        return max(1, int(old_world) - len(set(self.lost_ranks)))
+
+    def plan_elastic_resume(self, manager: Any, world: int,
+                            new_world: Optional[int] = None,
+                            mesh=None) -> ElasticResumePlan:
+        """The survivors' shrink (or grow) decision: agree the newest
+        commonly-valid snapshot of ``manager`` across the remaining
+        ranks (:func:`flinkml_tpu.parallel.distributed
+        .agree_resume_epoch` — fires the ``rendezvous.rescale`` seam)
+        and return the :class:`ElasticResumePlan` to resume from.
+        ``new_world`` defaults to :meth:`survivor_world` of ``world``."""
+        from flinkml_tpu.parallel.distributed import agree_resume_epoch
+
+        target = (int(new_world) if new_world is not None
+                  else self.survivor_world(world))
+        epoch = agree_resume_epoch(manager, mesh=mesh,
+                                   old_world=int(world), new_world=target)
+        plan = ElasticResumePlan(epoch=epoch, old_world=int(world),
+                                 new_world=target)
+        _log.warning(
+            "elastic resume planned: world %d -> %d from snapshot epoch "
+            "%s (lost ranks: %s)", plan.old_world, plan.new_world,
+            plan.epoch, sorted(set(self.lost_ranks)),
+        )
+        return plan
 
     # -- shutdown actions ----------------------------------------------------
     def register_engine(self, engine: Any) -> None:

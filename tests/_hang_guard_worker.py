@@ -321,8 +321,8 @@ def case5d_crash_resume():
     class Crash(CheckpointManager):
         fired = False
 
-        def save(self, state, epoch, extra=None):
-            p = super().save(state, epoch, extra)
+        def save(self, state, epoch, extra=None, **kw):
+            p = super().save(state, epoch, extra, **kw)
             if not Crash.fired and epoch >= 2:
                 Crash.fired = True
                 raise RuntimeError("injected crash")

@@ -35,8 +35,8 @@ class CrashAfterSave(CheckpointManager):
         self.crash_after_epoch = crash_after_epoch
         self.fired = False
 
-    def save(self, state, epoch, extra=None):
-        path = super().save(state, epoch, extra)
+    def save(self, state, epoch, extra=None, **kw):
+        path = super().save(state, epoch, extra, **kw)
         if not self.fired and epoch >= self.crash_after_epoch:
             self.fired = True
             raise RuntimeError(f"injected crash after checkpoint {epoch}")

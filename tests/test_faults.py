@@ -333,3 +333,51 @@ def test_watchdog_finalize_idempotent():
     wd.finalize()
     wd.finalize()
     assert engine.stopped == [True]
+
+
+def test_watchdog_preemption_with_torn_final_write_falls_back(tmp_path):
+    """Compound failure (ISSUE 6 satellite): SIGTERM arrives AND the
+    preemption's final checkpoint write tears (``TornWrite`` at the
+    ``checkpoint.write`` seam — the host dies mid-flush of its last
+    snapshot). The torn commit must surface, the PRIOR interval commit
+    must remain the restore point, and a resume must reach parity with
+    the uninterrupted run."""
+    stream = [float(i) for i in range(8)]
+    golden = iterate(_count_step, 0.0, stream,
+                     IterationConfig(TerminateOnMaxIter(8))).state
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    wd = PreemptionWatchdog(signals=(signal.SIGTERM,))
+
+    def step(state, data, epoch):
+        if epoch == 4:
+            os.kill(os.getpid(), signal.SIGTERM)  # a REAL SIGTERM
+        return state + float(data), None
+
+    # Interval commits land at epochs 2 and 4; the preemption stop then
+    # attempts a terminal snapshot at epoch 5, whose write tears.
+    with wd:
+        with faults.armed(faults.FaultPlan(faults.TornWrite(5))) as plan:
+            with pytest.raises(faults.FaultInjected, match="torn"):
+                iterate(
+                    step, 0.0, stream,
+                    IterationConfig(TerminateOnMaxIter(8),
+                                    checkpoint_interval=2,
+                                    checkpoint_manager=mgr),
+                )
+    assert ("checkpoint.write", "TornWrite(5)", {
+        "epoch": 5, "directory": str(tmp_path / "ckpt"),
+    }) in [(s, d, {k: v for k, v in c.items() if k != "path"})
+           for s, d, c in plan.log]
+    # The torn epoch-5 snapshot never became visible; epoch 4 survives.
+    assert mgr.latest_epoch() == 4
+    state, epoch = mgr.restore_latest(0.0)
+    assert (state, epoch) == (0.0 + 0 + 1 + 2 + 3, 4)
+
+    resumed = iterate(_count_step, 0.0, stream,
+                      IterationConfig(TerminateOnMaxIter(8),
+                                      checkpoint_interval=2,
+                                      checkpoint_manager=mgr),
+                      resume=True)
+    assert not resumed.preempted
+    assert resumed.state == golden

@@ -223,8 +223,8 @@ def test_datacache_resume_exact(tmp_path, mesh):
     class Crash(CheckpointManager):
         fired = False
 
-        def save(self, state, epoch, extra=None):
-            p = super().save(state, epoch, extra)
+        def save(self, state, epoch, extra=None, **kw):
+            p = super().save(state, epoch, extra, **kw)
             if not Crash.fired and epoch >= 3:
                 Crash.fired = True
                 raise RuntimeError("injected crash")

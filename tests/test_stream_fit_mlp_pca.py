@@ -16,8 +16,8 @@ def _crash_manager_cls(crash_at_epoch):
     class Crash(CheckpointManager):
         fired = False
 
-        def save(self, state, epoch, extra=None):
-            p = super().save(state, epoch, extra)
+        def save(self, state, epoch, extra=None, **kw):
+            p = super().save(state, epoch, extra, **kw)
             if not Crash.fired and epoch >= crash_at_epoch:
                 Crash.fired = True
                 raise RuntimeError("injected crash")

@@ -147,6 +147,81 @@ EOF
 }
 stage "chaos smoke (kill+corrupt+resume)" chaos_smoke
 
+# Elasticity chaos (ISSUE 6 acceptance): a synthetic-source online LR
+# fed by the world-parallel ElasticFeed is killed at world 4 through the
+# rank.lost seam (watchdog shrink path: clean stop + terminal snapshot),
+# the survivors agree a resume point over the rendezvous, and the run
+# resumes at world 2 AND world 8 with batch-sequence parity and a
+# bit-identical model. Device-free (JAX_PLATFORMS=cpu).
+elasticity_chaos() {
+    JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import shutil, tempfile, os
+
+import numpy as np
+
+from flinkml_tpu import faults
+from flinkml_tpu.data import Dataset, ElasticFeed
+from flinkml_tpu.iteration import CheckpointManager
+from flinkml_tpu.models import OnlineLogisticRegression
+from flinkml_tpu.table import Table
+from flinkml_tpu.utils.preemption import PreemptionWatchdog
+
+B, DIM = 12, 6
+TRUE = np.arange(1.0, DIM + 1.0)
+
+def mk(i, rng):
+    x = rng.normal(size=(64, DIM))
+    return Table({"features": x, "label": (x @ TRUE > 0).astype(np.float64)})
+
+def feed(world):
+    return ElasticFeed(
+        lambda shard: Dataset.synthetic(mk, B, seed=5, shard=shard), world)
+
+def fit(world, **kw):
+    return OnlineLogisticRegression().set_alpha(0.5).fit_stream(
+        feed(world), **kw)
+
+# Batch-sequence parity of the feed itself: one canonical global order.
+def keys(world):
+    return [float(np.asarray(b.column("features"))[0, 0])
+            for b in feed(world)]
+golden_seq = keys(1)
+assert keys(4) == golden_seq and keys(2) == golden_seq and \
+    keys(8) == golden_seq, "ElasticFeed global order is world-dependent"
+
+golden = fit(1)
+
+with tempfile.TemporaryDirectory() as td:
+    kill_dir = os.path.join(td, "kill")
+    mgr = CheckpointManager(kill_dir, max_to_keep=10, rescale="reshard")
+    wd = PreemptionWatchdog(signals=())
+    with wd:
+        with faults.armed(faults.FaultPlan(faults.RankLost(epoch=7,
+                                                           rank=2))):
+            partial = fit(4, checkpoint_manager=mgr, checkpoint_interval=3)
+    assert wd.shrink_requested and wd.lost_ranks == [2]
+    assert partial.model_version == 7
+    assert mgr.latest_epoch() == 7, mgr.all_epochs()
+    plan = wd.plan_elastic_resume(mgr, world=4)
+    assert (plan.epoch, plan.old_world, plan.new_world) == (7, 4, 3)
+    for world in (2, 8):
+        wdir = os.path.join(td, f"w{world}")
+        shutil.copytree(kill_dir, wdir)
+        m = CheckpointManager(wdir, max_to_keep=10, rescale="reshard")
+        rec = fit(world, checkpoint_manager=m, checkpoint_interval=3,
+                  resume=True)
+        assert np.array_equal(rec.coefficient, golden.coefficient), \
+            f"world-{world} resumed model != uninterrupted model"
+        assert rec.model_version == golden.model_version == B
+        cur = m.last_restored_extra["data_cursor"]
+        assert cur["num_shards"] == 4 and cur["emitted"] == 7
+    print("elasticity chaos: rank 2 lost at world 4 (epoch 7, snapshot",
+          "committed) -> resumed at world 2 and world 8, batch-sequence",
+          "parity + bit-exact model")
+EOF
+}
+stage "elasticity chaos (kill@world4 -> resume@2/@8)" elasticity_chaos
+
 # Input-pipeline smoke (ISSUE 5 acceptance): a shuffled CSV-glob Dataset
 # drives the fused 5-stage chain through the bucketed async prefetcher
 # with ZERO retraces after warmup (TransferRetraceGuard-verified), and a
