@@ -892,6 +892,69 @@ def _inner_input_pipeline_cpu() -> dict:
     return _input_pipeline_stage()
 
 
+def _sharded_train_stage(n=16_384, dim=512, iters=24) -> dict:
+    """Stage: plan-sharded training throughput — full-batch momentum-SGD
+    logreg through ``sharding.apply.train_linear_plan`` under each plan
+    preset (dp / FSDP / FSDP×TP), one number per preset
+    (``sharded_samples_per_sec``). The ISSUE-7 trajectory: the same
+    jitted plan-sharded step the product trains with, batch sharded
+    along the plan's batch axes, parameters + momentum sharded per the
+    plan, GSPMD collectives included in the wall. The replicated preset
+    is measured too so the sharding overhead/benefit is one division
+    away."""
+    import jax
+
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.sharding import PRESETS
+    from flinkml_tpu.sharding.apply import train_linear_plan
+
+    x, y, w = make_data(n, dim)
+    rates = {}
+    for name in ("replicated", "batch_parallel", "fsdp", "fsdp_tp"):
+        plan = PRESETS[name]
+        mesh = DeviceMesh.for_plan(plan)
+
+        def run(max_iter):
+            return train_linear_plan(
+                x, y, w, plan, mesh, loss="logistic", optimizer="sgd",
+                max_iter=max_iter, learning_rate=0.1,
+            )
+
+        run(2)  # compile + warm the window upload path
+        start = time.perf_counter()
+        coef = run(iters)
+        elapsed = time.perf_counter() - start
+        assert np.isfinite(coef).all()
+        rates[name] = round(n * iters / elapsed, 1)
+        _log(f"sharded_train[{name}]: {rates[name]} samples/s "
+             f"({len(jax.devices())} devices)")
+    return {
+        "sharded_samples_per_sec": rates,
+        "rows": n,
+        "dim": dim,
+        "devices": len(jax.devices()),
+    }
+
+
+def _inner_sharded_train() -> dict:
+    _setup_jax_cache()
+    return _sharded_train_stage()
+
+
+def _inner_sharded_train_cpu() -> dict:
+    """The plan-preset measurement pinned to an 8-virtual-device host
+    CPU mesh — tunnel-immune (CI's sharding stage parses it), so every
+    preset's trajectory is always observable; the device variant above
+    runs the same programs when the tunnel returns."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    return _sharded_train_stage()
+
+
 # Epoch-mean logistic-loss target for the convergence stage. Calibrated on
 # the seeded a9a-shaped config (CPU, f32): loss 0.599 after 1 epoch, 0.219
 # after 25, 0.169 after 50 — tol 0.20 lands at ~30 epochs: long enough to
@@ -1001,6 +1064,8 @@ _INNER_STAGES = {
     "feed_overlap": _inner_feed_overlap,
     "input_pipeline": _inner_input_pipeline,
     "input_pipeline_cpu": _inner_input_pipeline_cpu,
+    "sharded_train": _inner_sharded_train,
+    "sharded_train_cpu": _inner_sharded_train_cpu,
     "converge": _inner_converge,
     "converge_cpu": _inner_converge_cpu,
     "converge_sparse": _inner_converge_sparse,
@@ -1148,7 +1213,7 @@ def main():
         # the tunnel, so it must not contend for the single-tenant lock
         # (it runs while a watcher capture may hold the device).
         if inner in ("converge_cpu", "pipeline_fused_cpu", "serving_cpu",
-                     "input_pipeline_cpu"):
+                     "input_pipeline_cpu", "sharded_train_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():
@@ -1219,8 +1284,8 @@ def main():
     # wedging UNDER a heavy compile.
     stage_order = ["dense", "dense_bf16", "svc", "converge", "ftrl",
                    "kmeans", "kmeans_mnist", "pipeline_fused",
-                   "feed_overlap", "input_pipeline", "gbt",
-                   "als", "word2vec", "converge_sparse", "sparse"]
+                   "feed_overlap", "input_pipeline", "sharded_train",
+                   "gbt", "als", "word2vec", "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
     # concurrent clients wedged the tunnel for 8+ hours in round 2
@@ -1323,6 +1388,11 @@ def main():
         # Shuffled Dataset → bucketed prefetch → jitted consumer rows/s
         # + stall fraction — the ISSUE-5 input-pipeline trajectory.
         extras["input_pipeline"] = results["input_pipeline"]
+    if results.get("sharded_train") is not None:
+        # Plan-sharded trainer samples/s per preset (dp/FSDP/FSDP×TP) —
+        # the ISSUE-7 sharding trajectory (workload on
+        # _sharded_train_stage).
+        extras["sharded_train"] = results["sharded_train"]
     if results.get("converge") is not None:
         # Epochs + wall to fixed tol on device — the second half of
         # BASELINE.json's "samples/sec/chip + epochs-to-converge".

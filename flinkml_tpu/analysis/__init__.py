@@ -15,6 +15,12 @@ any host):
      for hot loops: compile-cache misses beyond the bucket policy and
      host↔device transfers beyond declared budgets; backs the
      ``no_retrace`` pytest marker.
+  4. **Sharding-plan validation** (:mod:`.sharding_check`) — FML5xx:
+     validates :class:`~flinkml_tpu.sharding.plan.ShardingPlan`s against
+     a mesh BEFORE any compile (unknown/illegal axis, non-dividing
+     shard, replicated-but-huge family vs the HBM budget, conflicting
+     cross-plan collective orders); consumes live plans or
+     ``*.plan.json`` fixtures.
 
 CLI: ``python -m flinkml_tpu.analysis <paths...> [--fail-on-findings]``
 (see :mod:`.__main__`); rule catalog in :data:`.findings.RULES` and
@@ -51,4 +57,11 @@ from flinkml_tpu.analysis.guard import (  # noqa: F401
     GuardViolation,
     TransferRetraceGuard,
     transfer_retrace_guard,
+)
+from flinkml_tpu.analysis.sharding_check import (  # noqa: F401
+    check_cross_plan,
+    check_plan,
+    check_plan_file,
+    check_program,
+    plan_collective_signature,
 )

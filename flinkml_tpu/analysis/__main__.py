@@ -7,6 +7,10 @@ Runs all three analysis passes device-free over the given targets:
   2. *collective order*: every ``*.trace.json`` target (a recorded
      dispatch trace, e.g. a fixture of the PR 1 threaded-kmeans deadlock)
      is checked for unlocked concurrent collective dispatch;
+  2b. *sharding plans*: every ``*.plan.json`` target (a declared
+     ShardingPlan + mesh + param shapes, see
+     ``docs/development/sharding.md``) is validated pre-compile —
+     FML501-504;
   3. *transfer/retrace self-check*: a representative fused scaler→
      predictor chain is executed at several row counts inside one bucket
      under :class:`~flinkml_tpu.analysis.guard.TransferRetraceGuard` —
@@ -56,6 +60,13 @@ def _pass_traces(trace_targets, report: Report) -> None:
         report.extend(
             check_dispatch_trace(load_trace(path), location=path)
         )
+
+
+def _pass_plans(plan_targets, report: Report) -> None:
+    from flinkml_tpu.analysis.sharding_check import check_plan_file
+
+    for path in plan_targets:
+        report.extend(check_plan_file(path))
 
 
 def _pass_retrace_selfcheck(report: Report) -> None:
@@ -131,8 +142,8 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "targets", nargs="*",
-        help=".py files / directories to lint and *.trace.json dispatch "
-             "traces to check",
+        help=".py files / directories to lint, *.trace.json dispatch "
+             "traces, and *.plan.json sharding plans to check",
     )
     parser.add_argument(
         "--fail-on-findings", action="store_true",
@@ -157,10 +168,12 @@ def main(argv=None) -> int:
             print(f"{rule} [{sev}] {desc}")
         return 0
 
-    py_targets, trace_targets = [], []
+    py_targets, trace_targets, plan_targets = [], [], []
     for t in args.targets:
         if t.endswith(".trace.json"):
             trace_targets.append(t)
+        elif t.endswith(".plan.json"):
+            plan_targets.append(t)
         else:
             py_targets.append(t)
             if os.path.isdir(t):
@@ -169,12 +182,18 @@ def main(argv=None) -> int:
                         os.path.join(root, n) for n in sorted(names)
                         if n.endswith(".trace.json")
                     )
+                    plan_targets.extend(
+                        os.path.join(root, n) for n in sorted(names)
+                        if n.endswith(".plan.json")
+                    )
 
     report = Report()
     if py_targets:
         _pass_lint(py_targets, report)
     if trace_targets:
         _pass_traces(trace_targets, report)
+    if plan_targets:
+        _pass_plans(plan_targets, report)
     if not args.no_selfcheck:
         _pass_retrace_selfcheck(report)
 

@@ -9,7 +9,7 @@ them for humans (CLI) or machines (``--json``).
 Rule ids are permanent: a released id is never reused for a different
 check, so suppression lists stay meaningful across versions. Add new
 rules at the end of their band (1xx schema, 2xx graph wiring, 3xx
-collectives, 4xx transfer/retrace).
+collectives, 4xx transfer/retrace, 5xx sharding plans).
 """
 
 from __future__ import annotations
@@ -42,6 +42,11 @@ RULES = {
     "FML401": (ERROR, "host<->device transfer beyond the declared budget in a guarded region"),
     "FML402": (ERROR, "compile-cache miss beyond the declared bucket policy in a guarded region"),
     "FML403": (ERROR, "two compiles share input specs and bucket but differ in chain fingerprint"),
+    # -- 5xx: sharding plans -----------------------------------------------
+    "FML501": (ERROR, "sharding plan references an unknown mesh axis (or uses one illegally)"),
+    "FML502": (ERROR, "mesh axis size does not divide the parameter dimension it shards"),
+    "FML503": (ERROR, "replicated parameter (+ optimizer state) exceeds the per-device HBM budget"),
+    "FML504": (ERROR, "two sharding plans in one program imply conflicting collective orders"),
 }
 
 

@@ -52,6 +52,16 @@ class CheckpointIntegrityError(ValueError):
     damaged."""
 
 
+class LayoutConflictError(ValueError):
+    """A caller passed BOTH ``plan=`` and an explicit ``layouts=`` to
+    :meth:`CheckpointManager.save` and they disagree. The plan is the
+    single source of layout truth (ISSUE 7): stale hand-written tags
+    used to win silently and poison elastic resume with the wrong
+    re-layout — now the conflict is loud and names the first leaf where
+    the two disagree. Drop the ``layouts=`` override (the plan derives
+    them), or fix the plan."""
+
+
 class RescaleError(ValueError):
     """Restoring a snapshot under a different world size was refused —
     either the manager's :class:`RescalePolicy` rejects rescaling
@@ -196,7 +206,8 @@ def begin_resume(manager: Optional["CheckpointManager"], resume: bool,
 
 def save_agreed(manager: "CheckpointManager", state: Any, epoch: int,
                 mesh=None, per_rank: bool = False,
-                extra: Optional[dict] = None, layouts=None) -> None:
+                extra: Optional[dict] = None, layouts=None,
+                plan=None) -> None:
     """Multi-process-safe checkpoint save with an agreed commit barrier.
 
     ``per_rank=False`` (replicated state — coefficients, centroids, EM
@@ -212,9 +223,12 @@ def save_agreed(manager: "CheckpointManager", state: Any, epoch: int,
     when the writing rank raises before reaching it. Single-process this
     is exactly ``manager.save`` (async write preserved; no barrier).
     """
-    # layouts is forwarded only when set: None already means replicated,
-    # and manager subclasses predating layout tags keep working.
+    # layouts/plan are forwarded only when set: None already means
+    # replicated, and manager subclasses predating layout tags keep
+    # working.
     kw = {} if layouts is None else {"layouts": layouts}
+    if plan is not None:
+        kw["plan"] = plan
     if jax.process_count() == 1:
         manager.save(state, epoch, extra=extra, **kw)
         return
@@ -359,12 +373,49 @@ class CheckpointManager:
             _parse_layout(tag)
         return list(tag_leaves)
 
+    def _plan_layouts(self, plan, state, layouts, treedef,
+                      num_leaves: int):
+        """Derive the per-leaf layout tags from a ShardingPlan (the
+        authoritative source), and verify any explicit ``layouts=``
+        override agrees — :class:`LayoutConflictError` otherwise.
+        Returns the derived tag pytree (state-shaped)."""
+        from flinkml_tpu.sharding.plan import layouts_for, state_names
+
+        derived_tree = layouts_for(plan, state)
+        derived = list(jax.tree_util.tree_flatten(derived_tree)[0])
+        for tag in derived:
+            _parse_layout(tag)
+        if layouts is not None:
+            explicit = self._layout_list(layouts, num_leaves, treedef)
+            if explicit != derived:
+                names = [n for n, _ in state_names(state)]
+                for i, (d, e) in enumerate(zip(derived, explicit)):
+                    if d != e:
+                        raise LayoutConflictError(
+                            f"explicit layouts= disagree with plan "
+                            f"{plan.name!r} at leaf {i} "
+                            f"({names[i] if i < len(names) else '?'}): "
+                            f"plan derives {d!r}, caller passed {e!r}. "
+                            "The plan is authoritative — drop the "
+                            "layouts= override or fix the plan."
+                        )
+        return derived_tree
+
     # -- save --------------------------------------------------------------
     def save(self, state: Any, epoch: int, extra: Optional[dict] = None,
-             layouts=None) -> str:
+             layouts=None, plan=None) -> str:
         """Snapshot ``state`` at ``epoch``. ``layouts`` tags each leaf's
         world-size relationship (see the module layout notes) — None
         records every leaf as ``replicated``.
+
+        ``plan`` (a :class:`~flinkml_tpu.sharding.plan.ShardingPlan`) is
+        the AUTHORITATIVE layout source when given: tags are derived
+        from the plan's family table (``sharded:<dim>`` per sharded
+        family), so plan-sharded training and elastic resharded resume
+        share one source of truth. An explicit ``layouts=`` passed
+        alongside a plan must agree exactly — a conflicting override
+        raises :class:`LayoutConflictError` instead of silently
+        shipping stale hand-written tags into the next resume.
 
         With ``async_write=True`` the device→host transfer happens here
         (so the snapshot is consistent) but serialization + the atomic
@@ -375,6 +426,9 @@ class CheckpointManager:
         previous one, re-raising any failure.
         """
         leaves, treedef = jax.tree_util.tree_flatten(state)
+        if plan is not None:
+            layouts = self._plan_layouts(plan, state, layouts, treedef,
+                                         len(leaves))
         if self.async_write:
             # np.asarray is a zero-copy VIEW for numpy inputs; the caller
             # may mutate those buffers while the background write runs,

@@ -508,6 +508,7 @@ def train_linear_model(
     checkpoint_interval: int = 0,
     resume: bool = False,
     listeners=(),
+    sharding_plan=None,
 ) -> np.ndarray:
     """Dense distributed training; returns the coefficient on host.
 
@@ -519,12 +520,44 @@ def train_linear_model(
     fast whole-loop-on-device path IS the fault-tolerant path (see
     :func:`_run_chunked`). ``resume=True`` continues exactly from the
     latest snapshot.
+
+    ``sharding_plan`` (a :class:`~flinkml_tpu.sharding.plan.
+    ShardingPlan`) routes the fit through the plan-sharded trainer
+    (:func:`flinkml_tpu.sharding.apply.train_linear_plan`): parameters
+    and optimizer state shard per the plan (FSDP-style), batches along
+    the plan's batch axes, checkpoints carry plan-derived layout tags.
+    The plan path trains with momentum SGD over the same seeded row
+    order — convergence-equivalent to (not bit-identical with) the
+    replicated trainer. A mesh lacking the plan's axes is re-shaped
+    over the same devices via :meth:`DeviceMesh.for_plan`.
     """
     if loss not in _LOSS_KEYS:
         raise ValueError(f"loss must be one of {_LOSS_KEYS}, got {loss!r}")
     n = x.shape[0]
     if n == 0:
         raise ValueError("training table is empty")
+    if sharding_plan is not None:
+        from flinkml_tpu.sharding.apply import train_linear_plan
+
+        if listeners:
+            raise ValueError(
+                "listeners are not supported on the plan-sharded path"
+            )
+        if any(a not in mesh.mesh.shape
+               for a in sharding_plan.required_axes()):
+            mesh = DeviceMesh.for_plan(
+                sharding_plan,
+                devices=list(mesh.mesh.devices.reshape(-1)),
+            )
+        perm = np.random.default_rng(seed).permutation(n)
+        return train_linear_plan(
+            x[perm], y[perm], w[perm], sharding_plan, mesh, loss=loss,
+            max_iter=max_iter, learning_rate=learning_rate,
+            global_batch_size=global_batch_size, reg=reg,
+            elastic_net=elastic_net, tol=tol, dtype=dtype,
+            checkpoint_manager=checkpoint_manager,
+            checkpoint_interval=checkpoint_interval, resume=resume,
+        )
     p_size = mesh.axis_size()
     if dtype is not None:
         x, y, w = x.astype(dtype), y.astype(dtype), w.astype(dtype)
@@ -1317,13 +1350,17 @@ def train_linear_model_from_table(
     label_col: str,
     weight_col: Optional[str],
     label_check=None,
+    sharding_plan=None,
     **hyper,
 ) -> np.ndarray:
     """One fit dispatch for every linear estimator: SparseVector columns
     take the nnz-bucketed CSR trainer, everything else densifies into the
     dense trainer. ``label_check(y)`` (optional) validates labels on
     either branch. ``hyper`` passes straight to the trainers (loss, mesh,
-    max_iter, ...)."""
+    max_iter, ...). ``sharding_plan`` routes the DENSE branch through
+    the plan-sharded trainer (see :func:`train_linear_model`); the
+    sparse trainer keeps its replicated ``[dim]`` model and refuses a
+    plan loudly."""
     from flinkml_tpu.models._data import (
         labeled_data,
         labeled_sparse_data,
@@ -1331,6 +1368,12 @@ def train_linear_model_from_table(
     )
 
     if sparse_features(table, features_col) is not None:
+        if sharding_plan is not None:
+            raise ValueError(
+                "sharding_plan supports the dense path only; the sparse "
+                "trainer keeps its replicated [dim] model (shard it via "
+                "ROADMAP item 5's embedding-table path instead)"
+            )
         indptr, indices, values, dim, y, w = labeled_sparse_data(
             table, features_col, label_col, weight_col
         )
@@ -1344,7 +1387,7 @@ def train_linear_model_from_table(
         raise ValueError("training table is empty")
     if label_check is not None:
         label_check(y)
-    return train_linear_model(x, y, w, **hyper)
+    return train_linear_model(x, y, w, sharding_plan=sharding_plan, **hyper)
 
 
 # ---------------------------------------------------------------------------

@@ -71,6 +71,10 @@ class StreamingEstimatorMixin:
     estimator; see ``docs/development/iteration.md`` ("Out-of-core
     training") for the capacity model and the checkpoint protocol."""
 
+    #: Subclasses whose trainers thread a ShardingPlan set this True;
+    #: everyone else gets a constructor-time refusal of the knob.
+    _SHARDING_PLAN_AWARE = False
+
     def __init__(
         self,
         mesh=None,
@@ -79,6 +83,7 @@ class StreamingEstimatorMixin:
         checkpoint_manager=None,
         checkpoint_interval: int = 0,
         resume: bool = False,
+        sharding_plan=None,
     ):
         super().__init__()
         self.mesh = mesh
@@ -87,6 +92,22 @@ class StreamingEstimatorMixin:
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_interval = checkpoint_interval
         self.resume = resume
+        if sharding_plan is not None and not type(self)._SHARDING_PLAN_AWARE:
+            # Constructor-time loud refusal: a silently-ignored plan on
+            # a plan-unaware estimator would train replicated — exactly
+            # the OOM the user configured the plan to avoid.
+            raise ValueError(
+                f"{type(self).__name__} does not support sharding_plan "
+                "yet (plan-aware estimators: the linear family's dense "
+                "paths — LogisticRegression, LinearSVC, LinearRegression)"
+            )
+        #: Optional :class:`~flinkml_tpu.sharding.plan.ShardingPlan` —
+        #: plan-aware estimators (``_SHARDING_PLAN_AWARE = True``; the
+        #: linear family's dense paths) shard parameters + optimizer
+        #: state per the plan; every other estimator refuses the knob at
+        #: construction, and the aware ones refuse it loudly on their
+        #: plan-unaware branches (sparse features, streamed fits).
+        self.sharding_plan = sharding_plan
 
     def _checkpoint_kwargs(self) -> dict:
         return dict(

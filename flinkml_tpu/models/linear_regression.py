@@ -125,6 +125,7 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
     parity), checkpointable via ``checkpoint_manager``/
     ``checkpoint_interval``/``resume``."""
 
+    _SHARDING_PLAN_AWARE = True  # sgd dense path threads a ShardingPlan
 
     def _make_model(self, coef) -> "LinearRegressionModel":
         model = LinearRegressionModel()
@@ -140,6 +141,11 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
                 raise ValueError(
                     "solver='normal' does not support streamed fits (the "
                     "closed form needs the full gram); use solver='sgd'"
+                )
+            if self.sharding_plan is not None:
+                raise ValueError(
+                    "sharding_plan supports in-RAM Table fits only; "
+                    "streamed fits keep their replicated carry"
                 )
             coef = _linear_sgd.streamed_linear_fit(
                 table,
@@ -165,6 +171,12 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
                 raise ValueError(
                     "solver='normal' is a one-shot closed form; "
                     "checkpointing applies to solver='sgd'"
+                )
+            if self.sharding_plan is not None:
+                raise ValueError(
+                    "solver='normal' does not thread a sharding_plan "
+                    "(the closed form materializes the replicated "
+                    "[d, d] gram); use solver='sgd'"
                 )
             if self.get(self.ELASTIC_NET) > 0:
                 raise ValueError(
@@ -199,6 +211,7 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
             table, features_col,
             self.get(_LinearRegressionParams.LABEL_COL),
             self.get(_LinearRegressionParams.WEIGHT_COL),
+            sharding_plan=self.sharding_plan,
             **self._checkpoint_kwargs(),
             **hyper,
         )

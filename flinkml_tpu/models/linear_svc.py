@@ -74,6 +74,7 @@ class LinearSVC(StreamingEstimatorMixin, _LinearSVCParams, Estimator):
     trainer; ``ReplayOperator.java:62-250`` parity), checkpointable via
     ``checkpoint_manager``/``checkpoint_interval``/``resume``."""
 
+    _SHARDING_PLAN_AWARE = True  # dense path threads a ShardingPlan
 
     def _make_model(self, coef) -> "LinearSVCModel":
         model = LinearSVCModel()
@@ -85,6 +86,11 @@ class LinearSVC(StreamingEstimatorMixin, _LinearSVCParams, Estimator):
         (table,) = inputs
         features_col = self.get(_LinearSVCParams.FEATURES_COL)
         if not isinstance(table, Table):
+            if self.sharding_plan is not None:
+                raise ValueError(
+                    "sharding_plan supports in-RAM Table fits only; "
+                    "streamed fits keep their replicated carry"
+                )
             coef = _linear_sgd.streamed_linear_fit(
                 table,
                 features_col=features_col,
@@ -120,6 +126,7 @@ class LinearSVC(StreamingEstimatorMixin, _LinearSVCParams, Estimator):
             self.get(_LinearSVCParams.LABEL_COL),
             self.get(_LinearSVCParams.WEIGHT_COL),
             label_check=lambda y: check_binary_labels(y, "LinearSVC"),
+            sharding_plan=self.sharding_plan,
             **hyper,
         )
         return self._make_model(coef)

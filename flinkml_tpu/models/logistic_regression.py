@@ -99,6 +99,7 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
         replayed every epoch, no caching pass needed.
     """
 
+    _SHARDING_PLAN_AWARE = True  # dense binomial path threads a plan
 
     def fit(self, *inputs) -> "LogisticRegressionModel":
         (table,) = inputs
@@ -124,6 +125,13 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
             # gradient scatter; the dense [dim] model stays replicated.
             # Host-side packing: the trainer shards from host, so the full
             # dataset never stages through a single device's HBM.
+            if self.sharding_plan is not None:
+                raise ValueError(
+                    "sharding_plan supports the dense binomial path "
+                    "only; the sparse trainer keeps its replicated "
+                    "[dim] model (shard it via ROADMAP item 5's "
+                    "embedding-table path instead)"
+                )
             indptr, indices, values, dim, y, w = labeled_sparse_data(
                 table, features_col,
                 self.get(_LogisticRegressionParams.LABEL_COL),
@@ -153,6 +161,12 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
                 # Softmax cross-entropy over integer classes 0..k-1:
                 # coefficient is [k, d] (beyond the reference snapshot,
                 # which rejects multinomial outright).
+                if self.sharding_plan is not None:
+                    raise ValueError(
+                        "sharding_plan supports the dense binomial "
+                        "path only (the softmax trainer is not yet "
+                        "plan-aware)"
+                    )
                 num_classes = _check_multinomial_labels(y)
                 coef = _linear_sgd.train_softmax_model(
                     x, y, w, num_classes=num_classes, elastic_net=0.0,
@@ -160,7 +174,9 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
                 )
             else:
                 _check_binomial_labels(y)
-                coef = train_logistic_regression(x, y, w, **hyper)
+                coef = train_logistic_regression(
+                    x, y, w, sharding_plan=self.sharding_plan, **hyper,
+                )
 
         model = LogisticRegressionModel(mesh=self.mesh)
         model.copy_params_from(self)
@@ -174,6 +190,11 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
             raise ValueError(
                 "multinomial logistic regression does not support "
                 "streamed fits; materialize the data as a Table"
+            )
+        if self.sharding_plan is not None:
+            raise ValueError(
+                "sharding_plan supports in-RAM Table fits only; streamed "
+                "fits keep their replicated carry"
             )
 
         features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
@@ -439,6 +460,7 @@ def train_logistic_regression(
     checkpoint_interval: int = 0,
     resume: bool = False,
     listeners=(),
+    sharding_plan=None,
 ) -> np.ndarray:
     """The distributed SGD loop; returns the fitted coefficient on host.
 
@@ -462,6 +484,11 @@ def train_logistic_regression(
     """
     if mode not in ("device", "host"):
         raise ValueError(f"mode must be 'device' or 'host', got {mode!r}")
+    if sharding_plan is not None and mode == "host":
+        raise ValueError(
+            "sharding_plan is supported in mode='device' only (the host "
+            "iterate loop replicates its carry)"
+        )
     if mode == "host" and checkpoint_manager is not None:
         # The rescale guard must compare against THIS trainer's mesh, not
         # the process-global device count (they differ on subset meshes).
@@ -478,6 +505,7 @@ def train_logistic_regression(
             checkpoint_manager=checkpoint_manager,
             checkpoint_interval=checkpoint_interval,
             resume=resume, listeners=listeners,
+            sharding_plan=sharding_plan,
         )
 
     # host mode: per-epoch dispatch with listener/checkpoint support.

@@ -32,6 +32,11 @@ class DeviceMesh:
     """
 
     DATA_AXIS = "data"
+    #: Model/optimizer state sharding axis (FSDP/ZeRO-3) and tensor-
+    #: parallel axis — the named axes the ``flinkml_tpu.sharding``
+    #: plans key their ``PartitionSpec``s to.
+    FSDP_AXIS = "fsdp"
+    TP_AXIS = "tp"
 
     def __init__(
         self,
@@ -64,6 +69,40 @@ class DeviceMesh:
 
     def axis_size(self, name: str = DATA_AXIS) -> int:
         return self.mesh.shape[name]
+
+    # -- plan-shaped construction ------------------------------------------
+    @classmethod
+    def for_plan(cls, plan, devices: Optional[Sequence[jax.Device]] = None,
+                 tp_size: Optional[int] = None) -> "DeviceMesh":
+        """A mesh shaped for a :class:`~flinkml_tpu.sharding.plan.
+        ShardingPlan`'s required axes over the given devices (all local
+        devices by default).
+
+        - only ``data`` (or no axes at all): 1-D ``{"data": n}`` — the
+          classic substrate, unchanged;
+        - ``fsdp`` without ``tp``: ``{"data": 1, "fsdp": n}`` — every
+          device serves both batch and state sharding (the plans' batch
+          axes are ``("data", "fsdp")``, so batches still split n ways);
+        - ``fsdp`` + ``tp``: ``{"data": 1, "fsdp": n // tp, "tp": tp}``
+          with ``tp_size`` defaulting to 2 (must divide the device
+          count).
+        """
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        axes = set(plan.required_axes())
+        if cls.TP_AXIS in axes and cls.FSDP_AXIS in axes:
+            tp = int(tp_size) if tp_size is not None else min(2, n)
+            if n % tp != 0:
+                raise ValueError(
+                    f"tp_size {tp} does not divide {n} devices"
+                )
+            return cls({cls.DATA_AXIS: 1, cls.FSDP_AXIS: n // tp,
+                        cls.TP_AXIS: tp}, devices=devices)
+        if cls.FSDP_AXIS in axes:
+            return cls({cls.DATA_AXIS: 1, cls.FSDP_AXIS: n},
+                       devices=devices)
+        return cls({cls.DATA_AXIS: n}, devices=devices)
 
     # -- elastic re-shaping ------------------------------------------------
     def shrink(self, new_size: int, axis: str = DATA_AXIS) -> "DeviceMesh":

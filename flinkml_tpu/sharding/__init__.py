@@ -1,0 +1,68 @@
+"""flinkml_tpu.sharding — the declarative sharding layer (ROADMAP item 1).
+
+The multichip dryruns (``MULTICHIP_r01..r05.json``) prove dp / sp / tp /
+pp / ep shardings compile and run on an 8-device mesh, but until this
+subsystem nothing user-facing could *ask* for them. A
+:class:`~flinkml_tpu.sharding.plan.ShardingPlan` is a small frozen value
+between the model code and ``pjit``: it maps parameter FAMILIES (name
+patterns) to ``PartitionSpec``s over the named mesh axes ``data`` /
+``fsdp`` / ``tp``, declares how batches shard, and is validated against
+the mesh BEFORE any compile by the FML5xx analysis pass
+(:mod:`flinkml_tpu.analysis.sharding_check`).
+
+Three layers:
+
+- :mod:`.plan` — the plan value itself: presets (``REPLICATED``,
+  ``BATCH_PARALLEL``, ``FSDP``, ``FSDP_TP``), ``infer_plan`` (cheapest
+  plan whose per-device footprint fits an HBM budget), JSON round-trip,
+  and checkpoint layout-tag derivation (``layouts_for`` — the single
+  source of truth the elastic-resume layer consumes).
+- :mod:`.apply` — threads a plan through trainer hot loops: parameters
+  AND optimizer state (SGD momentum, Adam m/v) shard FSDP-style under
+  one jitted step whose in/out shardings come from the plan, batches
+  shard along the plan's batch axes, and GSPMD inserts the collectives.
+- :mod:`flinkml_tpu.analysis.sharding_check` — FML501 (unknown/illegal
+  axis), FML502 (axis size does not divide the sharded dim), FML503
+  (replicated-but-huge parameter vs the HBM budget), FML504 (two plans
+  in one program implying conflicting collective orders).
+
+See ``docs/development/sharding.md``.
+"""
+
+from flinkml_tpu.sharding.plan import (  # noqa: F401
+    BATCH_PARALLEL,
+    FSDP,
+    FSDP_TP,
+    NoFeasiblePlanError,
+    PRESETS,
+    REPLICATED,
+    ShardingPlan,
+    infer_plan,
+    layouts_for,
+    per_device_state_bytes,
+)
+from flinkml_tpu.sharding.apply import (  # noqa: F401
+    PlanValidationError,
+    batch_sharding,
+    shard_state,
+    state_shardings,
+    train_linear_plan,
+)
+
+__all__ = [
+    "ShardingPlan",
+    "REPLICATED",
+    "BATCH_PARALLEL",
+    "FSDP",
+    "FSDP_TP",
+    "PRESETS",
+    "infer_plan",
+    "layouts_for",
+    "per_device_state_bytes",
+    "NoFeasiblePlanError",
+    "PlanValidationError",
+    "batch_sharding",
+    "shard_state",
+    "state_shardings",
+    "train_linear_plan",
+]

@@ -1,0 +1,358 @@
+"""Threading a :class:`ShardingPlan` through trainer hot loops.
+
+The plan names WHAT shards; this module makes pjit DO it: one jitted
+step whose ``in_shardings``/``out_shardings`` come straight from the
+plan, so parameters AND optimizer state (SGD momentum, Adam m/v) live
+sharded FSDP-style across the mesh, batches arrive sharded along the
+plan's batch axes, and GSPMD inserts the collectives (the gradient
+reduce-scatter / parameter all-gather pair FSDP is). A model whose
+replicated per-device footprint exceeds one chip's HBM slice trains
+end-to-end because no device ever holds more than its plan shard of
+the state.
+
+Every entry point validates the plan against the mesh BEFORE any
+compile via the FML5xx pass (:mod:`flinkml_tpu.analysis.sharding_check`)
+— a wrong-axis or non-dividing plan fails in milliseconds with a rule
+id, not minutes later inside XLA.
+
+Checkpointing composes through the plan too:
+``CheckpointManager.save(state, epoch, plan=plan)`` records layout tags
+*derived from the plan* (``sharded:<dim>`` per family), so the elastic
+resharded-resume machinery (PR 6) restores a plan-sharded snapshot at a
+different world size with the same one-source-of-truth tags training
+used. The loop runs the same ``rank.lost`` fault seam + preemption
+watchdog protocol as :func:`flinkml_tpu.iteration.iterate`, so the
+elastic kill/shrink/resume story covers plan-sharded training.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flinkml_tpu.faults as faults
+from flinkml_tpu.ops.losses import margin_terms
+from flinkml_tpu.sharding.plan import ShardingPlan, layouts_for, state_names
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("sharding")
+
+
+class PlanValidationError(ValueError):
+    """A :class:`ShardingPlan` failed FML5xx validation against its mesh
+    — raised BEFORE any compile, carrying the rendered findings (rule
+    ids + fix hints). The ahead-of-time half of the plan contract: a
+    plan that reaches pjit has already passed the same checks
+    ``python -m flinkml_tpu.analysis`` runs on ``.plan.json``
+    fixtures."""
+
+
+def _inner_mesh(mesh):
+    """The ``jax.sharding.Mesh`` inside a ``DeviceMesh`` (or the mesh
+    itself)."""
+    return getattr(mesh, "mesh", mesh)
+
+
+def validate_plan(plan: ShardingPlan, mesh,
+                  param_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                  hbm_budget_bytes: Optional[int] = None,
+                  dtype_bytes: int = 4,
+                  optimizer_slots: int = 1) -> None:
+    """Run the FML5xx pass; raise :class:`PlanValidationError` on any
+    error-severity finding."""
+    from flinkml_tpu.analysis.sharding_check import check_plan
+
+    findings = check_plan(
+        plan, mesh, param_shapes=param_shapes,
+        hbm_budget_bytes=hbm_budget_bytes, dtype_bytes=dtype_bytes,
+        optimizer_slots=optimizer_slots,
+    )
+    errors = [f for f in findings if f.severity == "error"]
+    if errors:
+        raise PlanValidationError(
+            f"sharding plan {plan.name!r} failed validation against the "
+            "mesh:\n" + "\n".join(f.render() for f in errors)
+        )
+
+
+# -- sharding construction ---------------------------------------------------
+
+
+def state_shardings(plan: ShardingPlan, mesh, state):
+    """A ``NamedSharding`` pytree for ``state`` per the plan's family
+    table (leaf names follow :func:`~flinkml_tpu.sharding.plan.
+    state_names`'s ``a/b/c`` key-path convention)."""
+    from jax.sharding import NamedSharding
+
+    m = _inner_mesh(mesh)
+    names = iter(state_names(state))
+
+    def one(leaf):
+        name, _ = next(names)
+        return NamedSharding(
+            m, plan.partition_spec(name, ndim=int(np.ndim(leaf)))
+        )
+
+    return jax.tree_util.tree_map(one, state)
+
+
+def batch_sharding(plan: ShardingPlan, mesh):
+    """The ``NamedSharding`` for a batch array: leading dim over the
+    plan's batch axes."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(_inner_mesh(mesh), plan.batch_partition_spec())
+
+
+def shard_state(plan: ShardingPlan, mesh, state):
+    """``device_put`` every state leaf per the plan — the placement step
+    that turns a host (or replicated) pytree into the FSDP-sharded
+    working set."""
+    return jax.tree_util.tree_map(
+        jax.device_put, state, state_shardings(plan, mesh, state)
+    )
+
+
+def batch_world(plan: ShardingPlan, mesh) -> int:
+    """The product of the plan's batch-axis sizes — what batch row
+    counts must divide (pad with zero-weight rows otherwise)."""
+    sizes = _inner_mesh(mesh).shape
+    n = 1
+    for axis in plan.batch_axes:
+        n *= int(sizes[axis])
+    return n
+
+
+# -- the plan-threaded linear trainer ---------------------------------------
+
+
+def init_linear_state(dim: int, optimizer: str, dtype) -> Dict[str, Any]:
+    """The parameter + optimizer-state pytree for the linear family:
+    SGD carries a same-shaped ``momentum`` buffer, Adam carries
+    ``m``/``v`` plus the scalar step count. Dict-keyed so the plan's
+    family patterns (and the checkpoint layout derivation) see names."""
+    zeros = np.zeros(int(dim), dtype=np.dtype(dtype))
+    if optimizer == "sgd":
+        return {"coef": zeros, "momentum": zeros.copy()}
+    if optimizer == "adam":
+        return {"coef": zeros, "m": zeros.copy(), "v": zeros.copy(),
+                "step": np.zeros((), dtype=np.dtype(dtype))}
+    raise ValueError(f"optimizer must be 'sgd' or 'adam', got {optimizer!r}")
+
+
+def _soft_threshold(x, t):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_linear_step(mesh, plan: ShardingPlan, loss: str, optimizer: str,
+                      dim: int, dtype_name: str,
+                      learning_rate: float, momentum: float,
+                      reg_l2: float, reg_l1: float):
+    """ONE jitted plan-sharded step: margin gradient on the (data ×
+    fsdp)-sharded batch, update on the fsdp-sharded state. The plan is
+    part of the cache key (frozen + hashable), so two plans never alias
+    one executable."""
+    dt = jnp.dtype(dtype_name)
+    state0 = init_linear_state(dim, optimizer, dt)
+    state_sh = state_shardings(plan, mesh, state0)
+    b_sh = batch_sharding(plan, mesh)
+    lr = jnp.asarray(learning_rate, dt)
+    mom = jnp.asarray(momentum, dt)
+    l2 = jnp.asarray(reg_l2, dt)
+    l1 = jnp.asarray(reg_l1, dt)
+
+    def step(state, xb, yb, wb):
+        coef = state["coef"]
+        dot = xb @ coef
+        mult, per_ex = margin_terms(loss, dot, yb, wb)
+        wsum = jnp.maximum(jnp.sum(wb), jnp.asarray(1e-12, dt))
+        grad = xb.T @ mult / wsum + 2.0 * l2 * coef
+        if optimizer == "sgd":
+            buf = mom * state["momentum"] + grad
+            new_coef = _soft_threshold(coef - lr * buf, lr * l1)
+            new_state = {"coef": new_coef, "momentum": buf}
+        else:  # adam
+            t = state["step"] + 1.0
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = b1 * state["m"] + (1.0 - b1) * grad
+            v = b2 * state["v"] + (1.0 - b2) * grad * grad
+            update = (m / (1.0 - b1 ** t)) / (
+                jnp.sqrt(v / (1.0 - b2 ** t)) + eps
+            )
+            new_coef = _soft_threshold(coef - lr * update, lr * l1)
+            new_state = {"coef": new_coef, "m": m, "v": v, "step": t}
+        loss_val = (jnp.sum(per_ex) + l2 * jnp.sum(jnp.square(coef))) / wsum
+        return new_state, loss_val
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    scalar_sh = NamedSharding(_inner_mesh(mesh), P())
+    return jax.jit(
+        step,
+        in_shardings=(state_sh, b_sh, b_sh, b_sh),
+        out_shardings=(state_sh, scalar_sh),
+    )
+
+
+def train_linear_plan(
+    x: np.ndarray,
+    y: np.ndarray,
+    w: Optional[np.ndarray],
+    plan: ShardingPlan,
+    mesh,
+    *,
+    loss: str = "logistic",
+    optimizer: str = "sgd",
+    max_iter: int = 100,
+    learning_rate: float = 0.1,
+    momentum: float = 0.9,
+    global_batch_size: Optional[int] = None,
+    reg: float = 0.0,
+    elastic_net: float = 0.0,
+    tol: float = 0.0,
+    dtype=None,
+    hbm_budget_bytes: Optional[int] = None,
+    checkpoint_manager=None,
+    checkpoint_interval: int = 0,
+    resume: bool = False,
+) -> np.ndarray:
+    """Plan-sharded linear-model training; returns the (global) host
+    coefficient.
+
+    The hot loop: one jitted plan-sharded step per epoch over a clamped
+    rotating window of ``global_batch_size`` rows (the whole table when
+    None) — the window is a function of the EPOCH alone, never of the
+    mesh, so the same data trajectory runs at every world size (what
+    makes plan × elastic resume composable). Rows pad to the plan's
+    batch world with zero-weight rows (exact no-ops).
+
+    ``hbm_budget_bytes`` feeds the pre-compile FML5xx validation: a
+    replicated-but-huge family fails FML503 *here*, before XLA sees the
+    program. ``checkpoint_manager`` snapshots the full parameter +
+    optimizer state with PLAN-DERIVED layout tags
+    (``save(..., plan=plan)``), so a snapshot taken at this mesh's world
+    resumes at another under ``rescale="reshard"``. The loop honors the
+    ``rank.lost`` fault seam and an ambient
+    :class:`~flinkml_tpu.utils.preemption.PreemptionWatchdog` exactly
+    like :func:`~flinkml_tpu.iteration.iterate`: a lost peer stops the
+    loop cleanly at the epoch boundary with a terminal snapshot.
+    """
+    from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
+    from flinkml_tpu.utils import preemption
+
+    if loss not in ("logistic", "hinge", "squared"):
+        raise ValueError(f"unsupported loss {loss!r}")
+    x = np.asarray(x)
+    n, dim = x.shape
+    if n == 0:
+        raise ValueError("training table is empty")
+    dt = np.dtype(dtype) if dtype is not None else x.dtype
+    # Canonicalize against the x64 flag so f64 inputs under 32-bit jax
+    # train (consistently) in f32 instead of warning per scalar.
+    dt = np.dtype(jax.dtypes.canonicalize_dtype(dt))
+    x = x.astype(dt, copy=False)
+    y = np.asarray(y, dtype=dt)
+    w = (np.ones(n, dtype=dt) if w is None else np.asarray(w, dtype=dt))
+
+    validate_plan(
+        plan, mesh, param_shapes={"coef": (dim,)},
+        hbm_budget_bytes=hbm_budget_bytes, dtype_bytes=dt.itemsize,
+        optimizer_slots=1 if optimizer == "sgd" else 2,
+    )
+
+    world = _inner_mesh(mesh).size
+    resume_epoch = begin_resume(checkpoint_manager, resume, world)
+    state_h = init_linear_state(dim, optimizer, dt)
+    epoch = 0
+    if resume_epoch is not None:
+        restored = checkpoint_manager.restore_latest(state_h)
+        if restored is not None:
+            state_h, epoch = restored
+            _log.info(
+                "plan-sharded resume: plan=%s epoch=%d world=%d",
+                plan.name, epoch, world,
+            )
+    state = shard_state(plan, mesh, state_h)
+
+    l2 = reg * (1.0 - elastic_net)
+    l1 = reg * elastic_net
+    step = _plan_linear_step(
+        _inner_mesh(mesh), plan, loss, optimizer, dim, dt.name,
+        float(learning_rate), float(momentum), float(l2), float(l1),
+    )
+    from flinkml_tpu.parallel.mesh import pad_to_multiple
+
+    b_sh = batch_sharding(plan, mesh)
+    bw = batch_world(plan, mesh)
+    bs = n if global_batch_size is None else min(int(global_batch_size), n)
+    n_windows = max(-(-n // bs), 1)
+    window_cache: Dict[int, Tuple] = {}
+
+    def window(epoch: int):
+        # The clamped rotating tile of _linear_sgd._window, host-side:
+        # a function of the epoch only, identical at every world. There
+        # are only n_windows distinct windows per run, so each one pads
+        # and uploads ONCE and stays device-resident (the full-batch
+        # default is a single resident upload, matching the replicated
+        # trainer's shard_batch economics). Padded rows carry weight 0
+        # (w pads with zeros), so they are exact no-ops in the step.
+        widx = epoch % n_windows
+        cached = window_cache.get(widx)
+        if cached is not None:
+            return cached
+        start = min(widx * bs, max(n - bs, 0))
+        batch = tuple(
+            jax.device_put(pad_to_multiple(a[start:start + bs], bw)[0],
+                           b_sh)
+            for a in (x, y, w)
+        )
+        window_cache[widx] = batch
+        return batch
+
+    watchdog = preemption.active()
+    cur_loss = math.inf
+    preempted = False
+    terminal = False
+    while epoch < max_iter:
+        if faults.ACTIVE is not None:
+            # Elastic seam: a scripted RankLost marks a peer dead at this
+            # epoch boundary; the watchdog converts it into a clean
+            # shrink-triggering stop (hard crash without one) — the same
+            # contract as iterate's epoch boundary.
+            faults.fire("rank.lost", epoch=epoch, watchdog=watchdog)
+        if watchdog is not None and watchdog.requested:
+            preempted = True
+            break
+        state, loss_dev = step(state, *window(epoch))
+        epoch += 1
+        cur_loss = float(loss_dev)
+        terminal = tol > 0.0 and cur_loss <= tol
+        if should_snapshot(checkpoint_manager, checkpoint_interval, epoch,
+                           max_iter, terminal=terminal):
+            checkpoint_manager.save(
+                jax.tree_util.tree_map(np.asarray, state), epoch, plan=plan
+            )
+        if terminal:
+            break
+    if preempted and checkpoint_manager is not None:
+        # The preemption's final snapshot (iterate's terminal-commit
+        # contract): the survivors resume from exactly this epoch.
+        checkpoint_manager.save(
+            jax.tree_util.tree_map(np.asarray, state), epoch, plan=plan
+        )
+    if checkpoint_manager is not None:
+        checkpoint_manager.wait()
+    return np.asarray(state["coef"])
+
+
+def plan_layouts(plan: ShardingPlan, state):
+    """Public alias of :func:`flinkml_tpu.sharding.plan.layouts_for` —
+    the tag pytree ``save(plan=...)`` derives (exposed for tests and
+    for callers composing with ``reshard_rank_state``)."""
+    return layouts_for(plan, state)

@@ -1,0 +1,396 @@
+"""ShardingPlan — canonical ``PartitionSpec``s per parameter family.
+
+The mold is SNIPPETS.md [1] (``SpecLayout``: per-family specs keyed to
+named mesh axes) and [2] (centralized presets like ``BATCH_SHARDING`` /
+``MODEL_SHARDING``): a small declarative value between the model code
+and ``pjit``. A plan answers three questions, each consumed by a
+different layer:
+
+1. *How does parameter ``name`` shard?* — ``spec_for``/``partition_spec``
+   (consumed by :mod:`flinkml_tpu.sharding.apply`'s jitted steps);
+2. *How do batches shard?* — ``batch_axes``/``batch_partition_spec``;
+3. *How does a checkpointed leaf relate to the world size?* —
+   ``layout_tag``/:func:`layouts_for` (consumed by
+   :meth:`flinkml_tpu.iteration.checkpoint.CheckpointManager.save`'s
+   ``plan=`` integration, which makes elastic resharded resume and
+   plan-sharded training compose through ONE source of truth).
+
+Family matching: ``rules`` is an ordered ``(pattern, spec)`` table;
+``fnmatch`` patterns match the parameter's name (and, for nested
+pytrees, its ``a/b/c`` key path) — FIRST match wins, unmatched names
+take ``default_spec``. Spec entries are ``None`` (dim replicated), an
+axis name, or a tuple of axis names (dim sharded over the product).
+A spec longer than a parameter's rank TRUNCATES to the rank — the rule
+that lets one ``FSDP_TP`` table serve both ``[d, h]`` matrices
+(``("fsdp", "tp")``) and ``[d]`` vectors (``("fsdp",)``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import math
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+#: The canonical mesh axis names (SNIPPETS.md [1]'s ``SpecLayout`` axes).
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+
+SpecEntry = Union[None, str, Tuple[str, ...]]
+Spec = Tuple[SpecEntry, ...]
+
+
+class NoFeasiblePlanError(ValueError):
+    """:func:`infer_plan` found no candidate plan whose per-device
+    parameter + optimizer-state footprint fits the HBM budget on the
+    given mesh. The message lists every candidate's footprint so the
+    caller can see how far off the budget is (and whether the fix is a
+    bigger mesh, an ``fsdp``/``tp`` axis the mesh lacks, or a larger
+    budget)."""
+
+
+def _normalize_entry(entry: Any) -> SpecEntry:
+    if entry is None:
+        return None
+    if isinstance(entry, str):
+        return entry
+    if isinstance(entry, (tuple, list)):
+        out = tuple(entry)
+        if not all(isinstance(a, str) for a in out):
+            raise ValueError(f"spec axis names must be strings, got {entry!r}")
+        return out
+    raise ValueError(
+        f"spec entries must be None, an axis name, or a tuple of axis "
+        f"names; got {entry!r}"
+    )
+
+
+def _normalize_spec(spec: Any) -> Spec:
+    if spec is None:
+        return ()
+    if isinstance(spec, str):
+        return (spec,)
+    return tuple(_normalize_entry(e) for e in spec)
+
+
+def entry_axes(entry: SpecEntry) -> Tuple[str, ...]:
+    """The axis names one spec entry shards its dim over (() if none)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """A frozen mapping from parameter families to partition specs over
+    named mesh axes, plus the batch sharding. Hashable (usable as a
+    compile-cache key) and JSON round-trippable (usable as an analysis
+    fixture).
+
+    ``rules``: ordered ``(fnmatch pattern, spec)`` pairs; first match
+    wins. ``batch_axes``: the axes the batch's leading (row) dim shards
+    over — ``()`` means replicated batches. ``default_spec``: the spec
+    for names no rule matches (replicated by default — the safe
+    fallback the checkpoint layer's ``replicated`` tag mirrors).
+    """
+
+    name: str
+    rules: Tuple[Tuple[str, Spec], ...] = ()
+    batch_axes: Tuple[str, ...] = ()
+    default_spec: Spec = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "rules",
+            tuple((str(p), _normalize_spec(s)) for p, s in self.rules),
+        )
+        object.__setattr__(
+            self, "batch_axes", tuple(str(a) for a in self.batch_axes)
+        )
+        object.__setattr__(
+            self, "default_spec", _normalize_spec(self.default_spec)
+        )
+
+    # -- family resolution -------------------------------------------------
+    def spec_for(self, name: str, ndim: Optional[int] = None) -> Spec:
+        """The spec for parameter ``name`` (first matching rule, else the
+        default), truncated to ``ndim`` entries when given."""
+        spec = self.default_spec
+        last = name.rsplit("/", 1)[-1]
+        for pattern, rule_spec in self.rules:
+            if fnmatch.fnmatchcase(name, pattern) or \
+                    fnmatch.fnmatchcase(last, pattern):
+                spec = rule_spec
+                break
+        if ndim is not None:
+            spec = spec[:ndim]
+        return spec
+
+    def partition_spec(self, name: str, ndim: Optional[int] = None):
+        """The jax ``PartitionSpec`` for parameter ``name``."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(*self.spec_for(name, ndim))
+
+    def batch_partition_spec(self):
+        """``PartitionSpec`` for a batch: leading dim over ``batch_axes``
+        (as one composite entry), trailing dims replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        if not self.batch_axes:
+            return P()
+        return P(self.batch_axes if len(self.batch_axes) > 1
+                 else self.batch_axes[0])
+
+    # -- introspection -----------------------------------------------------
+    def param_axes(self, name: str, ndim: Optional[int] = None
+                   ) -> Tuple[str, ...]:
+        """Every axis name ``name``'s spec shards over, in dim order."""
+        out: List[str] = []
+        for entry in self.spec_for(name, ndim):
+            out.extend(entry_axes(entry))
+        return tuple(out)
+
+    def is_sharded(self, name: str, ndim: Optional[int] = None) -> bool:
+        return bool(self.param_axes(name, ndim))
+
+    def shard_dim(self, name: str, ndim: Optional[int] = None
+                  ) -> Optional[int]:
+        """The FIRST dim index ``name``'s spec shards (None when fully
+        replicated) — the dim the checkpoint ``sharded:<axis>`` layout
+        tag records."""
+        for i, entry in enumerate(self.spec_for(name, ndim)):
+            if entry_axes(entry):
+                return i
+        return None
+
+    def required_axes(self) -> Tuple[str, ...]:
+        """Every mesh axis the plan references (params + batch), in
+        first-use order."""
+        seen: Dict[str, None] = {}
+        for axis in self.batch_axes:
+            seen.setdefault(axis)
+        for _, spec in tuple(self.rules) + (("*", self.default_spec),):
+            for entry in spec:
+                for axis in entry_axes(entry):
+                    seen.setdefault(axis)
+        return tuple(seen)
+
+    # -- checkpoint layout derivation --------------------------------------
+    def layout_tag(self, name: str, ndim: Optional[int] = None) -> str:
+        """The checkpoint leaf layout tag this plan implies for
+        parameter ``name``: ``sharded:<dim>`` for the first sharded dim,
+        else ``replicated``. This is the ONE source of truth tying
+        plan-sharded training to elastic resharded resume: a snapshot
+        of a plan-sharded state records the assembled global value plus
+        this tag, so restore at a different world revalidates the same
+        dim the plan shards."""
+        from flinkml_tpu.iteration.checkpoint import (
+            LAYOUT_REPLICATED,
+            sharded,
+        )
+
+        dim = self.shard_dim(name, ndim)
+        return LAYOUT_REPLICATED if dim is None else sharded(dim)
+
+    # -- serialization -----------------------------------------------------
+    def to_json_dict(self) -> dict:
+        def enc(entry: SpecEntry):
+            return list(entry) if isinstance(entry, tuple) else entry
+
+        return {
+            "name": self.name,
+            "rules": [[p, [enc(e) for e in s]] for p, s in self.rules],
+            "batch_axes": list(self.batch_axes),
+            "default_spec": [enc(e) for e in self.default_spec],
+        }
+
+    @staticmethod
+    def from_json_dict(d: Mapping) -> "ShardingPlan":
+        def dec(entry):
+            return tuple(entry) if isinstance(entry, list) else entry
+
+        return ShardingPlan(
+            name=str(d.get("name", "plan")),
+            rules=tuple(
+                (p, tuple(dec(e) for e in s)) for p, s in d.get("rules", ())
+            ),
+            batch_axes=tuple(d.get("batch_axes", ())),
+            default_spec=tuple(dec(e) for e in d.get("default_spec", ())),
+        )
+
+
+# -- presets (SNIPPETS.md [2]'s BATCH_SHARDING/MODEL_SHARDING, grown up) ----
+
+#: Everything replicated, batches replicated — the single-device-
+#: equivalent program; the baseline every parity test compares against.
+REPLICATED = ShardingPlan("replicated")
+
+#: Classic data parallelism: parameters replicated, batches sharded over
+#: ``data``. The cheapest plan with any parallelism (one gradient psum
+#: per step).
+BATCH_PARALLEL = ShardingPlan("batch_parallel", batch_axes=(DATA_AXIS,))
+
+#: FSDP/ZeRO-3: parameters AND optimizer state shard dim 0 over
+#: ``fsdp``; batches shard over ``data × fsdp`` (the fsdp axis does
+#: double duty as a batch axis, the standard composition). Per-device
+#: state footprint divides by the fsdp axis size.
+FSDP = ShardingPlan(
+    "fsdp",
+    rules=(("*", (FSDP_AXIS,)),),
+    batch_axes=(DATA_AXIS, FSDP_AXIS),
+)
+
+#: FSDP × tensor parallelism: matrices shard dim 0 over ``fsdp`` and
+#: dim 1 over ``tp`` (SNIPPETS.md [1]'s ``qkv_projection`` shape);
+#: vectors truncate to ``("fsdp",)``.
+FSDP_TP = ShardingPlan(
+    "fsdp_tp",
+    rules=(("*", (FSDP_AXIS, TP_AXIS)),),
+    batch_axes=(DATA_AXIS, FSDP_AXIS),
+)
+
+PRESETS: Dict[str, ShardingPlan] = {
+    p.name: p for p in (REPLICATED, BATCH_PARALLEL, FSDP, FSDP_TP)
+}
+
+
+# -- footprint model + inference -------------------------------------------
+
+
+def _axis_sizes(mesh) -> Dict[str, int]:
+    """Normalize a mesh spec — a ``DeviceMesh``, a ``jax.sharding.Mesh``,
+    or a plain ``{axis: size}`` dict — to axis sizes."""
+    if isinstance(mesh, Mapping):
+        return {str(k): int(v) for k, v in mesh.items()}
+    inner = getattr(mesh, "mesh", mesh)  # DeviceMesh wraps .mesh
+    shape = getattr(inner, "shape", None)
+    if isinstance(shape, Mapping):
+        return {str(k): int(v) for k, v in shape.items()}
+    raise TypeError(
+        f"cannot read mesh axis sizes from {mesh!r}; pass a DeviceMesh, "
+        "a jax Mesh, or an {axis: size} dict"
+    )
+
+
+def _shard_factor(plan: ShardingPlan, axis_sizes: Mapping[str, int],
+                  name: str, shape: Sequence[int]) -> int:
+    """The product of mesh-axis sizes sharding parameter ``name`` —
+    what its per-device footprint divides by."""
+    factor = 1
+    for axis in plan.param_axes(name, ndim=len(shape)):
+        factor *= int(axis_sizes.get(axis, 1))
+    return factor
+
+
+def per_device_state_bytes(
+    plan: ShardingPlan,
+    mesh,
+    param_shapes: Mapping[str, Sequence[int]],
+    dtype_bytes: int = 4,
+    optimizer_slots: int = 1,
+) -> int:
+    """Per-device bytes of the parameters PLUS their optimizer state
+    under ``plan``. ``optimizer_slots`` counts same-shaped optimizer
+    companions per parameter (1 for SGD momentum, 2 for Adam m/v) —
+    they shard exactly like their parameter, so the multiplier applies
+    uniformly. Ceil-divides per parameter (an uneven shard's largest
+    slice is what must fit)."""
+    axis_sizes = _axis_sizes(mesh)
+    slots = 1 + int(optimizer_slots)
+    total = 0
+    for name, shape in param_shapes.items():
+        n = int(np.prod(shape, dtype=np.int64)) if len(shape) else 1
+        factor = _shard_factor(plan, axis_sizes, name, shape)
+        total += math.ceil(n / factor) * dtype_bytes * slots
+    return total
+
+
+def infer_plan(
+    mesh,
+    param_shapes: Mapping[str, Sequence[int]],
+    hbm_budget_bytes: int,
+    dtype_bytes: int = 4,
+    optimizer_slots: int = 1,
+    candidates: Sequence[ShardingPlan] = (BATCH_PARALLEL, FSDP, FSDP_TP),
+) -> ShardingPlan:
+    """The cheapest plan whose per-device parameter + optimizer-state
+    footprint fits ``hbm_budget_bytes`` on ``mesh``.
+
+    ``candidates`` are tried in order — the default order is ascending
+    communication cost (data parallel's one psum < FSDP's
+    all-gather/reduce-scatter pair < FSDP×TP's extra tp collectives), so
+    "first fit" IS "cheapest fit". Candidates referencing axes the mesh
+    does not have are skipped (a 1-D ``data`` mesh cannot host FSDP).
+    Raises :class:`NoFeasiblePlanError` with every candidate's footprint
+    when nothing fits.
+    """
+    axis_sizes = _axis_sizes(mesh)
+    budget = int(hbm_budget_bytes)
+    tried: List[Tuple[str, str]] = []
+    for plan in candidates:
+        missing = [a for a in plan.required_axes() if a not in axis_sizes]
+        if missing:
+            tried.append((plan.name, f"mesh lacks axes {missing}"))
+            continue
+        footprint = per_device_state_bytes(
+            plan, axis_sizes, param_shapes, dtype_bytes, optimizer_slots
+        )
+        if footprint <= budget:
+            return plan
+        tried.append((plan.name, f"{footprint} B/device > budget"))
+    raise NoFeasiblePlanError(
+        f"no sharding plan fits hbm_budget_bytes={budget} on mesh "
+        f"{axis_sizes}: "
+        + "; ".join(f"{name}: {why}" for name, why in tried)
+        + ". Add an fsdp/tp mesh axis, shrink the model, or raise the "
+        "budget."
+    )
+
+
+# -- pytree naming + layout derivation --------------------------------------
+
+
+def _key_name(key) -> str:
+    """One pytree path entry's name (DictKey/GetAttrKey/SequenceKey/
+    FlattenedIndexKey all duck-type to something printable)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
+
+
+def state_names(state) -> Tuple[Tuple[str, Any], ...]:
+    """``(name, leaf)`` per leaf of ``state``, names joined as ``a/b/c``
+    key paths — the naming convention every plan-aware consumer
+    (sharding application, layout derivation, validation) shares."""
+    import jax
+
+    leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(state)
+    return tuple(
+        ("/".join(_key_name(k) for k in path) or "param", leaf)
+        for path, leaf in leaves_with_paths
+    )
+
+
+def layouts_for(plan: ShardingPlan, state):
+    """The checkpoint layout-tag pytree ``plan`` implies for ``state`` —
+    what :meth:`CheckpointManager.save`'s ``plan=`` kwarg records
+    instead of hand-written ``layouts=`` tags (the ISSUE 7 single
+    source of truth)."""
+    import jax
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state)
+    tags = [
+        plan.layout_tag(
+            "/".join(_key_name(k) for k in path) or "param",
+            ndim=int(np.ndim(leaf)),
+        )
+        for path, leaf in leaves_with_paths
+    ]
+    return jax.tree_util.tree_unflatten(treedef, tags)

@@ -558,6 +558,70 @@ def test_chained_reshard_watermark_stays_exact():
     )
 
 
+# ---------------------------------------------------------------------------
+# Plan x elastic resume composition (ISSUE 7): FSDP-sharded state,
+# plan-derived layout tags, world change through the rank.lost seam
+# ---------------------------------------------------------------------------
+
+def test_fsdp_plan_kill_world4_resume_world2_and_world8(tmp_path):
+    """An FSDP-sharded SGD trainer (parameters + momentum sharded per
+    the plan, snapshots tagged by ``save(plan=...)``) killed at world 4
+    through the ``rank.lost`` seam resumes at world 2 AND world 8 — the
+    plan-derived ``sharded:0`` tags are what make the cross-world
+    re-layout legal, with no hand-written ``layouts=`` anywhere."""
+    import json
+    import shutil
+
+    import jax
+
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.sharding import FSDP
+    from flinkml_tpu.sharding.apply import train_linear_plan
+
+    dim = 64
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(96, dim))
+    y = (x @ np.arange(1.0, dim + 1.0) > 0).astype(x.dtype)
+
+    def run(world, mgr=None, resume=False):
+        mesh = DeviceMesh.for_plan(FSDP, devices=jax.devices()[:world])
+        return train_linear_plan(
+            x, y, None, FSDP, mesh, max_iter=B, learning_rate=0.5,
+            checkpoint_manager=mgr, checkpoint_interval=INTERVAL,
+            resume=resume,
+        )
+
+    golden = run(1)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10,
+                            rescale="reshard")
+    wd = PreemptionWatchdog(signals=())
+    with wd:
+        with faults.armed(faults.FaultPlan(
+                faults.RankLost(epoch=KILL_EPOCH, rank=2))):
+            run(4, mgr)
+    assert wd.shrink_requested and wd.lost_ranks == [2]
+    assert mgr.latest_epoch() == KILL_EPOCH  # the preemption's snapshot
+
+    # The kill-time snapshot carries PLAN-derived tags at world 4.
+    with open(tmp_path / "ckpt" / f"ckpt-{KILL_EPOCH}" / "meta.json") as fh:
+        meta = json.load(fh)
+    assert meta["layouts"] == ["sharded:0", "sharded:0"]  # coef, momentum
+    assert meta["world_size"] == 4
+
+    for world in (2, 8):
+        shutil.copytree(str(tmp_path / "ckpt"), str(tmp_path / f"w{world}"))
+        m = CheckpointManager(str(tmp_path / f"w{world}"), max_to_keep=10,
+                              rescale="reshard")
+        recovered = run(world, m, resume=True)
+        np.testing.assert_allclose(recovered, golden, rtol=1e-9,
+                                   atol=1e-12)
+        # The resumed run's own terminal snapshot records ITS world.
+        with open(tmp_path / f"w{world}" / f"ckpt-{B}" /
+                  "meta.json") as fh:
+            assert json.load(fh)["world_size"] == world
+
+
 def test_verify_keeps_bool_contract_over_failed_async_write(tmp_path):
     """A parked async-write failure (the crash path verify exists for)
     must not leak out of the verification queries: the failure is

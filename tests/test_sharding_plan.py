@@ -1,0 +1,507 @@
+"""flinkml_tpu.sharding (ISSUE 7): the declarative ShardingPlan layer.
+
+Promotes the MULTICHIP dryrun shardings into pinned tests — each
+sharding family the ``MULTICHIP_r05.json`` dryrun proves (dp, tp, fsdp,
+fsdp×tp) becomes an equivalent :class:`ShardingPlan` that must compile
+and match the replicated run's numerics on the 8-CPU-device mesh — and
+covers the plan value itself (families, presets, truncation, JSON),
+``infer_plan``'s budget arithmetic, the FML5xx validation pass, the
+checkpoint ``save(plan=...)`` single-source-of-truth integration, and
+THE acceptance scenario: a parameter + optimizer pytree whose
+replicated per-device footprint provably exceeds a configured HBM
+budget trains under FSDP, converges to the replicated baseline, and
+checkpoints with plan-derived layout tags.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from flinkml_tpu.analysis.sharding_check import (
+    check_cross_plan,
+    check_plan,
+    check_plan_file,
+    check_program,
+    plan_collective_signature,
+)
+from flinkml_tpu.iteration import CheckpointManager, LayoutConflictError
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.sharding import (
+    BATCH_PARALLEL,
+    FSDP,
+    FSDP_TP,
+    NoFeasiblePlanError,
+    PRESETS,
+    REPLICATED,
+    ShardingPlan,
+    infer_plan,
+    layouts_for,
+    per_device_state_bytes,
+)
+from flinkml_tpu.sharding.apply import (
+    PlanValidationError,
+    batch_world,
+    init_linear_state,
+    shard_state,
+    state_shardings,
+    train_linear_plan,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def _mesh(plan, n=None, tp_size=None):
+    devices = jax.devices()
+    if n is not None:
+        devices = devices[:n]
+    return DeviceMesh.for_plan(plan, devices=devices, tp_size=tp_size)
+
+
+def _data(n=128, dim=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim))
+    true = rng.normal(size=dim)
+    y = (x @ true > 0).astype(x.dtype)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# The plan value
+# ---------------------------------------------------------------------------
+
+def test_family_matching_first_rule_wins_and_default_replicates():
+    plan = ShardingPlan(
+        "custom",
+        rules=(("embed*", (("fsdp", "tp"), None)), ("*_bias", ()),
+               ("*", ("fsdp",))),
+        batch_axes=("data",),
+    )
+    assert plan.spec_for("embedding_table") == (("fsdp", "tp"), None)
+    assert plan.spec_for("dense_bias") == ()
+    assert plan.spec_for("coef") == ("fsdp",)
+    # Key-path names match on the last component too.
+    assert plan.spec_for("layer0/dense_bias") == ()
+    # Unmatched names take the default (replicated unless overridden).
+    narrow = ShardingPlan("narrow", rules=(("coef", ("fsdp",)),))
+    assert narrow.spec_for("other") == ()
+
+
+def test_spec_truncates_to_parameter_rank():
+    # The rule that lets one FSDP_TP table serve matrices AND vectors.
+    assert FSDP_TP.spec_for("w", ndim=2) == ("fsdp", "tp")
+    assert FSDP_TP.spec_for("w", ndim=1) == ("fsdp",)
+    assert FSDP_TP.spec_for("step", ndim=0) == ()
+    assert FSDP_TP.layout_tag("step", ndim=0) == "replicated"
+
+
+def test_presets_catalog_and_required_axes():
+    assert set(PRESETS) == {"replicated", "batch_parallel", "fsdp",
+                            "fsdp_tp"}
+    assert REPLICATED.required_axes() == ()
+    assert BATCH_PARALLEL.required_axes() == ("data",)
+    assert FSDP.required_axes() == ("data", "fsdp")
+    assert FSDP_TP.required_axes() == ("data", "fsdp", "tp")
+    assert FSDP.layout_tag("coef", ndim=1) == "sharded:0"
+    assert REPLICATED.layout_tag("coef", ndim=1) == "replicated"
+
+
+def test_plan_json_roundtrip():
+    plan = ShardingPlan(
+        "rt",
+        rules=(("embed*", (("fsdp", "tp"), None)), ("*", ("fsdp",))),
+        batch_axes=("data", "fsdp"),
+        default_spec=(None, "tp"),
+    )
+    back = ShardingPlan.from_json_dict(
+        json.loads(json.dumps(plan.to_json_dict()))
+    )
+    assert back == plan
+    assert hash(back) == hash(plan)  # usable as a compile-cache key
+
+
+def test_layouts_for_derives_tags_per_leaf():
+    state = init_linear_state(64, "adam", np.float32)
+    tags = layouts_for(FSDP, state)
+    assert tags == {"coef": "sharded:0", "m": "sharded:0",
+                    "v": "sharded:0", "step": "replicated"}
+    assert layouts_for(BATCH_PARALLEL, state) == {
+        "coef": "replicated", "m": "replicated", "v": "replicated",
+        "step": "replicated",
+    }
+
+
+def test_mesh_for_plan_shapes():
+    assert dict(_mesh(REPLICATED).mesh.shape) == {"data": 8}
+    assert dict(_mesh(BATCH_PARALLEL).mesh.shape) == {"data": 8}
+    assert dict(_mesh(FSDP).mesh.shape) == {"data": 1, "fsdp": 8}
+    assert dict(_mesh(FSDP_TP).mesh.shape) == {"data": 1, "fsdp": 4,
+                                               "tp": 2}
+    assert dict(_mesh(FSDP_TP, tp_size=4).mesh.shape) == {
+        "data": 1, "fsdp": 2, "tp": 4}
+    with pytest.raises(ValueError, match="does not divide"):
+        _mesh(FSDP_TP, tp_size=3)
+
+
+# ---------------------------------------------------------------------------
+# infer_plan: cheapest plan whose footprint fits
+# ---------------------------------------------------------------------------
+
+def test_per_device_state_bytes_counts_optimizer_slots():
+    mesh = {"data": 1, "fsdp": 8}
+    shapes = {"coef": (8000,)}
+    # replicated sgd: 8000 * 4 B * (1 param + 1 momentum)
+    assert per_device_state_bytes(BATCH_PARALLEL, mesh, shapes) == 64_000
+    # adam: 3 same-shaped slots
+    assert per_device_state_bytes(BATCH_PARALLEL, mesh, shapes,
+                                  optimizer_slots=2) == 96_000
+    # fsdp divides by the fsdp axis
+    assert per_device_state_bytes(FSDP, mesh, shapes) == 8_000
+
+
+def test_infer_plan_picks_cheapest_fitting_preset():
+    mesh = {"data": 1, "fsdp": 4, "tp": 2}
+    shapes = {"w": (64, 64)}  # 4096 elems -> 32768 B replicated w/ slot
+    assert infer_plan(mesh, shapes, 32_768).name == "batch_parallel"
+    # Too small for replication, fits /4 under fsdp (8192 B).
+    assert infer_plan(mesh, shapes, 10_000).name == "fsdp"
+    # Only the full fsdp x tp factoring (/8 -> 4096 B) fits.
+    assert infer_plan(mesh, shapes, 5_000).name == "fsdp_tp"
+    with pytest.raises(NoFeasiblePlanError, match="no sharding plan fits"):
+        infer_plan(mesh, shapes, 1_000)
+    # A mesh without fsdp axes can only batch-parallel; the error says
+    # which candidates were skipped and why.
+    with pytest.raises(NoFeasiblePlanError, match="mesh lacks axes"):
+        infer_plan({"data": 8}, shapes, 10_000)
+
+
+def test_infer_plan_accepts_device_mesh():
+    mesh = _mesh(FSDP)
+    plan = infer_plan(mesh, {"coef": (8192,)}, 40_000)
+    assert plan.name == "fsdp"
+
+
+# ---------------------------------------------------------------------------
+# FML5xx: plan validation before compile
+# ---------------------------------------------------------------------------
+
+def test_fml501_unknown_and_duplicate_axes():
+    bad = ShardingPlan("bad", rules=(("*", ("model",)),),
+                       batch_axes=("batch",))
+    rules = [f.rule for f in check_plan(bad, {"data": 8})]
+    assert rules == ["FML501", "FML501"]  # batch axis + family axis
+    dup = ShardingPlan("dup", rules=(("*", ("fsdp", "fsdp")),))
+    findings = check_plan(dup, {"data": 1, "fsdp": 8})
+    assert [f.rule for f in findings] == ["FML501"]
+    assert "at most once" in findings[0].message
+
+
+def test_fml502_axis_size_must_divide_shard_dim():
+    findings = check_plan(FSDP, {"data": 1, "fsdp": 8},
+                          param_shapes={"coef": (4090,)})
+    assert [f.rule for f in findings] == ["FML502"]
+    assert "does not divide" in findings[0].message
+    assert check_plan(FSDP, {"data": 1, "fsdp": 8},
+                      param_shapes={"coef": (4096,)}) == []
+
+
+def test_fml503_replicated_but_huge_vs_hbm_budget():
+    shapes = {"coef": (8192,)}
+    findings = check_plan(BATCH_PARALLEL, {"data": 8}, param_shapes=shapes,
+                          hbm_budget_bytes=16_384)
+    assert [f.rule for f in findings] == ["FML503"]
+    # The fix the finding suggests — sharding — really clears it.
+    assert check_plan(FSDP, {"data": 1, "fsdp": 8}, param_shapes=shapes,
+                      hbm_budget_bytes=16_384) == []
+
+
+def test_fml504_conflicting_plans_compose_with_fml301_checker():
+    mesh = {"data": 1, "fsdp": 8}
+    shapes = {"coef": (4096,)}
+    # The derived signatures are CollectiveOp sequences — the FML301
+    # comparator's currency.
+    sig = plan_collective_signature(FSDP, mesh, shapes)
+    assert [c.primitive for c in sig] == ["all_gather", "reduce_scatter"]
+    assert plan_collective_signature(BATCH_PARALLEL, mesh, shapes)[0] \
+        .primitive == "psum"
+    findings = check_cross_plan([FSDP, BATCH_PARALLEL], mesh, shapes)
+    assert [f.rule for f in findings] == ["FML504"]
+    # Identical family tables agree: no findings.
+    assert check_cross_plan([FSDP, FSDP], mesh, shapes) == []
+    assert check_program([FSDP], mesh, shapes) == []
+
+
+def test_fml504_fires_for_distinct_plans_sharing_a_name():
+    """Two conflicting plans that happen to share a name must not
+    collapse into one comparator entry."""
+    mesh = {"data": 1, "fsdp": 8}
+    shapes = {"coef": (4096,)}
+    a = ShardingPlan("p", rules=(("*", ("fsdp",)),),
+                     batch_axes=("data", "fsdp"))
+    b = ShardingPlan("p", rules=(("*", ()),), batch_axes=("data", "fsdp"))
+    findings = check_cross_plan([a, b], mesh, shapes)
+    assert [f.rule for f in findings] == ["FML504"]
+    # Two literally identical plans still agree.
+    assert check_cross_plan([a, ShardingPlan(
+        "p", rules=(("*", ("fsdp",)),), batch_axes=("data", "fsdp"),
+    )], mesh, shapes) == []
+
+
+@pytest.mark.parametrize("rule", ["FML501", "FML502", "FML503", "FML504"])
+def test_seeded_plan_fixtures_are_flagged(rule):
+    path = {
+        "FML501": "bad_plan_fml501_unknown_axis.plan.json",
+        "FML502": "bad_plan_fml502_indivisible.plan.json",
+        "FML503": "bad_plan_fml503_replicated_huge.plan.json",
+        "FML504": "bad_plan_fml504_conflicting.plan.json",
+    }[rule]
+    findings = check_plan_file(os.path.join(FIXTURES, path))
+    assert [f.rule for f in findings] == [rule]
+
+
+def test_cli_runs_the_sharding_pass():
+    from flinkml_tpu.analysis.__main__ import main
+
+    fixture = os.path.join(FIXTURES, "bad_plan_fml502_indivisible.plan.json")
+    assert main([fixture, "--no-selfcheck"]) == 1
+
+
+def test_unreadable_plan_file_fails_loudly(tmp_path):
+    bad = tmp_path / "broken.plan.json"
+    bad.write_text("{not json")
+    findings = check_plan_file(str(bad))
+    assert [f.rule for f in findings] == ["FML501"]
+    empty = tmp_path / "empty.plan.json"
+    empty.write_text("{}")
+    assert [f.rule for f in check_plan_file(str(empty))] == ["FML501"]
+
+
+# ---------------------------------------------------------------------------
+# Promoted dryrun shardings: each MULTICHIP family's equivalent plan
+# compiles and matches the replicated numerics on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+@pytest.mark.parametrize("preset", ["batch_parallel", "fsdp", "fsdp_tp"])
+def test_dryrun_promoted_plan_matches_replicated_numerics(preset, optimizer):
+    """dp (batch_parallel) and fsdp(, x tp) from the MULTICHIP dryrun as
+    pinned plans: same seeded program, full-batch windows, so the only
+    difference from REPLICATED is the sharding — numerics must agree to
+    float-associativity."""
+    x, y = _data()
+    plan = PRESETS[preset]
+
+    def run(p):
+        return train_linear_plan(
+            x, y, None, p, _mesh(p), loss="logistic", optimizer=optimizer,
+            max_iter=8, learning_rate=0.5,
+        )
+
+    golden = run(REPLICATED)
+    coef = run(plan)
+    assert np.isfinite(coef).all()
+    np.testing.assert_allclose(coef, golden, rtol=1e-9, atol=1e-12)
+
+
+def test_dryrun_promoted_tp_matmul_plan_matches_replicated():
+    """The tp dryrun family as a plan: a 2-layer MLP forward whose
+    weights shard Megatron-style (W1 columns / W2 rows over ``tp``) via
+    plan-derived in_shardings; output must equal the replicated (and
+    host numpy) forward."""
+    plan = ShardingPlan(
+        "tp_mlp",
+        rules=(("w1", (None, "tp")), ("w2", ("tp", None))),
+        batch_axes=(),
+    )
+    mesh = DeviceMesh({"data": 1, "tp": 8})
+    assert check_plan(plan, mesh,
+                      param_shapes={"w1": (16, 32), "w2": (32, 16)}) == []
+    rng = np.random.default_rng(3)
+    xh = rng.normal(size=(24, 16))
+    params = {"w1": rng.normal(size=(16, 32)),
+              "w2": rng.normal(size=(32, 16))}
+    sharded = shard_state(plan, mesh, params)
+
+    def forward(p, xb):
+        return np.tanh(xb @ p["w1"]) @ p["w2"]
+
+    import jax.numpy as jnp
+
+    def jforward(p, xb):
+        return jnp.tanh(xb @ p["w1"]) @ p["w2"]
+
+    out = jax.jit(
+        jforward,
+        in_shardings=(state_shardings(plan, mesh, params), None),
+    )(sharded, xh)
+    np.testing.assert_allclose(np.asarray(out), forward(params, xh),
+                               rtol=1e-9)
+
+
+def test_batch_world_and_state_placement():
+    mesh = _mesh(FSDP)
+    assert batch_world(FSDP, mesh) == 8
+    assert batch_world(REPLICATED, mesh) == 1
+    state = shard_state(FSDP, mesh, init_linear_state(64, "sgd", np.float64))
+    # Each device holds 1/8th of every sharded leaf.
+    shard_rows = {s.data.shape[0]
+                  for s in state["coef"].addressable_shards}
+    assert shard_rows == {8}
+    assert state["momentum"].sharding.spec == \
+        state["coef"].sharding.spec
+
+
+def test_estimator_accepts_sharding_plan_and_rejects_unaware_paths():
+    """The user-facing ask (ROADMAP item 1): an estimator takes a plan.
+    The dense binomial LR path trains through it; plan-unaware paths
+    (sparse features, streamed fits) refuse loudly instead of silently
+    replicating."""
+    from flinkml_tpu.models.logistic_regression import LogisticRegression
+    from flinkml_tpu.table import Table
+
+    x, y = _data(n=64, dim=16, seed=2)
+    table = Table({"features": x, "label": y})
+    est = LogisticRegression(sharding_plan=FSDP)
+    est.set(LogisticRegression.MAX_ITER, 5)
+    model = est.fit(table)
+    (out,) = model.transform(Table({"features": x}))
+    pred = np.asarray(out.column("prediction"))
+    assert pred.shape == (64,) and np.isfinite(pred).all()
+    # Convergence sanity: the plan-trained model separates the data.
+    baseline = LogisticRegression()
+    baseline.set(LogisticRegression.MAX_ITER, 5)
+    base_pred = np.asarray(
+        baseline.fit(table).transform(Table({"features": x}))[0]
+        .column("prediction")
+    )
+    assert np.mean(pred == y) >= np.mean(base_pred == y) - 0.2
+
+    with pytest.raises(ValueError, match="streamed"):
+        LogisticRegression(sharding_plan=FSDP).fit(iter([table]))
+
+
+def test_plan_unaware_estimators_refuse_the_knob_at_construction():
+    """A silently-ignored plan would train replicated — the OOM the
+    user configured the plan to avoid — so plan-unaware estimators
+    refuse the knob up front; the whole linear family accepts it."""
+    from flinkml_tpu.models.kmeans import KMeans
+    from flinkml_tpu.models.linear_regression import LinearRegression
+    from flinkml_tpu.models.linear_svc import LinearSVC
+    from flinkml_tpu.table import Table
+
+    with pytest.raises(ValueError, match="does not support sharding_plan"):
+        KMeans(sharding_plan=FSDP)
+
+    x, y = _data(n=64, dim=16, seed=4)
+    table = Table({"features": x, "label": y})
+    svc = LinearSVC(sharding_plan=FSDP)
+    svc.set(LinearSVC.MAX_ITER, 3)
+    assert np.isfinite(
+        np.asarray(svc.fit(table)._coefficient)
+    ).all()
+    reg = LinearRegression(sharding_plan=FSDP)
+    reg.set(LinearRegression.MAX_ITER, 3)
+    assert np.isfinite(
+        np.asarray(reg.fit(Table({"features": x,
+                                  "label": x @ np.ones(16)}))._coefficient)
+    ).all()
+    normal = LinearRegression(sharding_plan=FSDP)
+    normal.set(LinearRegression.SOLVER, "normal")
+    with pytest.raises(ValueError, match="solver='sgd'"):
+        normal.fit(Table({"features": x, "label": y}))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integration: plan-derived layout tags, one source of truth
+# ---------------------------------------------------------------------------
+
+def test_save_plan_records_derived_layout_tags(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), world_size=8)
+    state = init_linear_state(64, "adam", np.float32)
+    mgr.save(state, 1, plan=FSDP)
+    with open(tmp_path / "ckpt-1" / "meta.json") as fh:
+        meta = json.load(fh)
+    # dict leaves flatten in sorted key order: coef, m, step, v.
+    assert meta["layouts"] == ["sharded:0", "sharded:0", "replicated",
+                               "sharded:0"]
+    assert meta["world_size"] == 8
+
+
+def test_save_plan_conflicting_explicit_layouts_raise_typed(tmp_path):
+    """Satellite bugfix: stale hand-written layouts used to win silently
+    over the plan; now the plan is authoritative and a conflicting
+    override is a typed, named refusal."""
+    mgr = CheckpointManager(str(tmp_path))
+    state = init_linear_state(64, "sgd", np.float32)
+    with pytest.raises(LayoutConflictError, match="authoritative") as exc:
+        mgr.save(state, 1, plan=FSDP, layouts="replicated")
+    assert "coef" in str(exc.value)  # names the first conflicting leaf
+    assert mgr.all_epochs() == []  # nothing committed
+    # An AGREEING explicit override is redundant but legal.
+    mgr.save(state, 2, plan=FSDP,
+             layouts={"coef": "sharded:0", "momentum": "sharded:0"})
+    assert mgr.all_epochs() == [2]
+
+
+def test_save_plan_through_save_agreed(tmp_path):
+    from flinkml_tpu.iteration.checkpoint import save_agreed
+
+    mgr = CheckpointManager(str(tmp_path), world_size=8)
+    save_agreed(mgr, init_linear_state(64, "sgd", np.float32), 3,
+                plan=FSDP)
+    with open(tmp_path / "ckpt-3" / "meta.json") as fh:
+        assert json.load(fh)["layouts"] == ["sharded:0", "sharded:0"]
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: over-budget replicated -> FSDP trains,
+# checkpoints with plan tags, resumes at a different world
+# ---------------------------------------------------------------------------
+
+def test_over_budget_model_trains_under_fsdp_and_resumes_elsewhere(tmp_path):
+    dim = 64
+    x, y = _data(n=96, dim=dim, seed=1)
+    dt = x.dtype  # f64 under the test config's x64
+    # Provably over budget replicated: coef + momentum = 2 leaves.
+    budget = int(dim * dt.itemsize * 2 * 0.75)
+    assert per_device_state_bytes(
+        REPLICATED, {"data": 8}, {"coef": (dim,)},
+        dtype_bytes=dt.itemsize) > budget
+    # infer_plan picks FSDP as the cheapest fitting plan...
+    mesh8 = _mesh(FSDP)
+    plan = infer_plan(mesh8, {"coef": (dim,)}, budget,
+                      dtype_bytes=dt.itemsize)
+    assert plan.name == "fsdp"
+    # ... and the pre-compile gate refuses the replicated plan outright.
+    with pytest.raises(PlanValidationError, match="FML503"):
+        train_linear_plan(x, y, None, BATCH_PARALLEL,
+                          _mesh(BATCH_PARALLEL), max_iter=1,
+                          hbm_budget_bytes=budget)
+
+    golden = train_linear_plan(
+        x, y, None, REPLICATED, _mesh(REPLICATED), max_iter=12,
+        learning_rate=0.5,
+    )
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=10, rescale="reshard")
+    coef8 = train_linear_plan(
+        x, y, None, plan, mesh8, max_iter=12, learning_rate=0.5,
+        hbm_budget_bytes=budget, checkpoint_manager=mgr,
+        checkpoint_interval=4,
+    )
+    np.testing.assert_allclose(coef8, golden, rtol=1e-9, atol=1e-12)
+    with open(tmp_path / "ckpt-12" / "meta.json") as fh:
+        meta = json.load(fh)
+    assert meta["layouts"] == ["sharded:0", "sharded:0"]
+    assert meta["world_size"] == 8
+
+    # Resume the final snapshot at world 2: the plan-derived sharded:0
+    # tags make the reshard legal, and continuing for 0 further epochs
+    # returns the same (global) coefficient.
+    mesh2 = _mesh(FSDP, n=2)
+    coef2 = train_linear_plan(
+        x, y, None, FSDP, mesh2, max_iter=12, learning_rate=0.5,
+        checkpoint_manager=mgr, checkpoint_interval=4, resume=True,
+    )
+    np.testing.assert_allclose(coef2, coef8, rtol=0, atol=0)

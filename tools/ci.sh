@@ -335,6 +335,98 @@ EOF
 }
 stage "input-pipeline smoke (CPU)" input_pipeline_smoke
 
+# Sharding smoke (ISSUE 7 acceptance): device-free, 8 host-platform
+# devices. A parameter + momentum pytree whose replicated per-device
+# footprint provably exceeds a configured HBM budget (a) is refused
+# pre-compile for the replicated plan (FML503), (b) is routed to FSDP
+# by infer_plan, (c) trains FSDP-sharded to the replicated baseline's
+# numerics, (d) checkpoints with PLAN-derived layout tags and resumes
+# at a different world, and the seeded FML5xx plan fixtures are flagged
+# by the analysis CLI. Then the sharded_train_cpu bench stage must emit
+# sharded_samples_per_sec per plan preset.
+sharding_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 300 python - <<'EOF' || return 1
+import json, os, subprocess, sys, tempfile
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from flinkml_tpu.iteration import CheckpointManager
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.sharding import (
+    BATCH_PARALLEL, FSDP, REPLICATED, infer_plan, per_device_state_bytes,
+)
+from flinkml_tpu.sharding.apply import PlanValidationError, train_linear_plan
+
+dim, n = 64, 96
+rng = np.random.default_rng(0)
+x = rng.normal(size=(n, dim)).astype(np.float32)
+y = (x @ rng.normal(size=dim) > 0).astype(np.float32)
+
+budget = int(dim * 4 * 2 * 0.75)  # coef + momentum replicated: over
+assert per_device_state_bytes(
+    BATCH_PARALLEL, {"data": 8}, {"coef": (dim,)}) > budget
+mesh = DeviceMesh.for_plan(FSDP)
+plan = infer_plan(mesh, {"coef": (dim,)}, budget)
+assert plan.name == "fsdp"
+try:
+    train_linear_plan(x, y, None, BATCH_PARALLEL,
+                      DeviceMesh.for_plan(BATCH_PARALLEL), max_iter=1,
+                      hbm_budget_bytes=budget)
+    raise SystemExit("over-budget replicated plan was not refused")
+except PlanValidationError as e:
+    assert "FML503" in str(e)
+
+golden = train_linear_plan(x, y, None, REPLICATED,
+                           DeviceMesh.for_plan(REPLICATED),
+                           max_iter=10, learning_rate=0.5)
+with tempfile.TemporaryDirectory() as td:
+    mgr = CheckpointManager(td, rescale="reshard")
+    coef = train_linear_plan(
+        x, y, None, plan, mesh, max_iter=10, learning_rate=0.5,
+        hbm_budget_bytes=budget, checkpoint_manager=mgr,
+        checkpoint_interval=5,
+    )
+    np.testing.assert_allclose(coef, golden, rtol=1e-5, atol=1e-7)
+    with open(os.path.join(td, "ckpt-10", "meta.json")) as fh:
+        meta = json.load(fh)
+    assert meta["layouts"] == ["sharded:0", "sharded:0"], meta["layouts"]
+    assert meta["world_size"] == 8
+    mesh2 = DeviceMesh.for_plan(FSDP, devices=jax.devices()[:2])
+    coef2 = train_linear_plan(
+        x, y, None, FSDP, mesh2, max_iter=10, learning_rate=0.5,
+        checkpoint_manager=CheckpointManager(td, rescale="reshard"),
+        checkpoint_interval=5, resume=True,
+    )
+    assert np.array_equal(coef2, coef), "world-2 resume != world-8 model"
+
+rc = subprocess.run(
+    [sys.executable, "-m", "flinkml_tpu.analysis",
+     "tests/analysis_fixtures/bad_plan_fml502_indivisible.plan.json",
+     "--no-selfcheck"], stdout=subprocess.DEVNULL,
+).returncode
+assert rc == 1, "seeded FML5xx plan fixture was not flagged"
+print("sharding smoke: infer->fsdp, FML503 refusal pre-compile, FSDP",
+      "parity vs replicated, plan-tagged snapshot resumed at world 2,",
+      "FML5xx fixtures flagged")
+EOF
+    local out
+    out=$(_FLINKML_BENCH_INNER=sharded_train_cpu timeout 420 python bench.py) \
+        || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+rates = rec['sharded_samples_per_sec']
+assert {'replicated', 'batch_parallel', 'fsdp', 'fsdp_tp'} <= set(rates), rates
+assert all(v > 0 for v in rates.values()), rates
+print('sharding smoke: sharded_samples_per_sec per preset:', rates)
+"
+}
+stage "sharding smoke (FSDP parity + FML5xx gate)" sharding_smoke
+
 example_smoke() {
     local ex
     for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
