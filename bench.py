@@ -732,6 +732,169 @@ def _serving_stage(n_clients=8, duration_s=4.0, max_batch_rows=256,
     }
 
 
+def _serving_scaleout_stage(n_replicas=8, n_clients=None, duration_s=3.0,
+                            max_batch_rows=128, max_wait_ms=2.0,
+                            n=50_000, d=32) -> dict:
+    """Stage: serving scale-out — the ROADMAP item 3 / ISSUE 8 number.
+
+    Three measurements against the 5-stage fused chain, same closed-loop
+    offered load (``n_clients`` threads, 1-32 rows per request):
+
+      1. ONE ServingEngine (continuous batching) — the PR 3 shape;
+      2. an ``n_replicas`` ReplicaPool with FIFO whole-request packing;
+      3. the same pool with continuous batching (the product default).
+
+    Emits ``serving_scaleout_rows_per_sec`` plus
+    ``serving_rows_per_sec_per_replica`` (so the per-chip number
+    survives the dead device tunnel), pool-level p50/p99 (client-side,
+    enqueue→complete), the pool-vs-single speedup (acceptance: >= 4x on
+    the 8-CPU-device mesh — requires >= 8 host cores backing the 8
+    virtual devices; ``host_cpu_count`` is recorded so a 2-core CI box's
+    number is never mistaken for the acceptance measurement), and the
+    FIFO-vs-continuous p50 delta at the same offered load (acceptance:
+    continuous measurably lower)."""
+    import threading
+
+    from flinkml_tpu.serving import ReplicaPool, ServingConfig, ServingEngine
+    from flinkml_tpu.table import Table
+
+    if n_clients is None:
+        n_clients = 2 * n_replicas
+    model, x = _five_stage_model(n, d)
+    example = Table({"features": x[:4]})
+
+    def cfg(**kw):
+        return ServingConfig(max_batch_rows=max_batch_rows,
+                             max_wait_ms=max_wait_ms, **kw)
+
+    def run_load(predict, label):
+        stop = threading.Event()
+        rows_served = [0] * n_clients
+        lat_ms = [[] for _ in range(n_clients)]
+        errors = []
+
+        def client(tid):
+            rng = np.random.default_rng(tid)
+            try:
+                while not stop.is_set():
+                    rows = int(rng.integers(1, 33))
+                    lo = int(rng.integers(0, n - rows))
+                    t0 = time.perf_counter()
+                    predict({"features": x[lo:lo + rows]})
+                    lat_ms[tid].append((time.perf_counter() - t0) * 1e3)
+                    rows_served[tid] += rows
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(n_clients)
+        ]
+        _log(f"serving_scaleout[{label}]: {n_clients} closed-loop clients "
+             f"for {duration_s}s ...")
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        elapsed = time.perf_counter() - start
+        if errors:
+            raise errors[0]
+        lats = np.concatenate([np.asarray(l) for l in lat_ms if l])
+        p50, p99 = np.percentile(lats, [50, 99])
+        return {
+            "rows_per_sec": round(sum(rows_served) / elapsed, 1),
+            "p50_ms": round(float(p50), 3),
+            "p99_ms": round(float(p99), 3),
+            "requests": int(lats.size),
+        }, elapsed
+
+    # 1. Single engine (continuous): the PR 3 baseline shape.
+    engine = ServingEngine(
+        model, example, cfg(), output_cols=("prediction",),
+        name="scaleout_single",
+    ).start()
+    single, _ = run_load(engine.predict, "single")
+    engine.stop()
+
+    # 2. Pool, FIFO packing: isolates the continuous-batching delta.
+    pool = ReplicaPool(
+        model, example, config=cfg(batching="fifo"),
+        n_replicas=n_replicas, output_cols=("prediction",),
+        name="scaleout_fifo",
+    ).start()
+    fifo, _ = run_load(pool.predict, "pool_fifo")
+    pool.stop()
+
+    # 3. Pool, continuous batching: the product configuration.
+    pool = ReplicaPool(
+        model, example, config=cfg(),
+        n_replicas=n_replicas, output_cols=("prediction",),
+        name="scaleout",
+    ).start()
+    cont, elapsed = run_load(pool.predict, "pool_continuous")
+    stats = pool.stats()
+    per_replica = {
+        rname: round(rec["counters"].get("rows", 0.0) / elapsed, 1)
+        for rname, rec in stats["per_replica"].items()
+    }
+    pool.stop()
+
+    import jax
+
+    return {
+        "serving_scaleout_rows_per_sec": cont["rows_per_sec"],
+        "serving_rows_per_sec_per_replica": per_replica,
+        "pool_p50_ms": cont["p50_ms"],
+        "pool_p99_ms": cont["p99_ms"],
+        "pool_speedup_vs_single_engine": round(
+            cont["rows_per_sec"] / single["rows_per_sec"], 2
+        ),
+        "single_engine_rows_per_sec": single["rows_per_sec"],
+        "fifo_pool_rows_per_sec": fifo["rows_per_sec"],
+        "fifo_p50_ms": fifo["p50_ms"],
+        "continuous_p50_ms": cont["p50_ms"],
+        "continuous_vs_fifo_p50": round(
+            cont["p50_ms"] / fifo["p50_ms"], 3
+        ) if fifo["p50_ms"] else None,
+        "batching_window_ms": max_wait_ms,
+        "replicas": n_replicas,
+        "clients": n_clients,
+        "devices": len(jax.devices()),
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
+def _inner_serving_scaleout() -> dict:
+    _setup_jax_cache()
+    return _serving_scaleout_stage()
+
+
+def _inner_serving_scaleout_cpu() -> dict:
+    """The scale-out measurement pinned to an 8-virtual-device host CPU
+    mesh — tunnel-immune (CI's serving-scaleout stage parses it), so the
+    rows/s-per-replica trajectory is always observable; the device
+    variant runs the same programs when the tunnel returns.
+
+    Replica count is capped at the HOST core count: each replica's
+    device executor needs a core behind it, and running 8 executors on a
+    2-core CI box measures the OS scheduler (observed: ~100 ms CFS
+    timeslice stalls inside 2 ms programs), not the pool. On the
+    acceptance host (>= 8 cores) this is exactly the 8-replica config."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    _setup_jax_cache()
+    return _serving_scaleout_stage(
+        n_replicas=max(2, min(8, os.cpu_count() or 2))
+    )
+
+
 def _inner_serving() -> dict:
     _setup_jax_cache()
     return _serving_stage()
@@ -1061,6 +1224,8 @@ _INNER_STAGES = {
     "pipeline_fused_cpu": _inner_pipeline_fused_cpu,
     "serving": _inner_serving,
     "serving_cpu": _inner_serving_cpu,
+    "serving_scaleout": _inner_serving_scaleout,
+    "serving_scaleout_cpu": _inner_serving_scaleout_cpu,
     "feed_overlap": _inner_feed_overlap,
     "input_pipeline": _inner_input_pipeline,
     "input_pipeline_cpu": _inner_input_pipeline_cpu,
@@ -1213,7 +1378,8 @@ def main():
         # the tunnel, so it must not contend for the single-tenant lock
         # (it runs while a watcher capture may hold the device).
         if inner in ("converge_cpu", "pipeline_fused_cpu", "serving_cpu",
-                     "input_pipeline_cpu", "sharded_train_cpu"):
+                     "serving_scaleout_cpu", "input_pipeline_cpu",
+                     "sharded_train_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():

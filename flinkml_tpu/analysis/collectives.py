@@ -185,12 +185,31 @@ def load_trace(path: str) -> List[DispatchEvent]:
     return [DispatchEvent.from_map(m) for m in events]
 
 
+#: Dispatch-trace program prefix of serving replica-pool slices (the
+#: :class:`~flinkml_tpu.serving.pool.ReplicaPool` tags each replica's
+#: engine ``serving.pool/<pool>/<replica>`` — see
+#: ``ServingConfig.dispatch_tag``).
+POOL_PROGRAM_PREFIX = "serving.pool/"
+
+
+def _is_pool_dispatch(event: DispatchEvent) -> bool:
+    return event.program.startswith(POOL_PROGRAM_PREFIX)
+
+
 def check_dispatch_trace(events: Iterable[DispatchEvent],
                          location: Optional[str] = None) -> List[Finding]:
-    """FML302 for every pair of threads that dispatched multi-device
-    collective programs over intersecting device sets without a common
-    lock token. One finding per (thread pair, program pair) shape, not
-    per event occurrence."""
+    """FML302/FML303 for every pair of threads that dispatched
+    multi-device collective programs over intersecting device sets
+    without a common lock token. One finding per (thread pair, program
+    pair) shape, not per event occurrence.
+
+    The shape specializes to **FML303** when either side is a serving
+    replica-pool slice dispatch (program prefix
+    :data:`POOL_PROGRAM_PREFIX`): a pool whose mesh slices overlap a
+    concurrently registered training dispatch (or another pool's slices)
+    without a shared ``local_execution_lock`` — the pool-specific fix is
+    to give the replicas their slice meshes (``ServingConfig.mesh``) so
+    the per-slice locks compose with every overlapping set."""
     multi = [e for e in events if len(e.devices) > 1]
     findings: List[Finding] = []
     reported = set()
@@ -206,6 +225,27 @@ def check_dispatch_trace(events: Iterable[DispatchEvent],
             if key in reported:
                 continue
             reported.add(key)
+            if _is_pool_dispatch(a) or _is_pool_dispatch(b):
+                pool_ev, other = (
+                    (a, b) if _is_pool_dispatch(a) else (b, a)
+                )
+                findings.append(Finding(
+                    "FML303",
+                    f"replica-pool slice {pool_ev.program!r} (thread "
+                    f"{pool_ev.thread!r}) overlaps the concurrent dispatch "
+                    f"{other.program!r} (thread {other.thread!r}) on shared "
+                    "devices with no common slice lock — the replica's and "
+                    "the trainer's collective enqueues may interleave and "
+                    "deadlock the rendezvous",
+                    stage=f"{pool_ev.program} / {other.program}",
+                    location=location,
+                    fix_hint="give the pool replicas their slice meshes "
+                             "(ServingConfig.mesh / ReplicaPool(meshes=...)) "
+                             "so every batch holds local_execution_lock("
+                             "slice), which composes with overlapping "
+                             "training locks",
+                ))
+                continue
             findings.append(Finding(
                 "FML302",
                 f"threads {a.thread!r} and {b.thread!r} dispatch collective "

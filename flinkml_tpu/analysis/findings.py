@@ -38,6 +38,7 @@ RULES = {
     # -- 3xx: collectives --------------------------------------------------
     "FML301": (ERROR, "cross-rank collective sequences diverge (rendezvous mismatch)"),
     "FML302": (ERROR, "concurrent multi-device collective dispatch without a common lock"),
+    "FML303": (ERROR, "serving replica-pool mesh slice overlaps a concurrent dispatch without a shared slice lock"),
     # -- 4xx: transfer / retrace guard -------------------------------------
     "FML401": (ERROR, "host<->device transfer beyond the declared budget in a guarded region"),
     "FML402": (ERROR, "compile-cache miss beyond the declared bucket policy in a guarded region"),

@@ -44,6 +44,16 @@ site                      where it fires
                           agreement on the newest commonly-valid
                           snapshot before an elastic resume (a raise
                           models a failed shrink rendezvous)
+``serving.replica``       top of every :meth:`flinkml_tpu.serving
+                          .ServingEngine._serve_batch` dispatch, before
+                          the batch transform; the context carries the
+                          engine name, so a :class:`ReplicaDown` can
+                          kill ONE replica of a
+                          :class:`~flinkml_tpu.serving.pool.ReplicaPool`
+                          mid-traffic (every batch on that replica
+                          raises from then on — the pool must retire it
+                          and respread traffic; the chaos contract of
+                          the ``serving scaleout`` CI stage)
 ========================  ====================================================
 
 Arming is explicit and scoped (:func:`armed`); with **no plan armed the
@@ -346,6 +356,44 @@ class RankLost(Fault):
 
     def describe(self):
         return f"RankLost(rank={self.rank}, epoch={self.epoch})"
+
+
+class ReplicaDown(Fault):
+    """Kill one serving replica: from the ``at_batch``-th batch this
+    replica dispatches (1-based, counted per fault instance) onward,
+    EVERY batch raises :class:`FaultInjected` — the replica is dead, not
+    hiccuping. ``engine`` matches the engine name (a pool replica's is
+    ``"<pool>/<replica>"``, e.g. ``"pool/r1"``; a bare replica name like
+    ``"r1"`` matches its suffix). The in-flight batch's requests fail
+    with the injection; a :class:`~flinkml_tpu.serving.pool.ReplicaPool`
+    router retries them on healthy replicas and retires the dead one."""
+
+    site = "serving.replica"
+
+    def __init__(self, engine: str, at_batch: int = 1):
+        self.engine = str(engine)
+        self.at_batch = int(at_batch)
+        self._seen = 0
+        self.fired = False
+
+    def _matches(self, name: str) -> bool:
+        return name == self.engine or name.endswith(f"/{self.engine}")
+
+    def should_fire(self, ctx):
+        if not self._matches(str(ctx.get("engine", ""))):
+            return False
+        self._seen += 1
+        return self._seen >= self.at_batch
+
+    def apply(self, ctx):
+        self.fired = True
+        raise FaultInjected(
+            f"injected replica death ({ctx.get('engine')}, batch "
+            f"#{self._seen})"
+        )
+
+    def describe(self):
+        return f"ReplicaDown({self.engine}, at_batch={self.at_batch})"
 
 
 class FailRendezvous(Fault):

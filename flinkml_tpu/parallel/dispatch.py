@@ -157,6 +157,19 @@ _MESH_LOCKS: dict = {}  # frozenset(device ids) -> TrackedRLock
 _MESH_LOCKS_GUARD = threading.Lock()
 
 
+def _device_id_set(mesh) -> frozenset:
+    """Normalize a lock subject to its device-id set: a ``DeviceMesh``,
+    a raw ``jax.sharding.Mesh``, or a plain sequence of ``jax.Device``s
+    / integer device ids (how a serving replica pool names a per-replica
+    slice without building a mesh around it)."""
+    if isinstance(mesh, (list, tuple, set, frozenset)):
+        return frozenset(
+            d if isinstance(d, int) else d.id for d in mesh
+        )
+    devices = getattr(mesh, "mesh", mesh).devices
+    return frozenset(d.id for d in devices.flatten())
+
+
 def local_execution_lock(mesh=None):
     """The collective-dispatch mutex for ``mesh``'s device set (see
     above). Hold it (``with local_execution_lock(mesh):``) around any
@@ -167,19 +180,20 @@ def local_execution_lock(mesh=None):
     behaviour): it acquires the process lock plus every registered mesh
     lock, so it serializes against every mesh-keyed fit — and new mesh
     locks cannot register while it is held (registration synchronizes on
-    the process lock), so no fit can slip past it. With a mesh,
+    the process lock), so no fit can slip past it. With a mesh (or a
+    plain device sequence — a replica pool's per-slice placement),
     identical device sets share one tracked lock, disjoint sets get
-    independent locks (concurrent fits over disjoint meshes proceed in
-    parallel), and a set that overlaps other registered sets gets a
-    composite acquiring every intersecting lock in canonical order —
-    overlapping fits always share at least one component lock, so the
-    rendezvous-interleaving hazard cannot occur (and the shared token is
-    visible to the analyzer's FML302 check).
+    independent locks (concurrent fits over disjoint meshes — and pool
+    replicas over disjoint slices — proceed in parallel), and a set that
+    overlaps other registered sets gets a composite acquiring every
+    intersecting lock in canonical order — overlapping fits always share
+    at least one component lock, so the rendezvous-interleaving hazard
+    cannot occur (and the shared token is visible to the analyzer's
+    FML302/FML303 checks).
     """
     if mesh is None:
         return _GlobalLock()
-    devices = getattr(mesh, "mesh", mesh).devices
-    key = frozenset(d.id for d in devices.flatten())
+    key = _device_id_set(mesh)
     with _MESH_LOCKS_GUARD:
         lock = _MESH_LOCKS.get(key)
     if lock is None:

@@ -2,14 +2,23 @@
 
 The layer between the train/transform framework and "heavy traffic from
 millions of users" (ROADMAP north star): a request path in front of the
-fused pipeline executor, versioned model publication, and zero-downtime
-model rollout. Four pieces:
+fused pipeline executor, versioned model publication, zero-downtime
+model rollout, and a horizontally scaled replica-pool front. The pieces:
 
-- :class:`ServingEngine` — thread-safe ``predict()`` with **adaptive
-  micro-batching**: concurrent requests coalesce into the power-of-two
-  row buckets the fused compile cache already owns (per-bucket warmup at
-  load, so steady state is zero-retrace), with bounded-queue admission
-  control, per-request deadlines, and host-path load shedding.
+- :class:`ServingEngine` — thread-safe ``predict()`` with
+  **continuous batching**: concurrent requests coalesce into the
+  power-of-two row buckets the fused compile cache already owns,
+  splitting at bucket boundaries so a late arrival joins the currently
+  forming bucket (per-request row reassembly keeps responses bitwise
+  single-version); per-bucket warmup at load, bounded-queue admission
+  control, per-request deadlines swept promptly, and host-path load
+  shedding. ``ServingConfig(batching="fifo")`` keeps PR 3's
+  whole-request packing for comparison.
+- :class:`ReplicaPool` + :class:`Router` — N engine replicas (one per
+  device, or one per mesh slice time-sharing with training through
+  ``local_execution_lock``) behind least-outstanding-rows routing with
+  deadline-aware admission, per-replica overload degradation, automatic
+  failover, and rolling (one-replica-at-a-time) registry hot-swaps.
 - :class:`ModelRegistry` — versioned, fingerprint-verified model store
   with an atomic "current" pointer; ``publish`` / ``get`` / ``rollback``.
 - :class:`SnapshotPublisher` — an ``IterationListener`` that turns a
@@ -19,12 +28,17 @@ model rollout. Four pieces:
 - typed errors (:mod:`flinkml_tpu.serving.errors`) for every rejection
   the online path can produce.
 
-See ``docs/operators/serving.md`` for lifecycle, knobs, and semantics,
-and ``examples/serve_pipeline.py`` for the end-to-end
-fit → publish → serve → hot-swap flow.
+See ``docs/operators/serving.md`` for lifecycle, knobs, and semantics
+(including the scale-out section), and ``examples/serve_pipeline.py``
+for the end-to-end fit → publish → serve → hot-swap flow.
 """
 
-from flinkml_tpu.serving.batcher import AdaptiveMicroBatcher, ServingRequest
+from flinkml_tpu.serving.batcher import (
+    AdaptiveMicroBatcher,
+    BatchSegment,
+    ContinuousBatcher,
+    ServingRequest,
+)
 from flinkml_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
@@ -34,22 +48,35 @@ from flinkml_tpu.serving.errors import (
     EngineStoppedError,
     ModelIntegrityError,
     ModelVersionNotFoundError,
+    PoolUnavailableError,
     RegistryError,
     ServingError,
     ServingOverloadError,
     ServingSchemaError,
     ServingTimeoutError,
 )
+from flinkml_tpu.serving.health import HealthPolicy, ReplicaHealth, ReplicaState
+from flinkml_tpu.serving.pool import Replica, ReplicaPool, slice_meshes
 from flinkml_tpu.serving.publisher import SnapshotPublisher
 from flinkml_tpu.serving.registry import ModelRegistry
+from flinkml_tpu.serving.router import Router
 
 __all__ = [
     "AdaptiveMicroBatcher",
+    "BatchSegment",
+    "ContinuousBatcher",
     "EngineStoppedError",
+    "HealthPolicy",
     "ModelIntegrityError",
     "ModelRegistry",
     "ModelVersionNotFoundError",
+    "PoolUnavailableError",
     "RegistryError",
+    "Replica",
+    "ReplicaHealth",
+    "ReplicaPool",
+    "ReplicaState",
+    "Router",
     "ServingConfig",
     "ServingEngine",
     "ServingError",
@@ -59,4 +86,5 @@ __all__ = [
     "ServingSchemaError",
     "ServingTimeoutError",
     "SnapshotPublisher",
+    "slice_meshes",
 ]

@@ -1,12 +1,14 @@
-"""Adaptive micro-batching: coalesce concurrent requests into shape buckets.
+"""Micro-batching: coalesce concurrent requests into shape buckets.
 
 The fused pipeline executor (:mod:`flinkml_tpu.pipeline_fusion`) compiles
 one program per power-of-two row bucket and serves any row count within a
 bucket with zero retraces — so the *only* cost of batching requests
 together is padding waste inside the bucket, and the only cost of not
-batching is per-dispatch overhead. The policy here (in the adaptive-
-batching tradition of Clipper, Crankshaw et al., NSDI'17) exploits that
-structure directly:
+batching is per-dispatch overhead. Two policies share that structure:
+
+:class:`AdaptiveMicroBatcher` (the PR 3 policy, in the adaptive-batching
+tradition of Clipper, Crankshaw et al., NSDI'17) packs whole requests
+FIFO:
 
   - a request that arrives alone waits at most ``max_wait_s`` for company
     (the latency the operator is willing to trade for occupancy);
@@ -14,14 +16,39 @@ structure directly:
     power-of-two bucket (occupancy 1.0 — waiting longer buys nothing the
     compile cache doesn't already give a later batch) or reach
     ``max_batch_rows``;
-  - admission is bounded: past ``max_queue_rows`` queued rows,
-    :meth:`offer` refuses and the engine sheds or rejects — queueing
-    theory does the rest of the argument (an unbounded queue under
-    saturation has unbounded latency).
+  - requests are never split, so a request too large for the batch's
+    remaining capacity blocks everything behind it (head-of-line).
 
-Requests are never split across batches; batches pop FIFO, so the oldest
-request's deadline governs the window. Thread-safe; one consumer (the
-engine's dispatcher thread) and any number of producers.
+:class:`ContinuousBatcher` (the Orca-style policy, Yu et al., OSDI'22,
+specialized to bucketed row batching) splits requests at bucket
+boundaries instead:
+
+  - a late arrival joins the **currently forming bucket**: when queued
+    rows reach the bucket the window opened on, the window closes and
+    exactly that bucket dispatches (occupancy 1.0), the straddling
+    request contributing only its head rows;
+  - the tail rows stay at the FRONT of the queue and ride the next
+    dispatch — no request ever waits behind a batch it could have
+    partially joined, which is what deletes the FIFO policy's
+    head-of-line latency under load;
+  - per-request row reassembly lives in :class:`ServingRequest`
+    (:meth:`ServingRequest.add_segment`): responses are stitched back in
+    row order, and a request whose segments were served by different
+    model versions is re-dispatched whole so the version-tagging
+    contract (one response == one version, bitwise-equal to that
+    version's transform) survives splitting.
+
+Both policies share bounded admission: past ``max_queue_rows`` queued
+rows, :meth:`offer` refuses and the engine sheds or rejects — queueing
+theory does the rest of the argument (an unbounded queue under
+saturation has unbounded latency). Deadlines are swept **promptly**: the
+consumer wakes at the earliest queued deadline and fails overdue
+requests the moment it passes, instead of letting them ride out the
+max-wait window (a never-filling queue used to hold an expired request
+for the whole window).
+
+Thread-safe; one consumer (the engine's dispatcher thread) and any
+number of producers.
 """
 
 from __future__ import annotations
@@ -30,7 +57,7 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,10 +65,13 @@ from flinkml_tpu.pipeline_fusion import row_bucket
 from flinkml_tpu.serving.errors import EngineStoppedError
 
 
-@dataclasses.dataclass
-class ServingRequest:
+@dataclasses.dataclass(eq=False)  # identity equality: queues remove by
+class ServingRequest:             # object, and columns hold numpy arrays
     """One in-flight ``predict`` call: host input columns plus a
-    completion event the calling thread waits on."""
+    completion event the calling thread waits on. Under continuous
+    batching a request may be served in several row SEGMENTS; the
+    dispatcher feeds them to :meth:`add_segment` and the request
+    reassembles its response in row order."""
 
     columns: Dict[str, np.ndarray]
     rows: int
@@ -52,6 +82,14 @@ class ServingRequest:
     error: Optional[BaseException] = None
     version: Optional[int] = None
     shed: bool = False
+    #: Rows the batcher has handed out in segments (queue-side cursor;
+    #: only the consumer thread advances it, under the batcher's lock).
+    dispatched_rows: int = 0
+    #: Completed ``(start, columns, version, rows)`` segments awaiting
+    #: reassembly. Only the dispatcher thread touches this.
+    segments: List[Tuple[int, Dict[str, np.ndarray], Optional[int], int]] = (
+        dataclasses.field(default_factory=list)
+    )
     #: Set by whichever side (client wait-expiry or dispatcher in-queue
     #: expiry) counts the timeout first, so one request never increments
     #: the 'timeouts' counter twice. Guarded by ``_count_lock`` — use
@@ -81,9 +119,63 @@ class ServingRequest:
         self.error = error
         self.done.set()
 
+    # -- segment reassembly (dispatcher thread only) -----------------------
+    def add_segment(self, start: int, columns: Dict[str, np.ndarray],
+                    version: Optional[int], rows: int):
+        """Record one served segment. Returns ``None`` while more rows
+        are outstanding, the assembled ``(columns, version)`` response
+        when all rows landed on one version (the caller completes the
+        request), or the string ``"mixed"`` when segments span model
+        versions — the caller must :meth:`reset_segments` and
+        re-dispatch the whole request so the response stays
+        single-version."""
+        if self.done.is_set():  # expired/failed while a segment was in flight
+            return None
+        self.segments.append((start, columns, version, rows))
+        served = sum(r for _, _, _, r in self.segments)
+        if served < self.rows:
+            return None
+        versions = {v for _, _, v, _ in self.segments}
+        if len(versions) > 1:
+            return "mixed"
+        self.segments.sort(key=lambda s: s[0])
+        if len(self.segments) == 1:
+            assembled = self.segments[0][1]
+        else:
+            names = self.segments[0][1].keys()
+            assembled = {
+                c: np.concatenate([cols[c] for _, cols, _, _ in self.segments])
+                for c in names
+            }
+        return assembled, versions.pop()
+
+    def reset_segments(self) -> None:
+        """Discard partial results ahead of a whole-request re-dispatch
+        (version skew across a hot swap)."""
+        self.segments.clear()
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchSegment:
+    """One contiguous row range of a request inside a dispatched batch.
+    Whole-request policies emit one full-range segment per request."""
+
+    request: ServingRequest
+    start: int
+    rows: int
+
+    @property
+    def columns(self) -> Dict[str, np.ndarray]:
+        if self.start == 0 and self.rows == self.request.rows:
+            return self.request.columns
+        return {
+            name: a[self.start:self.start + self.rows]
+            for name, a in self.request.columns.items()
+        }
+
 
 class AdaptiveMicroBatcher:
-    """Bounded thread-safe request queue + the coalescing policy above."""
+    """Bounded thread-safe request queue + FIFO whole-request packing."""
 
     def __init__(
         self,
@@ -121,6 +213,20 @@ class AdaptiveMicroBatcher:
             self._cond.notify_all()
             return True
 
+    def requeue(self, request: ServingRequest) -> bool:
+        """Put a request back at the FRONT of the queue for a whole
+        re-dispatch (mixed-version reassembly across a hot swap). False
+        after :meth:`stop` — the caller fails the request instead."""
+        with self._cond:
+            if self._stopped:
+                return False
+            request.dispatched_rows = 0
+            request.reset_segments()
+            self._queue.appendleft(request)
+            self._queued_rows += request.rows
+            self._cond.notify_all()
+            return True
+
     @property
     def queue_depth(self) -> int:
         with self._cond:
@@ -134,11 +240,13 @@ class AdaptiveMicroBatcher:
     # -- consumer side (the dispatcher thread) -----------------------------
     def next_batch(
         self, poll_s: float = 0.05
-    ) -> Tuple[List[ServingRequest], List[ServingRequest]]:
+    ) -> Tuple[List[BatchSegment], List[ServingRequest]]:
         """Block up to ``poll_s`` for work, then apply the batching window;
         returns ``(batch, expired)`` — either may be empty. ``expired``
         are requests whose deadline passed while queued (the caller fails
-        them with the timeout error); they never occupy batch rows."""
+        them with the timeout error); they never occupy batch rows, and
+        an expiry observed mid-window returns IMMEDIATELY so the typed
+        timeout is prompt rather than delayed to the window's close."""
         with self._cond:
             if not self._queue and not self._stopped:
                 self._cond.wait(poll_s)
@@ -151,12 +259,24 @@ class AdaptiveMicroBatcher:
             # a small margin) so it dispatches in time instead of being
             # expired by the very wait that was supposed to batch it.
             window_end = self._queue[0].enqueued_at + self.max_wait_s
+            forming_bucket = min(
+                self.max_batch_rows, row_bucket(self._queued_rows)
+            )
             while not self._stopped:
+                newly_expired = self._drop_expired()
+                if newly_expired:
+                    # Prompt sweep: fail overdue requests NOW (the caller
+                    # raises the typed timeout) instead of holding them —
+                    # or the window — until the max-wait elapses.
+                    expired.extend(newly_expired)
+                    return [], expired
+                if not self._queue:
+                    return [], expired
                 rows = self._queued_rows
                 if rows >= self.max_batch_rows:
                     break
-                if rows == row_bucket(rows):
-                    break  # bucket exactly full: occupancy 1.0, go now
+                if self._close_early(rows, forming_bucket):
+                    break
                 deadlines = [
                     r.deadline for r in self._queue if r.deadline is not None
                 ]
@@ -167,25 +287,41 @@ class AdaptiveMicroBatcher:
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
-            # No re-expiry after the window: a deadline that lapsed DURING
-            # the window (bounded by max_wait_s) rides the batch — the
-            # caller's completion wait carries a grace margin, and
-            # dispatching beats wasting the rows. Requests overdue before
-            # the window (queued behind a busy dispatcher) were dropped
-            # above.
-            batch: List[ServingRequest] = []
-            rows = 0
-            while self._queue:
-                req = self._queue[0]
-                if batch and rows + req.rows > self.max_batch_rows:
-                    break
-                self._queue.popleft()
-                self._queued_rows -= req.rows
-                batch.append(req)
-                rows += req.rows
-                if rows >= self.max_batch_rows:
-                    break
-            return batch, expired
+            return self._pop_batch(forming_bucket), expired
+
+    def _close_early(self, rows: int, forming_bucket: int) -> bool:
+        # Bucket exactly full: occupancy 1.0, waiting buys nothing.
+        return rows == row_bucket(rows)
+
+    def _discard_if_dead(self, req: ServingRequest) -> bool:
+        """Drop a queued request that already completed or failed (a
+        split request's earlier batch erred, or shutdown failed it) —
+        its remaining rows must neither occupy batch rows nor inflate
+        the admission bound. Caller holds the lock and ``req`` is the
+        queue head."""
+        if not req.done.is_set():
+            return False
+        self._queue.popleft()
+        self._queued_rows -= req.rows - req.dispatched_rows
+        return True
+
+    def _pop_batch(self, forming_bucket: int) -> List[BatchSegment]:
+        """FIFO whole-request packing (never splits)."""
+        batch: List[BatchSegment] = []
+        rows = 0
+        while self._queue:
+            req = self._queue[0]
+            if self._discard_if_dead(req):
+                continue
+            if batch and rows + req.rows > self.max_batch_rows:
+                break
+            self._queue.popleft()
+            self._queued_rows -= req.rows
+            batch.append(BatchSegment(req, 0, req.rows))
+            rows += req.rows
+            if rows >= self.max_batch_rows:
+                break
+        return batch
 
     def _drop_expired(self) -> List[ServingRequest]:
         now = time.monotonic()
@@ -194,7 +330,7 @@ class AdaptiveMicroBatcher:
         ]
         for r in expired:
             self._queue.remove(r)
-            self._queued_rows -= r.rows
+            self._queued_rows -= r.rows - r.dispatched_rows
         return expired
 
     # -- shutdown ----------------------------------------------------------
@@ -212,3 +348,52 @@ class AdaptiveMicroBatcher:
             self._queue.clear()
             self._queued_rows = 0
             return pending
+
+
+def _pow2_floor(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    return 1 << (int(n).bit_length() - 1)
+
+
+class ContinuousBatcher(AdaptiveMicroBatcher):
+    """Continuous batching: requests split at bucket boundaries (see the
+    module docstring). Shares admission, deadlines, and shutdown with the
+    FIFO policy; only the window-close condition and the pop differ."""
+
+    def _close_early(self, rows: int, forming_bucket: int) -> bool:
+        # Late arrivals filled the bucket the window opened on: dispatch
+        # exactly that full bucket now (the straddler splits), instead of
+        # waiting out the window only to pad a larger bucket.
+        return rows >= forming_bucket or rows == row_bucket(rows)
+
+    def _pop_batch(self, forming_bucket: int) -> List[BatchSegment]:
+        q = self._queued_rows
+        if q >= self.max_batch_rows:
+            # Saturated: every dispatch is an exactly-full cap bucket.
+            target = self.max_batch_rows
+        elif q >= forming_bucket:
+            # The forming bucket filled (early close): take the largest
+            # exactly-full bucket available — zero padding; the remainder
+            # opens the next window at the queue front.
+            target = min(self.max_batch_rows, _pow2_floor(q))
+        else:
+            # Window expired under-full: latency beats occupancy, flush
+            # everything (padded to its bucket by the executor).
+            target = q
+        batch: List[BatchSegment] = []
+        taken = 0
+        while self._queue and taken < target:
+            req = self._queue[0]
+            if self._discard_if_dead(req):
+                # A failed head batch killed this request; its tail rows
+                # must not be dispatched as dead device work.
+                continue
+            remaining = req.rows - req.dispatched_rows
+            take = min(remaining, target - taken)
+            batch.append(BatchSegment(req, req.dispatched_rows, take))
+            req.dispatched_rows += take
+            self._queued_rows -= take
+            taken += take
+            if req.dispatched_rows >= req.rows:
+                self._queue.popleft()
+        return batch

@@ -56,8 +56,14 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+import flinkml_tpu.faults as faults
 from flinkml_tpu import pipeline_fusion
-from flinkml_tpu.serving.batcher import AdaptiveMicroBatcher, ServingRequest
+from flinkml_tpu.serving.batcher import (
+    AdaptiveMicroBatcher,
+    BatchSegment,
+    ContinuousBatcher,
+    ServingRequest,
+)
 from flinkml_tpu.serving.errors import (
     EngineStoppedError,
     RegistryError,
@@ -79,6 +85,22 @@ class ServingConfig:
     Pass an explicit tuple to warm fewer (new buckets still compile
     lazily on first use; the retrace guard's default policy allows
     new-bucket compiles of a known chain).
+
+    ``batching`` selects the queue policy: ``"continuous"`` (default —
+    requests split at bucket boundaries, Orca-style; see
+    :class:`~flinkml_tpu.serving.batcher.ContinuousBatcher`) or
+    ``"fifo"`` (PR 3's whole-request packing, kept for A/B comparison —
+    the ``serving_scaleout`` bench stage measures both).
+
+    ``device`` pins every dispatch (warmup included) to one
+    ``jax.Device`` via ``jax.default_device`` — how a
+    :class:`~flinkml_tpu.serving.pool.ReplicaPool` places one replica
+    per device. ``metrics_name``/``metrics_labels`` let several engines
+    share one metric GROUP distinguished by labels (per-replica gauges
+    aggregate instead of colliding); ``dispatch_tag`` overrides the
+    program name recorded for dispatch-trace observers (the pool tags
+    replicas ``serving.pool/<pool>/<replica>`` so the analyzer's FML303
+    check can see pool slices).
     """
 
     max_batch_rows: int = 1024
@@ -89,6 +111,11 @@ class ServingConfig:
     warmup_row_counts: Optional[Sequence[int]] = None
     mesh: Optional[Any] = None  # DeviceMesh for SPMD-serving models
     latency_window: int = 2048  # ring size backing the p50/p99 gauges
+    batching: str = "continuous"  # or "fifo"
+    device: Optional[Any] = None  # jax.Device to pin all dispatches to
+    metrics_name: Optional[str] = None  # metric group name (default: name)
+    metrics_labels: Optional[Dict[str, str]] = None
+    dispatch_tag: Optional[str] = None  # trace program prefix override
 
 
 @dataclasses.dataclass
@@ -146,12 +173,16 @@ class ServingEngine:
         self._output_cols: Optional[Tuple[str, ...]] = (
             tuple(output_cols) if output_cols is not None else None
         )
-        self._metrics = metrics.group(f"serving.{name}")
-        self._batcher = AdaptiveMicroBatcher(
-            max_batch_rows=self.config.max_batch_rows,
-            max_wait_s=self.config.max_wait_ms / 1000.0,
-            max_queue_rows=self.config.max_queue_rows,
+        self._metrics = metrics.group(
+            f"serving.{self.config.metrics_name or name}",
+            labels=self.config.metrics_labels,
         )
+        if self.config.batching not in ("continuous", "fifo"):
+            raise ValueError(
+                f"batching must be 'continuous' or 'fifo', got "
+                f"{self.config.batching!r}"
+            )
+        self._batcher = self._make_batcher()
         self._active: Optional[_ActiveModel] = None
         self._swap_lock = threading.Lock()
         # Serializes pointer-FOLLOWING swaps (listener delivery + the
@@ -171,6 +202,17 @@ class ServingEngine:
         self._following = False       # listener currently registered
         self._follow_requested = False  # survives stop(): restart re-follows
 
+    def _make_batcher(self) -> AdaptiveMicroBatcher:
+        cls = (
+            ContinuousBatcher if self.config.batching == "continuous"
+            else AdaptiveMicroBatcher
+        )
+        return cls(
+            max_batch_rows=self.config.max_batch_rows,
+            max_wait_s=self.config.max_wait_ms / 1000.0,
+            max_queue_rows=self.config.max_queue_rows,
+        )
+
     # -- lifecycle ---------------------------------------------------------
     @property
     def running(self) -> bool:
@@ -187,11 +229,7 @@ class ServingEngine:
         if self.running:
             return self
         if self._batcher._stopped:  # restart after stop(): fresh queue
-            self._batcher = AdaptiveMicroBatcher(
-                max_batch_rows=self.config.max_batch_rows,
-                max_wait_s=self.config.max_wait_ms / 1000.0,
-                max_queue_rows=self.config.max_queue_rows,
-            )
+            self._batcher = self._make_batcher()
         if self._registry is not None:
             version, model = self._registry.get()
         else:
@@ -223,9 +261,13 @@ class ServingEngine:
         if self._following and self._registry is not None:
             self._registry.remove_listener(self._on_registry_change)
             self._following = False
-        if self._thread is not None:
-            self._thread.join(timeout)
-            if self._thread.is_alive():
+        # Local capture: stop() may run concurrently (the pool's retire
+        # thread and pool.stop() both stop a dead replica) and the loser
+        # must not trip over the winner clearing self._thread.
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
                 # join timed out mid-batch: keep the reference so running
                 # stays True and start() cannot spawn a second dispatcher
                 # over the same batcher while the orphan drains.
@@ -467,17 +509,20 @@ class ServingEngine:
                 return
             self._metrics.gauge("queue_depth", self._batcher.queue_depth)
 
-    def _serve_batch(self, batch: List[ServingRequest]) -> None:
+    def _serve_batch(self, batch: List[BatchSegment]) -> None:
         active = self._active  # snapshot: in-flight work stays on it
-        rows = sum(r.rows for r in batch)
-        packed = {
-            name: (
-                np.concatenate([r.columns[name] for r in batch])
-                if len(batch) > 1 else batch[0].columns[name]
-            )
-            for name in self._schema
-        }
+        rows = sum(s.rows for s in batch)
         try:
+            if faults.ACTIVE is not None:  # replica-kill seam (pool chaos)
+                faults.fire("serving.replica", engine=self.name, rows=rows)
+            cols = [s.columns for s in batch]
+            packed = {
+                name: (
+                    np.concatenate([c[name] for c in cols])
+                    if len(batch) > 1 else cols[0][name]
+                )
+                for name in self._schema
+            }
             table = Table(packed)
             with self._dispatch_guard():
                 from flinkml_tpu.parallel import dispatch as _dispatch
@@ -485,9 +530,10 @@ class ServingEngine:
                 if _dispatch.has_dispatch_observers():
                     # The event carries the lock tokens this thread holds,
                     # so analysis.collectives.check_dispatch_trace can
-                    # audit serving+training runs (FML302).
+                    # audit serving+training runs (FML302/FML303).
                     _dispatch.record_collective_dispatch(
-                        "serving.batch", self._device_ids()
+                        f"{self.config.dispatch_tag or 'serving'}.batch",
+                        self._device_ids(),
                     )
                 (out,) = active.model.transform(table)
                 host = {
@@ -495,8 +541,8 @@ class ServingEngine:
                 }
         except BaseException as e:  # noqa: BLE001 — fail the batch, not the loop
             self._metrics.counter("errors")
-            for req in batch:
-                req.fail(e)
+            for seg in batch:
+                seg.request.fail(e)
             return
         bucket = pipeline_fusion.row_bucket(rows)
         self._metrics.counter("batches")
@@ -506,39 +552,70 @@ class ServingEngine:
         now = time.monotonic()
         offset = 0
         completions = []
-        for req in batch:
+        for seg in batch:
             # Copies, not views: responses to different clients must not
             # alias one batch buffer (a client post-processing its arrays
             # in place would corrupt its batchmates' results).
             sliced = {
-                c: host[c][offset:offset + req.rows].copy() for c in host
+                c: host[c][offset:offset + seg.rows].copy() for c in host
             }
-            offset += req.rows
-            completions.append((req, sliced))
-        with self._lat_lock:  # one acquisition for the whole batch
-            self._latencies.extend(
-                (now - req.enqueued_at) * 1000.0 for req in batch
+            offset += seg.rows
+            outcome = seg.request.add_segment(
+                seg.start, sliced, active.version, seg.rows
             )
-        # Gauges first, completions second: a client reading stats right
-        # after its predict() returns sees its own request reflected.
-        self._update_latency_gauges()
-        for req, sliced in completions:
-            req.complete(sliced, active.version)
+            if outcome is None:
+                continue  # more segments to come (or already failed)
+            if outcome == "mixed":
+                # A hot swap landed between this request's segments: one
+                # response must carry ONE version, so discard the partials
+                # and re-dispatch the whole request on the new model.
+                seg.request.reset_segments()
+                self._metrics.counter("redispatched_for_version")
+                if not self._batcher.requeue(seg.request):
+                    seg.request.fail(EngineStoppedError(
+                        "engine stopped while re-dispatching a request "
+                        "split across a model swap"
+                    ))
+                continue
+            completions.append((seg.request, *outcome))
+        if completions:
+            with self._lat_lock:  # one acquisition for the whole batch
+                self._latencies.extend(
+                    (now - req.enqueued_at) * 1000.0
+                    for req, _, _ in completions
+                )
+            # Gauges first, completions second: a client reading stats
+            # right after its predict() returns sees its own request
+            # reflected.
+            self._update_latency_gauges()
+        for req, result, version in completions:
+            req.complete(result, version)
 
+    @contextlib.contextmanager
     def _dispatch_guard(self):
         """Multi-device serving programs time-share devices with training
         via the mesh lock; single-device programs (the fused executor's
-        output) need no cross-thread lock — see module docstring."""
-        if self.config.mesh is None:
-            return contextlib.nullcontext()
-        from flinkml_tpu.parallel.dispatch import local_execution_lock
+        output) need no cross-thread lock — see module docstring. A
+        ``config.device`` pin additionally routes every dispatch (and its
+        input placement) to that device via ``jax.default_device`` — the
+        replica pool's one-engine-per-device placement."""
+        with contextlib.ExitStack() as stack:
+            if self.config.device is not None:
+                import jax
 
-        return local_execution_lock(self.config.mesh)
+                stack.enter_context(jax.default_device(self.config.device))
+            if self.config.mesh is not None:
+                from flinkml_tpu.parallel.dispatch import local_execution_lock
+
+                stack.enter_context(local_execution_lock(self.config.mesh))
+            yield
 
     def _device_ids(self) -> Tuple[int, ...]:
         if self.config.mesh is not None:
             mesh = getattr(self.config.mesh, "mesh", self.config.mesh)
             return tuple(d.id for d in mesh.devices.flatten())
+        if self.config.device is not None:
+            return (self.config.device.id,)
         import jax
 
         return (jax.devices()[0].id,)

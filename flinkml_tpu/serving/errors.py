@@ -39,6 +39,13 @@ class ServingSchemaError(ServingError, ValueError):
     trailing shapes) fixed by the warmup example at load time."""
 
 
+class PoolUnavailableError(ServingError):
+    """The replica pool has no healthy replica left to route to — every
+    replica is unhealthy or draining. Distinct from
+    :class:`ServingOverloadError` (healthy replicas exist but every
+    bounded queue is full): this one pages, that one backs off."""
+
+
 class RegistryError(RuntimeError):
     """Base class of model-registry errors."""
 
@@ -50,6 +57,7 @@ class ModelVersionNotFoundError(RegistryError, KeyError):
 
 __all__ = [
     "ModelIntegrityError",
+    "PoolUnavailableError",
     "ServingError",
     "ServingOverloadError",
     "ServingTimeoutError",

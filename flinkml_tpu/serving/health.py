@@ -1,0 +1,181 @@
+"""Per-replica health: states, degradation policy, and the ledger.
+
+The pool's overload story degrades **by replica, not globally** (ROADMAP
+item 3): one replica tripping its queue bound or failing its dispatches
+is taken out of rotation while the rest of the pool keeps serving. Three
+states:
+
+- ``HEALTHY`` — in rotation.
+- ``DRAINING`` — temporarily out of rotation after tripping its queue
+  bound ``overload_trip`` times in a row; the engine keeps draining its
+  queue, and the replica rejoins automatically once its backlog falls
+  under ``drain_low_water`` of capacity (checked inline on every routing
+  decision — no poller thread).
+- ``UNHEALTHY`` — failed hard (``max_consecutive_errors`` dispatch
+  failures, e.g. the ``serving.replica`` fault seam killing it): the
+  pool retires it (stop without drain — queued requests fail fast and
+  the router re-runs them on healthy replicas) and never routes to it
+  again until :meth:`ReplicaHealth.revive`.
+
+Transitions are CAS-style under one lock so racing router threads agree
+on exactly one retirement per replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Optional
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"
+    DRAINING = "draining"
+    UNHEALTHY = "unhealthy"
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthPolicy:
+    """Degradation thresholds (see module docstring)."""
+
+    #: Dispatch failures in a row before the replica is retired.
+    max_consecutive_errors: int = 1
+    #: Queue-full refusals in a row before the replica drains.
+    overload_trip: int = 8
+    #: Fraction of ``max_queue_rows`` the backlog must fall under for a
+    #: DRAINING replica to rejoin rotation.
+    drain_low_water: float = 0.25
+
+
+class ReplicaHealth:
+    """One replica's health ledger. Thread-safe; shared by every router
+    thread touching the replica."""
+
+    def __init__(self, name: str, policy: Optional[HealthPolicy] = None):
+        self.name = name
+        self.policy = policy or HealthPolicy()
+        self._lock = threading.Lock()
+        self._state = ReplicaState.HEALTHY
+        self._consecutive_errors = 0
+        self._consecutive_overloads = 0
+        self._last_error: Optional[BaseException] = None
+        self._state_since = time.monotonic()
+        #: Rows submitted to this replica and not yet settled — the
+        #: router's least-outstanding-rows balance key.
+        self.outstanding_rows = 0
+        #: EWMA of observed ms per served row (queue wait included);
+        #: feeds the router's deadline-aware replica ordering.
+        self.ewma_ms_per_row: Optional[float] = None
+
+    # -- state -------------------------------------------------------------
+    @property
+    def state(self) -> ReplicaState:
+        return self._state
+
+    @property
+    def last_error(self) -> Optional[BaseException]:
+        return self._last_error
+
+    def _transition(self, state: ReplicaState) -> None:
+        self._state = state
+        self._state_since = time.monotonic()
+
+    def routable(self) -> bool:
+        return self._state is ReplicaState.HEALTHY
+
+    # -- router accounting -------------------------------------------------
+    def submit(self, rows: int) -> None:
+        with self._lock:
+            self.outstanding_rows += rows
+
+    def settle(self, rows: int) -> None:
+        with self._lock:
+            self.outstanding_rows = max(0, self.outstanding_rows - rows)
+
+    def estimated_wait_ms(self) -> Optional[float]:
+        """Outstanding backlog × observed per-row latency, or None before
+        any observation. An ESTIMATE for ordering/deadline hints only —
+        never a reason to hard-reject on its own."""
+        with self._lock:
+            if self.ewma_ms_per_row is None:
+                return None
+            return self.outstanding_rows * self.ewma_ms_per_row
+
+    # -- outcomes ----------------------------------------------------------
+    def on_success(self, rows: int, latency_ms: float) -> None:
+        with self._lock:
+            self._consecutive_errors = 0
+            self._consecutive_overloads = 0
+            if rows > 0:
+                per_row = latency_ms / rows
+                self.ewma_ms_per_row = (
+                    per_row if self.ewma_ms_per_row is None
+                    else 0.8 * self.ewma_ms_per_row + 0.2 * per_row
+                )
+
+    def on_overload(self) -> bool:
+        """Record one queue-full refusal; True when this trip moved the
+        replica HEALTHY -> DRAINING (the caller logs/metrics it)."""
+        with self._lock:
+            self._consecutive_overloads += 1
+            if (
+                self._state is ReplicaState.HEALTHY
+                and self._consecutive_overloads >= self.policy.overload_trip
+            ):
+                self._transition(ReplicaState.DRAINING)
+                return True
+            return False
+
+    def on_error(self, error: BaseException) -> bool:
+        """Record one dispatch failure; True when this failure crossed
+        the threshold and the replica must be RETIRED (exactly one caller
+        gets True — the CAS the pool's single-retire relies on)."""
+        with self._lock:
+            self._last_error = error
+            self._consecutive_errors += 1
+            if (
+                self._state is not ReplicaState.UNHEALTHY
+                and self._consecutive_errors
+                >= self.policy.max_consecutive_errors
+            ):
+                self._transition(ReplicaState.UNHEALTHY)
+                return True
+            return False
+
+    def maybe_rejoin(self, queued_rows: int, max_queue_rows: int) -> bool:
+        """Inline DRAINING -> HEALTHY recovery check (called by the
+        router on every pass over the replicas)."""
+        with self._lock:
+            if self._state is not ReplicaState.DRAINING:
+                return False
+            if queued_rows <= max_queue_rows * self.policy.drain_low_water:
+                self._transition(ReplicaState.HEALTHY)
+                self._consecutive_overloads = 0
+                return True
+            return False
+
+    def revive(self) -> None:
+        """Operator-driven UNHEALTHY -> HEALTHY (after the pool restarted
+        the engine)."""
+        with self._lock:
+            self._consecutive_errors = 0
+            self._consecutive_overloads = 0
+            self._last_error = None
+            self._transition(ReplicaState.HEALTHY)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "state": self._state.value,
+                "state_age_s": round(time.monotonic() - self._state_since, 3),
+                "outstanding_rows": self.outstanding_rows,
+                "consecutive_errors": self._consecutive_errors,
+                "consecutive_overloads": self._consecutive_overloads,
+                "ewma_ms_per_row": self.ewma_ms_per_row,
+                "last_error": (
+                    repr(self._last_error) if self._last_error else None
+                ),
+            }

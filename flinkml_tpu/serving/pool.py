@@ -1,0 +1,350 @@
+"""Replica pool: N serving engines behind one router — serving scale-out.
+
+The reference serves models through Flink's parallel task slots; here
+the slot is a :class:`~flinkml_tpu.serving.engine.ServingEngine` replica
+and the parallelism substrate is the device plane (ROADMAP item 3). A
+:class:`ReplicaPool` spins up one engine per **device** (the fused
+executor's single-device programs dispatch lock-free and in parallel —
+each replica's dispatcher thread owns one device via
+``jax.default_device``) or per **mesh slice** (SPMD models: each replica
+holds ``local_execution_lock(slice)`` per batch, so pools time-share
+devices with concurrent training exactly like concurrent fits do, and
+the slice locks compose through ``parallel.dispatch``'s overlap
+machinery — analyzer-checkable, FML303).
+
+What the pool adds over N independent engines:
+
+- **One front door** — :meth:`predict` routes through a
+  :class:`~flinkml_tpu.serving.router.Router`:
+  least-outstanding-rows balance, deadline-aware admission, and
+  automatic failover of pure transforms.
+- **Per-replica degradation** — a replica that trips its queue bound
+  drains and rejoins; one that fails its dispatches (e.g. the
+  ``serving.replica`` fault seam killing it mid-traffic) is retired
+  (stopped without drain, so its queued requests fail fast into the
+  router's retry) while the pool keeps serving. No global brownout.
+- **Rolling hot-swap** — :meth:`follow_registry` registers ONE pool
+  listener and rolls each publish/rollback across the replicas one at a
+  time, re-reading the registry's CURRENT pointer at every step: each
+  engine's swap is individually zero-downtime, at most one replica is
+  warming at any moment (never all down at once), and a rollback racing
+  a publish converges every replica to whatever the pointer last said
+  (the registry serializes deliveries and re-reads the pointer per
+  delivery, so the final roll always carries the newest version).
+
+Metrics: every replica's engine reports into ONE group
+(``serving.<pool>``) distinguished by a ``replica`` label, so
+per-replica gauges aggregate in the Prometheus exposition instead of
+colliding; pool-level routing counters live in ``serving.<pool>.router``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from flinkml_tpu.serving.engine import ServingConfig, ServingEngine
+from flinkml_tpu.serving.errors import RegistryError
+from flinkml_tpu.serving.health import HealthPolicy, ReplicaHealth, ReplicaState
+from flinkml_tpu.serving.registry import ModelRegistry
+from flinkml_tpu.serving.router import Router
+from flinkml_tpu.table import Table
+from flinkml_tpu.utils.logging import get_logger
+from flinkml_tpu.utils.metrics import metrics
+
+_log = get_logger("serving.pool")
+
+
+def slice_meshes(n_slices: int, devices: Optional[Sequence[Any]] = None
+                 ) -> List[Any]:
+    """Cut the local devices into ``n_slices`` disjoint 1-D data meshes —
+    the per-replica placement for SPMD serving models. Disjoint slices
+    get independent ``local_execution_lock``s (replicas dispatch
+    concurrently); a slice overlapping a training mesh composes every
+    intersecting lock, which is what keeps a pool safe beside training."""
+    import jax
+
+    from flinkml_tpu.parallel import DeviceMesh
+
+    if devices is None:
+        devices = jax.devices()
+    n_slices = int(n_slices)
+    if not 1 <= n_slices <= len(devices):
+        raise ValueError(
+            f"cannot cut {len(devices)} devices into {n_slices} slices"
+        )
+    if len(devices) % n_slices:
+        # Silently dropping the remainder would quietly serve on fewer
+        # devices than the operator provisioned.
+        raise ValueError(
+            f"{len(devices)} devices do not divide into {n_slices} equal "
+            f"slices; pass an explicit devices= subset"
+        )
+    per = len(devices) // n_slices
+    return [
+        DeviceMesh({DeviceMesh.DATA_AXIS: per},
+                   devices=list(devices[i * per:(i + 1) * per]))
+        for i in range(n_slices)
+    ]
+
+
+@dataclasses.dataclass
+class Replica:
+    """One pool slot: a named engine plus its health ledger."""
+
+    name: str
+    engine: ServingEngine
+    health: ReplicaHealth
+    device: Optional[Any] = None
+    mesh: Optional[Any] = None
+
+
+class ReplicaPool:
+    """See module docstring.
+
+    ``source`` is a :class:`ModelRegistry` (versioned, rolling hot-swap)
+    or a fixed transformer stage. Placement, one of:
+
+    - default: one replica per local ``jax.Device`` (``n_replicas``
+      caps/repeats over them);
+    - ``devices=[...]``: one replica per given device;
+    - ``meshes=[...]``: one replica per mesh slice (SPMD models; each
+      engine gets ``config.mesh`` and time-shares via the slice lock —
+      build slices with :func:`slice_meshes`).
+
+    ``config`` is the per-replica engine template; per-replica queue
+    bounds apply per engine, so pool capacity is the sum.
+    ``shed_on_overload`` is forced off for replicas — a full replica
+    queue fails over to a less-loaded replica (and trips DRAINING after
+    enough refusals) instead of serving slowly on the router's thread.
+    """
+
+    def __init__(
+        self,
+        source: Union[ModelRegistry, Any],
+        example: Table,
+        *,
+        config: Optional[ServingConfig] = None,
+        n_replicas: Optional[int] = None,
+        devices: Optional[Sequence[Any]] = None,
+        meshes: Optional[Sequence[Any]] = None,
+        output_cols: Optional[Sequence[str]] = None,
+        name: str = "pool",
+        health_policy: Optional[HealthPolicy] = None,
+    ):
+        if devices is not None and meshes is not None:
+            raise ValueError("pass devices= or meshes=, not both")
+        self.name = name
+        self._registry = source if isinstance(source, ModelRegistry) else None
+        base = config or ServingConfig()
+        placements: List[Dict[str, Any]]
+        if meshes is not None:
+            placements = [{"mesh": m} for m in meshes]
+        else:
+            if devices is None:
+                import jax
+
+                devices = jax.devices()
+            n = int(n_replicas) if n_replicas is not None else len(devices)
+            if n < 1:
+                raise ValueError(f"n_replicas must be >= 1, got {n}")
+            placements = [
+                {"device": devices[i % len(devices)]} for i in range(n)
+            ]
+        self._schema = {
+            c: (np.asarray(example.column(c)).dtype,
+                np.asarray(example.column(c)).shape[1:])
+            for c in example.column_names
+        }
+        policy = health_policy or HealthPolicy()
+        self.replicas: List[Replica] = []
+        for i, place in enumerate(placements):
+            rname = f"r{i}"
+            cfg = dataclasses.replace(
+                base,
+                device=place.get("device"),
+                mesh=place.get("mesh"),
+                metrics_name=name,
+                metrics_labels={"replica": rname},
+                dispatch_tag=f"serving.pool/{name}/{rname}",
+                # Replicas never shed to the caller's host path: shedding
+                # would serve the request slowly on the ROUTER thread and
+                # hide the queue-full signal the per-replica degradation
+                # (failover -> DRAINING -> pool overload) is built on.
+                # The pool's shed path IS failover to a less-loaded
+                # replica.
+                shed_on_overload=False,
+            )
+            engine = ServingEngine(
+                source, example, cfg, output_cols=output_cols,
+                name=f"{name}/{rname}",
+            )
+            self.replicas.append(Replica(
+                name=rname, engine=engine,
+                health=ReplicaHealth(rname, policy),
+                device=place.get("device"), mesh=place.get("mesh"),
+            ))
+        self._metrics = metrics.group(f"serving.{name}.router")
+        self._router = Router(
+            self.replicas, self._rows_of, self._metrics,
+            on_retire=self._retire,
+        )
+        self._roll_lock = threading.RLock()
+        self._following = False
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ReplicaPool":
+        """Start every replica (load + per-bucket warmup, serially — each
+        replica warms its own device's executables). Returns self."""
+        for replica in self.replicas:
+            replica.engine.start()
+        self._started = True
+        self._metrics.gauge("replicas", float(len(self.replicas)))
+        self._update_health_gauge()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        if self._following and self._registry is not None:
+            self._registry.remove_listener(self._on_registry_change)
+            self._following = False
+        for replica in self.replicas:
+            replica.engine.stop(drain=drain, timeout=timeout)
+        self._started = False
+
+    # -- the request path --------------------------------------------------
+    def predict(self, features: Union[Table, Mapping[str, Any]],
+                timeout_ms: Optional[float] = None):
+        """Route one request (same contract as
+        :meth:`ServingEngine.predict`, plus failover — see
+        :class:`~flinkml_tpu.serving.router.Router`)."""
+        return self._router.predict(features, timeout_ms=timeout_ms)
+
+    def _rows_of(self, features: Union[Table, Mapping[str, Any]]) -> int:
+        try:
+            col, (_, trailing) = next(iter(self._schema.items()))
+            a = (features.column(col) if isinstance(features, Table)
+                 else features[col])
+            a = np.asarray(a)
+            return a.shape[0] if a.ndim > len(trailing) else 1
+        except Exception:  # noqa: BLE001 — schema errors surface in the engine
+            return 1
+
+    # -- degradation -------------------------------------------------------
+    def _retire(self, replica: Replica, error: BaseException) -> None:
+        """Take a failed replica out of service: stop WITHOUT drain so
+        its queued requests fail fast into the router's retry path. Runs
+        the stop off-thread — the retiring router thread must not block
+        on the dead replica's dispatcher."""
+        self._metrics.counter("replicas_retired")
+        self._update_health_gauge()
+        _log.warning(
+            "retiring replica %s/%s after %r; traffic respread over %d "
+            "healthy replicas", self.name, replica.name, error,
+            len(self.healthy_replicas()),
+        )
+
+        def _stop():
+            try:
+                replica.engine.stop(drain=False, timeout=5.0)
+            except Exception:  # noqa: BLE001 — already failed; log only
+                _log.exception("stopping retired replica %s", replica.name)
+
+        threading.Thread(
+            target=_stop, name=f"retire-{self.name}/{replica.name}",
+            daemon=True,
+        ).start()
+
+    def revive(self, replica_name: str) -> None:
+        """Operator path: restart a retired replica and rejoin rotation
+        (re-synced to the registry's current version when following)."""
+        replica = self._replica(replica_name)
+        replica.engine.start()
+        replica.health.revive()
+        self._update_health_gauge()
+        if self._following:
+            self._roll_to_current()
+
+    def healthy_replicas(self) -> List[Replica]:
+        return [
+            r for r in self.replicas
+            if r.health.state is not ReplicaState.UNHEALTHY
+        ]
+
+    def _replica(self, name: str) -> Replica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"no replica {name!r} in pool {self.name}")
+
+    def _update_health_gauge(self) -> None:
+        healthy = sum(
+            1 for r in self.replicas
+            if r.health.state is ReplicaState.HEALTHY
+        )
+        self._metrics.gauge("healthy_replicas", float(healthy))
+
+    # -- rolling hot-swap --------------------------------------------------
+    def follow_registry(self) -> "ReplicaPool":
+        """Roll every registry publish/rollback across the pool, one
+        replica at a time (see module docstring)."""
+        if self._registry is None:
+            raise RegistryError(
+                "follow_registry requires a ModelRegistry-backed pool"
+            )
+        if not self._following:
+            self._registry.add_listener(self._on_registry_change)
+            self._following = True
+        self._roll_to_current()  # catch up on anything already published
+        return self
+
+    def _on_registry_change(self, version: int) -> None:
+        self._roll_to_current()
+
+    def _roll_to_current(self) -> None:
+        with self._roll_lock:
+            for replica in self.replicas:
+                if replica.health.state is ReplicaState.UNHEALTHY:
+                    continue  # revive() re-syncs it
+                # Re-read CURRENT per step: a rollback racing this roll
+                # flips the remaining replicas to the rolled-back version
+                # mid-roll, and the rollback's own (serialized) delivery
+                # converges the early ones — last pointer wins everywhere.
+                current = self._registry.current_version()
+                if current is None:
+                    return
+                if replica.engine.active_version != current:
+                    replica.engine.swap_to(current)
+                    self._metrics.counter("rolled_swaps")
+
+    # -- observability -----------------------------------------------------
+    def versions(self) -> Dict[str, Optional[int]]:
+        return {r.name: r.engine.active_version for r in self.replicas}
+
+    def stats(self) -> Dict[str, Any]:
+        per_replica = {}
+        for r in self.replicas:
+            snap = r.engine._metrics.snapshot()
+            per_replica[r.name] = {
+                **r.health.snapshot(),
+                "engine_running": r.engine.running,
+                "active_version": r.engine.active_version,
+                "queue_depth": r.engine._batcher.queue_depth,
+                "queued_rows": r.engine._batcher.queued_rows,
+                "counters": snap["counters"],
+                "gauges": snap["gauges"],
+            }
+        return {
+            "name": self.name,
+            "replicas": len(self.replicas),
+            "healthy": len([
+                r for r in self.replicas
+                if r.health.state is ReplicaState.HEALTHY
+            ]),
+            "router": self._metrics.snapshot()["counters"],
+            "per_replica": per_replica,
+        }

@@ -1,0 +1,177 @@
+"""Request routing over a replica pool: balance, admit, fail over.
+
+The router is the pool's front door. Per request:
+
+1. **Admission** is deadline-aware: a request whose deadline already
+   passed is refused with the typed timeout BEFORE it occupies any
+   queue, and replicas are ordered so ones whose estimated backlog
+   (outstanding rows × observed ms/row EWMA) fits the remaining budget
+   come first — the estimate orders candidates, it never hard-rejects
+   (an EWMA is a hint, not a promise).
+2. **Balance** is least-outstanding-rows: among routable replicas the
+   one with the fewest submitted-but-unsettled rows wins — cheap,
+   greedy, and (unlike round-robin) automatically biased away from slow
+   or draining-adjacent replicas because their backlog settles late.
+3. **Failover**: a replica whose dispatch fails (a killed replica's
+   batches raise, a stopped engine refuses) reports to its health
+   ledger — crossing the threshold retires it via the pool callback —
+   and the request is re-run on the next candidate. Transforms are pure,
+   so a retry cannot double-apply anything; a request is retried at most
+   once per replica. Queue-full refusals fail over the same way without
+   counting as errors (and trip the replica into DRAINING after enough
+   consecutive refusals — per-replica degradation, not a global brownout).
+
+Typed outcomes: client mistakes (:class:`ServingSchemaError`) and
+deadline expiry (:class:`ServingTimeoutError`) propagate immediately —
+they would fail identically on every replica. When every candidate was
+tried: all-queues-full is :class:`ServingOverloadError` (back off and
+retry), no-routable-replica is :class:`PoolUnavailableError` (page).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from flinkml_tpu.serving.errors import (
+    PoolUnavailableError,
+    ServingOverloadError,
+    ServingSchemaError,
+    ServingTimeoutError,
+)
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.router")
+
+
+class Router:
+    """Stateless-per-request router over the pool's replicas. ``replicas``
+    is a live sequence of objects with ``.name``, ``.engine`` and
+    ``.health`` (:class:`~flinkml_tpu.serving.health.ReplicaHealth`);
+    ``rows_of`` estimates a request's row count for balance accounting;
+    ``on_retire(replica, error)`` is the pool's retirement hook (invoked
+    exactly once per replica, from whichever router thread crossed the
+    error threshold)."""
+
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        rows_of: Callable[[Any], int],
+        metrics_group,
+        on_retire: Optional[Callable[[Any, BaseException], None]] = None,
+    ):
+        self._replicas = replicas
+        self._rows_of = rows_of
+        self._metrics = metrics_group
+        self._on_retire = on_retire
+
+    # -- candidate selection -----------------------------------------------
+    def _candidates(self, tried: set) -> List[Any]:
+        out = []
+        for replica in self._replicas:
+            if replica.name in tried:
+                continue
+            health = replica.health
+            if not health.routable():
+                # Inline DRAINING -> HEALTHY recovery: rejoin once the
+                # backlog fell under the policy's low-water mark.
+                health.maybe_rejoin(
+                    replica.engine._batcher.queued_rows,
+                    replica.engine.config.max_queue_rows,
+                )
+                if not health.routable():
+                    continue
+            out.append(replica)
+        return out
+
+    def _order(self, candidates: List[Any],
+               remaining_ms: Optional[float]) -> List[Any]:
+        def backlog(r):
+            return r.health.outstanding_rows
+
+        ordered = sorted(candidates, key=backlog)
+        if remaining_ms is None:
+            return ordered
+        fits, tight = [], []
+        for r in ordered:
+            est = r.health.estimated_wait_ms()
+            (fits if est is None or est <= remaining_ms else tight).append(r)
+        return fits + tight
+
+    # -- the request path --------------------------------------------------
+    def predict(self, features: Any, timeout_ms: Optional[float] = None):
+        t0 = time.monotonic()
+        deadline = t0 + timeout_ms / 1000.0 if timeout_ms is not None else None
+        rows = self._rows_of(features)
+        self._metrics.counter("routed_requests")
+        self._metrics.counter("routed_rows", float(rows))
+        tried: set = set()
+        last_overload: Optional[BaseException] = None
+        last_failure: Optional[BaseException] = None
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                self._metrics.counter("admission_timeouts")
+                raise ServingTimeoutError(
+                    f"request deadline ({timeout_ms}ms) expired at pool "
+                    "admission"
+                )
+            remaining_ms = (
+                None if deadline is None
+                else (deadline - time.monotonic()) * 1000.0
+            )
+            candidates = self._order(self._candidates(tried), remaining_ms)
+            if not candidates:
+                break
+            replica = candidates[0]
+            health = replica.health
+            health.submit(rows)
+            attempt_t0 = time.monotonic()
+            try:
+                resp = replica.engine.predict(
+                    features, timeout_ms=remaining_ms
+                )
+            except ServingSchemaError:
+                raise  # client mistake: identical on every replica
+            except ServingTimeoutError:
+                raise  # the deadline contract outranks failover
+            except ServingOverloadError as e:
+                last_overload = e
+                tried.add(replica.name)
+                self._metrics.counter("overload_reroutes")
+                if health.on_overload():
+                    self._metrics.counter("replicas_draining")
+                    _log.warning(
+                        "replica %s tripped its queue bound -> DRAINING",
+                        replica.name,
+                    )
+                continue
+            except BaseException as e:  # noqa: BLE001 — replica failure
+                last_failure = e
+                tried.add(replica.name)
+                self._metrics.counter("failovers")
+                if health.on_error(e):
+                    _log.warning(
+                        "replica %s failed dispatch (%r) -> UNHEALTHY",
+                        replica.name, e,
+                    )
+                    if self._on_retire is not None:
+                        self._on_retire(replica, e)
+                continue
+            finally:
+                health.settle(rows)
+            # Per-ATTEMPT latency: time spent failing over on earlier
+            # replicas must not inflate this replica's backlog estimate.
+            health.on_success(rows, (time.monotonic() - attempt_t0) * 1000.0)
+            if tried:
+                self._metrics.counter("retried_successes")
+            return resp
+        if last_overload is not None:
+            self._metrics.counter("pool_overloads")
+            raise ServingOverloadError(
+                "every healthy replica's queue is full; retry with backoff"
+            ) from last_overload
+        self._metrics.counter("pool_unavailable")
+        raise PoolUnavailableError(
+            "no healthy replica available"
+            + (f" (last failure: {last_failure!r})" if last_failure else "")
+        ) from last_failure

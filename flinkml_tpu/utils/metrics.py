@@ -49,10 +49,19 @@ class Meter:
 
 
 class MetricGroup:
-    """Named scope of counters/gauges/meters/histories (thread-safe)."""
+    """Named scope of counters/gauges/meters/histories (thread-safe).
 
-    def __init__(self, name: str):
+    ``labels`` are extra Prometheus label pairs attached to every sample
+    the group emits in :meth:`MetricsRegistry.render_text` — e.g. the
+    serving pool registers one group per replica under the SAME group
+    name with ``labels={"replica": "r3"}``, so per-replica gauges
+    aggregate as one labeled family instead of colliding in a flat
+    namespace (``flinkml_p50_ms{group="serving.pool",replica="r3"}``).
+    """
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
         self.name = name
+        self.labels: Dict[str, str] = dict(labels or {})
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = collections.defaultdict(float)
         self._gauges: Dict[str, Any] = {}
@@ -102,18 +111,33 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._groups: Dict[str, MetricGroup] = {}
+        # key: (name, sorted label items) — label-less groups keep the
+        # plain name as their snapshot key, so existing consumers see
+        # exactly the old namespace.
+        self._groups: Dict[Any, MetricGroup] = {}
 
-    def group(self, name: str) -> MetricGroup:
+    def group(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> MetricGroup:
+        key = (name, tuple(sorted((labels or {}).items())))
         with self._lock:
-            if name not in self._groups:
-                self._groups[name] = MetricGroup(name)
-            return self._groups[name]
+            if key not in self._groups:
+                self._groups[key] = MetricGroup(name, labels)
+            return self._groups[key]
+
+    @staticmethod
+    def _qualified(g: MetricGroup) -> str:
+        if not g.labels:
+            return g.name
+        inner = ",".join(
+            f'{k}="{_escape_label(str(v))}"'
+            for k, v in sorted(g.labels.items())
+        )
+        return f"{g.name}{{{inner}}}"
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
-            groups = dict(self._groups)
-        return {name: g.snapshot() for name, g in groups.items()}
+            groups = list(self._groups.values())
+        return {self._qualified(g): g.snapshot() for g in groups}
 
     def dump_json(self) -> str:
         return json.dumps(self.snapshot(), default=str, sort_keys=True)
@@ -132,10 +156,15 @@ class MetricsRegistry:
         :meth:`snapshot` for those). Output is sorted, so diffs are
         stable. This backs the serving engine's stats dump; wire it to
         an HTTP endpoint for a real scrape target.
+
+        A group's extra ``labels`` (see :class:`MetricGroup`) render as
+        additional label pairs after ``group=``, e.g.::
+
+            flinkml_queue_depth{group="serving.pool",replica="r3"} 2
         """
         with self._lock:
-            groups = dict(self._groups)
-        # metric name -> (prom type, [(group label, value)])
+            groups = list(self._groups.values())
+        # metric name -> (prom type, [(rendered label set, value)])
         samples: Dict[str, Any] = {}
 
         def add(name: str, kind: str, group: str, value: float) -> None:
@@ -151,29 +180,32 @@ class MetricsRegistry:
                 entry = samples.setdefault(name, (kind, []))
             entry[1].append((group, value))
 
-        for gname, g in sorted(groups.items()):
+        for g in sorted(groups, key=self._qualified):
+            pairs = [("group", g.name)] + sorted(g.labels.items())
+            labelset = ",".join(
+                f'{k}="{_escape_label(str(v))}"' for k, v in pairs
+            )
             snap = g.snapshot()
             for k, v in snap["counters"].items():
-                add(f"flinkml_{_sanitize(k)}", "counter", gname, v)
+                add(f"flinkml_{_sanitize(k)}", "counter", labelset, v)
             for k, v in snap["gauges"].items():
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
                     continue
-                add(f"flinkml_{_sanitize(k)}", "gauge", gname, v)
+                add(f"flinkml_{_sanitize(k)}", "gauge", labelset, v)
             for k, rate in snap["meters"].items():
-                add(f"flinkml_{_sanitize(k)}_rate", "gauge", gname, rate)
+                add(f"flinkml_{_sanitize(k)}_rate", "gauge", labelset, rate)
         lines: List[str] = []
         for name in sorted(samples):
             kind, values = samples[name]
             lines.append(f"# TYPE {name} {kind}")
-            for group, value in sorted(values):
-                label = _escape_label(group)
+            for labelset, value in sorted(values):
                 # Full precision: '%g' would truncate counters past 6
                 # significant digits (1_234_567 -> 1.23457e+06).
                 rendered = (
                     str(int(value)) if float(value).is_integer()
                     else repr(float(value))
                 )
-                lines.append(f'{name}{{group="{label}"}} {rendered}')
+                lines.append(f"{name}{{{labelset}}} {rendered}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:

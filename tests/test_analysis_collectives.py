@@ -31,6 +31,7 @@ from flinkml_tpu.parallel.dispatch import (
 
 DEADLOCK_TRACE = "tests/analysis_fixtures/kmeans_threaded_deadlock.trace.json"
 LOCKED_TRACE = "tests/analysis_fixtures/kmeans_threaded_locked.trace.json"
+POOL_TRACE = "tests/analysis_fixtures/pool_slice_unlocked.trace.json"
 
 
 # ---------------------------------------------------------------------------
@@ -114,6 +115,79 @@ def test_dispatch_trace_rules():
     assert check_dispatch_trace(
         [ev("a", (0, 1), ["L1"]), ev("b", (1, 2), ["L2"])]
     )
+
+
+def test_pool_slice_overlap_fml303():
+    """The FML302 pair machinery specializes to FML303 when one side is
+    a serving replica-pool slice dispatch (program prefix
+    ``serving.pool/``): the unlocked shape is flagged with the
+    pool-specific rule and fix hint, a shared slice lock clears it, and
+    the seeded bad-trace fixture is flagged through the file loader."""
+    def ev(thread, program, devices, locks=()):
+        return DispatchEvent(thread=thread, program=program,
+                             devices=devices, locks=tuple(locks))
+
+    pool_ev = ev("serving-p0/r0", "serving.pool/p0/r0.batch", (0, 1))
+    train = ev("trainer", "kmeans.lloyd_epoch", (0, 1, 2, 3),
+               ["lock:mesh:0,1,2,3"])
+    findings = check_dispatch_trace([pool_ev, train])
+    assert [f.rule for f in findings] == ["FML303"]
+    assert "serving.pool/p0/r0.batch" in findings[0].message
+    assert "slice" in findings[0].fix_hint
+
+    # The replica holding its slice lock composes with the overlapping
+    # training lock (overlap => the trainer's composite includes it).
+    locked_pool = ev("serving-p0/r0", "serving.pool/p0/r0.batch", (0, 1),
+                     ["lock:mesh:0,1"])
+    locked_train = ev("trainer", "kmeans.lloyd_epoch", (0, 1, 2, 3),
+                      ["lock:mesh:0,1,2,3", "lock:mesh:0,1"])
+    assert check_dispatch_trace([locked_pool, locked_train]) == []
+
+    # Single-device replicas dispatch no collectives: never flagged.
+    assert check_dispatch_trace(
+        [ev("serving-p0/r0", "serving.pool/p0/r0.batch", (0,)), train]
+    ) == []
+
+    # Two pool replicas over overlapping slices without a shared lock is
+    # the same hazard (a misconfigured pool): also FML303.
+    other = ev("serving-p0/r1", "serving.pool/p0/r1.batch", (1, 2))
+    assert [f.rule for f in check_dispatch_trace([pool_ev, other])] == [
+        "FML303"
+    ]
+
+    fixture = load_trace(POOL_TRACE)
+    flagged = check_dispatch_trace(fixture, location=POOL_TRACE)
+    assert [f.rule for f in flagged] == ["FML303"]
+
+
+def test_local_execution_lock_accepts_device_sequences():
+    """Per-slice lock composition without a mesh object: a plain device
+    (id) sequence keys the same tracked lock as an identical mesh set,
+    so pool replicas and trainers compose through one registry."""
+    locks_before = set(dispatch._MESH_LOCKS)
+    try:
+        lock_a = local_execution_lock([901, 902])
+        lock_b = local_execution_lock((902, 901))
+        with lock_a:
+            tokens = held_lock_tokens()
+            assert "lock:mesh:901,902" in tokens
+        # Identical set -> the same TrackedRLock instance.
+        assert lock_a is lock_b or getattr(lock_a, "token", None) == getattr(
+            lock_b, "token", None
+        )
+        # Overlapping sets compose: acquiring the overlap holds both
+        # tokens.
+        composite = local_execution_lock([902, 903])
+        with composite:
+            tokens = held_lock_tokens()
+            assert "lock:mesh:901,902" in tokens
+            assert "lock:mesh:902,903" in tokens
+    finally:
+        # The fake id sets must not linger in the process-wide registry
+        # (a global-lock holder would acquire them forever after).
+        with dispatch._MESH_LOCKS_GUARD:
+            for key in set(dispatch._MESH_LOCKS) - locks_before:
+                del dispatch._MESH_LOCKS[key]
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,10 @@ The acceptance contract of the serving subsystem:
   5. Hot swap mid-traffic: every response carries the version that served
      it, and responses verify bitwise against THAT version's model — no
      dropped and no mis-versioned responses across the swap.
+  6. Pool rolling swaps under racing registry writes: a rollback racing a
+     publish across a following ReplicaPool converges EVERY replica to
+     the registry's final CURRENT pointer, with zero mis-versioned
+     responses throughout.
 """
 
 import threading
@@ -379,3 +383,94 @@ def test_hot_swap_mid_traffic_no_misversioned_responses(tmp_path):
         assert versions_seen == {1, 2}
     finally:
         eng.stop()
+
+
+def test_pool_rollback_races_publish_converges(tmp_path):
+    """A rollback racing a publish across a following 3-replica pool:
+    whatever order the registry commits them, every replica must converge
+    to the FINAL CURRENT pointer (the registry serializes listener
+    deliveries and re-reads the pointer per delivery; the pool's rolling
+    swap re-reads it per replica), and every response served throughout
+    must verify bitwise against the model of the version it claims."""
+    from flinkml_tpu.serving import ReplicaPool
+
+    x, y = _data()
+    pm1 = _three_stage_chain(x, y)
+    pm2 = _three_stage_chain(x, -y + 1)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(pm1)
+    models = {1: pm1, 2: pm2}
+    pool = ReplicaPool(
+        reg, Table({"features": x[:4]}),
+        config=ServingConfig(max_batch_rows=64, max_queue_rows=512,
+                             max_wait_ms=1.0),
+        n_replicas=3, output_cols=("prediction",), name="race_pool",
+    ).start()
+    pool.follow_registry()
+    errors = []
+    versions_seen = set()
+    done = [0]
+    stop = threading.Event()
+
+    def client(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            while not stop.is_set():
+                rows = int(rng.integers(1, 9))
+                lo = int(rng.integers(0, x.shape[0] - rows))
+                sl = x[lo:lo + rows]
+                resp = pool.predict({"features": sl})
+                versions_seen.add(resp.version)
+                (ref,) = models[resp.version].transform(
+                    Table({"features": sl})
+                )
+                np.testing.assert_array_equal(
+                    ref.column("prediction"), resp.column("prediction")
+                )
+                done[0] += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    def publisher():
+        reg.publish(pm2)
+
+    def rollbacker():
+        # Spin until v2 exists, then roll back — racing the publish's
+        # listener delivery (and the pool's roll) as closely as possible.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if 2 in reg.versions():
+                reg.rollback(1)
+                return
+            time.sleep(0.0005)
+
+    try:
+        clients = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        for t in clients:
+            t.start()
+        time.sleep(0.2)
+        tp = threading.Thread(target=publisher)
+        tr = threading.Thread(target=rollbacker)
+        tp.start()
+        tr.start()
+        tp.join(timeout=120)
+        tr.join(timeout=120)
+        assert not tp.is_alive() and not tr.is_alive()
+        time.sleep(0.3)  # let the last (serialized) delivery finish
+        stop.set()
+        for t in clients:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in clients)
+        assert not errors, errors[:3]
+        final = reg.current_version()
+        assert final == 1  # the rollback ran after the publish committed
+        assert pool.versions() == {"r0": final, "r1": final, "r2": final}, (
+            "replicas did not converge to the registry pointer"
+        )
+        assert done[0] > 0
+        assert versions_seen <= {1, 2}
+        assert pool.predict({"features": x[:2]}).version == final
+    finally:
+        pool.stop()

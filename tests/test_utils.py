@@ -157,6 +157,35 @@ def test_render_text_sanitizes_names_and_default_registry():
     assert '{group="serving.a\\"b\\\\c"}' in reg2.render_text()
 
 
+def test_render_text_replica_labels_aggregate():
+    """Per-replica groups share ONE metric family distinguished by a
+    ``replica`` label (the serving pool's exposition) instead of
+    colliding in a flat namespace; label-less groups are unchanged."""
+    reg = MetricsRegistry()
+    for i, depth in enumerate((2, 5)):
+        g = reg.group("serving.pool", labels={"replica": f"r{i}"})
+        g.gauge("queue_depth", depth)
+        g.counter("requests", 10 * (i + 1))
+    reg.group("serving.pool").counter("requests", 7)  # pool-level, no label
+    text = reg.render_text()
+    lines = text.splitlines()
+    assert lines.count("# TYPE flinkml_queue_depth gauge") == 1
+    assert 'flinkml_queue_depth{group="serving.pool",replica="r0"} 2' in lines
+    assert 'flinkml_queue_depth{group="serving.pool",replica="r1"} 5' in lines
+    assert 'flinkml_requests{group="serving.pool",replica="r0"} 10' in lines
+    assert 'flinkml_requests{group="serving.pool",replica="r1"} 20' in lines
+    assert 'flinkml_requests{group="serving.pool"} 7' in lines
+    # Distinct label sets are distinct groups; same set is the same one.
+    a = reg.group("serving.pool", labels={"replica": "r0"})
+    assert a is reg.group("serving.pool", labels={"replica": "r0"})
+    assert a is not reg.group("serving.pool")
+    # snapshot() keys label-qualified names; plain names stay plain.
+    snap = reg.snapshot()
+    assert snap['serving.pool{replica="r0"}']["gauges"]["queue_depth"] == 2
+    assert snap["serving.pool"]["counters"]["requests"] == 7
+    assert text == reg.render_text()  # deterministic
+
+
 def test_render_text_full_precision_and_type_collisions():
     # Counters keep full precision (no %g truncation past 6 sig digits).
     reg = MetricsRegistry()
