@@ -54,6 +54,23 @@ site                      where it fires
                           raises from then on — the pool must retire it
                           and respread traffic; the chaos contract of
                           the ``serving scaleout`` CI stage)
+``train.step``            around every training step of
+                          :func:`flinkml_tpu.iteration.iterate` and
+                          ``sharding.apply.train_linear_plan`` — fired
+                          twice per step with ``phase='pre'`` (the
+                          context carries the ``batch``: a
+                          :class:`PoisonBatch` replaces it with a
+                          NaN-filled twin) and ``phase='post'`` (the
+                          context carries the post-step ``state`` and
+                          ``criteria``: :class:`NaNGrad` poisons the
+                          float state leaves, :class:`InfLoss` the
+                          loss). These faults mutate the fired context
+                          instead of raising — the numerics-sentinel
+                          seam (``flinkml_tpu.recovery``), not a crash
+                          seam; they re-fire on every visit to their
+                          batch, so only quarantining the batch heals
+                          the run (a deterministically poisoned batch,
+                          not a transient flake)
 ========================  ====================================================
 
 Arming is explicit and scoped (:func:`armed`); with **no plan armed the
@@ -424,6 +441,137 @@ class FailRendezvous(Fault):
         return f"FailRendezvous(#{self.at_count})"
 
 
+# -- train.step numerics faults ----------------------------------------------
+#
+# These do NOT raise: they corrupt the fired context in place (the seam
+# code reads the possibly-replaced values back out), modeling silent
+# numerics damage — a poisoned input batch, a NaN'd gradient, an
+# overflowed loss — that only a numerics sentinel
+# (flinkml_tpu.recovery) can catch. They key on the SOURCE batch index
+# (``source_index`` in the context: the position in the un-quarantined
+# feed, equal to the epoch until a batch is quarantined) and re-fire on
+# EVERY visit: rolling back and retrying the same batch fails the same
+# way, so the only recovery that converges is quarantining the batch —
+# which is exactly the contract the recovery engine implements.
+
+
+def _poison_float_leaves(tree):
+    """NaN-fill every floating leaf of a pytree (int/bool leaves — model
+    versions, counters — pass through untouched). Multiplying by NaN
+    preserves device placement/sharding of jax arrays."""
+    import jax
+    import numpy as np
+
+    def one(leaf):
+        if hasattr(leaf, "dtype") and np.issubdtype(
+                np.dtype(leaf.dtype), np.floating):
+            return leaf * float("nan")
+        return leaf
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _poison_batch_value(batch):
+    """A NaN-filled twin of a training batch: every float column/array
+    becomes all-NaN, non-float data and the container shape survive
+    (so shapes/buckets — and therefore compile caches — are
+    untouched)."""
+    import numpy as np
+
+    try:
+        from flinkml_tpu.table import Table
+    except ImportError:  # pragma: no cover
+        Table = None
+    if Table is not None and isinstance(batch, Table):
+        cols = {}
+        for name in batch.column_names:
+            arr = np.asarray(batch.column(name))
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = np.full_like(arr, np.nan)
+            cols[name] = arr
+        return Table(cols)
+    if isinstance(batch, dict):
+        return {k: _poison_batch_value(v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        out = [_poison_batch_value(v) for v in batch]
+        return tuple(out) if isinstance(batch, tuple) else out
+    if hasattr(batch, "dtype"):
+        return _poison_float_leaves(batch)
+    return batch
+
+
+class NaNGrad(Fault):
+    """Poison the post-step state at source batch ``at_epoch`` — the
+    scripted NaN gradient: every float leaf of the step's output state
+    becomes NaN, exactly as a NaN'd gradient propagated into the
+    parameters would leave it. Re-fires on every retry of that batch
+    (see the train.step notes above)."""
+
+    site = "train.step"
+
+    def __init__(self, at_epoch: int):
+        self.at_epoch = int(at_epoch)
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return (ctx.get("phase") == "post"
+                and ctx.get("source_index") == self.at_epoch)
+
+    def apply(self, ctx):
+        self.fired = True
+        ctx["state"] = _poison_float_leaves(ctx["state"])
+
+    def describe(self):
+        return f"NaNGrad(at_epoch={self.at_epoch})"
+
+
+class InfLoss(Fault):
+    """Overflow the step's loss to +inf at source batch ``at_epoch``
+    (the state stays finite — the overflowed-loss shape a too-hot batch
+    produces). Re-fires on every retry of that batch."""
+
+    site = "train.step"
+
+    def __init__(self, at_epoch: int):
+        self.at_epoch = int(at_epoch)
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return (ctx.get("phase") == "post"
+                and ctx.get("source_index") == self.at_epoch)
+
+    def apply(self, ctx):
+        self.fired = True
+        ctx["criteria"] = float("inf")
+
+    def describe(self):
+        return f"InfLoss(at_epoch={self.at_epoch})"
+
+
+class PoisonBatch(Fault):
+    """Replace source batch ``at_batch``'s float data with NaN before
+    the step consumes it — the scripted poisoned input (a corrupted
+    upstream record, a bad feature join). Re-fires on every retry: the
+    batch itself is bad, and only quarantining it heals the run."""
+
+    site = "train.step"
+
+    def __init__(self, at_batch: int):
+        self.at_batch = int(at_batch)
+        self.fired = False
+
+    def should_fire(self, ctx):
+        return (ctx.get("phase") == "pre"
+                and ctx.get("source_index") == self.at_batch)
+
+    def apply(self, ctx):
+        self.fired = True
+        ctx["batch"] = _poison_batch_value(ctx["batch"])
+
+    def describe(self):
+        return f"PoisonBatch(at_batch={self.at_batch})"
+
+
 class FaultPlan:
     """An ordered script of :class:`Fault`s. ``fire`` runs every matching
     fault in plan order (so ``[CorruptSnapshot(...), KillAfterCheckpoint
@@ -436,6 +584,12 @@ class FaultPlan:
         self.log: List[Tuple[str, str, Dict[str, Any]]] = []
 
     def fire(self, site: str, **ctx: Any) -> None:
+        self.fire_into(site, ctx)
+
+    def fire_into(self, site: str, ctx: Dict[str, Any]) -> None:
+        """Like :meth:`fire` but over a caller-owned context dict, so
+        mutating faults (the ``train.step`` family) can hand replaced
+        values — a poisoned batch, a NaN'd state — back to the seam."""
         for fault in self.faults:
             if fault.site == site and fault.should_fire(ctx):
                 summary = {
@@ -494,6 +648,16 @@ def fire(site: str, **ctx: Any) -> None:
         plan.fire(site, **ctx)
 
 
+def fire_into(site: str, ctx: Dict[str, Any]) -> None:
+    """Mutable-context variant of :func:`fire` for seams whose faults
+    replace values (``train.step``): the seam reads the possibly-mutated
+    entries back out of ``ctx`` after the call. Same disarmed-cost
+    contract (guard with ``faults.ACTIVE is not None`` first)."""
+    plan = ACTIVE
+    if plan is not None:
+        plan.fire_into(site, ctx)
+
+
 # -- snapshot corruption helpers --------------------------------------------
 #
 # Used by CorruptSnapshot and directly by tests/operators to simulate disk
@@ -550,3 +714,184 @@ def corrupt_latest(manager: Any, target: str = "arrays") -> int:
         os.path.join(manager.directory, f"ckpt-{epoch}"), target=target
     )
     return epoch
+
+
+# -- plan serialization (deterministic repro artifacts) ----------------------
+#
+# A FaultPlan round-trips through JSON so the chaos soak
+# (flinkml_tpu.recovery.fuzz) can COMMIT a failing schedule as a minimal
+# reproducer: deserializing builds fresh fault instances (fired flags
+# and counters reset), so a written repro replays the exact schedule.
+# Specs are derived from each fault class's __init__ signature — every
+# fault stores its constructor args under the same attribute names.
+
+
+def fault_types() -> Dict[str, type]:
+    """Every concrete :class:`Fault` subclass in this module, by name."""
+    return {
+        cls.__name__: cls
+        for cls in globals().values()
+        if isinstance(cls, type) and issubclass(cls, Fault)
+        and cls is not Fault
+    }
+
+
+def fault_to_spec(fault: Fault) -> Dict[str, Any]:
+    """``{"type": <class>, <arg>: <value>, ...}`` — the JSON-safe
+    constructor record of one fault."""
+    import inspect
+
+    spec: Dict[str, Any] = {"type": type(fault).__name__}
+    sig = inspect.signature(type(fault).__init__)
+    for name in sig.parameters:
+        if name == "self":
+            continue
+        if not hasattr(fault, name):
+            raise ValueError(
+                f"{type(fault).__name__} does not store constructor arg "
+                f"{name!r}; cannot serialize"
+            )
+        spec[name] = getattr(fault, name)
+    return spec
+
+
+def fault_from_spec(spec: Dict[str, Any]) -> Fault:
+    """Rebuild a fresh fault instance from :func:`fault_to_spec`'s
+    record (unknown types raise ``ValueError``)."""
+    kwargs = dict(spec)
+    name = kwargs.pop("type", None)
+    types = fault_types()
+    if name not in types:
+        raise ValueError(f"unknown fault type {name!r} "
+                         f"(known: {sorted(types)})")
+    return types[name](**kwargs)
+
+
+def plan_to_json(plan: FaultPlan, extra: Optional[Dict[str, Any]] = None
+                 ) -> str:
+    """Serialize ``plan`` (plan order preserved) plus optional metadata
+    — the committed-repro format of the chaos soak."""
+    import json
+
+    record = dict(extra or {})
+    record["faults"] = [fault_to_spec(f) for f in plan.faults]
+    return json.dumps(record, indent=2, sort_keys=True)
+
+
+def plan_from_json(payload: str) -> FaultPlan:
+    """Rebuild a fresh :class:`FaultPlan` from :func:`plan_to_json`
+    output (fired flags reset — the plan replays from scratch)."""
+    import json
+
+    record = json.loads(payload)
+    return FaultPlan(*[fault_from_spec(s) for s in record["faults"]])
+
+
+# -- randomized schedule sampling (the chaos-soak front end) -----------------
+
+
+class FuzzPlan:
+    """Deterministic sampler of fault schedules for the chaos soak
+    (:mod:`flinkml_tpu.recovery.fuzz`).
+
+    ``sample(i)`` derives schedule ``i`` purely from ``(seed, i)``: the
+    same (seed, index) always yields the same :class:`FaultPlan`, so a
+    soak failure is reproducible by index alone (and shrinkable to a
+    committed minimal repro — :func:`plan_to_json`). Each schedule draws
+    1–``max_faults`` faults from the catalog entries whose seam site is
+    in ``seams``, with epoch/batch triggers inside ``horizon`` (the
+    scenario's batch count).
+
+    Args:
+        seed: the soak's RNG seed.
+        seams: seam sites to sample across (default: the trainer-loop
+            seams a device-free online fit exercises — iteration.epoch,
+            rank.lost, checkpoint.write, checkpoint.committed,
+            data.read, and the train.step numerics faults).
+        budget: how many schedules a full soak runs (``schedules()``
+            yields exactly this many).
+        horizon: the scenario's batch/epoch count — triggers are
+            sampled in ``[1, horizon - 1]``.
+        max_faults: most faults per schedule.
+    """
+
+    DEFAULT_SEAMS = (
+        "iteration.epoch",
+        "rank.lost",
+        "checkpoint.write",
+        "checkpoint.committed",
+        "data.read",
+        "train.step",
+    )
+
+    def __init__(self, seed: int, seams: Optional[Tuple[str, ...]] = None,
+                 budget: int = 25, horizon: int = 10, max_faults: int = 3):
+        self.seed = int(seed)
+        self.seams = tuple(seams) if seams is not None else self.DEFAULT_SEAMS
+        self.budget = int(budget)
+        self.horizon = int(horizon)
+        self.max_faults = int(max_faults)
+        if self.horizon < 3:
+            raise ValueError(f"horizon must be >= 3, got {self.horizon}")
+        unknown = set(self.seams) - set(self._samplers())
+        if unknown:
+            raise ValueError(
+                f"no samplable faults for seam(s) {sorted(unknown)}; "
+                f"samplable: {sorted(self._samplers())}"
+            )
+
+    def _samplers(self):
+        """seam site -> list of (rng, horizon) -> Fault constructors."""
+        h = self.horizon
+
+        def epoch(rng):
+            return int(rng.integers(1, h))
+
+        return {
+            "iteration.epoch": [
+                lambda rng: RaiseAtEpoch(epoch(rng)),
+            ],
+            "rank.lost": [
+                # No watchdog in the soak scenario: a RankLost is a hard
+                # crash, exercising the restart-resume path.
+                lambda rng: RankLost(epoch(rng), rank=0),
+            ],
+            "checkpoint.write": [
+                lambda rng: TornWrite(epoch(rng)),
+            ],
+            "checkpoint.committed": [
+                lambda rng: KillAfterCheckpoint(min_epoch=epoch(rng)),
+                lambda rng: CorruptSnapshot(
+                    min_epoch=epoch(rng),
+                    target=str(rng.choice(
+                        ["arrays", "manifest", "truncate"])),
+                ),
+            ],
+            "data.read": [
+                lambda rng: RaiseAtRead(at_read=int(rng.integers(1, h))),
+            ],
+            "train.step": [
+                lambda rng: NaNGrad(epoch(rng)),
+                lambda rng: InfLoss(epoch(rng)),
+                lambda rng: PoisonBatch(int(rng.integers(0, h))),
+            ],
+        }
+
+    def sample(self, index: int) -> FaultPlan:
+        """Schedule ``index`` — deterministic in ``(seed, index)``."""
+        import numpy as np
+
+        rng = np.random.default_rng([self.seed, int(index)])
+        samplers = self._samplers()
+        n = int(rng.integers(1, self.max_faults + 1))
+        out = []
+        for _ in range(n):
+            seam = str(rng.choice(list(self.seams)))
+            maker = samplers[seam][int(rng.integers(len(samplers[seam])))]
+            out.append(maker(rng))
+        return FaultPlan(*out)
+
+    def schedules(self):
+        """Yield ``(index, FaultPlan)`` for the full ``budget``."""
+        for i in range(self.budget):
+            yield i, self.sample(i)

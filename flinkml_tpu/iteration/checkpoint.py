@@ -703,6 +703,28 @@ class CheckpointManager:
                 return epoch
         return None
 
+    def read_extra(self, epoch: int) -> dict:
+        """The ``extra`` manifest of the committed snapshot at ``epoch``
+        WITHOUT restoring arrays — pytree-structure-independent, so
+        consumers of sidecar records (the data cursor, the quarantine
+        ledger) need no knowledge of the carry's shape. Raises
+        :class:`CheckpointIntegrityError` on a damaged manifest."""
+        self._drain_quietly()
+        ckpt_dir = os.path.join(self.directory, f"ckpt-{int(epoch)}")
+        return self._read_meta(ckpt_dir).get("extra") or {}
+
+    def discard(self, epoch: int) -> None:
+        """Remove the committed snapshot at ``epoch``. For snapshots
+        known to be WORSE than absent — e.g. the recovery engine's
+        rollback walk-back found a non-finite carry committed inside a
+        sentinel interval window: left on disk it would be the newest
+        epoch a finiteness-unaware ``restore_latest`` hands a resumed
+        run. Logged; idempotent."""
+        self.wait()
+        path = os.path.join(self.directory, f"ckpt-{int(epoch)}")
+        shutil.rmtree(path, ignore_errors=True)
+        _log.warning("checkpoint discarded: epoch %s (%s)", epoch, path)
+
     def _drain_quietly(self) -> None:
         """Drain a pending async write WITHOUT re-raising its failure —
         a parked write error belongs to ``save()``, not to the

@@ -218,6 +218,20 @@ class IterationConfig:
     # loop stops cleanly, commits one final checkpoint (manager permitting)
     # and drains the watchdog's registered serving engines.
     watchdog: Optional[Any] = None
+    # Numerics sentinel (flinkml_tpu.recovery.NumericsSentinel): a fused
+    # on-device finiteness/magnitude verdict over loss + carry at every
+    # epoch boundary, BEFORE the state can be checkpointed or handed to
+    # listeners. Raises a typed NumericsError when it trips; with
+    # `recovery` set the raise is healed in-loop instead.
+    sentinel: Optional[Any] = None
+    # Self-healing policy (flinkml_tpu.recovery.RecoveryPolicy): on a
+    # sentinel verdict, roll back to the newest VALID snapshot
+    # (restore_latest walk-back), quarantine the offending source batch
+    # (ledgered in the snapshot `extra` so resume honors it), and retry
+    # with jittered backoff. Implies a default NumericsSentinel when
+    # `sentinel` is unset. See docs/development/fault_tolerance.md,
+    # "Self-healing".
+    recovery: Optional[Any] = None
 
     def __post_init__(self):
         if self.stream_resume not in ("replay", "continue"):
@@ -236,6 +250,10 @@ class IterationResult:
     # True when a PreemptionWatchdog stopped the loop early; the final
     # state was checkpointed (manager permitting) and resumes cleanly.
     preempted: bool = False
+    # The recovery session's summary when IterationConfig.recovery is
+    # set ({"rollbacks", "retries", "quarantined", "quarantine_ranges",
+    # "stopped_early"}); None otherwise.
+    recovery: Optional[dict] = None
 
 
 # ---------------------------------------------------------------------------
@@ -246,12 +264,14 @@ StepFn = Callable[..., Tuple]
 DataProvider = Union[None, Any, Callable[[int], Any], Iterable]
 
 
-def _epoch_data(data: DataProvider, epoch: int, it: Optional[Iterator]) -> Tuple[Any, bool]:
-    """Resolve the data for one epoch; returns (batch, exhausted)."""
+def _epoch_data(data: DataProvider, index: int, it: Optional[Iterator]) -> Tuple[Any, bool]:
+    """Resolve the data for one epoch; returns (batch, exhausted).
+    ``index`` is the SOURCE batch index for callable providers (equal to
+    the epoch until a quarantine skips a batch)."""
     if data is None:
         return None, False
     if callable(data):
-        batch = data(epoch)
+        batch = data(index)
         return batch, batch is None
     if it is not None:
         try:
@@ -260,6 +280,32 @@ def _epoch_data(data: DataProvider, epoch: int, it: Optional[Iterator]) -> Tuple
             return None, True
     # Static pytree: bounded replay — same data every epoch.
     return data, False
+
+
+def _source_position(delivered: int, ledger) -> int:
+    """Delivered-batch watermark -> source watermark (the quarantined
+    batches below it were read and discarded, so the feed must
+    fast-forward past them too)."""
+    if ledger is None:
+        return delivered
+    return ledger.source_position(delivered)
+
+
+def _feed_replayable(data: DataProvider, config: IterationConfig) -> bool:
+    """Whether a rollback can re-position this feed: Datasets and
+    ElasticFeeds replay from their cursor, restartable sequences and
+    callables replay by index; a live one-shot iterator (or
+    stream_resume='continue') cannot be rewound."""
+    if data is None or callable(data) or not _is_stream(data):
+        return True
+    try:
+        from flinkml_tpu.data import Dataset, ElasticFeed
+
+        if isinstance(data, (Dataset, ElasticFeed)):
+            return True
+    except ImportError:  # pragma: no cover — data subsystem always ships
+        pass
+    return isinstance(data, list) and config.stream_resume == "replay"
 
 
 def iterate(
@@ -292,7 +338,17 @@ def iterate(
             :class:`~flinkml_tpu.data.Cursor` alongside the state (in the
             snapshot's ``extra`` manifest) and a resumed run reopens the
             Dataset at the exact batch the crash interrupted.
-        config: termination + checkpointing.
+        config: termination + checkpointing + self-healing. With
+            ``config.sentinel`` set, every epoch's post-step state (and
+            loss) passes a fused on-device numerics verdict; with
+            ``config.recovery`` also set, a bad verdict is healed
+            in-loop — rollback to the newest valid snapshot, quarantine
+            of the offending source batch (the ledger rides every
+            snapshot's ``extra``, so a kill mid-recovery resumes with
+            the skips intact), jittered-backoff retry. On a retry the
+            returned ``criteria_history``/``outputs``/``epochs`` cover
+            the final attempt only; ``IterationResult.recovery``
+            carries the session summary.
         listeners: epoch-boundary callbacks.
         resume: restore (state, epoch) from ``config.checkpoint_manager``
             and continue mid-training.
@@ -300,33 +356,125 @@ def iterate(
     config = config or IterationConfig()
     state = init_state
     start_epoch = 0
+    restored = False
+    manager = config.checkpoint_manager
     if resume:
-        if config.checkpoint_manager is None:
+        if manager is None:
             raise ValueError("resume=True requires config.checkpoint_manager")
         # restore_latest verifies integrity and falls back past torn or
         # corrupt snapshots to the newest valid one (checkpoint.py).
-        restored = config.checkpoint_manager.restore_latest(like=init_state)
-        if restored is not None:
-            state, start_epoch = restored
+        r = manager.restore_latest(like=init_state)
+        if r is not None:
+            state, start_epoch = r
+            restored = True
 
+    # Quarantine ledger: honored whenever the restored snapshot recorded
+    # one (a resumed self-healed run keeps its skips even without a
+    # policy configured); owned and extended by the recovery session.
+    ledger = None
+    if restored:
+        recorded_q = (
+            getattr(manager, "last_restored_extra", None) or {}
+        ).get("quarantine")
+        if recorded_q:
+            from flinkml_tpu.recovery.policy import QuarantineLedger
+
+            ledger = QuarantineLedger.from_json_dict(recorded_q)
+
+    sentinel = config.sentinel
+    session = None
+    if config.recovery is not None:
+        from flinkml_tpu.recovery.engine import RecoverySession
+        from flinkml_tpu.recovery.policy import QuarantineLedger
+        from flinkml_tpu.recovery.sentinel import NumericsSentinel
+
+        if sentinel is None:
+            sentinel = NumericsSentinel()
+        if ledger is None:
+            ledger = QuarantineLedger()
+        session = RecoverySession(
+            config.recovery, manager, sentinel, ledger, init_state,
+            replayable=_feed_replayable(data, config),
+            initially_restored=restored,
+        )
+
+    initial_epoch = start_epoch
+    while True:
+        try:
+            result = _run_attempt(
+                step_fn, state, data, config, listeners, start_epoch,
+                restored, sentinel, ledger, session,
+            )
+            if session is not None:
+                result.recovery = session.summary()
+            return result
+        except RuntimeError as err:
+            if session is None:
+                raise
+            from flinkml_tpu.recovery.sentinel import NumericsError
+
+            if not isinstance(err, NumericsError):
+                raise
+            verb, state, start_epoch, restored = session.handle(err)
+            if verb == "stop":
+                # stop_at_last_valid: terminate with the newest valid
+                # model (already durable on disk — no terminal rewrite).
+                for listener in listeners:
+                    listener.on_iteration_terminated(state)
+                return IterationResult(
+                    state=state,
+                    epochs=max(0, start_epoch - initial_epoch),
+                    criteria_history=[],
+                    outputs=[],
+                    preempted=False,
+                    recovery=session.summary(),
+                )
+
+
+def _run_attempt(
+    step_fn: StepFn,
+    state: Any,
+    data: DataProvider,
+    config: IterationConfig,
+    listeners: Sequence[IterationListener],
+    start_epoch: int,
+    restored: bool,
+    sentinel,
+    ledger,
+    session=None,
+) -> IterationResult:
+    """One pass of the epoch loop from ``start_epoch`` (the whole run
+    when no recovery retry intervenes). ``ledger`` batches are read past
+    and never stepped; ``sentinel`` verdicts raise before the state can
+    be checkpointed or handed to listeners."""
+    source_skip = _source_position(start_epoch, ledger)
     data_iter: Optional[Iterator] = None
     dataset_iter = None  # tracked flinkml_tpu.data iterator (cursor owner)
+    # Source index of the NEXT batch to pull (None: no positional stream
+    # — static/None data, or a live 'continue' stream whose indices are
+    # unknowable, where quarantine does not apply).
+    src_index: Optional[int] = None
     if data is not None and not callable(data) and _is_stream(data):
-        dataset_iter = _open_dataset(data, start_epoch, config)
+        dataset_iter = _open_dataset(data, start_epoch, config, ledger)
         if dataset_iter is not None:
             data_iter = dataset_iter
+            src_index = source_skip
         else:
             data_iter = iter(data)
             if config.stream_resume == "replay":
                 # The iterable restarts from the beginning: fast-forward
-                # past the epochs the pre-failure run consumed. For a live
+                # past the batches the pre-failure run consumed —
+                # delivered epochs PLUS quarantined skips. For a live
                 # one-shot stream this would drop real data — set
                 # stream_resume='continue' there.
-                for _ in range(start_epoch):
+                for _ in range(source_skip):
                     try:
                         next(data_iter)
                     except StopIteration:
                         break
+                src_index = source_skip
+    elif callable(data):
+        src_index = source_skip
 
     criteria_history: List[Optional[float]] = []
     outputs: List[Any] = []
@@ -335,7 +483,7 @@ def iterate(
     preempted = False
     # The last epoch a snapshot committed for (resume counts: the restored
     # epoch IS on disk) — lets the terminal save skip redundant rewrites.
-    last_saved = start_epoch if (resume and start_epoch > 0) else None
+    last_saved = start_epoch if (restored and start_epoch > 0) else None
     from flinkml_tpu.utils import preemption
 
     watchdog = (
@@ -366,9 +514,29 @@ def iterate(
                 # back.
                 preempted = True
                 break
-            batch, exhausted = _epoch_data(data, epoch, data_iter)
+            if src_index is None:
+                batch, exhausted = _epoch_data(data, epoch, data_iter)
+                idx = None
+            else:
+                while True:
+                    batch, exhausted = _epoch_data(data, src_index, data_iter)
+                    if exhausted:
+                        idx = None
+                        break
+                    idx, src_index = src_index, src_index + 1
+                    if ledger is None or idx not in ledger:
+                        break
+                    # Quarantined source batch: read past (advancing the
+                    # cursor watermark), never stepped, never an epoch.
             if exhausted:
                 break
+
+            if faults.ACTIVE is not None and data is not None:
+                # train.step pre seam: a PoisonBatch replaces the batch.
+                fctx = {"phase": "pre", "epoch": epoch,
+                        "source_index": idx, "batch": batch}
+                faults.fire_into("train.step", fctx)
+                batch = fctx["batch"]
 
             if data is None:
                 result = step_fn(state, epoch)
@@ -382,7 +550,21 @@ def iterate(
                 state, criteria, output = result
                 outputs.append(output)
 
+            if faults.ACTIVE is not None:
+                # train.step post seam: NaNGrad poisons the state,
+                # InfLoss the loss.
+                fctx = {"phase": "post", "epoch": epoch,
+                        "source_index": idx, "state": state,
+                        "criteria": criteria}
+                faults.fire_into("train.step", fctx)
+                state, criteria = fctx["state"], fctx["criteria"]
+
             criteria_value = None if criteria is None else float(criteria)
+            if sentinel is not None:
+                # The numerics verdict — BEFORE the state can be
+                # checkpointed, published (listeners), or counted.
+                sentinel.check(state, criteria_value, epoch=epoch,
+                               source_index=idx)
             if criteria_value is None:
                 guard.after_dispatch(state)
             criteria_history.append(criteria_value)
@@ -400,9 +582,13 @@ def iterate(
                 and epoch % config.checkpoint_interval == 0
             ):
                 config.checkpoint_manager.save(
-                    state, epoch, extra=_cursor_extra(dataset_iter)
+                    state, epoch, extra=_snapshot_extra(dataset_iter, ledger)
                 )
                 last_saved = epoch
+                if session is not None:
+                    # This run's commit: a legitimate rollback target
+                    # (even over a dirty pre-existing directory).
+                    session.note_saved(epoch)
     finally:
         # A Dataset's prefetch stage runs a worker thread; an injected
         # crash (or any raise) must not strand it. close() is idempotent
@@ -420,7 +606,7 @@ def iterate(
         # contract; single-process commit — the hand-rolled multi-process
         # loops go through checkpoint.save_agreed instead).
         config.checkpoint_manager.save(
-            state, epoch, extra=_cursor_extra(dataset_iter)
+            state, epoch, extra=_snapshot_extra(dataset_iter, ledger)
         )
     if config.checkpoint_manager is not None and hasattr(
         config.checkpoint_manager, "wait"
@@ -443,7 +629,8 @@ def iterate(
     )
 
 
-def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
+def _open_dataset(data: Any, start_epoch: int, config: IterationConfig,
+                  ledger=None):
     """When ``data`` is a :class:`flinkml_tpu.data.Dataset` (or an
     :class:`~flinkml_tpu.data.ElasticFeed` — the world-parallel
     global-order feed), open a TRACKED iteration positioned at
@@ -461,6 +648,12 @@ def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
     checkpoint saves below), it seeds the reopen; the restored epoch
     stays authoritative if the two disagree (the cursor may be from an
     in-flight write the epoch superseded).
+
+    ``ledger`` (a quarantine ledger) converts the delivered epoch into
+    the SOURCE watermark the reopened feed must fast-forward to:
+    delivered batches plus every quarantined batch interleaved below
+    them (those were read and discarded, and the cursor counts them) —
+    "advancing the cursor watermark past the quarantined range".
     """
     try:
         from flinkml_tpu.data import Cursor, Dataset, ElasticFeed
@@ -468,6 +661,7 @@ def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
         return None
     if not isinstance(data, (Dataset, ElasticFeed)):
         return None
+    expected_source = _source_position(start_epoch, ledger)
     cursor = None
     if start_epoch > 0:
         extra = getattr(
@@ -476,7 +670,7 @@ def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
         recorded = extra.get("data_cursor")
         if recorded is not None:
             cursor = Cursor.from_json_dict(recorded)
-            if cursor.emitted != start_epoch:
+            if cursor.emitted != expected_source:
                 # The restored epoch stays authoritative; shift the
                 # recorded global watermark by the same number of
                 # lockstep rounds (one batch per shard per round; a
@@ -486,22 +680,27 @@ def _open_dataset(data: Any, start_epoch: int, config: IterationConfig):
                     per_round = (cursor.num_shards
                                  if cursor.shard_index is not None
                                  and cursor.num_shards is not None else 1)
-                    watermark += (start_epoch - cursor.emitted) * per_round
+                    watermark += (expected_source - cursor.emitted) * per_round
                 cursor = dataclasses.replace(
-                    cursor, emitted=start_epoch, global_watermark=watermark
+                    cursor, emitted=expected_source,
+                    global_watermark=watermark,
                 )
         else:
-            cursor = Cursor(emitted=start_epoch)
+            cursor = Cursor(emitted=expected_source)
     return data.iterate(cursor)
 
 
-def _cursor_extra(dataset_iter) -> Optional[dict]:
-    """The checkpoint ``extra`` payload carrying the input-pipeline
-    cursor (None for non-Dataset streams — the manifest stays as
-    before)."""
-    if dataset_iter is None:
-        return None
-    return {"data_cursor": dataset_iter.cursor().to_json_dict()}
+def _snapshot_extra(dataset_iter, ledger=None) -> Optional[dict]:
+    """The checkpoint ``extra`` payload: the input-pipeline cursor (for
+    Dataset streams) and the quarantine ledger (when any batch is
+    quarantined) — the two records a resumed run needs to reconstruct
+    the exact delivered sequence."""
+    extra: dict = {}
+    if dataset_iter is not None:
+        extra["data_cursor"] = dataset_iter.cursor().to_json_dict()
+    if ledger:
+        extra["quarantine"] = ledger.to_json_dict()
+    return extra or None
 
 
 def _is_stream(data: Any) -> bool:

@@ -32,7 +32,14 @@ def peek_stream(batches) -> Tuple[Optional[Any], Any]:
       global-order feed) follows the Dataset contract — peeked with a
       throwaway iteration, handed to ``iterate`` whole so its GLOBAL
       cursor (and the elastic reshard on resume) belongs to the runtime;
-    - a plain iterable is peeked destructively and re-chained.
+    - a LIST of batches is peeked in place and handed to ``iterate`` AS
+      the list — ``iterate`` re-iterates it from the start (its replay
+      fast-forward handles positioning), which is also what lets the
+      self-healing recovery loop re-open it after a rollback (a chained
+      one-shot iterator could never be rewound). Lists only: the
+      runtime's stream detection treats a tuple as a static pytree, so
+      a tuple feed must keep the chained-iterator path;
+    - any other iterable is peeked destructively and re-chained.
     """
     try:
         from flinkml_tpu.data import Dataset, ElasticFeed
@@ -40,6 +47,10 @@ def peek_stream(batches) -> Tuple[Optional[Any], Any]:
         Dataset = ElasticFeed = None
     if Dataset is not None and isinstance(batches, (Dataset, ElasticFeed)):
         return batches.peek(), batches
+    if isinstance(batches, list):
+        if not batches:
+            return None, iter(())
+        return batches[0], batches
     import itertools
 
     it = iter(batches)

@@ -117,6 +117,8 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         checkpoint_interval: int = 0,
         resume: bool = False,
         stream_resume: str = "replay",
+        sentinel=None,
+        recovery=None,
     ) -> "OnlineKMeansModel":
         """One decayed centroid update per arriving batch.
 
@@ -129,6 +131,13 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         ``'continue'`` consumes a live stream from the front. See
         ``docs/development/fault_tolerance.md``.
 
+        Self-healing (ISSUE 9): ``sentinel`` /``recovery`` thread the
+        numerics sentinel and the rollback-and-quarantine policy of
+        :mod:`flinkml_tpu.recovery` through the loop — a NaN'd batch is
+        quarantined and the fit converges to the model the same stream
+        without that batch produces (see the OnlineLogisticRegression
+        docstring and ``fault_tolerance.md``, "Self-healing").
+
         Multi-process (round 4): each process feeds its OWN arriving
         stream partition; every update is one psum'd global assignment
         pass in SPMD lockstep (``stream_sync.synced_stream``), initial
@@ -140,11 +149,12 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         features_col = self.get(self.FEATURES_COL)
         rng = np.random.default_rng(self.get_seed())
         if jax.process_count() > 1:
-            if checkpoint_manager is not None or resume:
+            if (checkpoint_manager is not None or resume
+                    or sentinel is not None or recovery is not None):
                 raise NotImplementedError(
-                    "checkpoint/resume for the multi-process online stream "
-                    "path is not wired yet; run the checkpointing fit "
-                    "single-process"
+                    "checkpoint/resume and sentinel/recovery for the "
+                    "multi-process online stream path are not wired yet; "
+                    "run the checkpointing/self-healing fit single-process"
                 )
             return self._fit_stream_multiprocess(
                 batches, k, decay, features_col, rng
@@ -219,6 +229,8 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
                 checkpoint_interval=checkpoint_interval,
                 checkpoint_manager=checkpoint_manager,
                 stream_resume=stream_resume,
+                sentinel=sentinel,
+                recovery=recovery,
             ),
             resume=resume,
         )
@@ -227,6 +239,8 @@ class OnlineKMeans(_OnlineKMeansParams, Estimator):
         model.copy_params_from(self)
         model._centroids = np.asarray(final["centroids"])
         model._model_version = int(final["version"])
+        # Self-healing record of the fit (None without a recovery policy).
+        model.recovery_summary = result.recovery
         return model
 
     def _model_from_empty_stream(

@@ -153,6 +153,8 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
         checkpoint_interval: int = 0,
         resume: bool = False,
         stream_resume: str = "replay",
+        sentinel=None,
+        recovery=None,
     ) -> "OnlineLogisticRegressionModel":
         """True unbounded mode: one FTRL update per arriving batch.
 
@@ -168,6 +170,17 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
         already-consumed batches are skipped), ``'continue'`` for live
         one-shot streams already positioned at "now".
 
+        Self-healing (ISSUE 9): ``sentinel`` (a
+        :class:`~flinkml_tpu.recovery.NumericsSentinel`) verifies the
+        carry + loss finite on-device at every epoch boundary, raising a
+        typed ``NumericsError`` before a NaN'd model can be snapshotted
+        or published; ``recovery`` (a
+        :class:`~flinkml_tpu.recovery.RecoveryPolicy`, implies a default
+        sentinel) heals the raise in-loop — rollback to the newest valid
+        snapshot, quarantine of the poisoned batch (ledgered in the
+        snapshot so resume honors it), jittered-backoff retry. See
+        ``docs/development/fault_tolerance.md`` ("Self-healing").
+
         Multi-process (round 4): each process feeds its OWN arriving
         stream partition; every update is one psum'd global FTRL step
         in SPMD lockstep (``stream_sync.synced_stream`` — exhausted
@@ -181,10 +194,12 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
         en = self.get(_OnlineLogisticRegressionParams.ELASTIC_NET)
         l1, l2 = reg * en, reg * (1.0 - en)
         if jax.process_count() > 1:
-            if checkpoint_manager is not None or resume:
+            if (checkpoint_manager is not None or resume
+                    or sentinel is not None or recovery is not None):
                 raise NotImplementedError(
-                    "checkpoint/resume for the multi-process online stream "
-                    "path is not wired yet; run the checkpointing fit "
+                    "checkpoint/resume and sentinel/recovery for the "
+                    "multi-process online stream path are not wired yet; "
+                    "run the checkpointing/self-healing fit "
                     "single-process, or use the bounded multi-process "
                     "streamed fits (train_*_stream) which support "
                     "save_agreed commits"
@@ -255,6 +270,8 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
                 checkpoint_interval=checkpoint_interval,
                 checkpoint_manager=checkpoint_manager,
                 stream_resume=stream_resume,
+                sentinel=sentinel,
+                recovery=recovery,
             ),
             resume=resume,
         )
@@ -263,6 +280,9 @@ class OnlineLogisticRegression(_OnlineLogisticRegressionParams, Estimator):
         model.copy_params_from(self)
         model._coefficient = np.asarray(final["coef"])
         model._model_version = int(final["version"])
+        # Self-healing record of the fit (None without a recovery
+        # policy): rollbacks, retries by class, quarantined batches.
+        model.recovery_summary = result.recovery
         return model
 
     def _model_from_empty_stream(

@@ -58,8 +58,16 @@ class OnlineStandardScaler(
         checkpoint_interval: int = 0,
         resume: bool = False,
         stream_resume: str = "replay",
+        sentinel=None,
+        recovery=None,
     ) -> "OnlineStandardScalerModel":
         """One exact Chan-merge per arriving batch.
+
+        Self-healing (ISSUE 9): ``sentinel``/``recovery`` thread the
+        numerics sentinel + rollback-and-quarantine policy of
+        :mod:`flinkml_tpu.recovery` through the loop (see the
+        OnlineLogisticRegression docstring and
+        ``fault_tolerance.md``, "Self-healing").
 
         Crash safety (ISSUE 4, single-process): ``checkpoint_manager`` +
         ``checkpoint_interval`` snapshot the moment carry (n, mean, M2,
@@ -110,11 +118,12 @@ class OnlineStandardScaler(
 
         multi = jax.process_count() > 1
         if multi:
-            if checkpoint_manager is not None or resume:
+            if (checkpoint_manager is not None or resume
+                    or sentinel is not None or recovery is not None):
                 raise NotImplementedError(
-                    "checkpoint/resume for the multi-process online stream "
-                    "path is not wired yet; run the checkpointing fit "
-                    "single-process"
+                    "checkpoint/resume and sentinel/recovery for the "
+                    "multi-process online stream path are not wired yet; "
+                    "run the checkpointing/self-healing fit single-process"
                 )
             # The local pass's failures are HELD: a rank-local raise would
             # strand the peers in the final merge collective.
@@ -173,18 +182,25 @@ class OnlineStandardScaler(
                 "m2": np.zeros(d),
                 "version": 0,
             }
-            final = iterate(
+            result = iterate(
                 step, state, stream,
                 IterationConfig(
                     TerminateOnMaxIter(2**31 - 1),
                     checkpoint_interval=checkpoint_interval,
                     checkpoint_manager=checkpoint_manager,
                     stream_resume=stream_resume,
+                    sentinel=sentinel,
+                    recovery=recovery,
                 ),
                 resume=resume,
-            ).state
+            )
+            final = result.state
             if float(final["n"]) == 0.0:
                 raise ValueError("training stream is empty")
+            model = self._model_from_final(final)
+            # Self-healing record of the fit (None without a policy).
+            model.recovery_summary = result.recovery
+            return model
         return self._model_from_final(final)
 
     def _model_from_final(self, final) -> "OnlineStandardScalerModel":

@@ -57,12 +57,36 @@ def _is_transient_rendezvous_error(err: BaseException) -> bool:
     return any(marker in msg for marker in _TRANSIENT_MARKERS)
 
 
+def retry_backoff_s(attempt: int, backoff_s: float,
+                    jitter: float = 0.25,
+                    rng: Optional["random.Random"] = None) -> float:
+    """The jittered exponential delay before retry ``attempt`` (1-based):
+    ``backoff_s * 2**(attempt-1) * (1 + U[0, jitter])``.
+
+    The jitter is the point: N ranks that hit the same transient
+    rendezvous failure retry in LOCKSTEP under pure exponential backoff
+    — they re-collide at the coordinator on every attempt, indefinitely.
+    A per-process uniform draw decorrelates the herd (each process seeds
+    from its own entropy), which is the standard
+    thundering-herd-breaking shape. Exposed for tests and for other
+    retry sites (the recovery engine's policy uses the same shape)."""
+    import random
+
+    if backoff_s <= 0:
+        return 0.0
+    base = backoff_s * (2 ** (max(int(attempt), 1) - 1))
+    r = (rng or random).random()
+    return base * (1.0 + max(0.0, float(jitter)) * r)
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     max_attempts: int = 3,
     backoff_s: float = 1.0,
+    backoff_jitter: float = 0.25,
+    deadline_s: Optional[float] = None,
 ) -> Tuple[int, int]:
     """Join the jax.distributed coordination service (DCN control plane).
 
@@ -74,10 +98,16 @@ def init_distributed(
 
     Transient rendezvous failures (coordinator still booting, dropped
     connections, deadline overruns — the normal churn of a pod slice
-    coming up host by host) are retried up to ``max_attempts`` times with
-    exponential backoff (``backoff_s * 2**attempt``), each attempt
-    logged; non-transient errors (bad address, rank mismatch) fail fast
-    on the first occurrence.
+    coming up host by host) are retried up to ``max_attempts`` times
+    with exponential backoff **plus per-process jitter**
+    (:func:`retry_backoff_s` — N ranks retrying in pure-exponential
+    lockstep re-collide at the coordinator indefinitely; the jitter
+    decorrelates them). ``deadline_s`` caps the TOTAL time spent
+    rendezvousing (attempts + sleeps): when the next backoff would
+    overrun it, the retry ladder stops and the last failure is raised —
+    a pod that cannot form within its startup budget should fail loudly,
+    not spin. Non-transient errors (bad address, rank mismatch) still
+    fail fast on the first occurrence.
 
     Returns ``(process_index, process_count)``.
     """
@@ -102,7 +132,10 @@ def init_distributed(
     ):
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         _enable_cpu_collectives()
+        t0 = time.monotonic()
         for attempt in range(1, max_attempts + 1):
             try:
                 jax.distributed.initialize(
@@ -117,23 +150,32 @@ def init_distributed(
                 )
                 break
             except Exception as e:  # noqa: BLE001 — classified below
+                delay = retry_backoff_s(attempt, backoff_s, backoff_jitter)
+                elapsed = time.monotonic() - t0
+                overrun = (
+                    deadline_s is not None
+                    and elapsed + delay > deadline_s
+                )
                 if (
                     attempt == max_attempts
+                    or overrun
                     or not _is_transient_rendezvous_error(e)
                 ):
                     _log.error(
-                        "rendezvous with %s failed %s (attempt %d/%d): %r",
+                        "rendezvous with %s failed %s (attempt %d/%d, "
+                        "%.1fs elapsed): %r",
                         coordinator_address,
                         "permanently" if attempt == max_attempts
-                        else "fast (non-transient)",
-                        attempt, max_attempts, e,
+                        else ("at the total deadline "
+                              f"({deadline_s}s)" if overrun
+                              else "fast (non-transient)"),
+                        attempt, max_attempts, elapsed, e,
                     )
                     raise
-                delay = backoff_s * (2 ** (attempt - 1))
                 _log.warning(
                     "transient rendezvous failure with %s (attempt %d/%d), "
-                    "retrying in %.1fs: %r", coordinator_address, attempt,
-                    max_attempts, delay, e,
+                    "retrying in %.2fs (jittered): %r", coordinator_address,
+                    attempt, max_attempts, delay, e,
                 )
                 time.sleep(delay)
     index, count = jax.process_index(), jax.process_count()

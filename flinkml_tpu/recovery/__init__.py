@@ -1,0 +1,49 @@
+"""Self-healing training: numerics sentinel, rollback-and-quarantine
+recovery, randomized chaos soak (ISSUE 9).
+
+The pieces, composed by :func:`flinkml_tpu.iteration.iterate` (and by
+the online trainers' ``fit_stream`` which thread the same knobs):
+
+- :class:`NumericsSentinel` — a fused on-device finiteness/magnitude
+  verdict over loss + carry at every epoch boundary, raising a typed
+  :class:`NumericsError` classified data-poison vs systemic;
+- :class:`RecoveryPolicy` + :class:`QuarantineLedger` — rollback to the
+  newest valid snapshot, quarantine the offending source-batch range
+  (ledgered in the snapshot ``extra`` so resume honors it), retry with
+  jittered backoff;
+- :mod:`flinkml_tpu.recovery.fuzz` — the randomized chaos soak:
+  seeded :class:`~flinkml_tpu.faults.FuzzPlan` schedules across the
+  fault seams, invariant checkers, and shrink-to-minimal-repro.
+
+See ``docs/development/fault_tolerance.md`` ("Self-healing").
+"""
+
+from flinkml_tpu.recovery.policy import (
+    ACTION_ABORT,
+    ACTION_ROLLBACK_QUARANTINE,
+    ACTION_STOP_AT_LAST_VALID,
+    QuarantineLedger,
+    RecoveryPolicy,
+)
+from flinkml_tpu.recovery.sentinel import (
+    DATA_POISON,
+    SYSTEMIC,
+    NonFiniteModelError,
+    NumericsError,
+    NumericsSentinel,
+    check_stage_finite,
+)
+
+__all__ = [
+    "ACTION_ABORT",
+    "ACTION_ROLLBACK_QUARANTINE",
+    "ACTION_STOP_AT_LAST_VALID",
+    "DATA_POISON",
+    "SYSTEMIC",
+    "NonFiniteModelError",
+    "NumericsError",
+    "NumericsSentinel",
+    "QuarantineLedger",
+    "RecoveryPolicy",
+    "check_stage_finite",
+]

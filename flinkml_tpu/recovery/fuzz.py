@@ -1,0 +1,400 @@
+"""Randomized chaos soak: sampled fault schedules, invariants, shrink.
+
+Hand-scripted fault plans only cover the interleavings someone thought
+to write down. The soak samples schedules across the fault seams
+(:class:`flinkml_tpu.faults.FuzzPlan` — deterministic in ``(seed,
+index)``), runs a real online trainer under each one with the
+self-healing machinery armed, restarts it on scripted crashes exactly
+like an orchestrator would, and asserts the recovery INVARIANTS:
+
+1. **finite** — the final model holds no non-finite value;
+2. **no silent fresh start / no mis-versioned model** — the model
+   version equals ``batches - quarantined`` (a resume that silently
+   restarted, or a poisoned batch that silently counted, both break
+   this);
+3. **parity** — the final coefficients are bit-identical to the same
+   stream trained WITHOUT the quarantined batches (the golden run);
+4. **ledger consistent** — the quarantine ledger names exactly the
+   batches the schedule's numerics faults poisoned, nothing else.
+
+A failing schedule is **shrunk** to a minimal reproducer (greedy
+delta-debugging over the fault list: drop every fault whose removal
+keeps the failure) and written as a deterministic
+:class:`~flinkml_tpu.faults.FaultPlan` JSON artifact
+(:func:`flinkml_tpu.faults.plan_to_json`) that
+:func:`flinkml_tpu.faults.plan_from_json` replays exactly.
+
+CI runs ``tools/ci.sh``'s *chaos soak* stage: a fixed-seed soak of ≥ 25
+schedules inside a wall-clock budget, plus a shrink demonstration on a
+seeded failing schedule. Run it by hand::
+
+    JAX_PLATFORMS=cpu python -m flinkml_tpu.recovery.fuzz \
+        --seed 7 --budget 25 --repro-dir /tmp/repros
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+from flinkml_tpu import faults as faults_mod
+from flinkml_tpu.recovery.policy import RecoveryPolicy
+from flinkml_tpu.recovery.sentinel import NumericsError, NumericsSentinel
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("recovery.fuzz")
+
+#: The soak scenario (small on purpose: 25+ schedules must fit a CI
+#: wall-clock budget; every jitted program is shared across schedules).
+SCENARIO_BATCHES = 10
+SCENARIO_ROWS = 32
+SCENARIO_DIM = 4
+SCENARIO_ALPHA = 0.5
+SCENARIO_INTERVAL = 2
+_POISON_FAULTS = ("NaNGrad", "InfLoss", "PoisonBatch")
+
+
+def scenario_dataset(seed: int = 0):
+    """The soak's feed: a synthetic :class:`~flinkml_tpu.data.Dataset`
+    (so the ``data.read`` seam is live), deterministic in ``seed``."""
+    from flinkml_tpu.data import Dataset
+    from flinkml_tpu.table import Table
+
+    true = np.arange(1.0, SCENARIO_DIM + 1.0)
+
+    def mk(i, rng):
+        x = rng.normal(size=(SCENARIO_ROWS, SCENARIO_DIM))
+        return Table({
+            "features": x,
+            "label": (x @ true > 0).astype(np.float64),
+        })
+
+    return Dataset.synthetic(mk, SCENARIO_BATCHES, seed=seed)
+
+
+def scenario_batches(seed: int = 0) -> List[Any]:
+    """The same feed materialized as a list (golden runs filter it)."""
+    return list(scenario_dataset(seed))
+
+
+def _fit(feed, manager, resume: bool, self_heal: bool):
+    from flinkml_tpu.models import OnlineLogisticRegression
+
+    kwargs: Dict[str, Any] = {}
+    if self_heal:
+        kwargs["recovery"] = RecoveryPolicy(backoff_s=0.0)
+        kwargs["sentinel"] = NumericsSentinel()
+    return OnlineLogisticRegression().set_alpha(SCENARIO_ALPHA).fit_stream(
+        feed, checkpoint_manager=manager,
+        checkpoint_interval=SCENARIO_INTERVAL, resume=resume, **kwargs,
+    )
+
+
+class GoldenCache:
+    """Golden models per exclusion set (the run with the quarantined
+    batches excluded), computed lazily — most schedules share the empty
+    exclusion."""
+
+    def __init__(self, seed: int = 0):
+        self._batches = scenario_batches(seed)
+        self._cache: Dict[FrozenSet[int], Any] = {}
+
+    def model(self, excluded: FrozenSet[int]):
+        key = frozenset(int(i) for i in excluded)
+        if key not in self._cache:
+            from flinkml_tpu.models import OnlineLogisticRegression
+
+            kept = [b for i, b in enumerate(self._batches)
+                    if i not in key]
+            self._cache[key] = (
+                OnlineLogisticRegression().set_alpha(SCENARIO_ALPHA)
+                .fit_stream(kept)
+            )
+        return self._cache[key]
+
+
+def expected_quarantine(plan: "faults_mod.FaultPlan") -> FrozenSet[int]:
+    """The batches a schedule's numerics faults poison — what a
+    consistent ledger must name exactly."""
+    out = set()
+    for f in plan.faults:
+        name = type(f).__name__
+        if name in ("NaNGrad", "InfLoss"):
+            out.add(int(f.at_epoch))
+        elif name == "PoisonBatch":
+            out.add(int(f.at_batch))
+    return frozenset(i for i in out if 0 <= i < SCENARIO_BATCHES)
+
+
+@dataclasses.dataclass
+class ScheduleResult:
+    index: int
+    faults: List[str]
+    ok: bool
+    failures: List[str]
+    restarts: int
+    quarantined: List[int]
+    elapsed_s: float
+
+
+def run_schedule(plan: "faults_mod.FaultPlan", golden: GoldenCache,
+                 data_seed: int = 0, self_heal: bool = True,
+                 max_restarts: int = 10) -> Tuple[Any, List[str], int]:
+    """Run the scenario under ``plan``: the trainer is restarted on
+    every scripted crash (``FaultInjected`` — the orchestrator's role),
+    numerics faults are healed in-loop when ``self_heal``. Returns
+    ``(model_or_None, invariant_failures, restarts)``."""
+    failures: List[str] = []
+    model = None
+    restarts = 0
+    with tempfile.TemporaryDirectory(prefix="fuzz-ckpt-") as td:
+        from flinkml_tpu.iteration import CheckpointManager
+        from flinkml_tpu.iteration.checkpoint import (
+            CheckpointIntegrityError,
+        )
+
+        manager = CheckpointManager(td, max_to_keep=10)
+        with faults_mod.armed(plan):
+            while True:
+                try:
+                    model = _fit(scenario_dataset(data_seed), manager,
+                                 resume=restarts > 0, self_heal=self_heal)
+                    break
+                except faults_mod.FaultInjected:
+                    restarts += 1
+                    if restarts > max_restarts:
+                        failures.append(
+                            f"did not complete within {max_restarts} "
+                            "restarts"
+                        )
+                        break
+                except NumericsError as e:
+                    failures.append(f"unhealed numerics failure: {e}")
+                    break
+        # The on-disk ledger: what the newest valid snapshot recorded
+        # (what a NEXT resume would honor). read_extra is carry-shape-
+        # independent; the epoch just passed verify(), so a failure
+        # here is a real regression in ledger persistence — recorded as
+        # an invariant failure, never a vacuously-empty disk ledger.
+        recorded = None
+        epoch = manager.newest_valid_epoch()
+        if epoch is not None:
+            try:
+                recorded = manager.read_extra(epoch).get("quarantine")
+            except CheckpointIntegrityError as e:
+                failures.append(
+                    f"snapshot {epoch} passed verify() but its extra "
+                    f"manifest is unreadable: {e}"
+                )
+    from flinkml_tpu.recovery.policy import QuarantineLedger
+
+    disk_ledger = QuarantineLedger.from_json_dict(recorded).indices()
+
+    if model is not None:
+        expected = expected_quarantine(plan) if self_heal else frozenset()
+        summary = getattr(model, "recovery_summary", None) or {}
+        quarantined = summary.get("quarantined", [])
+        if not np.isfinite(model.coefficient).all():
+            failures.append("final model is not finite")
+        want_version = SCENARIO_BATCHES - len(expected)
+        if model.model_version != want_version:
+            failures.append(
+                f"model version {model.model_version} != "
+                f"{want_version} (batches - quarantined: silent fresh "
+                "start or mis-counted poison)"
+            )
+        if self_heal:
+            # The run's quarantines carry across restarts via the
+            # snapshot ledger; the final restart's summary plus the
+            # resumed skips must name exactly the poisoned batches —
+            # read the union of the summary and the on-disk record.
+            seen = set(quarantined) | set(disk_ledger)
+            if seen != set(expected):
+                failures.append(
+                    f"quarantine ledger {sorted(seen)} != poisoned "
+                    f"batches {sorted(expected)}"
+                )
+            if not set(disk_ledger) <= set(expected):
+                failures.append(
+                    f"on-disk ledger {disk_ledger} names batches no "
+                    f"fault poisoned ({sorted(expected)})"
+                )
+        if not failures:
+            ref = golden.model(expected)
+            if not np.array_equal(model.coefficient, ref.coefficient):
+                failures.append(
+                    "final model != golden run with the quarantined "
+                    "batches excluded"
+                )
+    elif not failures:
+        failures.append("no model produced")
+    return model, failures, restarts
+
+
+def shrink_schedule(plan: "faults_mod.FaultPlan",
+                    still_fails: Callable[["faults_mod.FaultPlan"], bool]
+                    ) -> "faults_mod.FaultPlan":
+    """Greedy delta-debugging over the fault list: drop every fault
+    whose removal keeps ``still_fails`` true; repeat until stable. Each
+    probe runs a FRESH plan (fired flags reset via spec round-trip), so
+    probes never contaminate each other."""
+    specs = [faults_mod.fault_to_spec(f) for f in plan.faults]
+
+    def build(subset):
+        return faults_mod.FaultPlan(
+            *[faults_mod.fault_from_spec(dict(s)) for s in subset]
+        )
+
+    changed = True
+    while changed and len(specs) > 1:
+        changed = False
+        for i in range(len(specs)):
+            candidate = specs[:i] + specs[i + 1:]
+            if still_fails(build(candidate)):
+                specs = candidate
+                changed = True
+                break
+    return build(specs)
+
+
+@dataclasses.dataclass
+class SoakReport:
+    seed: int
+    results: List[ScheduleResult]
+    elapsed_s: float
+    budget: int
+    #: Schedules skipped because the wall-clock budget ran out (0 when
+    #: the soak covered the full budget) — never silently truncated.
+    skipped: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.skipped == 0 and all(r.ok for r in self.results)
+
+    @property
+    def failures(self) -> List[ScheduleResult]:
+        return [r for r in self.results if not r.ok]
+
+    def summary(self) -> str:
+        n_q = sum(len(r.quarantined) for r in self.results)
+        n_r = sum(r.restarts for r in self.results)
+        return (
+            f"chaos soak seed={self.seed}: {len(self.results)}/"
+            f"{self.budget} schedules, {len(self.failures)} failed, "
+            f"{n_r} restarts, {n_q} quarantined batches, "
+            f"{self.elapsed_s:.1f}s"
+            + (f" ({self.skipped} SKIPPED on wall budget)"
+               if self.skipped else "")
+        )
+
+
+def run_soak(seed: int = 7, budget: int = 25,
+             wall_budget_s: Optional[float] = None,
+             fuzz: Optional["faults_mod.FuzzPlan"] = None,
+             repro_dir: Optional[str] = None,
+             data_seed: int = 0) -> SoakReport:
+    """The full soak: ``budget`` sampled schedules, invariants asserted,
+    every failing schedule shrunk and (when ``repro_dir`` is given)
+    committed as a minimal ``FaultPlan`` JSON repro."""
+    fuzz = fuzz or faults_mod.FuzzPlan(
+        seed=seed, budget=budget, horizon=SCENARIO_BATCHES
+    )
+    golden = GoldenCache(data_seed)
+    golden.model(frozenset())  # warm the jits outside the timed window
+    t0 = time.perf_counter()
+    results: List[ScheduleResult] = []
+    skipped = 0
+    for index, plan in fuzz.schedules():
+        if (wall_budget_s is not None
+                and time.perf_counter() - t0 > wall_budget_s):
+            skipped = fuzz.budget - index
+            _log.warning(
+                "soak wall budget (%ss) exhausted at schedule %d/%d",
+                wall_budget_s, index, fuzz.budget,
+            )
+            break
+        st = time.perf_counter()
+        descs = [f.describe() for f in plan.faults]
+        _, failures, restarts = run_schedule(
+            plan, golden, data_seed=data_seed
+        )
+        # Re-read the expected set for the record (the ledger equals it
+        # on a green schedule).
+        expected = sorted(expected_quarantine(plan))
+        result = ScheduleResult(
+            index=index, faults=descs, ok=not failures,
+            failures=failures, restarts=restarts,
+            quarantined=expected if not failures else [],
+            elapsed_s=round(time.perf_counter() - st, 3),
+        )
+        results.append(result)
+        if failures:
+            _log.error("schedule %d FAILED %s: %s", index, descs, failures)
+            if repro_dir is not None:
+                minimal = shrink_schedule(
+                    plan,
+                    lambda p: bool(
+                        run_schedule(p, golden, data_seed=data_seed)[1]
+                    ),
+                )
+                os.makedirs(repro_dir, exist_ok=True)
+                path = os.path.join(
+                    repro_dir, f"fuzz_repro_seed{seed}_sched{index}.json"
+                )
+                with open(path, "w") as f:
+                    f.write(faults_mod.plan_to_json(minimal, extra={
+                        "seed": seed, "schedule": index,
+                        "failures": failures,
+                        "scenario": {
+                            "batches": SCENARIO_BATCHES,
+                            "rows": SCENARIO_ROWS,
+                            "dim": SCENARIO_DIM,
+                            "alpha": SCENARIO_ALPHA,
+                            "checkpoint_interval": SCENARIO_INTERVAL,
+                            "data_seed": data_seed,
+                        },
+                    }))
+                _log.error("minimal repro written: %s (%d -> %d faults)",
+                           path, len(plan.faults), len(minimal.faults))
+        else:
+            _log.info("schedule %d ok %s (restarts=%d)", index, descs,
+                      restarts)
+    report = SoakReport(
+        seed=seed, results=results,
+        elapsed_s=round(time.perf_counter() - t0, 2),
+        budget=fuzz.budget, skipped=skipped,
+    )
+    _log.warning("%s", report.summary())
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="flinkml_tpu chaos soak (device-free; run under "
+                    "JAX_PLATFORMS=cpu)"
+    )
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--budget", type=int, default=25)
+    parser.add_argument("--wall-budget-s", type=float, default=None)
+    parser.add_argument("--repro-dir", default=None,
+                        help="write minimal FaultPlan repros for failing "
+                             "schedules here")
+    args = parser.parse_args(argv)
+    report = run_soak(seed=args.seed, budget=args.budget,
+                      wall_budget_s=args.wall_budget_s,
+                      repro_dir=args.repro_dir)
+    print(report.summary())
+    for r in report.failures:
+        print(f"  FAILED schedule {r.index}: {r.faults} -> {r.failures}")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover — CLI shim
+    raise SystemExit(main())

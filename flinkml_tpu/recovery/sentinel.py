@@ -1,0 +1,286 @@
+"""Numerics sentinel: a fused on-device finiteness/magnitude verdict.
+
+A NaN'd model trains silently to garbage: every downstream update of a
+non-finite carry stays non-finite, the loop keeps consuming batches, and
+the damage is only discovered at serve time (if ever). The sentinel
+closes that gap at the cheapest possible point — the epoch boundary the
+loop already synchronizes at:
+
+- **one fused jitted reduction** over the loss and every float leaf of
+  the loop carry produces a single int32 verdict bitmask on device
+  (finiteness of the loss, finiteness of the state, a magnitude bound);
+- **one scalar transfer** pulls the verdict to the host. Loops that
+  already sync a host criteria every epoch (the online trainers pull
+  ``float(loss)``) pay only the tiny fused reduction — no new sync
+  point is introduced;
+- a bad verdict raises a typed :class:`NumericsError` **before** the
+  poisoned state can be checkpointed, published, or served, classified
+  as *data-poison* (non-finite loss/state right after a step — one bad
+  batch) vs *systemic* (a finite but exploding magnitude persisting
+  ``systemic_streak`` consecutive checks — divergence no single batch
+  explains).
+
+Thread it through :func:`flinkml_tpu.iteration.iterate` via
+``IterationConfig(sentinel=NumericsSentinel())`` (the online trainers
+expose the same knob on ``fit_stream``) or through the plan-sharded
+trainer via ``train_linear_plan(..., sentinel=...)``. Pair it with a
+:class:`~flinkml_tpu.recovery.RecoveryPolicy` and the raise becomes a
+self-healing rollback-and-quarantine instead of a crash
+(``docs/development/fault_tolerance.md``, "Self-healing").
+
+The registry/serving side of the same contract lives here too:
+:func:`check_stage_finite` refuses a non-finite model at
+``ModelRegistry.publish`` and at ``ServingEngine`` model install.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+
+# verdict bitmask (host-decoded from the device scalar)
+VERDICT_LOSS_NONFINITE = 1
+VERDICT_STATE_NONFINITE = 2
+VERDICT_MAGNITUDE = 4
+
+#: classification values carried by :class:`NumericsError`
+DATA_POISON = "data_poison"
+SYSTEMIC = "systemic"
+
+
+class NumericsError(RuntimeError):
+    """The sentinel's typed verdict: training numerics went bad.
+
+    Attributes:
+        classification: :data:`DATA_POISON` (non-finite loss/state right
+            after a step — one bad batch; rollback + quarantine heals
+            it) or :data:`SYSTEMIC` (persistent divergence — a bad
+            hyperparameter, a broken kernel, or a poison budget
+            exhausted; no single batch to quarantine).
+        epoch: the delivered-batch epoch the verdict fired at.
+        source_index: the SOURCE index of the batch consumed at that
+            epoch (what a quarantine excludes) — None when unknown.
+        verdict: the raw bitmask (VERDICT_* flags).
+        exact: False when the sentinel checks on an interval > 1 and the
+            offending batch is only known to lie in ``(last_clean,
+            epoch]`` — the recovery engine then rolls back and re-runs
+            with per-epoch checks to pinpoint it before quarantining.
+    """
+
+    def __init__(self, message: str, classification: str, epoch: int,
+                 source_index: Optional[int] = None, verdict: int = 0,
+                 exact: bool = True):
+        super().__init__(message)
+        self.classification = classification
+        self.epoch = int(epoch)
+        self.source_index = (None if source_index is None
+                             else int(source_index))
+        self.verdict = int(verdict)
+        self.exact = bool(exact)
+
+
+class NonFiniteModelError(NumericsError):
+    """A model with non-finite parameters reached a publish/serve
+    boundary — refused before it can be swapped into a live engine or
+    recorded as a registry version."""
+
+    def __init__(self, message: str):
+        super().__init__(message, classification=DATA_POISON, epoch=-1)
+
+
+@functools.lru_cache(maxsize=None)
+def _verdict_fn():
+    """The fused verdict program: float leaves + loss -> int32 bitmask.
+
+    jit retraces once per (leaf count, shapes, dtypes) — i.e. once per
+    training run — and the whole check is a handful of reductions fused
+    into one tiny program, so the armed cost is one dispatch + one
+    scalar device->host transfer per checked epoch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def verdict(leaves, loss, max_abs):
+        loss_ok = jnp.isfinite(loss)
+        state_ok = jnp.bool_(True)
+        mag = jnp.float32(0.0)
+        for leaf in leaves:
+            state_ok = state_ok & jnp.all(jnp.isfinite(leaf))
+            mag = jnp.maximum(
+                mag, jnp.max(jnp.abs(leaf)).astype(jnp.float32)
+            )
+        bits = jnp.where(loss_ok, 0, VERDICT_LOSS_NONFINITE)
+        bits = bits | jnp.where(state_ok, 0, VERDICT_STATE_NONFINITE)
+        bits = bits | jnp.where(
+            mag <= jnp.float32(max_abs), 0, VERDICT_MAGNITUDE
+        )
+        return bits.astype(jnp.int32)
+
+    return verdict
+
+
+def _float_leaves(state: Any):
+    import jax
+
+    return tuple(
+        leaf for leaf in jax.tree_util.tree_leaves(state)
+        if hasattr(leaf, "dtype")
+        and np.issubdtype(np.dtype(leaf.dtype), np.floating)
+    )
+
+
+class NumericsSentinel:
+    """See module docstring.
+
+    Args:
+        max_abs: magnitude bound over the state's float leaves; a finite
+            state exceeding it for ``systemic_streak`` consecutive
+            checks is classified :data:`SYSTEMIC` divergence. ``None``
+            disables the magnitude check (finiteness only).
+        systemic_streak: consecutive over-magnitude checks before the
+            systemic raise (1 = immediately).
+        interval: check every N epochs (1 = every epoch). With N > 1 a
+            detection is *inexact* — the bad batch lies somewhere in the
+            unchecked window — and the raise carries ``exact=False`` so
+            the recovery engine re-runs the window with per-epoch checks
+            to pinpoint it (``begin_pinpoint``).
+    """
+
+    def __init__(self, max_abs: Optional[float] = 1e8,
+                 systemic_streak: int = 3, interval: int = 1):
+        if interval < 1:
+            raise ValueError(f"interval must be >= 1, got {interval}")
+        if systemic_streak < 1:
+            raise ValueError(
+                f"systemic_streak must be >= 1, got {systemic_streak}"
+            )
+        self.max_abs = None if max_abs is None else float(max_abs)
+        self.systemic_streak = int(systemic_streak)
+        self.interval = int(interval)
+        self._mag_streak = 0
+        self._last_clean_epoch: Optional[int] = None
+        self._pinpoint_until: Optional[int] = None
+        #: epochs checked / raises, for tests and the recovery metrics
+        self.checks = 0
+        self.raises = 0
+
+    # -- recovery-engine hooks ----------------------------------------------
+    def begin_pinpoint(self, until_epoch: int) -> None:
+        """Force per-epoch checks through ``until_epoch`` (inclusive) —
+        the re-run after an inexact interval>1 detection."""
+        self._pinpoint_until = int(until_epoch)
+
+    def reset_streak(self) -> None:
+        """Forget magnitude-streak state (called after a rollback: the
+        restored carry predates the streak)."""
+        self._mag_streak = 0
+        self._last_clean_epoch = None
+
+    def _due(self, epoch: int) -> bool:
+        if self._pinpoint_until is not None:
+            if epoch <= self._pinpoint_until:
+                return True
+            self._pinpoint_until = None
+        return self.interval == 1 or (epoch + 1) % self.interval == 0
+
+    # -- the check -----------------------------------------------------------
+    def check(self, state: Any, criteria: Optional[float], epoch: int,
+              source_index: Optional[int] = None) -> None:
+        """Verdict over the post-step ``state`` (+ the step's loss, when
+        it returned one); raises :class:`NumericsError` on a bad one.
+        Call at the epoch boundary, BEFORE the state is checkpointed or
+        handed to listeners."""
+        if not self._due(epoch):
+            return
+        leaves = _float_leaves(state)
+        loss = 0.0 if criteria is None else criteria
+        max_abs = self.max_abs if self.max_abs is not None else np.inf
+        if leaves:
+            bits = int(_verdict_fn()(leaves, float(loss), float(max_abs)))
+        else:  # host-only carry with no float arrays: loss check only
+            bits = 0 if np.isfinite(loss) else VERDICT_LOSS_NONFINITE
+        self.checks += 1
+        exact = (
+            self.interval == 1
+            or self._pinpoint_until is not None
+            or self._last_clean_epoch == epoch - 1
+        )
+        if bits & (VERDICT_LOSS_NONFINITE | VERDICT_STATE_NONFINITE):
+            self.raises += 1
+            what = []
+            if bits & VERDICT_LOSS_NONFINITE:
+                what.append("loss")
+            if bits & VERDICT_STATE_NONFINITE:
+                what.append("state")
+            raise NumericsError(
+                f"non-finite {'/'.join(what)} at epoch {epoch} "
+                f"(source batch "
+                f"{'?' if source_index is None else source_index}"
+                f"{'' if exact else ', inexact: interval-checked'})",
+                classification=DATA_POISON, epoch=epoch,
+                source_index=source_index, verdict=bits, exact=exact,
+            )
+        if bits & VERDICT_MAGNITUDE:
+            self._mag_streak += 1
+            if self._mag_streak >= self.systemic_streak:
+                self.raises += 1
+                raise NumericsError(
+                    f"state magnitude exceeded {self.max_abs:g} for "
+                    f"{self._mag_streak} consecutive checks (epoch "
+                    f"{epoch}) — systemic divergence, not a single bad "
+                    "batch",
+                    classification=SYSTEMIC, epoch=epoch,
+                    source_index=source_index,
+                    verdict=bits, exact=exact,
+                )
+        else:
+            self._mag_streak = 0
+            self._last_clean_epoch = epoch
+
+
+# -- publish/serve boundary --------------------------------------------------
+
+
+def _iter_stage_arrays(stage: Any):
+    """Yield ``(name, array)`` for every float array a stage's model
+    data exposes. Pipelines recurse into their stages; stages without a
+    ``get_model_data`` surface (pure transforms — no learned arrays)
+    yield nothing."""
+    stages = getattr(stage, "stages", None)
+    if stages is not None and not callable(stages):
+        for i, sub in enumerate(stages):
+            for name, arr in _iter_stage_arrays(sub):
+                yield f"stage[{i}].{name}", arr
+        return
+    get_model_data = getattr(stage, "get_model_data", None)
+    if get_model_data is None:
+        return
+    try:
+        tables = get_model_data()
+    except ValueError:
+        return  # no model data set — nothing to verify
+    for t, table in enumerate(tables):
+        for col in getattr(table, "column_names", ()):
+            arr = np.asarray(table.column(col))
+            if np.issubdtype(arr.dtype, np.floating):
+                yield f"model_data[{t}].{col}", arr
+
+
+def check_stage_finite(stage: Any, where: str = "publish") -> None:
+    """Refuse a non-finite model at a publish/serve boundary: raises
+    :class:`NonFiniteModelError` naming the first bad array. Stages
+    without learned arrays pass trivially."""
+    for name, arr in _iter_stage_arrays(stage):
+        if not np.isfinite(arr).all():
+            bad = int(np.size(arr) - np.isfinite(arr).sum())
+            raise NonFiniteModelError(
+                f"refusing to {where} {type(stage).__name__}: model "
+                f"array {name!r} holds {bad} non-finite value(s) — a "
+                "NaN'd model must never reach serving (roll back to the "
+                "newest valid snapshot / registry version; see "
+                "docs/development/fault_tolerance.md, 'Self-healing')"
+            )

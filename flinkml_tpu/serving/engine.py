@@ -116,6 +116,11 @@ class ServingConfig:
     metrics_name: Optional[str] = None  # metric group name (default: name)
     metrics_labels: Optional[Dict[str, str]] = None
     dispatch_tag: Optional[str] = None  # trace program prefix override
+    # Refuse to install a model whose learned arrays hold non-finite
+    # values (NonFiniteModelError at load/swap time — the serving half
+    # of the self-healing contract; a follower's refused swap keeps the
+    # old model serving).
+    refuse_nonfinite: bool = True
 
 
 @dataclasses.dataclass
@@ -323,6 +328,17 @@ class ServingEngine:
             self._install(v, model)
 
     def _install(self, version: Optional[int], model: Any) -> None:
+        if self.config.refuse_nonfinite:
+            # Refuse BEFORE warmup/flip: a follower's failed swap keeps
+            # the previous (finite) model serving — the registry's own
+            # publish check makes this a second line of defense, not the
+            # first.
+            from flinkml_tpu.recovery.sentinel import check_stage_finite
+
+            check_stage_finite(
+                model,
+                where=f"serve (engine {self.name!r}, version {version})",
+            )
         # Warmup dispatches real transforms: SPMD engines (config.mesh)
         # must hold the mesh lock here too, or the load/swap path would
         # interleave collective rendezvous with a concurrent trainer —

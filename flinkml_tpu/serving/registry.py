@@ -128,8 +128,17 @@ class ModelRegistry:
 
     # -- writes ------------------------------------------------------------
     def publish(self, stage: Any, version: Optional[int] = None,
-                dedupe_key: Optional[str] = None) -> int:
+                dedupe_key: Optional[str] = None,
+                check_finite: bool = True) -> int:
         """Save ``stage`` as a new version and repoint ``CURRENT`` at it.
+
+        ``check_finite`` (default on) refuses a model whose learned
+        arrays hold non-finite values with a typed
+        :class:`~flinkml_tpu.recovery.NonFiniteModelError` BEFORE any
+        file is written — a NaN'd model must never become a registry
+        version a follower could hot-swap into a live engine (the
+        publish half of the self-healing contract,
+        ``docs/development/fault_tolerance.md``).
 
         Returns the assigned version. The version number is claimed by an
         atomic ``mkdir`` of the final directory — safe against concurrent
@@ -148,6 +157,12 @@ class ModelRegistry:
         SnapshotPublisher`), that version is returned and NOTHING is
         written — the resume-then-republish path cannot grow duplicate
         versions."""
+        if check_finite:
+            # Outside the lock (pure read of the stage), before the seam:
+            # a refused publish never counts as a fault-plan event.
+            from flinkml_tpu.recovery.sentinel import check_stage_finite
+
+            check_stage_finite(stage, where="publish")
         with self._lock:
             if faults.ACTIVE is not None:  # dropped-publish seam
                 faults.fire("registry.publish", root=self.root,

@@ -221,6 +221,7 @@ def train_linear_plan(
     checkpoint_manager=None,
     checkpoint_interval: int = 0,
     resume: bool = False,
+    sentinel=None,
 ) -> np.ndarray:
     """Plan-sharded linear-model training; returns the (global) host
     coefficient.
@@ -242,6 +243,15 @@ def train_linear_plan(
     :class:`~flinkml_tpu.utils.preemption.PreemptionWatchdog` exactly
     like :func:`~flinkml_tpu.iteration.iterate`: a lost peer stops the
     loop cleanly at the epoch boundary with a terminal snapshot.
+
+    ``sentinel`` (a :class:`~flinkml_tpu.recovery.NumericsSentinel`)
+    runs the same fused on-device numerics verdict as ``iterate`` over
+    the plan-SHARDED state + loss at every epoch boundary — the verdict
+    reduction shards with the state, so no gather is introduced — and
+    raises a typed ``NumericsError`` before a non-finite state can be
+    snapshotted. The loop also fires the ``train.step`` fault seam
+    (pre/post), so the NaNGrad/InfLoss/PoisonBatch chaos faults cover
+    plan-sharded training too.
     """
     from flinkml_tpu.iteration.checkpoint import begin_resume, should_snapshot
     from flinkml_tpu.utils import preemption
@@ -329,9 +339,30 @@ def train_linear_plan(
         if watchdog is not None and watchdog.requested:
             preempted = True
             break
-        state, loss_dev = step(state, *window(epoch))
+        batch = window(epoch)
+        if faults.ACTIVE is not None:
+            # train.step pre seam: a PoisonBatch replaces the (cached,
+            # device-resident) window with a NaN twin for THIS step only
+            # — the cache keeps the clean window.
+            fctx = {"phase": "pre", "epoch": epoch, "source_index": epoch,
+                    "batch": batch}
+            faults.fire_into("train.step", fctx)
+            batch = fctx["batch"]
+        state, loss_dev = step(state, *batch)
+        if faults.ACTIVE is not None:
+            # train.step post seam: NaNGrad poisons the sharded state,
+            # InfLoss the loss.
+            fctx = {"phase": "post", "epoch": epoch, "source_index": epoch,
+                    "state": state, "criteria": loss_dev}
+            faults.fire_into("train.step", fctx)
+            state, loss_dev = fctx["state"], fctx["criteria"]
         epoch += 1
         cur_loss = float(loss_dev)
+        if sentinel is not None:
+            # Same verdict as iterate's epoch boundary, over the SHARDED
+            # state — before the snapshot below can persist a bad state.
+            sentinel.check(state, cur_loss, epoch=epoch - 1,
+                           source_index=epoch - 1)
         terminal = tol > 0.0 and cur_loss <= tol
         if should_snapshot(checkpoint_manager, checkpoint_interval, epoch,
                            max_iter, terminal=terminal):

@@ -55,9 +55,28 @@ def test_init_distributed_retries_transient_rendezvous(monkeypatch):
     idx, count = init_distributed("10.0.0.1:8476", 2, 0,
                                   max_attempts=3, backoff_s=0.5)
     assert len(calls) == 3
-    assert sleeps == [0.5, 1.0]  # exponential backoff
+    # Exponential base PLUS jitter (ISSUE 9 satellite): each sleep lies
+    # in [base, base * (1 + jitter)] — N ranks never retry in lockstep.
+    assert 0.5 <= sleeps[0] <= 0.5 * 1.25
+    assert 1.0 <= sleeps[1] <= 1.0 * 1.25
     # The real backend is still the single local process.
     assert (idx, count) == (jax.process_index(), jax.process_count())
+
+
+def test_init_distributed_backoff_jitter_decorrelates():
+    """The jitter draw is per-call uniform: two processes retrying the
+    same attempt get different delays (with overwhelming probability
+    over 32 draws), always inside [base, base*(1+jitter)]."""
+    from flinkml_tpu.parallel.distributed import retry_backoff_s
+
+    draws = {retry_backoff_s(3, 1.0, jitter=0.5) for _ in range(32)}
+    assert len(draws) > 1, "jitter produced identical delays"
+    assert all(4.0 <= d <= 6.0 for d in draws)
+    assert retry_backoff_s(1, 0.0) == 0.0  # disabled backoff stays 0
+    import random
+
+    assert (retry_backoff_s(2, 1.0, jitter=0.5, rng=random.Random(7))
+            == retry_backoff_s(2, 1.0, jitter=0.5, rng=random.Random(7)))
 
 
 def test_init_distributed_fails_fast_on_non_transient(monkeypatch):
@@ -78,7 +97,31 @@ def test_init_distributed_exhausts_attempts(monkeypatch):
     with pytest.raises(RuntimeError, match="connection refused"):
         init_distributed("10.0.0.1:8476", 2, 0,
                          max_attempts=2, backoff_s=0.25)
-    assert len(calls) == 2 and sleeps == [0.25]
+    assert len(calls) == 2 and len(sleeps) == 1
+    assert 0.25 <= sleeps[0] <= 0.25 * 1.25
+
+
+def test_init_distributed_total_deadline_cap(monkeypatch):
+    """ISSUE 9 satellite: a total-deadline cap bounds the whole retry
+    ladder — when the next (jittered) backoff would overrun it, the
+    last transient failure is raised instead of sleeping toward an
+    unbounded rendezvous."""
+    sleeps = []
+    err = RuntimeError("connection refused")
+    calls = _patch_rendezvous(monkeypatch, [err] * 10, sleeps)
+    import flinkml_tpu.parallel.distributed as dist
+
+    t = [0.0]
+    monkeypatch.setattr(dist.time, "monotonic", lambda: t[0])
+    with pytest.raises(RuntimeError, match="connection refused"):
+        # backoff 10s, deadline 5s: the FIRST retry sleep (>= 10s)
+        # already overruns the budget — exactly one attempt, no sleep.
+        init_distributed("10.0.0.1:8476", 2, 0,
+                         max_attempts=10, backoff_s=10.0, deadline_s=5.0)
+    assert len(calls) == 1 and sleeps == []
+
+    with pytest.raises(ValueError, match="deadline_s"):
+        init_distributed("10.0.0.1:8476", 2, 0, deadline_s=-1.0)
 
 
 def test_host_barrier_sums_over_all_devices():
