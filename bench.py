@@ -1356,6 +1356,113 @@ def _inner_converge_cpu() -> dict:
     return _converge_stage()
 
 
+def _precision_stage(n=65_536, d=64, reps=3, train_n=16_384, train_dim=256,
+                     iters=24) -> dict:
+    """Stage: policy-gated mixed precision A/B — the VERDICT item 7
+    bf16-roofline-gap attribution number. Two measurements, each a
+    same-program ratio:
+
+      - the fused 5-stage chain (4 scalers + LogisticRegressionModel)
+        under ``precision_scope("mixed_inference")`` vs no policy;
+      - the plan-sharded SGD trainer under ``precision="mixed"`` (bf16
+        compute, f32 accum + params) vs no policy.
+
+    Emits ``bf16_vs_f32_samples_per_sec_ratio`` per path plus the bf16
+    trainer's max-abs coefficient deviation from its f32 twin (what the
+    CI smoke stage asserts is finite and tolerance-bounded). On the CPU
+    mesh the ratio measures XLA's CPU bf16 lowering (often < 1 — CPUs
+    emulate bf16), NOT the TPU MXU story; the number exists so the
+    trajectory is observable through the dead device tunnel, and the
+    device variant runs the same programs when the tunnel returns."""
+    import jax
+
+    from flinkml_tpu import pipeline_fusion
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.sharding.plan import REPLICATED
+    from flinkml_tpu.sharding.apply import train_linear_plan
+    from flinkml_tpu.table import Table
+
+    # -- fused 5-stage chain ------------------------------------------------
+    model, x = _five_stage_model(n, d)
+    apply_table = Table({"features": x})
+
+    def chain_rows_per_sec():
+        np.asarray(
+            model.transform(apply_table)[0].column("prediction")
+        )  # warm-up: compiles + upload
+        start = time.perf_counter()
+        for _ in range(reps):
+            out = model.transform(apply_table)[0]
+            np.asarray(out.column("prediction"))
+        return n * reps / (time.perf_counter() - start)
+
+    full_chain = chain_rows_per_sec()
+    with pipeline_fusion.precision_scope("mixed_inference"):
+        bf16_chain = chain_rows_per_sec()
+    _log(f"precision[fused_chain]: f32 {full_chain:.0f} rows/s, "
+         f"bf16 {bf16_chain:.0f} rows/s "
+         f"(ratio {bf16_chain / full_chain:.3f})")
+
+    # -- plan-sharded SGD trainer ------------------------------------------
+    xt, yt, wt = make_data(train_n, train_dim)
+    mesh = DeviceMesh.for_plan(REPLICATED)
+
+    def train(precision, max_iter):
+        return train_linear_plan(
+            xt, yt, wt, REPLICATED, mesh, loss="logistic", optimizer="sgd",
+            max_iter=max_iter, learning_rate=0.1, precision=precision,
+        )
+
+    rates = {}
+    coefs = {}
+    for label, precision in (("f32", None), ("bf16", "mixed")):
+        train(precision, 2)  # compile + window upload
+        start = time.perf_counter()
+        coefs[label] = train(precision, iters)
+        rates[label] = train_n * iters / (time.perf_counter() - start)
+    coef_dev = float(np.max(np.abs(coefs["bf16"] - coefs["f32"])))
+    assert np.isfinite(coefs["bf16"]).all(), "bf16 trainer went non-finite"
+    _log(f"precision[sgd_train]: f32 {rates['f32']:.0f} samples/s, "
+         f"bf16 {rates['bf16']:.0f} samples/s "
+         f"(ratio {rates['bf16'] / rates['f32']:.3f}, "
+         f"coef max|Δ| {coef_dev:.2e})")
+
+    return {
+        "bf16_vs_f32_samples_per_sec_ratio": {
+            "fused_chain": round(bf16_chain / full_chain, 3),
+            "sgd_train": round(rates["bf16"] / rates["f32"], 3),
+        },
+        "fused_chain_rows_per_sec": {
+            "f32": round(full_chain, 1), "bf16": round(bf16_chain, 1),
+        },
+        "sgd_train_samples_per_sec": {
+            "f32": round(rates["f32"], 1), "bf16": round(rates["bf16"], 1),
+        },
+        "sgd_coef_max_abs_dev": coef_dev,
+        "rows": n,
+        "dim": d,
+        "devices": len(jax.devices()),
+    }
+
+
+def _inner_precision() -> dict:
+    _setup_jax_cache()
+    return _precision_stage()
+
+
+def _inner_precision_cpu() -> dict:
+    """The mixed-precision A/B pinned to an 8-virtual-device host CPU
+    mesh — tunnel-immune (CI's precision smoke stage parses it); the
+    device variant runs the same programs when the tunnel returns."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    return _precision_stage(n=16_384, train_n=8_192, train_dim=128)
+
+
 _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
@@ -1376,6 +1483,8 @@ _INNER_STAGES = {
     "input_pipeline_cpu": _inner_input_pipeline_cpu,
     "sharded_train": _inner_sharded_train,
     "sharded_train_cpu": _inner_sharded_train_cpu,
+    "precision": _inner_precision,
+    "precision_cpu": _inner_precision_cpu,
     "recovery": _inner_recovery,
     "recovery_cpu": _inner_recovery_cpu,
     "converge": _inner_converge,
@@ -1526,7 +1635,7 @@ def main():
         # (it runs while a watcher capture may hold the device).
         if inner in ("converge_cpu", "pipeline_fused_cpu", "serving_cpu",
                      "serving_scaleout_cpu", "input_pipeline_cpu",
-                     "sharded_train_cpu"):
+                     "sharded_train_cpu", "precision_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():
@@ -1598,7 +1707,8 @@ def main():
     stage_order = ["dense", "dense_bf16", "svc", "converge", "ftrl",
                    "kmeans", "kmeans_mnist", "pipeline_fused",
                    "feed_overlap", "input_pipeline", "sharded_train",
-                   "gbt", "als", "word2vec", "converge_sparse", "sparse"]
+                   "precision", "gbt", "als", "word2vec",
+                   "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
     # concurrent clients wedged the tunnel for 8+ hours in round 2
@@ -1706,6 +1816,11 @@ def main():
         # the ISSUE-7 sharding trajectory (workload on
         # _sharded_train_stage).
         extras["sharded_train"] = results["sharded_train"]
+    if results.get("precision") is not None:
+        # bf16-vs-f32 same-program ratios (fused chain + SGD trainer) —
+        # the VERDICT item 7 roofline-gap attribution (workload on
+        # _precision_stage).
+        extras["precision"] = results["precision"]
     if results.get("converge") is not None:
         # Epochs + wall to fixed tol on device — the second half of
         # BASELINE.json's "samples/sec/chip + epochs-to-converge".

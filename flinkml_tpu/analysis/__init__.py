@@ -21,6 +21,14 @@ any host):
      shard, replicated-but-huge family vs the HBM budget, conflicting
      cross-plan collective orders); consumes live plans or
      ``*.plan.json`` fixtures.
+  5. **Precision-flow validation** (:mod:`.precision`) — FML6xx:
+     abstract-interprets jaxprs tracking per-value dtype provenance
+     against a declared
+     :class:`~flinkml_tpu.precision.PrecisionPolicy` (narrow
+     accumulation, silent compute-region upcast, narrow-stored
+     parameters, narrow collectives, policy/plan width conflicts);
+     consumes live functions pre-compile or ``*.policy.json``
+     fixtures, and hosts the shared dtype-flow walk behind FML106.
 
 CLI: ``python -m flinkml_tpu.analysis <paths...> [--fail-on-findings]``
 (see :mod:`.__main__`); rule catalog in :data:`.findings.RULES` and
@@ -64,4 +72,12 @@ from flinkml_tpu.analysis.sharding_check import (  # noqa: F401
     check_plan_file,
     check_program,
     plan_collective_signature,
+)
+from flinkml_tpu.analysis.precision import (  # noqa: F401
+    check_closed_jaxpr,
+    check_policy_file,
+    check_policy_plan,
+    check_precision_fn,
+    promotion_findings,
+    validate_precision,
 )

@@ -11,15 +11,21 @@ Runs all three analysis passes device-free over the given targets:
      ShardingPlan + mesh + param shapes, see
      ``docs/development/sharding.md``) is validated pre-compile —
      FML501-504;
+  2c. *precision policies*: every ``*.policy.json`` target (a declared
+     PrecisionPolicy, optionally with an example program and a plan
+     width, see ``docs/development/precision.md``) runs the
+     precision-flow pass — FML601-605;
   3. *transfer/retrace self-check*: a representative fused scaler→
      predictor chain is executed at several row counts inside one bucket
      under :class:`~flinkml_tpu.analysis.guard.TransferRetraceGuard` —
      zero cache misses and exactly one upload per transform, or findings.
 
 Exit status: 0 when clean, 1 on any error-severity finding (or on ANY
-finding with ``--fail-on-findings``). ``--json`` emits machine-readable
-findings, ``--suppress FML104,...`` drops rules, ``--rules`` prints the
-catalog. See ``docs/development/static_analysis.md``.
+finding with ``--fail-on-findings``). ``--format json`` emits
+machine-readable findings (rule, severity, location, message — what CI
+annotates from; ``--json`` is the legacy spelling), ``--suppress
+FML104,...`` drops rules, ``--rules`` prints the catalog. See
+``docs/development/static_analysis.md``.
 """
 
 from __future__ import annotations
@@ -67,6 +73,14 @@ def _pass_plans(plan_targets, report: Report) -> None:
 
     for path in plan_targets:
         report.extend(check_plan_file(path))
+
+
+def _pass_policies(policy_targets, report: Report) -> None:
+    from flinkml_tpu.analysis.precision import check_policy_file
+
+    _pin_cpu()  # example programs trace jaxprs (abstract, device-free)
+    for path in policy_targets:
+        report.extend(check_policy_file(path))
 
 
 def _pass_retrace_selfcheck(report: Report) -> None:
@@ -143,14 +157,22 @@ def main(argv=None) -> int:
     parser.add_argument(
         "targets", nargs="*",
         help=".py files / directories to lint, *.trace.json dispatch "
-             "traces, and *.plan.json sharding plans to check",
+             "traces, *.plan.json sharding plans, and *.policy.json "
+             "precision policies to check",
     )
     parser.add_argument(
         "--fail-on-findings", action="store_true",
         help="exit non-zero on ANY finding (default: errors only)",
     )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default=None,
+        help="output format: human-readable text (default) or "
+             "machine-readable JSON findings (rule, severity, location, "
+             "message) for CI annotation",
+    )
     parser.add_argument("--json", action="store_true",
-                        help="emit findings as JSON")
+                        help="emit findings as JSON (legacy spelling of "
+                             "--format json)")
     parser.add_argument(
         "--suppress", default="",
         help="comma-separated rule ids to drop (e.g. FML104,FML106)",
@@ -168,12 +190,14 @@ def main(argv=None) -> int:
             print(f"{rule} [{sev}] {desc}")
         return 0
 
-    py_targets, trace_targets, plan_targets = [], [], []
+    py_targets, trace_targets, plan_targets, policy_targets = [], [], [], []
     for t in args.targets:
         if t.endswith(".trace.json"):
             trace_targets.append(t)
         elif t.endswith(".plan.json"):
             plan_targets.append(t)
+        elif t.endswith(".policy.json"):
+            policy_targets.append(t)
         else:
             py_targets.append(t)
             if os.path.isdir(t):
@@ -186,6 +210,10 @@ def main(argv=None) -> int:
                         os.path.join(root, n) for n in sorted(names)
                         if n.endswith(".plan.json")
                     )
+                    policy_targets.extend(
+                        os.path.join(root, n) for n in sorted(names)
+                        if n.endswith(".policy.json")
+                    )
 
     report = Report()
     if py_targets:
@@ -194,6 +222,8 @@ def main(argv=None) -> int:
         _pass_traces(trace_targets, report)
     if plan_targets:
         _pass_plans(plan_targets, report)
+    if policy_targets:
+        _pass_policies(policy_targets, report)
     if not args.no_selfcheck:
         _pass_retrace_selfcheck(report)
 
@@ -202,7 +232,7 @@ def main(argv=None) -> int:
             [r.strip() for r in args.suppress.split(",") if r.strip()]
         )
 
-    if args.json:
+    if args.json or args.format == "json":
         print(report.to_json())
     else:
         print(report.render())

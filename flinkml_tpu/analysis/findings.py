@@ -4,12 +4,13 @@ Each pass (graph validator, collective-order checker, transfer/retrace
 guard) reports :class:`Finding`s: a stable rule id from :data:`RULES`, a
 severity, the stage/column the finding anchors to, and a fix hint. A
 :class:`Report` aggregates findings, applies suppressions, and renders
-them for humans (CLI) or machines (``--json``).
+them for humans (CLI) or machines (``--format json``).
 
 Rule ids are permanent: a released id is never reused for a different
 check, so suppression lists stay meaningful across versions. Add new
 rules at the end of their band (1xx schema, 2xx graph wiring, 3xx
-collectives, 4xx transfer/retrace, 5xx sharding plans).
+collectives, 4xx transfer/retrace, 5xx sharding plans, 6xx precision
+flow).
 """
 
 from __future__ import annotations
@@ -48,6 +49,12 @@ RULES = {
     "FML502": (ERROR, "mesh axis size does not divide the parameter dimension it shards"),
     "FML503": (ERROR, "replicated parameter (+ optimizer state) exceeds the per-device HBM budget"),
     "FML504": (ERROR, "two sharding plans in one program imply conflicting collective orders"),
+    # -- 6xx: precision flow -------------------------------------------------
+    "FML601": (ERROR, "reduction/accumulation (sum, dot accumulator, state update) runs narrower than policy.accum"),
+    "FML602": (ERROR, "silent upcast in the compute region: a strong wide constant promotes policy.compute work"),
+    "FML603": (ERROR, "parameter or optimizer-state leaf stored narrower than policy.params"),
+    "FML604": (ERROR, "cross-rank collective runs narrower than policy.accum without an explicit pre-cast"),
+    "FML605": (ERROR, "sharding-plan HBM math assumed a parameter width different from policy.params"),
 }
 
 

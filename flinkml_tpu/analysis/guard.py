@@ -46,6 +46,15 @@ class GuardViolation(AssertionError):
         )
 
 
+def _chain_identity(key: Tuple) -> Tuple:
+    """A fused-cache key minus its row bucket (index 4 of the layout
+    ``(chain fp, ext specs, const specs, out names, bucket, policy)``):
+    the identity under which a compile at a NEW bucket is policy-allowed.
+    The precision policy STAYS in the identity — a policy flip compiles
+    a genuinely different program."""
+    return key[:4] + key[5:]
+
+
 def _counters(group: str) -> Dict[str, float]:
     from flinkml_tpu.utils.metrics import metrics
 
@@ -83,7 +92,7 @@ class TransferRetraceGuard:
         # NEW buckets are policy-allowed, not retraces.
         with pipeline_fusion._LOCK:
             self._known_chains = {
-                k[:-1] for k in pipeline_fusion._CACHE
+                _chain_identity(k) for k in pipeline_fusion._CACHE
                 if "__specs__" not in k
             }
         self._compiled_keys = []
@@ -108,7 +117,8 @@ class TransferRetraceGuard:
         findings: List[Finding] = []
 
         # Compile policy. Key layout (pipeline_fusion._run_program):
-        # (chain fingerprint, ext specs, const specs, out names, bucket).
+        # (chain fingerprint, ext specs, const specs, out names, bucket,
+        # precision policy).
         counted = 0
         seen_chains = set(self._known_chains)
         # Fingerprint-churn detection: keyed by everything EXCEPT the
@@ -119,10 +129,10 @@ class TransferRetraceGuard:
         # alternative chains (budgeted via allow_compiles) unflagged.
         by_shape: Dict[Tuple, set] = {}
         for key in self._compiled_keys:
-            chain_fp, ext_specs, consts, outs, bucket = key
-            by_shape.setdefault((ext_specs, consts, outs, bucket),
+            chain_fp, ext_specs, consts, outs, bucket, policy = key
+            by_shape.setdefault((ext_specs, consts, outs, bucket, policy),
                                 set()).add(chain_fp)
-        for (_ext, _consts, _outs, bucket), fps in by_shape.items():
+        for (_ext, _consts, _outs, bucket, _pol), fps in by_shape.items():
             if len(fps) >= 3:
                 findings.append(Finding(
                     "FML403",
@@ -135,10 +145,11 @@ class TransferRetraceGuard:
                              "function of stage config",
                 ))
         for key in self._compiled_keys:
-            chain = key[:-1]
-            # key[:-1] is bucket-independent, so a chain seen at ANY
-            # bucket (pre-region cache or earlier in-region compile)
-            # makes this a new-bucket compile of a known chain.
+            chain = _chain_identity(key)
+            # The identity is bucket-independent (but policy-INCLUSIVE:
+            # a policy flip is a genuinely new program), so a chain seen
+            # at ANY bucket (pre-region cache or earlier in-region
+            # compile) makes this a new-bucket compile of a known chain.
             if chain in seen_chains:
                 if not self.allow_new_buckets:
                     counted += 1

@@ -211,28 +211,47 @@ def _stable_kernel(stage):
     return k1, None
 
 
-_WIDE_FLOATS = (np.dtype(np.float64),)
+def _kernel_jaxpr(kernel, schema: TableSchema, rows: int = EVAL_ROWS):
+    """The closed jaxpr of one kernel under the fused executor's trace
+    context (x64, f32 mask) — what lets the shared FML106 path localize
+    the widening primitive. None when the trace fails (the FML103 check
+    already reported that)."""
+    import jax
+
+    try:
+        cols = {
+            c: jax.ShapeDtypeStruct((rows,) + schema[c].tail,
+                                    schema[c].dtype)
+            for c in kernel.input_cols
+        }
+        consts = {
+            k: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                    np.asarray(v).dtype)
+            for k, v in kernel.constants.items()
+        }
+        valid = jax.ShapeDtypeStruct((rows,), np.float32)
+        with jax.experimental.enable_x64(True):
+            return jax.make_jaxpr(kernel.fn)(cols, consts, valid)
+    except Exception:
+        return None
 
 
-def _promotion_findings(stage_label, in_specs, out_specs) -> List[Finding]:
-    """FML106: every known input is a narrow float but an output came back
-    float64 — the widening happened inside the stage, silently."""
-    known_in = [s.dtype for s in in_specs if s.known]
-    if not known_in or any(d.kind != "f" or d.itemsize >= 8 for d in known_in):
-        return []
-    out: List[Finding] = []
-    for name, spec in out_specs.items():
-        if spec.known and spec.dtype in _WIDE_FLOATS:
-            out.append(Finding(
-                "FML106",
-                f"inputs are {', '.join(str(d) for d in known_in)} but "
-                f"output {name!r} is float64 (silent promotion)",
-                stage=stage_label, column=name,
-                fix_hint="cast explicitly or preserve the input dtype; "
-                         "float64 on the CPU fallback path doubles "
-                         "bandwidth and memory",
-            ))
-    return out
+def _promotion_findings(stage_label, in_specs, out_specs,
+                        closed=None) -> List[Finding]:
+    """FML106 — delegates to the ONE dtype-flow code path
+    (:func:`flinkml_tpu.analysis.precision.promotion_findings`), which
+    also serves the fused multi-stage check in :func:`analyze_pipeline`.
+    ``closed`` (the kernel's jaxpr, or a lazy zero-arg thunk producing
+    it, optional) localizes the widening primitive in the message."""
+    from flinkml_tpu.analysis.precision import promotion_findings
+
+    return promotion_findings(
+        closed,
+        [s.dtype if s.known else None for s in in_specs],
+        {name: (s.dtype if s.known else None)
+         for name, s in out_specs.items()},
+        stage=stage_label,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -255,6 +274,8 @@ def analyze_pipeline(pipeline, schema: Optional[TableSchema] = None,
     produced_at: Dict[str, int] = {}
     pending_reads: List[Tuple[int, str, str]] = []  # (stage idx, label, col)
     kernel_capable: List[bool] = []
+    kernels: List = []                 # per-stage kernel (None = unfusable)
+    schema_before: List[TableSchema] = []  # schema snapshot at each stage
 
     for i, stage in enumerate(stages):
         label = f"[{i}] {type(stage).__name__}"
@@ -265,6 +286,8 @@ def analyze_pipeline(pipeline, schema: Optional[TableSchema] = None,
                 report.add(dataclasses.replace(f, stage=label,
                                                location=location))
         kernel_capable.append(kernel is not None)
+        kernels.append(kernel)
+        schema_before.append(dict(current))
 
         io = None
         if kernel is not None:
@@ -331,7 +354,14 @@ def analyze_pipeline(pipeline, schema: Optional[TableSchema] = None,
                 ))
                 out_specs = {c: UNKNOWN for c in writes}
             else:
-                for f in _promotion_findings(label, in_specs, out_specs):
+                # The jaxpr thunk is LAZY: promotion_findings only traces
+                # it when a finding is certain, so clean stages (the
+                # common case) pay no localization trace.
+                for f in _promotion_findings(
+                    label, in_specs, out_specs,
+                    closed=lambda k=kernel, s=dict(current):
+                        _kernel_jaxpr(k, s),
+                ):
                     report.add(dataclasses.replace(f, location=location))
             current.update(out_specs)
         else:
@@ -376,7 +406,101 @@ def analyze_pipeline(pipeline, schema: Optional[TableSchema] = None,
                 fix_hint="implement transform_kernel for this stage or "
                          "move it to the edge of the chain",
             ))
+
+    # FML106 over the FUSED program: each maximal kernel run (>= 2
+    # stages — what the executor actually compiles as one jaxpr) walks
+    # through the shared dtype-flow path in analysis.precision, which
+    # localizes the widening primitive; per-stage findings above came
+    # through the SAME code path, so (column-keyed) dedupe keeps one
+    # report. Catches widenings the per-stage abstract eval can see only
+    # in the assembled program (cross-stage const promotion under the
+    # executor's x64 trace).
+    flagged = {f.column for f in report if f.rule == "FML106"}
+    for start, end in _kernel_runs(kernel_capable):
+        for f in _fused_promotion_findings(
+            kernels[start:end], schema_before[start],
+            f"fused[{start}..{end - 1}]",
+        ):
+            if f.column not in flagged:
+                flagged.add(f.column)
+                report.add(dataclasses.replace(f, location=location))
     return report
+
+
+def _kernel_runs(kernel_capable: Sequence[bool]):
+    """Maximal runs of >= 2 consecutive kernel-capable stages — the
+    executor's fusion unit (``pipeline.py`` fuses exactly these)."""
+    runs = []
+    i = 0
+    while i < len(kernel_capable):
+        if not kernel_capable[i]:
+            i += 1
+            continue
+        j = i
+        while j < len(kernel_capable) and kernel_capable[j]:
+            j += 1
+        if j - i >= 2:
+            runs.append((i, j))
+        i = j
+    return runs
+
+
+def _fused_promotion_findings(run_kernels, schema: TableSchema,
+                              label: str) -> List[Finding]:
+    """Trace the run's REAL fused chain function (the executor's
+    ``_chain_fn``) abstractly and run the shared FML106 dtype-flow check
+    over the whole multi-stage program."""
+    import jax
+
+    from flinkml_tpu import pipeline_fusion
+    from flinkml_tpu.analysis.precision import promotion_findings
+
+    ext = pipeline_fusion.external_inputs(run_kernels)
+    ext_specs = [schema.get(c, UNKNOWN) for c in ext]
+    if not all(s.known for s in ext_specs):
+        return []
+    out_names = []
+    for k in run_kernels:
+        out_names.extend(c for c in k.output_cols if c not in out_names)
+    try:
+        chain = pipeline_fusion._chain_fn(
+            run_kernels, ext, out_names, EVAL_ROWS
+        )
+        ext_vals = tuple(
+            jax.ShapeDtypeStruct((EVAL_ROWS,) + s.tail, s.dtype)
+            for s in ext_specs
+        )
+        const_vals = tuple(
+            tuple(
+                jax.ShapeDtypeStruct(np.asarray(k.constants[c]).shape,
+                                     np.asarray(k.constants[c]).dtype)
+                for c in sorted(k.constants)
+            )
+            for k in run_kernels
+        )
+        with jax.experimental.enable_x64(True):
+            abstract = jax.eval_shape(
+                chain, ext_vals, const_vals, np.int32(EVAL_ROWS)
+            )
+        out_dtypes = {name: v.dtype for name, v in abstract.items()}
+
+        def closed():
+            # Lazy: the localization jaxpr is only traced once a
+            # finding is certain (promotion_findings' contract). A
+            # trace failure degrades to an unlocalized message.
+            try:
+                with jax.experimental.enable_x64(True):
+                    return jax.make_jaxpr(chain)(
+                        ext_vals, const_vals, np.int32(EVAL_ROWS)
+                    )
+            except Exception:
+                return None
+    except Exception:
+        # An untraceable chain already surfaced as FML103 per stage.
+        return []
+    return promotion_findings(
+        closed, [s.dtype for s in ext_specs], out_dtypes, stage=label,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -509,6 +509,7 @@ def train_linear_model(
     resume: bool = False,
     listeners=(),
     sharding_plan=None,
+    precision=None,
 ) -> np.ndarray:
     """Dense distributed training; returns the coefficient on host.
 
@@ -530,12 +531,26 @@ def train_linear_model(
     order — convergence-equivalent to (not bit-identical with) the
     replicated trainer. A mesh lacking the plan's axes is re-shaped
     over the same devices via :meth:`DeviceMesh.for_plan`.
+
+    ``precision`` (a :class:`~flinkml_tpu.precision.PrecisionPolicy`,
+    preset name, or policy JSON dict) declares the mixed-precision
+    contract and routes the fit through the policy-gated plan trainer
+    (under the ``replicated`` plan when no ``sharding_plan`` is given):
+    the step's jaxpr is validated against the policy BEFORE any compile
+    by the FML6xx precision-flow pass — see
+    ``docs/development/precision.md``.
     """
     if loss not in _LOSS_KEYS:
         raise ValueError(f"loss must be one of {_LOSS_KEYS}, got {loss!r}")
     n = x.shape[0]
     if n == 0:
         raise ValueError("training table is empty")
+    if precision is not None and sharding_plan is None:
+        # The policy-gated step lives on the plan path; REPLICATED is
+        # the plan-shaped spelling of "no sharding".
+        from flinkml_tpu.sharding.plan import REPLICATED
+
+        sharding_plan = REPLICATED
     if sharding_plan is not None:
         from flinkml_tpu.sharding.apply import train_linear_plan
 
@@ -555,6 +570,7 @@ def train_linear_model(
             max_iter=max_iter, learning_rate=learning_rate,
             global_batch_size=global_batch_size, reg=reg,
             elastic_net=elastic_net, tol=tol, dtype=dtype,
+            precision=precision,
             checkpoint_manager=checkpoint_manager,
             checkpoint_interval=checkpoint_interval, resume=resume,
         )
@@ -1351,6 +1367,7 @@ def train_linear_model_from_table(
     weight_col: Optional[str],
     label_check=None,
     sharding_plan=None,
+    precision=None,
     **hyper,
 ) -> np.ndarray:
     """One fit dispatch for every linear estimator: SparseVector columns
@@ -1360,7 +1377,9 @@ def train_linear_model_from_table(
     max_iter, ...). ``sharding_plan`` routes the DENSE branch through
     the plan-sharded trainer (see :func:`train_linear_model`); the
     sparse trainer keeps its replicated ``[dim]`` model and refuses a
-    plan loudly."""
+    plan loudly. ``precision`` (the FML6xx-gated mixed-precision
+    policy) rides the same dense-only route and is refused just as
+    loudly on the sparse branch."""
     from flinkml_tpu.models._data import (
         labeled_data,
         labeled_sparse_data,
@@ -1373,6 +1392,12 @@ def train_linear_model_from_table(
                 "sharding_plan supports the dense path only; the sparse "
                 "trainer keeps its replicated [dim] model (shard it via "
                 "ROADMAP item 5's embedding-table path instead)"
+            )
+        if precision is not None:
+            raise ValueError(
+                "precision supports the dense path only; the sparse "
+                "trainer's gather/segment-sum kernels are not yet "
+                "policy-gated"
             )
         indptr, indices, values, dim, y, w = labeled_sparse_data(
             table, features_col, label_col, weight_col
@@ -1387,7 +1412,8 @@ def train_linear_model_from_table(
         raise ValueError("training table is empty")
     if label_check is not None:
         label_check(y)
-    return train_linear_model(x, y, w, sharding_plan=sharding_plan, **hyper)
+    return train_linear_model(x, y, w, sharding_plan=sharding_plan,
+                              precision=precision, **hyper)
 
 
 # ---------------------------------------------------------------------------

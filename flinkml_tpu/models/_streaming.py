@@ -86,6 +86,11 @@ class StreamingEstimatorMixin:
     #: everyone else gets a constructor-time refusal of the knob.
     _SHARDING_PLAN_AWARE = False
 
+    #: Subclasses whose trainers thread a PrecisionPolicy (the FML6xx
+    #: policy-gated mixed-precision path) set this True; everyone else
+    #: gets a constructor-time refusal of the knob.
+    _PRECISION_AWARE = False
+
     def __init__(
         self,
         mesh=None,
@@ -95,6 +100,7 @@ class StreamingEstimatorMixin:
         checkpoint_interval: int = 0,
         resume: bool = False,
         sharding_plan=None,
+        precision=None,
     ):
         super().__init__()
         self.mesh = mesh
@@ -112,6 +118,24 @@ class StreamingEstimatorMixin:
                 "yet (plan-aware estimators: the linear family's dense "
                 "paths — LogisticRegression, LinearSVC, LinearRegression)"
             )
+        if precision is not None and not type(self)._PRECISION_AWARE:
+            # Same loud-refusal contract as the plan knob: a silently
+            # ignored policy would "train in bf16" at full f32 cost —
+            # the measurement the policy was declared to change.
+            raise ValueError(
+                f"{type(self).__name__} does not support precision yet "
+                "(policy-aware estimators: the linear family's dense "
+                "paths — LogisticRegression, LinearSVC, LinearRegression)"
+            )
+        from flinkml_tpu.precision import resolve_policy
+
+        #: Optional :class:`~flinkml_tpu.precision.PrecisionPolicy` (or
+        #: preset name / JSON dict, resolved here so a bad spelling
+        #: fails at construction) — policy-aware estimators validate
+        #: their step's jaxpr against it BEFORE any compile (FML6xx)
+        #: and run compute at ``policy.compute``; see
+        #: ``docs/development/precision.md``.
+        self.precision = resolve_policy(precision)
         #: Optional :class:`~flinkml_tpu.sharding.plan.ShardingPlan` —
         #: plan-aware estimators (``_SHARDING_PLAN_AWARE = True``; the
         #: linear family's dense paths) shard parameters + optimizer

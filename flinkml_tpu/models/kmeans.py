@@ -211,12 +211,23 @@ class KMeansModel(_KMeansParams, Model):
         from flinkml_tpu.api import ColumnKernel
 
         def fn(cols, consts, valid):
+            # Trace-time policy resolution (the fused program cache keys
+            # on the active policy). The distance math follows plain
+            # dtype propagation from policy.compute — so its reduce
+            # accumulates NARROW, and the FML6xx gate refuses this
+            # kernel under a policy whose accum is wider than compute
+            # (the strict "mixed" preset); "mixed_inference" admits it.
+            from flinkml_tpu import pipeline_fusion
+
+            pol = pipeline_fusion.active_policy()
+            kdt = jnp.dtype(pol.compute_dtype) \
+                if pol is not None and pol.mixed else dt
             x = cols[fcol]
             if x.ndim == 1:
                 x = x.reshape(-1, 1)
-            x = x.astype(dt)
+            x = x.astype(kdt)
             measure = DistanceMeasure.get_instance("euclidean")
-            assign = measure.nearest(x, consts["centroids"].astype(dt))
+            assign = measure.nearest(x, consts["centroids"].astype(kdt))
             return {pcol: assign.astype(idt)}
 
         return ColumnKernel(

@@ -126,6 +126,7 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
     ``checkpoint_interval``/``resume``."""
 
     _SHARDING_PLAN_AWARE = True  # sgd dense path threads a ShardingPlan
+    _PRECISION_AWARE = True  # ... and the FML6xx-gated precision policy
 
     def _make_model(self, coef) -> "LinearRegressionModel":
         model = LinearRegressionModel()
@@ -146,6 +147,11 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
                 raise ValueError(
                     "sharding_plan supports in-RAM Table fits only; "
                     "streamed fits keep their replicated carry"
+                )
+            if self.precision is not None:
+                raise ValueError(
+                    "precision supports in-RAM Table fits only; the "
+                    "streamed trainer is not yet policy-gated"
                 )
             coef = _linear_sgd.streamed_linear_fit(
                 table,
@@ -177,6 +183,12 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
                     "solver='normal' does not thread a sharding_plan "
                     "(the closed form materializes the replicated "
                     "[d, d] gram); use solver='sgd'"
+                )
+            if self.precision is not None:
+                raise ValueError(
+                    "solver='normal' does not thread a precision policy "
+                    "(the closed form is a one-shot f32 solve); use "
+                    "solver='sgd'"
                 )
             if self.get(self.ELASTIC_NET) > 0:
                 raise ValueError(
@@ -212,6 +224,7 @@ class LinearRegression(StreamingEstimatorMixin, _LinearRegressionParams, Estimat
             self.get(_LinearRegressionParams.LABEL_COL),
             self.get(_LinearRegressionParams.WEIGHT_COL),
             sharding_plan=self.sharding_plan,
+            precision=self.precision,
             **self._checkpoint_kwargs(),
             **hyper,
         )

@@ -75,6 +75,7 @@ class LinearSVC(StreamingEstimatorMixin, _LinearSVCParams, Estimator):
     ``checkpoint_manager``/``checkpoint_interval``/``resume``."""
 
     _SHARDING_PLAN_AWARE = True  # dense path threads a ShardingPlan
+    _PRECISION_AWARE = True  # ... and the FML6xx-gated precision policy
 
     def _make_model(self, coef) -> "LinearSVCModel":
         model = LinearSVCModel()
@@ -90,6 +91,11 @@ class LinearSVC(StreamingEstimatorMixin, _LinearSVCParams, Estimator):
                 raise ValueError(
                     "sharding_plan supports in-RAM Table fits only; "
                     "streamed fits keep their replicated carry"
+                )
+            if self.precision is not None:
+                raise ValueError(
+                    "precision supports in-RAM Table fits only; the "
+                    "streamed trainer is not yet policy-gated"
                 )
             coef = _linear_sgd.streamed_linear_fit(
                 table,
@@ -127,6 +133,7 @@ class LinearSVC(StreamingEstimatorMixin, _LinearSVCParams, Estimator):
             self.get(_LinearSVCParams.WEIGHT_COL),
             label_check=lambda y: check_binary_labels(y, "LinearSVC"),
             sharding_plan=self.sharding_plan,
+            precision=self.precision,
             **hyper,
         )
         return self._make_model(coef)

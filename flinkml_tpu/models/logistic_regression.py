@@ -100,6 +100,7 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
     """
 
     _SHARDING_PLAN_AWARE = True  # dense binomial path threads a plan
+    _PRECISION_AWARE = True  # ... and the FML6xx-gated precision policy
 
     def fit(self, *inputs) -> "LogisticRegressionModel":
         (table,) = inputs
@@ -131,6 +132,12 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
                     "only; the sparse trainer keeps its replicated "
                     "[dim] model (shard it via ROADMAP item 5's "
                     "embedding-table path instead)"
+                )
+            if self.precision is not None:
+                raise ValueError(
+                    "precision supports the dense binomial path only; "
+                    "the sparse trainer's gather/segment-sum kernels "
+                    "are not yet policy-gated"
                 )
             indptr, indices, values, dim, y, w = labeled_sparse_data(
                 table, features_col,
@@ -167,6 +174,12 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
                         "path only (the softmax trainer is not yet "
                         "plan-aware)"
                     )
+                if self.precision is not None:
+                    raise ValueError(
+                        "precision supports the dense binomial path "
+                        "only (the softmax trainer is not yet "
+                        "policy-gated)"
+                    )
                 num_classes = _check_multinomial_labels(y)
                 coef = _linear_sgd.train_softmax_model(
                     x, y, w, num_classes=num_classes, elastic_net=0.0,
@@ -175,7 +188,8 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
             else:
                 _check_binomial_labels(y)
                 coef = train_logistic_regression(
-                    x, y, w, sharding_plan=self.sharding_plan, **hyper,
+                    x, y, w, sharding_plan=self.sharding_plan,
+                    precision=self.precision, **hyper,
                 )
 
         model = LogisticRegressionModel(mesh=self.mesh)
@@ -195,6 +209,11 @@ class LogisticRegression(StreamingEstimatorMixin, _LogisticRegressionParams, Est
             raise ValueError(
                 "sharding_plan supports in-RAM Table fits only; streamed "
                 "fits keep their replicated carry"
+            )
+        if self.precision is not None:
+            raise ValueError(
+                "precision supports in-RAM Table fits only; the streamed "
+                "trainer is not yet policy-gated"
             )
 
         features_col = self.get(_LogisticRegressionParams.FEATURES_COL)
@@ -321,17 +340,29 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
         from flinkml_tpu.api import ColumnKernel
 
         def fn(cols, consts, valid):
+            # Resolved at TRACE time: the fused executor's program cache
+            # keys on the active PrecisionPolicy, so a bf16 trace and an
+            # f32 trace never share an executable. Under a mixed policy
+            # the kernel computes at policy.compute with the matmul
+            # accumulating at policy.accum (preferred_element_type)
+            # instead of re-widening to the captured per-stage dtype.
+            from flinkml_tpu import pipeline_fusion
+
+            pol = pipeline_fusion.active_policy()
+            mixed = pol is not None and pol.mixed
+            kdt = jnp.dtype(pol.compute_dtype) if mixed else dt
+            adt = jnp.dtype(pol.accum_dtype) if mixed else None
             x = cols[fcol]
             if x.ndim == 1:
                 x = x.reshape(-1, 1)
-            x = x.astype(dt)
-            coef = consts["coefficient"].astype(dt)
+            x = x.astype(kdt)
+            coef = consts["coefficient"].astype(kdt)
             if multinomial:
-                logits = x @ coef.T
+                logits = jnp.matmul(x, coef.T, preferred_element_type=adt)
                 raw = jax.nn.softmax(logits, axis=-1)
                 pred = jnp.argmax(logits, axis=-1).astype(x.dtype)
             else:
-                dot = x @ coef
+                dot = jnp.matmul(x, coef, preferred_element_type=adt)
                 p = jax.nn.sigmoid(dot)
                 pred = (dot >= 0).astype(x.dtype)
                 raw = jnp.stack([1.0 - p, p], axis=-1)
@@ -461,6 +492,7 @@ def train_logistic_regression(
     resume: bool = False,
     listeners=(),
     sharding_plan=None,
+    precision=None,
 ) -> np.ndarray:
     """The distributed SGD loop; returns the fitted coefficient on host.
 
@@ -489,6 +521,11 @@ def train_logistic_regression(
             "sharding_plan is supported in mode='device' only (the host "
             "iterate loop replicates its carry)"
         )
+    if precision is not None and mode == "host":
+        raise ValueError(
+            "precision is supported in mode='device' only (the "
+            "policy-gated step lives on the plan-sharded path)"
+        )
     if mode == "host" and checkpoint_manager is not None:
         # The rescale guard must compare against THIS trainer's mesh, not
         # the process-global device count (they differ on subset meshes).
@@ -505,7 +542,7 @@ def train_logistic_regression(
             checkpoint_manager=checkpoint_manager,
             checkpoint_interval=checkpoint_interval,
             resume=resume, listeners=listeners,
-            sharding_plan=sharding_plan,
+            sharding_plan=sharding_plan, precision=precision,
         )
 
     # host mode: per-epoch dispatch with listener/checkpoint support.

@@ -57,6 +57,26 @@ suite). The one carve-out: under ambient float32, outputs of
 equivalent rather than bitwise — f32 matmul reassociation differs between
 the bucket-padded fused shape and the exact-row per-stage shape.
 
+Mixed precision (the FML6xx policy gate)
+----------------------------------------
+
+An active :class:`~flinkml_tpu.precision.PrecisionPolicy`
+(:func:`set_policy` / :func:`precision_scope`; serving threads it via
+``ServingConfig.precision``) changes the fused program in exactly the
+declared way: every float external input column and every float model
+constant is cast to ``policy.compute`` at the program boundary (the
+upload stays at storage width; the savings are device-side), the
+validity mask is built at ``policy.compute``, and kernel math follows
+jax dtype propagation from there. The policy joins BOTH cache keys —
+program and abstract-spec — so a bf16 and an f32 program never alias
+one executable. Every fresh cache key is validated against the policy
+by the FML6xx precision-flow pass
+(:mod:`flinkml_tpu.analysis.precision`) BEFORE the program is built:
+a chain whose kernels accumulate below ``policy.accum`` (or smuggle a
+strong wide constant into the compute region) raises
+:class:`~flinkml_tpu.precision.PrecisionValidationError` instead of
+compiling. No active policy (the default) leaves every path untouched.
+
 Instrumentation (``metrics.group("pipeline.fusion")``): ``compiles`` /
 ``cache_hits`` counters, ``fused_segments`` / ``fused_stages``,
 ``host_to_device_transfers`` / ``host_to_device_bytes``, and
@@ -88,6 +108,10 @@ on_compile: List[Callable[[Tuple], None]] = []
 _CACHE: Dict[Tuple, Callable] = {}
 _LOCK = threading.Lock()
 _ENABLED = [True]
+# Per-THREAD policy slot: a ServingEngine scopes its own dispatcher
+# thread's dispatches without clobbering a concurrently-transforming
+# trainer thread's ambient policy (and vice versa).
+_POLICY = threading.local()
 
 
 def enabled() -> bool:
@@ -99,6 +123,56 @@ def enabled() -> bool:
 
 def set_enabled(flag: bool) -> None:
     _ENABLED[0] = bool(flag)
+
+
+def active_policy():
+    """The :class:`~flinkml_tpu.precision.PrecisionPolicy` fused programs
+    compile and validate under on THIS thread (None: plain full-width
+    execution). Thread-scoped: each dispatching thread carries its own
+    slot, so a serving engine's policy never leaks into a concurrent
+    trainer thread's transforms."""
+    return getattr(_POLICY, "value", None)
+
+
+def set_policy(policy) -> None:
+    """Install a :class:`~flinkml_tpu.precision.PrecisionPolicy` (object,
+    preset name, JSON dict, or None) as THIS thread's fused-executor
+    policy. Prefer :func:`precision_scope` for bounded use."""
+    from flinkml_tpu.precision import resolve_policy
+
+    _POLICY.value = resolve_policy(policy)
+
+
+class precision_scope:
+    """Context manager scoping an ambient fused-executor policy:
+
+    .. code-block:: python
+
+        with pipeline_fusion.precision_scope("mixed_inference"):
+            (out,) = model.transform(table)
+
+    Every fused program compiled inside the scope is FML6xx-validated
+    against the policy pre-compile and keyed by it (bf16/f32 programs
+    never alias); programs compiled OUTSIDE the scope are untouched and
+    untouchable from inside (distinct cache keys). The scope is
+    THREAD-scoped (enter/exit on the thread that transforms), so
+    concurrent threads — a serving dispatcher beside a training loop —
+    never clobber each other's policy."""
+
+    def __init__(self, policy):
+        from flinkml_tpu.precision import resolve_policy
+
+        self._policy = resolve_policy(policy)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = active_policy()
+        _POLICY.value = self._policy
+        return self._policy
+
+    def __exit__(self, *exc):
+        _POLICY.value = self._prev
+        return False
 
 
 def reset_cache() -> None:
@@ -248,7 +322,7 @@ def _closure_outputs(kernels: Sequence[ColumnKernel],
 
 
 def _chain_fn(kernels: Sequence[ColumnKernel], ext_names: Sequence[str],
-              out_names: Sequence[str], bucket: int):
+              out_names: Sequence[str], bucket: int, policy=None):
     """The pure cols→cols chain function for ``kernels``, returning only
     ``out_names``. Constants arrive as traced arguments (sorted by name
     per kernel) so model-data value changes reuse the compiled
@@ -257,41 +331,97 @@ def _chain_fn(kernels: Sequence[ColumnKernel], ext_names: Sequence[str],
     bucket share one program AND allocate nothing host-side). Columns NOT
     in ``out_names`` — and every kernel feeding only such columns — are
     dead code XLA eliminates, which is how lazy intermediate columns cost
-    nothing until someone reads them."""
+    nothing until someone reads them.
+
+    A mixed ``policy`` casts every float input and constant down to
+    ``policy.compute`` at the program boundary (the sanctioned
+    step-boundary down-cast the FML6xx walker recognizes) and builds the
+    validity mask at ``policy.compute`` so the mask multiply doesn't
+    silently promote the whole chain back to f32."""
     import jax
     import jax.numpy as jnp
 
     kernels = tuple(kernels)
     ext_names = tuple(ext_names)
     out_names = tuple(out_names)
+    mixed = policy is not None and policy.mixed
+    mask_dt = jnp.dtype(policy.compute_dtype) if mixed else jnp.float32
+
+    def _to_compute(v):
+        if mixed and jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(mask_dt)
+        return v
 
     def run(ext_vals, const_vals, n_valid):
-        valid = (jnp.arange(bucket) < n_valid).astype(jnp.float32)
-        cols = dict(zip(ext_names, ext_vals))
-        last = len(kernels) - 1
-        for i, (kernel, cv) in enumerate(zip(kernels, const_vals)):
-            consts = dict(zip(sorted(kernel.constants), cv))
-            outs = kernel.fn(
-                {c: cols[c] for c in kernel.input_cols}, consts, valid
+        # Kernels resolve active_policy() at TRACE time, and this body
+        # runs at trace time — on whatever thread first calls the jitted
+        # program. A lazy column's deferred trace (another thread, or
+        # after the scope exited) would otherwise compile under the
+        # READER's ambient policy while cached and validated under the
+        # CAPTURED key, so the captured policy is pinned for the trace.
+        prev = active_policy()
+        _POLICY.value = policy
+        try:
+            valid = (jnp.arange(bucket) < n_valid).astype(mask_dt)
+            ext_vals = tuple(_to_compute(v) for v in ext_vals)
+            const_vals = tuple(
+                tuple(_to_compute(v) for v in cv) for cv in const_vals
             )
-            if i != last:
-                # Pin per-stage rounding: without the barrier XLA's
-                # algebraic simplifier rewrites across stage boundaries
-                # (e.g. two chained scaler divisions (x/s1)/s2 become
-                # x/(s1*s2)), breaking the bit-parity contract with the
-                # per-stage path. Still ONE program / one dispatch;
-                # only cross-stage op rewriting is fenced.
-                outs = jax.lax.optimization_barrier(outs)
-            cols.update(outs)
-        return {c: cols[c] for c in out_names}
+            cols = dict(zip(ext_names, ext_vals))
+            last = len(kernels) - 1
+            for i, (kernel, cv) in enumerate(zip(kernels, const_vals)):
+                consts = dict(zip(sorted(kernel.constants), cv))
+                outs = kernel.fn(
+                    {c: cols[c] for c in kernel.input_cols}, consts, valid
+                )
+                if i != last:
+                    # Pin per-stage rounding: without the barrier XLA's
+                    # algebraic simplifier rewrites across stage
+                    # boundaries (e.g. two chained scaler divisions
+                    # (x/s1)/s2 become x/(s1*s2)), breaking the
+                    # bit-parity contract with the per-stage path. Still
+                    # ONE program / one dispatch; only cross-stage op
+                    # rewriting is fenced.
+                    outs = jax.lax.optimization_barrier(outs)
+                cols.update(outs)
+            return {c: cols[c] for c in out_names}
+        finally:
+            _POLICY.value = prev
 
     return run
 
 
+def _validate_chain(chain, ext_vals, const_vals, kernels, policy) -> None:
+    """The fused executor's pre-compile FML6xx gate: trace ``chain``
+    abstractly over the real (padded) buffers and check the jaxpr
+    against the active policy. External columns are ``data``, model-data
+    constants are ``param`` (an f16/bf16-STORED coefficient fails
+    FML603), and any narrow accumulation or smuggled wide constant
+    inside a kernel fails FML601/FML602 — all BEFORE jit sees the
+    chain. Raises
+    :class:`~flinkml_tpu.precision.PrecisionValidationError`."""
+    import jax
+    import numpy as _np
+
+    from flinkml_tpu.analysis.precision import validate_precision
+
+    validate_precision(
+        chain, tuple(ext_vals), tuple(const_vals), _np.int32(1),
+        policy=policy, param_argnums=(1,),
+        program="pipeline_fusion["
+                + "+".join(type(k).__name__ for k in kernels) + "]",
+    )
+
+
 def _run_program(kernels, ext_names, out_names, ext_specs, const_specs,
-                 ext_vals, const_vals, bucket: int, n: int):
-    """Compile-or-reuse the program for (chain, requested outputs, bucket)
-    and execute it; returns the dict of bucket-padded output buffers."""
+                 ext_vals, const_vals, bucket: int, n: int, policy=None):
+    """Compile-or-reuse the program for (chain, requested outputs,
+    bucket, policy) and execute it; returns the dict of bucket-padded
+    output buffers. ``policy`` is captured ONCE per
+    :func:`execute_kernel_chain` and passed down explicitly, so a lazy
+    column's deferred program — possibly materialized on another thread
+    or after the scope exited — compiles under the SAME policy as its
+    eager siblings."""
     import jax
 
     group = metrics.group("pipeline.fusion")
@@ -301,12 +431,24 @@ def _run_program(kernels, ext_names, out_names, ext_specs, const_specs,
         const_specs,
         tuple(out_names),
         bucket,
+        policy,
     )
+    with _LOCK:
+        program = _CACHE.get(key)
+    if program is None and policy is not None:
+        # Refusal precedes compile AND caching: a failing chain leaves
+        # no executable behind (re-entry revalidates — validation is an
+        # abstract trace, compile-free and cheap next to a compile).
+        with jax.experimental.enable_x64(True):
+            _validate_chain(
+                _chain_fn(kernels, ext_names, out_names, bucket, policy),
+                ext_vals, const_vals, kernels, policy,
+            )
     with _LOCK:
         program = _CACHE.get(key)
         if program is None:
             program = jax.jit(
-                _chain_fn(kernels, ext_names, out_names, bucket)
+                _chain_fn(kernels, ext_names, out_names, bucket, policy)
             )
             _CACHE[key] = program
             compiled = True
@@ -402,19 +544,22 @@ def execute_kernel_chain(table: Table, kernels: Sequence[ColumnKernel]) -> Table
 
         # Abstract trace (no compile, no compute): padded shape/dtype of
         # every output, for lazy-column construction and the bytes-avoided
-        # accounting. Cached alongside the programs.
+        # accounting. Cached alongside the programs. The active policy is
+        # key material here too: a mixed program's outputs ARE narrower.
+        policy = active_policy()
         spec_key = (
             tuple(k.fingerprint for k in kernels),
             tuple(ext_specs),
             const_specs,
             "__specs__",
             bucket,
+            policy,
         )
         with _LOCK:
             specs = _CACHE.get(spec_key)
         if specs is None:
             abstract = jax.eval_shape(
-                _chain_fn(kernels, ext, out_names, bucket),
+                _chain_fn(kernels, ext, out_names, bucket, policy),
                 tuple(ext_vals), const_vals, np.int32(n),
             )
             specs = {
@@ -425,7 +570,7 @@ def execute_kernel_chain(table: Table, kernels: Sequence[ColumnKernel]) -> Table
 
     outs = _run_program(
         kernels, ext, eager_names, ext_specs, const_specs,
-        ext_vals, const_vals, bucket, n,
+        ext_vals, const_vals, bucket, n, policy,
     )
 
     group.counter("fused_segments")
@@ -452,11 +597,12 @@ def execute_kernel_chain(table: Table, kernels: Sequence[ColumnKernel]) -> Table
     for name in lazy_names:
         shape, dtype = specs[name]
 
-        def thunk(name=name):
+        def thunk(name=name, policy=policy):
             try:
                 return _run_program(
                     kernels, ext, _closure_outputs(kernels, (name,)),
                     ext_specs, const_specs, ext_vals, const_vals, bucket, n,
+                    policy,
                 )[name]
             except RuntimeError as e:
                 if "deleted" in str(e).lower() or "donat" in str(e).lower():

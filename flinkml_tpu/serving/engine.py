@@ -121,6 +121,17 @@ class ServingConfig:
     # of the self-healing contract; a follower's refused swap keeps the
     # old model serving).
     refuse_nonfinite: bool = True
+    # Mixed-precision contract for every fused inference program this
+    # engine compiles: a PrecisionPolicy, preset name ("mixed_inference"
+    # is the serving preset), or policy JSON dict. Each program is
+    # FML6xx-validated against the policy BEFORE compile — at warmup, so
+    # a policy-violating model is refused at LOAD time
+    # (PrecisionValidationError) and a follower's refused swap keeps the
+    # previous model serving, exactly like refuse_nonfinite. The
+    # shed-to-host degradation path runs per-stage at full width (it
+    # exists to avoid the fused executor entirely); see
+    # docs/development/precision.md.
+    precision: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -178,6 +189,11 @@ class ServingEngine:
         self._output_cols: Optional[Tuple[str, ...]] = (
             tuple(output_cols) if output_cols is not None else None
         )
+        from flinkml_tpu.precision import resolve_policy
+
+        # Resolved once (a bad preset name fails construction, not the
+        # first swap); every fused dispatch below runs under this scope.
+        self._policy = resolve_policy(self.config.precision)
         self._metrics = metrics.group(
             f"serving.{self.config.metrics_name or name}",
             labels=self.config.metrics_labels,
@@ -343,8 +359,12 @@ class ServingEngine:
         # must hold the mesh lock here too, or the load/swap path would
         # interleave collective rendezvous with a concurrent trainer —
         # the same hazard _serve_batch guards against. Single-device
-        # engines get a nullcontext.
-        with self._dispatch_guard():
+        # engines get a nullcontext. Warmup runs under the engine's
+        # precision scope, so the FML6xx pre-compile gate fires HERE: a
+        # policy-violating model fails the install (the old model keeps
+        # serving) instead of failing live traffic.
+        with self._dispatch_guard(), \
+                pipeline_fusion.precision_scope(self._policy):
             buckets = self._warmup(model)
         with self._swap_lock:
             first = self._active is None
@@ -540,7 +560,8 @@ class ServingEngine:
                 for name in self._schema
             }
             table = Table(packed)
-            with self._dispatch_guard():
+            with self._dispatch_guard(), \
+                    pipeline_fusion.precision_scope(self._policy):
                 from flinkml_tpu.parallel import dispatch as _dispatch
 
                 if _dispatch.has_dispatch_observers():

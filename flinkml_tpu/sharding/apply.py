@@ -149,30 +149,61 @@ def _soft_threshold(x, t):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
 
 
-@functools.lru_cache(maxsize=64)
-def _plan_linear_step(mesh, plan: ShardingPlan, loss: str, optimizer: str,
-                      dim: int, dtype_name: str,
-                      learning_rate: float, momentum: float,
-                      reg_l2: float, reg_l1: float):
-    """ONE jitted plan-sharded step: margin gradient on the (data ×
-    fsdp)-sharded batch, update on the fsdp-sharded state. The plan is
-    part of the cache key (frozen + hashable), so two plans never alias
-    one executable."""
+def linear_step_fn(loss: str, optimizer: str, dtype_name: str,
+                   learning_rate: float, momentum: float,
+                   reg_l2: float, reg_l1: float, policy=None):
+    """The pure ``(state, xb, yb, wb) -> (new_state, loss)`` step of the
+    linear family — the ONE definition behind the plan-sharded trainer,
+    the FML6xx precision-flow validation, and the ``*.policy.json``
+    fixture example programs (a fixture exercises the same jaxpr the
+    product compiles).
+
+    ``dtype_name`` is the STORAGE dtype of the state and the batch (what
+    hyperparameter constants bake to). ``policy`` (a
+    :class:`~flinkml_tpu.precision.PrecisionPolicy`, preset name, or
+    None) enables the mixed-precision contract when it narrows compute
+    below params: the batch and coefficient are cast down to
+    ``policy.compute`` at the step boundary (SNIPPETS.md [3]'s
+    ``to_bf16``), both matmuls carry ``preferred_element_type =
+    policy.accum`` so the dot accumulators run full-width, and every
+    state/optimizer update runs at the storage dtype. The builder does
+    NOT second-guess a mis-declared combination — a ``dtype_name``
+    narrower than ``policy.params`` produces a step that genuinely
+    accumulates narrow, which is exactly what
+    :func:`~flinkml_tpu.analysis.precision.validate_precision` refuses
+    pre-compile (FML601/FML603)."""
+    from flinkml_tpu.precision import resolve_policy
+
+    policy = resolve_policy(policy)
     dt = jnp.dtype(dtype_name)
-    state0 = init_linear_state(dim, optimizer, dt)
-    state_sh = state_shardings(plan, mesh, state0)
-    b_sh = batch_sharding(plan, mesh)
     lr = jnp.asarray(learning_rate, dt)
     mom = jnp.asarray(momentum, dt)
     l2 = jnp.asarray(reg_l2, dt)
     l1 = jnp.asarray(reg_l1, dt)
+    mixed = policy is not None and policy.mixed
+    if mixed:
+        cdt = jnp.dtype(policy.compute_dtype)
+        adt = jnp.dtype(policy.accum_dtype)
 
     def step(state, xb, yb, wb):
         coef = state["coef"]
-        dot = xb @ coef
+        if mixed:
+            # Step-boundary down-cast: the forward/backward matmuls run
+            # at policy.compute, their accumulators at policy.accum.
+            xb_c = xb.astype(cdt)
+            coef_c = coef.astype(cdt)
+            dot = jnp.matmul(xb_c, coef_c, preferred_element_type=adt)
+        else:
+            dot = xb @ coef
         mult, per_ex = margin_terms(loss, dot, yb, wb)
         wsum = jnp.maximum(jnp.sum(wb), jnp.asarray(1e-12, dt))
-        grad = xb.T @ mult / wsum + 2.0 * l2 * coef
+        if mixed:
+            grad = jnp.matmul(
+                xb_c.T, mult.astype(cdt), preferred_element_type=adt
+            ) / wsum + 2.0 * l2 * coef
+            grad = grad.astype(dt)  # state math runs at the storage dtype
+        else:
+            grad = xb.T @ mult / wsum + 2.0 * l2 * coef
         if optimizer == "sgd":
             buf = mom * state["momentum"] + grad
             new_coef = _soft_threshold(coef - lr * buf, lr * l1)
@@ -189,6 +220,61 @@ def _plan_linear_step(mesh, plan: ShardingPlan, loss: str, optimizer: str,
             new_state = {"coef": new_coef, "m": m, "v": v, "step": t}
         loss_val = (jnp.sum(per_ex) + l2 * jnp.sum(jnp.square(coef))) / wsum
         return new_state, loss_val
+
+    return step
+
+
+def validate_linear_precision(policy, step, dim: int, rows: int, dt,
+                              optimizer: str, plan=None,
+                              program: str = "linear_step") -> None:
+    """The pre-compile FML6xx gate for a linear-family step: trace
+    ``step`` abstractly over the REAL state/batch specs and raise
+    :class:`~flinkml_tpu.precision.PrecisionValidationError` on any
+    finding — plus FML605 when ``plan`` is given and its HBM-budget
+    width (the storage ``dt``) disagrees with ``policy.params``."""
+    import jax
+
+    from flinkml_tpu.analysis.precision import (
+        check_policy_plan,
+        validate_precision,
+    )
+
+    dt = np.dtype(dt)
+    state = init_linear_state(dim, optimizer, dt)
+    state_spec = jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(np.shape(leaf), leaf.dtype), state
+    )
+    batch = jax.ShapeDtypeStruct((int(rows), int(dim)), dt)
+    vec = jax.ShapeDtypeStruct((int(rows),), dt)
+    extra = check_policy_plan(
+        policy, dtype_bytes=dt.itemsize,
+        plan_name=getattr(plan, "name", None),
+    ) if plan is not None else ()
+    validate_precision(
+        step, state_spec, batch, vec, vec,
+        policy=policy, param_argnums=(0,), program=program,
+        extra_findings=extra,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_linear_step(mesh, plan: ShardingPlan, loss: str, optimizer: str,
+                      dim: int, dtype_name: str,
+                      learning_rate: float, momentum: float,
+                      reg_l2: float, reg_l1: float, policy=None):
+    """ONE jitted plan-sharded step: margin gradient on the (data ×
+    fsdp)-sharded batch, update on the fsdp-sharded state. The plan AND
+    the precision policy are part of the cache key (both frozen +
+    hashable), so two plans — or a bf16 and an f32 program — never alias
+    one executable."""
+    dt = jnp.dtype(dtype_name)
+    state0 = init_linear_state(dim, optimizer, dt)
+    state_sh = state_shardings(plan, mesh, state0)
+    b_sh = batch_sharding(plan, mesh)
+    step = linear_step_fn(
+        loss, optimizer, dtype_name, learning_rate, momentum,
+        reg_l2, reg_l1, policy=policy,
+    )
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -217,6 +303,7 @@ def train_linear_plan(
     elastic_net: float = 0.0,
     tol: float = 0.0,
     dtype=None,
+    precision=None,
     hbm_budget_bytes: Optional[int] = None,
     checkpoint_manager=None,
     checkpoint_interval: int = 0,
@@ -244,6 +331,19 @@ def train_linear_plan(
     like :func:`~flinkml_tpu.iteration.iterate`: a lost peer stops the
     loop cleanly at the epoch boundary with a terminal snapshot.
 
+    ``precision`` (a :class:`~flinkml_tpu.precision.PrecisionPolicy`, a
+    preset name like ``"mixed"``, or a policy JSON dict) declares the
+    mixed-precision contract: the step's matmuls run at
+    ``policy.compute`` with ``policy.accum`` accumulators while the
+    parameter + optimizer state stays stored at ``dtype`` (which a
+    compliant policy declares as ``policy.params``). The step's jaxpr is
+    validated against the policy BEFORE any compile by the FML6xx
+    precision-flow pass — a bf16-accumulating combination (e.g.
+    ``dtype=bfloat16`` under the ``mixed`` policy) raises
+    :class:`~flinkml_tpu.precision.PrecisionValidationError` carrying
+    FML601/FML603 findings, exactly like :class:`PlanValidationError`
+    for FML5xx. See ``docs/development/precision.md``.
+
     ``sentinel`` (a :class:`~flinkml_tpu.recovery.NumericsSentinel`)
     runs the same fused on-device numerics verdict as ``iterate`` over
     the plan-SHARDED state + loss at every epoch boundary — the verdict
@@ -258,11 +358,24 @@ def train_linear_plan(
 
     if loss not in ("logistic", "hinge", "squared"):
         raise ValueError(f"unsupported loss {loss!r}")
+    from flinkml_tpu.precision import resolve_policy
+
+    policy = resolve_policy(precision)
     x = np.asarray(x)
     n, dim = x.shape
     if n == 0:
         raise ValueError("training table is empty")
-    dt = np.dtype(dtype) if dtype is not None else x.dtype
+    if dtype is not None:
+        dt = np.dtype(dtype)
+    elif policy is not None:
+        # The policy DECLARES the storage width: an undeclared dtype
+        # under a policy trains at policy.params (f64 input data under
+        # x64 would otherwise conflict with params=float32 — FML605).
+        # An EXPLICIT dtype still wins, and a conflicting one is
+        # refused below (FML601/603/605).
+        dt = policy.params_dtype
+    else:
+        dt = x.dtype
     # Canonicalize against the x64 flag so f64 inputs under 32-bit jax
     # train (consistently) in f32 instead of warning per scalar.
     dt = np.dtype(jax.dtypes.canonicalize_dtype(dt))
@@ -292,9 +405,23 @@ def train_linear_plan(
 
     l2 = reg * (1.0 - elastic_net)
     l1 = reg * elastic_net
+    if policy is not None:
+        # The FML6xx gate, pre-compile: the SAME pure step the jitted
+        # program below compiles, traced abstractly and checked against
+        # the declared policy (plus FML605 when the plan's HBM math
+        # width disagrees with policy.params).
+        validate_linear_precision(
+            policy,
+            linear_step_fn(loss, optimizer, dt.name, float(learning_rate),
+                           float(momentum), float(l2), float(l1),
+                           policy=policy),
+            dim, batch_world(plan, mesh), dt, optimizer, plan=plan,
+            program=f"train_linear_plan[{optimizer}/{loss}]",
+        )
     step = _plan_linear_step(
         _inner_mesh(mesh), plan, loss, optimizer, dim, dt.name,
         float(learning_rate), float(momentum), float(l2), float(l1),
+        policy,
     )
     from flinkml_tpu.parallel.mesh import pad_to_multiple
 

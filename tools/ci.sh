@@ -630,6 +630,118 @@ print('sharding smoke: sharded_samples_per_sec per preset:', rates)
 }
 stage "sharding smoke (FSDP parity + FML5xx gate)" sharding_smoke
 
+# Mixed-precision acceptance, device-free (ISSUE 10): (a) a deliberately
+# bf16-ACCUMULATING SGD step (bf16 storage under the 'mixed' policy) is
+# refused pre-compile with FML601/FML603 typed findings, (b) the
+# policy-correct variant (f32 storage, bf16 compute, f32 accum) trains
+# on the 8-CPU-device mesh to a finite model within tolerance of its
+# f32 twin, (c) the fused inference chain under "mixed_inference"
+# reproduces the f32 predictions, (d) the seeded FML6xx policy fixtures
+# are flagged by the analysis CLI (--format json), and (e) the
+# precision_cpu bench stage emits bf16_vs_f32_samples_per_sec_ratio
+# (reported, not gated — CPU bf16 is emulation, the TPU ratio is the
+# device stage's job).
+precision_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 300 python - <<'EOF' || return 1
+import json, subprocess, sys
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.pipeline import PipelineModel
+from flinkml_tpu.precision import MIXED, PrecisionValidationError
+from flinkml_tpu.sharding.plan import REPLICATED
+from flinkml_tpu.sharding.apply import train_linear_plan
+from flinkml_tpu.table import Table
+from flinkml_tpu import pipeline_fusion
+
+dim, n = 64, 256
+rng = np.random.default_rng(0)
+x = rng.normal(size=(n, dim)).astype(np.float32)
+y = (x @ rng.normal(size=dim) > 0).astype(np.float32) * 2 - 1
+mesh = DeviceMesh.for_plan(REPLICATED)
+
+# (a) bf16-accumulating step refused BEFORE any compile.
+try:
+    train_linear_plan(x, y, None, REPLICATED, mesh, max_iter=1,
+                      dtype="bfloat16", precision=MIXED)
+    raise SystemExit("bf16-accumulating SGD step was not refused")
+except PrecisionValidationError as e:
+    rules = {f.rule for f in e.findings}
+    assert "FML601" in rules and "FML603" in rules, rules
+
+# (b) the policy-correct variant: finite + tolerance-bounded vs f32.
+golden = train_linear_plan(x, y, None, REPLICATED, mesh, max_iter=20,
+                           learning_rate=0.5)
+mixed = train_linear_plan(x, y, None, REPLICATED, mesh, max_iter=20,
+                          learning_rate=0.5, precision="mixed")
+assert np.isfinite(mixed).all(), "mixed trainer went non-finite"
+np.testing.assert_allclose(mixed, golden, atol=2e-2)
+
+# (c) fused inference chain under the serving policy: probabilities
+# within bf16 tolerance of f32, decisions equal away from the 0.5
+# boundary (this heredoc runs AMBIENT float32 — exact pred equality is
+# an x64-only contract; see .claude/skills/verify/SKILL.md).
+from flinkml_tpu.models.logistic_regression import LogisticRegression
+from flinkml_tpu.models.scalers import StandardScaler
+t = Table({"features": x.astype(np.float64), "label": (y > 0).astype(np.float64)})
+sc = StandardScaler().set(StandardScaler.INPUT_COL, "features") \
+                     .set(StandardScaler.OUTPUT_COL, "scaled").fit(t)
+(st,) = sc.transform(t)
+lr = LogisticRegression().set(LogisticRegression.FEATURES_COL, "scaled") \
+                         .set(LogisticRegression.LABEL_COL, "label") \
+                         .set(LogisticRegression.SEED, 7) \
+                         .set_max_iter(2).fit(st)
+pm = PipelineModel([sc, lr])
+(o32,) = pm.transform(t)
+p32 = np.asarray(o32.column("prediction"))
+r32 = np.asarray(o32.column("rawPrediction")).astype(np.float64)
+with pipeline_fusion.precision_scope("mixed_inference"):
+    (obf,) = pm.transform(t)
+    pbf = np.asarray(obf.column("prediction"))
+    rbf = np.asarray(obf.column("rawPrediction")).astype(np.float64)
+np.testing.assert_allclose(r32, rbf, atol=2e-2)
+decisive = np.abs(r32[:, 1] - 0.5) > 2e-2
+assert decisive.any()
+assert np.array_equal(p32[decisive], pbf[decisive]), \
+    "bf16 fused predictions diverged away from the decision boundary"
+
+# (d) seeded FML6xx policy fixtures flagged, machine-readably.
+out = subprocess.run(
+    [sys.executable, "-m", "flinkml_tpu.analysis",
+     "tests/analysis_fixtures/bad_precision_fml601_bf16_accum_sgd.policy.json",
+     "--no-selfcheck", "--format", "json"],
+    stdout=subprocess.PIPE, text=True,
+)
+assert out.returncode == 1, "seeded FML6xx policy fixture was not flagged"
+rules = {f["rule"] for f in json.loads(out.stdout)}
+assert "FML601" in rules, rules
+print("precision smoke: FML601/603 refusal pre-compile, mixed SGD",
+      "within 2e-2 of f32, bf16 fused probs within 2e-2 + decisions",
+      "pinned off-boundary, FML6xx fixtures flagged via --format json")
+EOF
+    local out
+    out=$(_FLINKML_BENCH_INNER=precision_cpu timeout 560 python bench.py) \
+        || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+ratios = rec['bf16_vs_f32_samples_per_sec_ratio']
+assert {'fused_chain', 'sgd_train'} <= set(ratios), ratios
+assert all(v > 0 for v in ratios.values()), ratios
+dev = rec['sgd_coef_max_abs_dev']
+import math
+assert math.isfinite(dev) and dev < 2e-2, dev
+print('precision smoke: bf16_vs_f32_samples_per_sec_ratio:', ratios,
+      'sgd coef max|d|', dev)
+"
+}
+stage "precision smoke (FML6xx gate + bf16 A/B)" precision_smoke
+
 example_smoke() {
     local ex
     for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
