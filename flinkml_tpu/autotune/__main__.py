@@ -1,0 +1,74 @@
+"""``python -m flinkml_tpu.autotune`` — run the knob search, check or
+rewrite the committed tuning table.
+
+Modes:
+
+- default (no flags): measure and PRINT the results as JSON, leaving
+  the table untouched (a dry run);
+- ``--commit``: measure and rewrite the table's entry for the current
+  mesh (atomic; other meshes' entries are preserved);
+- ``--check``: validate the table's schema without measuring anything —
+  the CI gate (exit 1 on any problem).
+
+``--quick`` shrinks every scenario to smoke size; committed values
+should come from a full run on an otherwise-idle machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flinkml_tpu.autotune",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--knobs", default=None,
+        help="comma-separated knob subset (default: all)",
+    )
+    parser.add_argument("--quick", action="store_true",
+                        help="smoke-size scenarios")
+    parser.add_argument("--commit", action="store_true",
+                        help="rewrite the tuning table")
+    parser.add_argument("--table", default=None,
+                        help="table path (default: the committed one)")
+    parser.add_argument("--mesh", default=None,
+                        help="override the mesh key to write under")
+    parser.add_argument("--source", default="python -m flinkml_tpu.autotune",
+                        help="provenance string recorded per knob")
+    parser.add_argument("--check", action="store_true",
+                        help="validate the table schema and exit")
+    args = parser.parse_args(argv)
+
+    from flinkml_tpu.autotune.table import load_table
+
+    if args.check:
+        table = load_table(args.table)
+        problems = list(table.check())
+        for p in problems:
+            print(f"tuning-table problem: {p}", file=sys.stderr)
+        if not problems:
+            print(f"tuning table OK: {table.path} "
+                  f"({len(table.meshes())} mesh entries)")
+        return 1 if problems else 0
+
+    from flinkml_tpu.autotune.search import apply_results, search_knobs
+
+    knobs = args.knobs.split(",") if args.knobs else None
+    results = search_knobs(knobs, quick=args.quick, source=args.source)
+    print(json.dumps(results, indent=2, sort_keys=True))
+    if args.commit:
+        table = load_table(args.table)
+        apply_results(table, results, mesh=args.mesh, source=args.source)
+        path = table.save(args.table)
+        print(f"tuning table updated: {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,7 +82,13 @@ def _sparse_layout() -> str:
         return layout
     if os.environ.get("FLINKML_TPU_SORTED_SCATTER", "0") == "1":
         return "sorted"
-    return "unsorted"
+    # No explicit gate: the measured default for this mesh (committed by
+    # the autotune search; docs/development/compile_cache.md), falling
+    # back to the historical "unsorted".
+    from flinkml_tpu.autotune import tuned_default
+
+    return tuned_default("sparse_layout", "unsorted",
+                         allowed=_SPARSE_LAYOUTS)
 
 
 def _soft_threshold(x, t):

@@ -84,7 +84,14 @@ def _als_layout() -> str:
     passes plus a runs-sized sorted scatter. ``FLINKML_TPU_ALS_REDUCTION``
     selects; the device A/B decides the default. The streamed fit always
     uses ``segment`` (its chunks come from cache replay, unsorted)."""
-    layout = os.environ.get("FLINKML_TPU_ALS_REDUCTION", "segment")
+    layout = os.environ.get("FLINKML_TPU_ALS_REDUCTION")
+    if layout is None:
+        # Measured default for this mesh (autotune tuning table), else
+        # the historical "segment".
+        from flinkml_tpu.autotune import tuned_default
+
+        return tuned_default("als_reduction", "segment",
+                             allowed=("segment", "cumsum"))
     if layout not in ("segment", "cumsum"):
         raise ValueError(
             f"FLINKML_TPU_ALS_REDUCTION={layout!r}: expected "

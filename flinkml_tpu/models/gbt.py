@@ -138,7 +138,14 @@ def _hist_layout() -> str:
     :func:`~flinkml_tpu.ops.sparse.chunked_run_totals` — streaming
     passes, no per-level sort. ``FLINKML_TPU_GBT_HISTOGRAM`` selects;
     the device A/B (``tools/gbt_hist_probe.py``) decides the default."""
-    layout = os.environ.get("FLINKML_TPU_GBT_HISTOGRAM", "segment")
+    layout = os.environ.get("FLINKML_TPU_GBT_HISTOGRAM")
+    if layout is None:
+        # Measured default for this mesh (autotune tuning table), else
+        # the historical "segment".
+        from flinkml_tpu.autotune import tuned_default
+
+        return tuned_default("gbt_histogram", "segment",
+                             allowed=("segment", "cumsum"))
     if layout not in ("segment", "cumsum"):
         raise ValueError(
             f"FLINKML_TPU_GBT_HISTOGRAM={layout!r}: expected "

@@ -151,7 +151,14 @@ def _w2v_accum() -> str:
     Numerics: both accumulate the same per-pair gradients; they differ
     only in f32 summation order (pinned in ``tests/test_word2vec.py::
     test_onehot_accum_matches_scatter``)."""
-    layout = os.environ.get("FLINKML_TPU_W2V_ACCUM", "scatter")
+    layout = os.environ.get("FLINKML_TPU_W2V_ACCUM")
+    if layout is None:
+        # Measured default for this mesh (autotune tuning table), else
+        # the historical "scatter".
+        from flinkml_tpu.autotune import tuned_default
+
+        return tuned_default("w2v_accum", "scatter",
+                             allowed=("scatter", "onehot"))
     if layout not in ("scatter", "onehot"):
         raise ValueError(
             f"FLINKML_TPU_W2V_ACCUM={layout!r}: expected 'scatter' or "

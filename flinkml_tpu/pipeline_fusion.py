@@ -176,9 +176,17 @@ class precision_scope:
 
 
 def reset_cache() -> None:
-    """Drop every compiled program (tests; never needed in production)."""
+    """Drop every compiled program (tests; never needed in production).
+    Also drops the active compile-cache store's in-MEMORY artifact layer
+    — compile-counting tests expect a clean slate — while on-disk
+    artifacts (the persistent cache) survive."""
     with _LOCK:
         _CACHE.clear()
+    from flinkml_tpu import compile_cache
+
+    store = compile_cache.active_store()
+    if store is not None:
+        store.drop_memory()
 
 
 def compiled_program_count() -> int:
@@ -413,6 +421,29 @@ def _validate_chain(chain, ext_vals, const_vals, kernels, policy) -> None:
     )
 
 
+def _placement_ids(ext_vals) -> Tuple[int, ...]:
+    """Device ids the chain's inputs sit on — the placement signature
+    the AOT cache keys a loaded executable by (a compiled artifact is
+    bound to one placement; ``jax.jit`` would silently recompile per
+    placement, a ``Compiled`` must be retarget-loaded instead)."""
+    import jax
+
+    for v in ext_vals:
+        devices = getattr(v, "devices", None)
+        if callable(devices):
+            try:
+                ids = tuple(sorted(d.id for d in v.devices()))
+            except Exception:  # noqa: BLE001 — fall through to default
+                continue
+            if ids:
+                return ids
+    # jax_default_device may be a Device, a platform-name STRING (e.g.
+    # JAX_DEFAULT_DEVICE=cpu), or None — only a Device carries an id.
+    default_id = getattr(jax.config.jax_default_device, "id", None)
+    return (default_id if default_id is not None
+            else jax.devices()[0].id,)
+
+
 def _run_program(kernels, ext_names, out_names, ext_specs, const_specs,
                  ext_vals, const_vals, bucket: int, n: int, policy=None):
     """Compile-or-reuse the program for (chain, requested outputs,
@@ -421,10 +452,21 @@ def _run_program(kernels, ext_names, out_names, ext_specs, const_specs,
     :func:`execute_kernel_chain` and passed down explicitly, so a lazy
     column's deferred program — possibly materialized on another thread
     or after the scope exited — compiles under the SAME policy as its
-    eager siblings."""
+    eager siblings.
+
+    With an active :mod:`flinkml_tpu.compile_cache` store the program is
+    AOT-compiled (``jit(...).lower(...).compile()``) through the store:
+    a fresh process LOADS the serialized executable instead of paying
+    the XLA compile, and one replica's compile serves every other
+    replica via retargeted loads. Loaded programs are placement-bound,
+    so the in-memory key grows the input placement signature; without a
+    store the jit path (and its key) is exactly as before."""
     import jax
 
+    from flinkml_tpu import compile_cache
+
     group = metrics.group("pipeline.fusion")
+    store = compile_cache.active_store()
     key = (
         tuple(k.fingerprint for k in kernels),
         tuple(ext_specs),
@@ -433,27 +475,46 @@ def _run_program(kernels, ext_names, out_names, ext_specs, const_specs,
         bucket,
         policy,
     )
+    devsig = _placement_ids(ext_vals) if store is not None else None
+    cache_key = key if store is None else key + (devsig,)
     with _LOCK:
-        program = _CACHE.get(key)
+        program = _CACHE.get(cache_key)
     if program is None and policy is not None:
         # Refusal precedes compile AND caching: a failing chain leaves
         # no executable behind (re-entry revalidates — validation is an
         # abstract trace, compile-free and cheap next to a compile).
+        # This also gates AOT *loads*: a cached artifact only executes
+        # in a process whose policy gate admits the same chain.
         with jax.experimental.enable_x64(True):
             _validate_chain(
                 _chain_fn(kernels, ext_names, out_names, bucket, policy),
                 ext_vals, const_vals, kernels, policy,
             )
-    with _LOCK:
-        program = _CACHE.get(key)
-        if program is None:
-            program = jax.jit(
-                _chain_fn(kernels, ext_names, out_names, bucket, policy)
-            )
-            _CACHE[key] = program
-            compiled = True
-        else:
-            compiled = False
+    compiled = False
+    if program is None and store is not None:
+        def _build():
+            with jax.experimental.enable_x64(True):
+                return jax.jit(
+                    _chain_fn(kernels, ext_names, out_names, bucket, policy)
+                ).lower(tuple(ext_vals), const_vals, np.int32(n)).compile()
+
+        program, outcome = store.get_or_compile(
+            ("pipeline_fusion", key), _build, device_ids=devsig,
+        )
+        with _LOCK:
+            program = _CACHE.setdefault(cache_key, program)
+        compiled = outcome in ("compiled", "uncached")
+        if not compiled:
+            group.counter("aot_loads")
+    elif program is None:
+        with _LOCK:
+            program = _CACHE.get(cache_key)
+            if program is None:
+                program = jax.jit(
+                    _chain_fn(kernels, ext_names, out_names, bucket, policy)
+                )
+                _CACHE[cache_key] = program
+                compiled = True
     if compiled:
         group.counter("compiles")
         for hook in list(on_compile):

@@ -76,6 +76,29 @@ from flinkml_tpu.table import Table
 from flinkml_tpu.utils.metrics import metrics
 
 
+def _tuned_int(knob: str, fallback: int) -> int:
+    """An autotuned integer knob, degraded to ``fallback`` when the
+    table value is non-numeric or non-positive (a config-table typo must
+    not take serving down)."""
+    from flinkml_tpu.autotune import tuned_default
+
+    try:
+        value = int(tuned_default(knob, fallback))
+    except (TypeError, ValueError):
+        return fallback
+    return value if value >= 1 else fallback
+
+
+def _tuned_float(knob: str, fallback: float) -> float:
+    from flinkml_tpu.autotune import tuned_default
+
+    try:
+        value = float(tuned_default(knob, fallback))
+    except (TypeError, ValueError):
+        return fallback
+    return value if value > 0 else fallback
+
+
 @dataclasses.dataclass
 class ServingConfig:
     """Engine knobs (see module docstring for the policies they drive).
@@ -101,10 +124,17 @@ class ServingConfig:
     program name recorded for dispatch-trace observers (the pool tags
     replicas ``serving.pool/<pool>/<replica>`` so the analyzer's FML303
     check can see pool slices).
+
+    ``max_batch_rows`` (the power-of-two dispatch bucket cap) and
+    ``max_wait_ms`` (the batching window) default to None = the
+    MEASURED value for this mesh from the autotune tuning table
+    (knobs ``serving_max_batch_rows`` / ``serving_window_ms``; see
+    ``docs/development/compile_cache.md``), falling back to the
+    historical 1024 rows / 2 ms. An explicit value always wins.
     """
 
-    max_batch_rows: int = 1024
-    max_wait_ms: float = 2.0
+    max_batch_rows: Optional[int] = None
+    max_wait_ms: Optional[float] = None
     max_queue_rows: int = 8192
     default_timeout_ms: Optional[float] = None
     shed_on_overload: bool = True
@@ -174,7 +204,26 @@ class ServingEngine:
         output_cols: Optional[Sequence[str]] = None,
         name: str = "default",
     ):
-        self.config = config or ServingConfig()
+        cfg = config or ServingConfig()
+        # Resolve the autotuned knobs ONCE, at construction: everything
+        # downstream (batcher bounds, warmup bucket coverage, request
+        # validation) reads concrete values. A bad TABLE value degrades
+        # to the static default (the tuned_default contract: a stale or
+        # hand-edited table must never take serving down) — an explicit
+        # bad value still fails loudly in the batcher's own validation.
+        self.config = dataclasses.replace(
+            cfg,
+            max_batch_rows=(
+                int(cfg.max_batch_rows)
+                if cfg.max_batch_rows is not None
+                else _tuned_int("serving_max_batch_rows", 1024)
+            ),
+            max_wait_ms=(
+                float(cfg.max_wait_ms)
+                if cfg.max_wait_ms is not None
+                else _tuned_float("serving_window_ms", 2.0)
+            ),
+        )
         self.name = name
         self._registry = source if isinstance(source, ModelRegistry) else None
         self._fixed_model = None if self._registry is not None else source

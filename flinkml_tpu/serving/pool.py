@@ -134,9 +134,19 @@ class ReplicaPool:
         output_cols: Optional[Sequence[str]] = None,
         name: str = "pool",
         health_policy: Optional[HealthPolicy] = None,
+        share_compiles: bool = True,
     ):
         if devices is not None and meshes is not None:
             raise ValueError("pass devices= or meshes=, not both")
+        # N replicas warm the SAME (program, bucket, policy) identities;
+        # without an AOT artifact layer each per-device placement pays
+        # its own full XLA compile inside jax.jit (invisible to the
+        # fused executor's device-less cache key). share_compiles makes
+        # spin-up route through flinkml_tpu.compile_cache — replica 0
+        # compiles once, every other replica loads the retargeted
+        # artifact — installing a process-local memory store when no
+        # persistent one is configured.
+        self._share_compiles = bool(share_compiles)
         self.name = name
         self._registry = source if isinstance(source, ModelRegistry) else None
         base = config or ServingConfig()
@@ -198,8 +208,14 @@ class ReplicaPool:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ReplicaPool":
-        """Start every replica (load + per-bucket warmup, serially — each
-        replica warms its own device's executables). Returns self."""
+        """Start every replica (load + per-bucket warmup, serially — the
+        first replica compiles each (program, bucket, policy) once and
+        every later replica loads the shared AOT artifact retargeted to
+        its own device; see ``share_compiles``). Returns self."""
+        if self._share_compiles:
+            from flinkml_tpu import compile_cache
+
+            compile_cache.ensure_store()
         for replica in self.replicas:
             replica.engine.start()
         self._started = True

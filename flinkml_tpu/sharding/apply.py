@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import math
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -257,6 +258,44 @@ def validate_linear_precision(policy, step, dim: int, rows: int, dt,
     )
 
 
+class _PlanStepProgram:
+    """The plan-sharded step behind an AOT seam: with no active
+    :mod:`flinkml_tpu.compile_cache` store this IS the jitted step
+    (identical dispatch path to before); with one, each batch shape is
+    AOT-compiled through the store, so a fresh process — an elastic
+    reshard restart, a recovery re-spawn — loads the serialized
+    executable instead of re-paying the XLA compile. SPMD executables
+    are placement-bound, so the artifact key carries the mesh's device
+    ids and topology: a different device set misses (recompiles) rather
+    than mis-loading."""
+
+    def __init__(self, jitted, aot_key: tuple, device_ids: tuple):
+        self._jitted = jitted
+        self._aot_key = aot_key
+        self._device_ids = device_ids
+        self._programs: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, state, xb, yb, wb):
+        from flinkml_tpu import compile_cache
+
+        store = compile_cache.active_store()
+        if store is None:
+            return self._jitted(state, xb, yb, wb)
+        shape_key = (tuple(xb.shape), tuple(yb.shape), tuple(wb.shape))
+        with self._lock:
+            program = self._programs.get(shape_key)
+        if program is None:
+            program, _ = store.get_or_compile(
+                self._aot_key + (shape_key,),
+                lambda: self._jitted.lower(state, xb, yb, wb).compile(),
+                device_ids=self._device_ids,
+            )
+            with self._lock:
+                program = self._programs.setdefault(shape_key, program)
+        return program(state, xb, yb, wb)
+
+
 @functools.lru_cache(maxsize=64)
 def _plan_linear_step(mesh, plan: ShardingPlan, loss: str, optimizer: str,
                       dim: int, dtype_name: str,
@@ -266,7 +305,8 @@ def _plan_linear_step(mesh, plan: ShardingPlan, loss: str, optimizer: str,
     fsdp)-sharded batch, update on the fsdp-sharded state. The plan AND
     the precision policy are part of the cache key (both frozen +
     hashable), so two plans — or a bf16 and an f32 program — never alias
-    one executable."""
+    one executable. Returned wrapped in :class:`_PlanStepProgram`, the
+    persistent-compile-cache seam."""
     dt = jnp.dtype(dtype_name)
     state0 = init_linear_state(dim, optimizer, dt)
     state_sh = state_shardings(plan, mesh, state0)
@@ -278,12 +318,23 @@ def _plan_linear_step(mesh, plan: ShardingPlan, loss: str, optimizer: str,
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    scalar_sh = NamedSharding(_inner_mesh(mesh), P())
-    return jax.jit(
+    inner = _inner_mesh(mesh)
+    scalar_sh = NamedSharding(inner, P())
+    jitted = jax.jit(
         step,
         in_shardings=(state_sh, b_sh, b_sh, b_sh),
         out_shardings=(state_sh, scalar_sh),
     )
+    device_ids = tuple(int(d.id) for d in inner.devices.flatten())
+    aot_key = (
+        "sharding.plan_step",
+        tuple((str(a), int(s)) for a, s in inner.shape.items()),
+        device_ids,
+        plan, loss, optimizer, int(dim), dtype_name,
+        float(learning_rate), float(momentum),
+        float(reg_l2), float(reg_l1), policy,
+    )
+    return _PlanStepProgram(jitted, aot_key, device_ids)
 
 
 def train_linear_plan(

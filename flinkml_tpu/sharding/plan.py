@@ -311,25 +311,54 @@ def per_device_state_bytes(
     return total
 
 
+#: The static candidate order: ascending communication cost (data
+#: parallel's one psum < FSDP's all-gather/reduce-scatter pair <
+#: FSDP×TP's extra tp collectives) — what :func:`infer_plan` uses when
+#: the tuning table has no measured order for the current mesh.
+STATIC_CANDIDATE_ORDER: Tuple[ShardingPlan, ...] = (
+    BATCH_PARALLEL, FSDP, FSDP_TP,
+)
+
+
+def _tuned_candidates() -> Tuple[ShardingPlan, ...]:
+    """The measured candidate order for the current mesh (autotune knob
+    ``infer_plan_order``), else :data:`STATIC_CANDIDATE_ORDER`. Unknown
+    names in a table entry are skipped; presets it omits keep their
+    static relative order at the back."""
+    from flinkml_tpu.autotune import tuned_default
+
+    names = tuned_default("infer_plan_order", None)
+    if not names:
+        return STATIC_CANDIDATE_ORDER
+    by_name = {p.name: p for p in STATIC_CANDIDATE_ORDER}
+    ordered = [by_name[n] for n in names if n in by_name]
+    ordered += [p for p in STATIC_CANDIDATE_ORDER if p not in ordered]
+    return tuple(ordered)
+
+
 def infer_plan(
     mesh,
     param_shapes: Mapping[str, Sequence[int]],
     hbm_budget_bytes: int,
     dtype_bytes: int = 4,
     optimizer_slots: int = 1,
-    candidates: Sequence[ShardingPlan] = (BATCH_PARALLEL, FSDP, FSDP_TP),
+    candidates: Optional[Sequence[ShardingPlan]] = None,
 ) -> ShardingPlan:
-    """The cheapest plan whose per-device parameter + optimizer-state
+    """The best plan whose per-device parameter + optimizer-state
     footprint fits ``hbm_budget_bytes`` on ``mesh``.
 
-    ``candidates`` are tried in order — the default order is ascending
-    communication cost (data parallel's one psum < FSDP's
-    all-gather/reduce-scatter pair < FSDP×TP's extra tp collectives), so
-    "first fit" IS "cheapest fit". Candidates referencing axes the mesh
-    does not have are skipped (a 1-D ``data`` mesh cannot host FSDP).
-    Raises :class:`NoFeasiblePlanError` with every candidate's footprint
-    when nothing fits.
+    ``candidates`` are tried in order. The default order is the tuning
+    table's MEASURED preset order for this mesh when one is committed
+    (``infer_plan_order`` — the autotune search promotes a preset past a
+    cheaper one only on a decisive throughput win), else the static
+    ascending-communication-cost order, in which "first fit" IS
+    "cheapest fit". Candidates referencing axes the mesh does not have
+    are skipped (a 1-D ``data`` mesh cannot host FSDP). Raises
+    :class:`NoFeasiblePlanError` with every candidate's footprint when
+    nothing fits.
     """
+    if candidates is None:
+        candidates = _tuned_candidates()
     axis_sizes = _axis_sizes(mesh)
     budget = int(hbm_budget_bytes)
     tried: List[Tuple[str, str]] = []
