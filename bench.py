@@ -1678,6 +1678,107 @@ def _inner_autotune_cpu() -> dict:
     }
 
 
+def _pallas_stage() -> dict:
+    """Kernel-vs-XLA A/B for the three Pallas sites (ROADMAP item 2 /
+    ISSUE 13): per-site ``pallas/xla`` throughput ratio through the same
+    measurers the autotune search commits from, gated by a bitwise
+    parity probe per site — a wrong kernel must never emit a ratio. On
+    the CPU mesh the Pallas candidates run under the interpreter
+    (``interpret: 1`` in the record — the number audits the harness,
+    not the hardware); the device variant of this stage IS the queued
+    kernel-backend re-tune."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from flinkml_tpu import kernels, pipeline_fusion
+    from flinkml_tpu.autotune.search import (
+        _env,
+        _serving_model,
+        measure_kernel_backend_fused_chain,
+        measure_kernel_backend_segment_sum,
+        measure_kernel_backend_topk,
+    )
+    from flinkml_tpu.table import Table
+
+    # -- parity gates (bitwise at f32) --------------------------------
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 512, 4096), jnp.int32)
+    vals = jnp.asarray(rng.normal(size=4096).astype(np.float32))
+    a = np.asarray(jax.ops.segment_sum(vals, ids, num_segments=512))
+    b = np.asarray(kernels.segment_sum(vals, ids, 512, backend="pallas"))
+    assert a.tobytes() == b.tobytes(), "segment_sum parity violation"
+    sids = jnp.sort(ids)
+    a = np.asarray(jax.ops.segment_sum(
+        vals, sids, num_segments=512, indices_are_sorted=True))
+    b = np.asarray(kernels.segment_sum(
+        vals, sids, 512, indices_are_sorted=True, backend="pallas"))
+    assert a.tobytes() == b.tobytes(), "sorted segment_sum parity violation"
+    xq = jnp.asarray(rng.normal(size=(64, 512)).astype(np.float32))
+    rv, ri = jax.lax.top_k(xq, 8)
+    pv, pi = kernels.top_k(xq, 8, backend="pallas")
+    assert np.asarray(rv).tobytes() == np.asarray(pv).tobytes() and \
+        np.asarray(ri).tobytes() == np.asarray(pi).tobytes(), \
+        "topk parity violation"
+    model, xs = _serving_model()
+    batch = Table({"features": xs[:256], "label": np.zeros(256)})
+
+    def chain_outputs():
+        pipeline_fusion.reset_cache()
+        (out,) = model.transform(batch)
+        return {c: np.asarray(out.column(c)) for c in out.column_names
+                if c not in ("features", "label")}
+
+    with _env("FLINKML_TPU_KERNELS", "fused_chain=xla"):
+        ref = chain_outputs()
+    with _env("FLINKML_TPU_KERNELS", "fused_chain=pallas"):
+        got = chain_outputs()
+    pipeline_fusion.reset_cache()
+    for c in ref:
+        assert ref[c].tobytes() == got[c].tobytes(), \
+            f"fused_chain parity violation on column {c!r}"
+
+    # -- ratios -------------------------------------------------------
+    sites = {
+        "fused_chain": measure_kernel_backend_fused_chain,
+        "segment_sum": measure_kernel_backend_segment_sum,
+        "topk": measure_kernel_backend_topk,
+    }
+    ratios, rates = {}, {}
+    for site, measure in sites.items():
+        cand = measure(True)
+        ratios[site] = round(cand["pallas"] / cand["xla"], 4)
+        rates[site] = {name: round(v, 1) for name, v in cand.items()}
+    return {
+        "kernel_vs_xla_samples_per_sec_ratio": ratios,
+        "rates": rates,
+        "parity_bitwise": 1,
+        "interpret": int(kernels.interpret_mode()),
+    }
+
+
+def _inner_pallas() -> dict:
+    """The DEVICE kernel-backend re-tune (queued in stage_order for the
+    tunnel's return): compiled Mosaic kernels vs XLA on real hardware —
+    the measurement that can flip a committed ``kernel_backend_*``
+    default."""
+    _setup_jax_cache()
+    return _pallas_stage()
+
+
+def _inner_pallas_cpu() -> dict:
+    """Tunnel-immune CPU-mesh variant (interpret-mode pallas) — what
+    CI's ``pallas smoke`` stage parses."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    return _pallas_stage()
+
+
 _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
@@ -1705,6 +1806,8 @@ _INNER_STAGES = {
     "cold_start_child": _inner_cold_start_child,
     "autotune": _inner_autotune,
     "autotune_cpu": _inner_autotune_cpu,
+    "pallas": _inner_pallas,
+    "pallas_cpu": _inner_pallas_cpu,
     "recovery": _inner_recovery,
     "recovery_cpu": _inner_recovery_cpu,
     "converge": _inner_converge,
@@ -1856,7 +1959,8 @@ def main():
         if inner in ("converge_cpu", "pipeline_fused_cpu", "serving_cpu",
                      "serving_scaleout_cpu", "input_pipeline_cpu",
                      "sharded_train_cpu", "precision_cpu",
-                     "cold_start_cpu", "cold_start_child", "autotune_cpu"):
+                     "cold_start_cpu", "cold_start_child", "autotune_cpu",
+                     "pallas_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():
@@ -1928,7 +2032,7 @@ def main():
     stage_order = ["dense", "dense_bf16", "svc", "converge", "ftrl",
                    "kmeans", "kmeans_mnist", "pipeline_fused",
                    "feed_overlap", "input_pipeline", "sharded_train",
-                   "precision", "cold_start", "autotune",
+                   "precision", "cold_start", "autotune", "pallas",
                    "gbt", "als", "word2vec",
                    "converge_sparse", "sparse"]
     results = {}
@@ -2055,6 +2159,11 @@ def main():
         # order, serving bucket/window) — ROADMAP item 5 / VERDICT
         # top_next.
         extras["autotune"] = results["autotune"]
+    if results.get("pallas") is not None:
+        # Per-site Pallas-vs-XLA kernel ratios on real hardware — the
+        # queued kernel-backend device re-tune (ROADMAP item 2 /
+        # ISSUE 13; workload on _pallas_stage).
+        extras["pallas"] = results["pallas"]
     if results.get("converge") is not None:
         # Epochs + wall to fixed tol on device — the second half of
         # BASELINE.json's "samples/sec/chip + epochs-to-converge".

@@ -48,10 +48,11 @@ class GuardViolation(AssertionError):
 
 def _chain_identity(key: Tuple) -> Tuple:
     """A fused-cache key minus its row bucket (index 4 of the layout
-    ``(chain fp, ext specs, const specs, out names, bucket, policy)``):
-    the identity under which a compile at a NEW bucket is policy-allowed.
-    The precision policy STAYS in the identity — a policy flip compiles
-    a genuinely different program."""
+    ``(chain fp, ext specs, const specs, out names, bucket, policy,
+    kernel backend)``): the identity under which a compile at a NEW
+    bucket is policy-allowed. The precision policy AND the kernel
+    backend STAY in the identity — flipping either compiles a genuinely
+    different program."""
     return key[:4] + key[5:]
 
 
@@ -118,7 +119,7 @@ class TransferRetraceGuard:
 
         # Compile policy. Key layout (pipeline_fusion._run_program):
         # (chain fingerprint, ext specs, const specs, out names, bucket,
-        # precision policy).
+        # precision policy, kernel backend).
         counted = 0
         seen_chains = set(self._known_chains)
         # Fingerprint-churn detection: keyed by everything EXCEPT the
@@ -129,10 +130,12 @@ class TransferRetraceGuard:
         # alternative chains (budgeted via allow_compiles) unflagged.
         by_shape: Dict[Tuple, set] = {}
         for key in self._compiled_keys:
-            chain_fp, ext_specs, consts, outs, bucket, policy = key
-            by_shape.setdefault((ext_specs, consts, outs, bucket, policy),
-                                set()).add(chain_fp)
-        for (_ext, _consts, _outs, bucket, _pol), fps in by_shape.items():
+            chain_fp, ext_specs, consts, outs, bucket, policy, backend = key
+            by_shape.setdefault(
+                (ext_specs, consts, outs, bucket, policy, backend), set()
+            ).add(chain_fp)
+        for (_ext, _consts, _outs, bucket, _pol, _be), fps in \
+                by_shape.items():
             if len(fps) >= 3:
                 findings.append(Finding(
                     "FML403",

@@ -1,0 +1,185 @@
+"""The kernel-backend gate — the established measured-default idiom
+(:func:`flinkml_tpu.models._linear_sgd._sparse_layout`) applied to the
+choice between XLA's lowering and the hand-written Pallas kernels.
+
+Three *sites* exist, one per hot inner loop:
+
+- ``fused_chain``  — the fused pipeline executor's per-bucket chain
+  program (:mod:`flinkml_tpu.kernels.chain`),
+- ``segment_sum``  — the padded-ELL sparse gradient scatter-accumulate
+  shared by the linear SGD trainers, ``BatchedCSR.rmatvec``, and the
+  Word2Vec embedding accumulator (:mod:`flinkml_tpu.kernels.segsum`),
+- ``topk``         — the bucketed top-k behind KNN voting and LSH
+  candidate ranking (:mod:`flinkml_tpu.kernels.topk`).
+
+Lookup precedence per site (exactly the sort-class layout gates'):
+``FLINKML_TPU_KERNELS`` env var > the mesh-keyed autotune table's
+``kernel_backend_<site>`` knob > the static default ``"xla"``. The env
+var takes either one backend for every site (``pallas``/``xla``) or a
+per-site list (``fused_chain=pallas,topk=xla``); anything else raises.
+
+Refusal contract: a Pallas backend selected EXPLICITLY (env var or a
+``backend=`` argument) refuses unsupported dtypes/shapes LOUDLY with
+:class:`KernelUnsupportedError` — never a silent wrong-numerics
+fallback. A Pallas backend that came from the tuning table degrades to
+``"xla"`` with one warning (a committed table must never take training
+down — the same never-crash discipline as a stale autotune entry).
+
+Resolved backends are cached per (env value, site) — the lru key every
+consumer must thread into ITS compile cache: the fused executor's
+program key, the trainer factories' ``functools.lru_cache`` keys, and
+``jax.jit`` static args all carry the backend, so flipping the gate can
+never alias a Pallas program with an XLA one.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, Optional, Tuple
+
+from flinkml_tpu.utils.logging import get_logger
+
+_log = get_logger("kernels")
+
+#: The three gated sites (one per hot inner loop — module docstring).
+SITES = ("fused_chain", "segment_sum", "topk")
+
+#: Known backends. ``xla`` is the static default everywhere; ``pallas``
+#: must win a measured A/B (the autotune ``kernel_backend_*`` knobs) or
+#: be asked for explicitly.
+BACKENDS = ("xla", "pallas")
+
+#: Env gate: one backend for all sites, or ``site=backend`` pairs.
+ENV_VAR = "FLINKML_TPU_KERNELS"
+
+#: Force/forbid interpreter-mode ``pallas_call`` (default: interpret on
+#: every non-TPU backend so CPU CI runs the kernels device-free).
+ENV_INTERPRET_VAR = "FLINKML_TPU_KERNELS_INTERPRET"
+
+#: The autotune knob family (``kernel_backend_<site>``).
+KNOB_PREFIX = "kernel_backend_"
+
+_WARNED: set = set()
+
+
+class KernelUnsupportedError(ValueError):
+    """An explicitly-requested Pallas kernel cannot run this dtype/shape.
+
+    Raised INSTEAD of silently falling back: the caller asked for the
+    Pallas backend by name (env var or argument), so degrading quietly
+    would misreport what was measured. The message names the site, the
+    offending dtype/shape, and the supported set.
+    """
+
+
+@functools.lru_cache(maxsize=64)
+def _parse_env(raw: str) -> Dict[str, str]:
+    """``FLINKML_TPU_KERNELS`` → ``{site: backend}`` (``"*"`` = every
+    site). Raises ``ValueError`` on unknown sites/backends — a typo'd
+    gate must fail loudly, not silently select the default."""
+    raw = raw.strip()
+    if not raw:
+        return {}
+    if "=" not in raw:
+        if raw not in BACKENDS:
+            raise ValueError(
+                f"{ENV_VAR}={raw!r}: expected one of {BACKENDS} or "
+                f"site=backend pairs over sites {SITES}"
+            )
+        return {"*": raw}
+    out: Dict[str, str] = {}
+    for pair in raw.split(","):
+        site, _, backend = pair.partition("=")
+        site, backend = site.strip(), backend.strip()
+        if site not in SITES or backend not in BACKENDS:
+            raise ValueError(
+                f"{ENV_VAR}={raw!r}: bad pair {pair!r} — sites {SITES}, "
+                f"backends {BACKENDS}"
+            )
+        out[site] = backend
+    return out
+
+
+def resolve_backend(site: str) -> Tuple[str, bool]:
+    """``(backend, explicit)`` for ``site``: the env var wins (explicit),
+    then the current mesh's ``kernel_backend_<site>`` autotune entry
+    (not explicit), then ``"xla"``."""
+    if site not in SITES:
+        raise ValueError(f"unknown kernel site {site!r}; known: {SITES}")
+    env = _parse_env(os.environ.get(ENV_VAR, ""))
+    chosen = env.get(site, env.get("*"))
+    if chosen is not None:
+        return chosen, True
+    from flinkml_tpu.autotune import tuned_default
+
+    return tuned_default(KNOB_PREFIX + site, "xla", allowed=BACKENDS), False
+
+
+def backend_for(site: str) -> str:
+    """The resolved backend name for ``site`` (gate precedence in the
+    module docstring), ignoring per-call support — use the site
+    dispatchers for a support-checked choice."""
+    return resolve_backend(site)[0]
+
+
+def interpret_mode() -> bool:
+    """Whether ``pallas_call`` should run under the interpreter: yes on
+    every non-TPU backend (CPU CI stays device-free), overridable with
+    ``FLINKML_TPU_KERNELS_INTERPRET=0/1`` (device runs can force the
+    interpreter for a parity bisect)."""
+    forced = os.environ.get(ENV_INTERPRET_VAR)
+    if forced is not None:
+        if forced not in ("0", "1"):
+            raise ValueError(
+                f"{ENV_INTERPRET_VAR}={forced!r}: expected '0' or '1'"
+            )
+        return forced == "1"
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def refuse_or_fallback(site: str, explicit: bool, reason: str) -> str:
+    """The refusal contract: explicit Pallas + unsupported → raise
+    :class:`KernelUnsupportedError`; table-chosen Pallas + unsupported
+    → one warning, then ``"xla"``."""
+    if explicit:
+        raise KernelUnsupportedError(
+            f"kernels[{site}]: the pallas backend was requested "
+            f"explicitly but cannot run here: {reason}. Unset "
+            f"{ENV_VAR} (or pass backend='xla') to use the XLA lowering."
+        )
+    tag = (site, reason)
+    if tag not in _WARNED:
+        _WARNED.add(tag)
+        _log.warning(
+            "kernels[%s]: tuning table selected pallas but %s; using the "
+            "XLA lowering for this site", site, reason,
+        )
+    return "xla"
+
+
+def resolve_checked(site: str, unsupported_reason: Optional[str],
+                    backend: Optional[str] = None) -> str:
+    """Gate resolution + the support check in one step.
+
+    A ``backend`` argument that merely THREADS THROUGH what the gate
+    itself currently resolves (the factory idiom: consumers resolve
+    once at fit time and pass the result down as lru-key material)
+    inherits the gate's own explicitness — a table-chosen pallas still
+    degrades warn-once on unsupported operands instead of crashing the
+    consumer. A backend that DISAGREES with the gate is a genuinely
+    explicit per-call request and refuses loudly."""
+    gate_backend, gate_explicit = resolve_backend(site)
+    if backend is None:
+        backend, explicit = gate_backend, gate_explicit
+    else:
+        if backend not in BACKENDS:
+            raise ValueError(
+                f"backend={backend!r}: expected one of {BACKENDS}"
+            )
+        explicit = True if backend != gate_backend else gate_explicit
+    if backend == "pallas" and unsupported_reason is not None:
+        return refuse_or_fallback(site, explicit, unsupported_reason)
+    return backend

@@ -1,0 +1,200 @@
+"""Pallas padded-ELL segment-sum — the sparse gradient scatter-accumulate.
+
+The sparse trainers' dominant op at Criteo scale is one flat
+``segment_sum`` per step: ``contrib [cells]`` (or ``[cells, k]`` for the
+row-payload W2V accumulator) scatter-added into ``[num_segments]`` by
+``ids [cells]``. XLA lowers the unsorted case through a per-step bitonic
+sort over every cell (the round-4 A/B in
+:func:`flinkml_tpu.models._linear_sgd._sparse_layout`); this kernel
+streams the cells once instead, accumulating into the VMEM-resident
+output block:
+
+- **unsorted**: one sequential pass, ``out[ids[j]] += v[j]`` — addition
+  order equals XLA's CPU scatter order (element order), so the f32
+  result is bit-identical to ``jax.ops.segment_sum``.
+- **``indices_are_sorted=True``**: run-flush specialization — a carried
+  ``(current id, accumulator)`` pair flushes to ``out`` only at run
+  boundaries, turning ``cells`` read-modify-writes of the output into
+  ``runs`` predicated stores. Left-to-right addition within a run keeps
+  bit-parity with the sorted XLA scatter.
+
+Single-block kernel by design: the whole padded flat array and the
+``[num_segments, k]`` output live in one block, which is exactly right
+for the interpreter (CI) and for trainer shapes whose output is the
+VMEM-resident ``[dim]`` gradient; the supported-shape ceiling below
+refuses sizes that could not fit VMEM on a real device rather than
+compiling something that spills. The device re-tune (bench stage
+``pallas``) decides whether this beats XLA's scatter on hardware — the
+gate (:mod:`flinkml_tpu.kernels._gate`) keeps XLA the default until a
+measured win is committed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: Supported-shape ceiling for the COMPILED (non-interpret) path: cells
+#: beyond this cannot stream through one VMEM block on current TPUs.
+MAX_COMPILED_CELLS = 1 << 22
+
+_FLOAT_KINDS = "f"  # jnp dtype.kind for floating
+
+
+def unsupported_reason(values, ids, num_segments: int,
+                       interpret: bool) -> Optional[str]:
+    """Why the Pallas kernel cannot run these operands (None = it can).
+    The wording lands verbatim in :class:`KernelUnsupportedError`."""
+    import jax.numpy as jnp
+
+    v = jnp.asarray(values) if not hasattr(values, "dtype") else values
+    i = jnp.asarray(ids) if not hasattr(ids, "dtype") else ids
+    if v.ndim not in (1, 2):
+        return f"values must be [cells] or [cells, k], got rank {v.ndim}"
+    if i.ndim != 1:
+        return f"ids must be [cells], got rank {i.ndim}"
+    if v.shape[0] != i.shape[0]:
+        return f"values rows {v.shape[0]} != ids rows {i.shape[0]}"
+    if not jnp.issubdtype(v.dtype, jnp.floating):
+        return (f"values dtype {v.dtype} is not floating (supported: "
+                "bfloat16/float32, + float64 under the interpreter)")
+    if not jnp.issubdtype(i.dtype, jnp.integer):
+        return f"ids dtype {i.dtype} is not integer"
+    if num_segments < 1:
+        return f"num_segments must be >= 1, got {num_segments}"
+    if not interpret:
+        if v.dtype == jnp.float64:
+            return "float64 is interpreter-only (TPU has no f64 lanes)"
+        if v.shape[0] > MAX_COMPILED_CELLS:
+            return (f"{v.shape[0]} cells exceed the one-block compiled "
+                    f"ceiling of {MAX_COMPILED_CELLS}")
+    return None
+
+
+def _unsorted_body(ids_ref, val_ref, out_ref):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    out_ref[...] = jnp.zeros_like(out_ref)
+    cells = val_ref.shape[0]
+
+    def body(j, carry):
+        idx = ids_ref[j]
+        out_ref[pl.ds(idx, 1), :] = (
+            out_ref[pl.ds(idx, 1), :] + val_ref[pl.ds(j, 1), :]
+        )
+        return carry
+
+    jax.lax.fori_loop(0, cells, body, 0)
+
+
+def _sorted_body(ids_ref, val_ref, out_ref):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    out_ref[...] = jnp.zeros_like(out_ref)
+    cells = val_ref.shape[0]
+
+    def body(j, carry):
+        cur, acc = carry
+        idx = ids_ref[j]
+        v = val_ref[pl.ds(j, 1), :][0]
+        flush = idx != cur
+
+        @pl.when(flush)
+        def _():
+            out_ref[pl.ds(cur, 1), :] = (
+                out_ref[pl.ds(cur, 1), :] + acc[None, :]
+            )
+
+        return idx, jnp.where(flush, v, acc + v)
+
+    cur, acc = jax.lax.fori_loop(
+        0, cells, body,
+        (ids_ref[0], jnp.zeros_like(val_ref[pl.ds(0, 1), :][0])),
+    )
+    out_ref[pl.ds(cur, 1), :] = out_ref[pl.ds(cur, 1), :] + acc[None, :]
+
+
+def pallas_segment_sum(values, ids, num_segments: int, *,
+                       indices_are_sorted: bool = False,
+                       interpret: Optional[bool] = None):
+    """The Pallas scatter-accumulate (module docstring). Same contract
+    as ``jax.ops.segment_sum(values, ids, num_segments,
+    indices_are_sorted=...)`` for in-range ids; out-of-range ids are the
+    caller's bug on both backends (padding rides the ELL convention:
+    index 0 / value 0 is a no-op add)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from flinkml_tpu.kernels import _gate
+
+    if interpret is None:
+        interpret = _gate.interpret_mode()
+    flat = values.ndim == 1
+    v2 = values[:, None] if flat else values
+    cells, k = v2.shape
+    ids32 = ids.astype(jnp.int32)
+    body = _sorted_body if indices_are_sorted else _unsorted_body
+    out = pl.pallas_call(
+        body,
+        in_specs=[
+            pl.BlockSpec((cells,), lambda: (0,)),
+            pl.BlockSpec((cells, k), lambda: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_segments, k), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_segments, k), v2.dtype),
+        interpret=interpret,
+    )(ids32, v2)
+    return out[:, 0] if flat else out
+
+
+def segment_sum(values, ids, num_segments: int, *,
+                indices_are_sorted: bool = False,
+                backend: Optional[str] = None):
+    """The gated dispatcher: ``jax.ops.segment_sum`` under ``"xla"``,
+    :func:`pallas_segment_sum` under ``"pallas"``. ``backend=None``
+    resolves the gate (env > autotune table > xla); passing a backend
+    is an explicit request and refuses unsupported operands loudly.
+    Zero-cell and zero-segment inputs always take the XLA path (nothing
+    to measure, and the kernel needs >= 1 of each)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flinkml_tpu.kernels import _gate
+
+    values = jnp.asarray(values)
+    ids = jnp.asarray(ids)
+    if values.shape[0] == 0 or num_segments == 0:
+        return jax.ops.segment_sum(
+            values, ids, num_segments=num_segments,
+            indices_are_sorted=indices_are_sorted,
+        )
+    interpret = _gate.interpret_mode()
+    chosen = _gate.resolve_checked(
+        "segment_sum",
+        unsupported_reason(values, ids, num_segments, interpret),
+        backend,
+    )
+    if chosen == "pallas":
+        return pallas_segment_sum(
+            values, ids, num_segments,
+            indices_are_sorted=indices_are_sorted, interpret=interpret,
+        )
+    return jax.ops.segment_sum(
+        values, ids, num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
+    )
+
+
+def factory_backend() -> str:
+    """The segment-sum backend for a trainer FACTORY to bake into its
+    ``functools.lru_cache`` key (the established layout-gate idiom:
+    resolve once at fit time, thread down as a static argument, so a
+    gate flip re-keys the jitted trainer instead of silently reusing
+    the old program)."""
+    from flinkml_tpu.kernels import _gate
+
+    return _gate.backend_for("segment_sum")

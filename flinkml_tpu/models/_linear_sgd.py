@@ -91,6 +91,17 @@ def _sparse_layout() -> str:
                          allowed=_SPARSE_LAYOUTS)
 
 
+def _segsum_backend() -> str:
+    """The kernel-backend gate for the gradient scatter-accumulate
+    (:mod:`flinkml_tpu.kernels`, site ``segment_sum``): env var >
+    autotune table > ``"xla"``. Resolved at FIT time like
+    :func:`_sparse_layout` and threaded through the trainer factories'
+    lru keys, so flipping the gate re-keys the jitted trainer."""
+    from flinkml_tpu import kernels
+
+    return kernels.segsum_backend()
+
+
 def _soft_threshold(x, t):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
 
@@ -152,8 +163,15 @@ def make_dense_step(loss: str, local_bs: int, axis: str):
     return step
 
 
-def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
-    """Sparse (padded-ELL) variant: gather forward, segment-sum gradient."""
+def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int,
+                     segsum_backend: str = "xla"):
+    """Sparse (padded-ELL) variant: gather forward, segment-sum gradient.
+
+    ``segsum_backend`` selects the scatter-accumulate lowering (XLA's
+    ``segment_sum`` or the Pallas kernel, :mod:`flinkml_tpu.kernels`);
+    resolved ONCE at fit time and threaded through the trainer
+    factories' lru keys so a gate flip re-keys the jitted step."""
+    from flinkml_tpu import kernels
 
     def step(coef, epoch, idxl, vall, yl, wl, learning_rate, reg_l2, reg_l1):
         ib = _window(idxl, epoch, local_bs)
@@ -164,8 +182,8 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int):
         dot = jnp.sum(vb * coef[ib], axis=1)
         mult, per_ex = _margin_grad(loss, dot, yb, wb)
         contrib = (vb * mult[:, None]).reshape(-1)
-        grad_local = jax.ops.segment_sum(
-            contrib, ib.reshape(-1), num_segments=dim
+        grad_local = kernels.segment_sum(
+            contrib, ib.reshape(-1), dim, backend=segsum_backend
         )
         grad = jax.lax.psum(grad_local, axis)
         loss_sum = jax.lax.psum(jnp.sum(per_ex.astype(acc)), axis)
@@ -187,7 +205,8 @@ _SPARSE_ARGS_PER_BUCKET = {"unsorted": 4, "sorted": 6, "cumsum": 8}
 
 def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
                               axis: str, dim: int,
-                              layout: str = "unsorted"):
+                              layout: str = "unsorted",
+                              segsum_backend: str = "xla"):
     """nnz-bucketed sparse step: one window per bucket, fused scatters.
 
     The batch is stratified across the nnz buckets (``ops.sparse.
@@ -213,6 +232,8 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
       boundaries, and the only scatter is ``<= max_d`` ascending unique
       column adds. Every cells-sized op is a streaming pass.
     """
+
+    from flinkml_tpu import kernels
 
     def step(coef, epoch, blocks, learning_rate, reg_l2, reg_l1):
         acc = _acc_dt(coef.dtype)
@@ -242,9 +263,9 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
                 contrib = (vb * mult[:, None]).reshape(-1)
                 perm_w = window_of(block[4], epoch)
                 sids_w = window_of(block[5], epoch)
-                grad_local = grad_local + jax.ops.segment_sum(
-                    jnp.take(contrib, perm_w), sids_w,
-                    num_segments=dim, indices_are_sorted=True,
+                grad_local = grad_local + kernels.segment_sum(
+                    jnp.take(contrib, perm_w), sids_w, dim,
+                    indices_are_sorted=True, backend=segsum_backend,
                 )
             elif layout == "cumsum":
                 srowsl, svalsl, endsl, colsl = block[4:]
@@ -264,9 +285,9 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
             loss_l = loss_l + jnp.sum(per_ex.astype(acc))
             wsum_l = wsum_l + jnp.sum(wb.astype(acc))
         if layout == "unsorted":
-            grad_local = jax.ops.segment_sum(
+            grad_local = kernels.segment_sum(
                 jnp.concatenate(contribs), jnp.concatenate(flat_idx),
-                num_segments=dim,
+                dim, backend=segsum_backend,
             )
         grad = jax.lax.psum(grad_local, axis)
         loss_sum = jax.lax.psum(loss_l, axis)
@@ -286,13 +307,16 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
 @functools.lru_cache(maxsize=128)
 def _sparse_trainer_bucketed(mesh, loss: str, local_bss: Tuple[int, ...],
                              axis: str, dim: int,
-                             layout: str = "unsorted"):
+                             layout: str = "unsorted",
+                             segsum_backend: str = "xla"):
     """Bucketed counterpart of :func:`_sparse_trainer` — same carry-style
     contract; the data args are ``k·len(local_bss)`` sharded arrays where
     ``k = _SPARSE_ARGS_PER_BUCKET[layout]`` (indices, values, y, w, plus
-    the layout's pack-time tables)."""
+    the layout's pack-time tables). ``segsum_backend`` is lru-key
+    material: an XLA-scatter trainer and a Pallas-scatter trainer never
+    alias one jitted program."""
     local_step = make_sparse_step_bucketed(
-        loss, local_bss, axis, dim, layout
+        loss, local_bss, axis, dim, layout, segsum_backend
     )
     n_args = _SPARSE_ARGS_PER_BUCKET[layout] * len(local_bss)
 
@@ -366,10 +390,12 @@ def _dense_trainer(mesh, loss: str, local_bs: int, axis: str):
 
 
 @functools.lru_cache(maxsize=128)
-def _sparse_trainer(mesh, loss: str, local_bs: int, axis: str, dim: int):
+def _sparse_trainer(mesh, loss: str, local_bs: int, axis: str, dim: int,
+                    segsum_backend: str = "xla"):
     """Sparse counterpart of :func:`_dense_trainer` — same carry-style
-    contract (see there for the chunked-checkpointing rationale)."""
-    local_step = make_sparse_step(loss, local_bs, axis, dim)
+    contract (see there for the chunked-checkpointing rationale).
+    ``segsum_backend`` is lru-key material (kernel gate idiom)."""
+    local_step = make_sparse_step(loss, local_bs, axis, dim, segsum_backend)
 
     def per_device(coef, epoch, cur_loss, idxl, vall, yl, wl,
                    learning_rate, reg_l2, reg_l1, tol, epoch_end):
@@ -648,7 +674,8 @@ def train_linear_model_sparse(
     n_local = idxd.shape[0] // p_size
     local_bs = min(max(1, math.ceil(global_batch_size / p_size)), n_local)
     trainer = _sparse_trainer(
-        mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS, int(dim)
+        mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS, int(dim),
+        _segsum_backend(),
     )
     return _run_chunked(
         trainer, (idxd, vald, yd, wd), int(dim), vald.dtype,
@@ -865,7 +892,7 @@ def train_linear_model_sparse_csr(
     )
     trainer = _sparse_trainer_bucketed(
         mesh.mesh, loss, tuple(local_bss), DeviceMesh.DATA_AXIS, int(dim),
-        layout,
+        layout, _segsum_backend(),
     )
     return _run_chunked(
         trainer, tuple(data_args), int(dim), jnp.dtype(dtype),
@@ -1115,7 +1142,8 @@ def _train_linear_sparse_stream_multiprocess(
     p_size = mesh.axis_size()
     row_tile = p_size * 8
     axis = DeviceMesh.DATA_AXIS
-    stepper = _sparse_stream_stepper(mesh.mesh, loss, axis, int(sparse_dim))
+    stepper = _sparse_stream_stepper(mesh.mesh, loss, axis, int(sparse_dim),
+                                 _segsum_backend())
     l2 = reg * (1.0 - elastic_net)
     l1 = reg * elastic_net
 
@@ -1460,13 +1488,16 @@ def _stream_stepper(mesh, loss: str, axis: str):
 
 
 @functools.lru_cache(maxsize=64)
-def _sparse_stream_stepper(mesh, loss: str, axis: str, dim: int):
+def _sparse_stream_stepper(mesh, loss: str, axis: str, dim: int,
+                           segsum_backend: str = "xla"):
     """Sparse sibling of :func:`_stream_stepper`: the batch arrives as a
     sharded padded-ELL block (indices/values), the dense ``[dim]``
     coefficient stays replicated. Gather forward + one ``segment_sum``
     gradient scatter (the streamed path has no static windows, so the
     pack-time-sorted ``cumsum`` layout cannot apply here — each batch's
-    cells are seen once per epoch in stream order)."""
+    cells are seen once per epoch in stream order). ``segsum_backend``
+    is lru-key material (kernel gate idiom)."""
+    from flinkml_tpu import kernels
 
     def per_device(coef, ib, vb, yb, wb, learning_rate, reg_l2, reg_l1):
         acc = _acc_dt(vb.dtype)
@@ -1474,7 +1505,8 @@ def _sparse_stream_stepper(mesh, loss: str, axis: str, dim: int):
         mult, per_ex = _margin_grad(loss, dot, yb, wb)
         contrib = (vb * mult[:, None]).reshape(-1)
         grad = jax.lax.psum(
-            jax.ops.segment_sum(contrib, ib.reshape(-1), num_segments=dim),
+            kernels.segment_sum(contrib, ib.reshape(-1), dim,
+                                backend=segsum_backend),
             axis,
         ) + 2.0 * reg_l2 * coef
         loss_sum = jax.lax.psum(jnp.sum(per_ex.astype(acc)), axis) + (
@@ -1878,7 +1910,8 @@ def train_linear_model_stream(
     row_tile = p_size * 8  # bounds the set of padded shapes → compilations
     axis = DeviceMesh.DATA_AXIS
     stepper = (
-        _sparse_stream_stepper(mesh.mesh, loss, axis, int(sparse_dim))
+        _sparse_stream_stepper(mesh.mesh, loss, axis, int(sparse_dim),
+                               _segsum_backend())
         if sparse_dim is not None
         else _stream_stepper(mesh.mesh, loss, axis)
     )

@@ -11,7 +11,11 @@ and ``KnnModel.java:51-197``, rebuilt TPU-first:
     runs gemv-style distances + a top-k priority queue
     (``KnnModel.java:72-197``). Here the query batch hits the model in ONE
     [nq, d] @ [d, n] MXU matmul via the ‖x‖²-2xy+‖y‖² expansion, then
-    ``lax.top_k`` and a one-hot vote — no per-row loop anywhere.
+    a bucketed top-k and a one-hot vote — no per-row loop anywhere. The
+    top-k lowers through the kernel-backend gate
+    (:mod:`flinkml_tpu.kernels`): ``lax.top_k`` by default, the Pallas
+    masked-pass kernel when the gate selects it; the resolved backend is
+    a jit STATIC argument, so a gate flip re-keys the program.
   - Queries are processed in fixed-size chunks so the [chunk, n] distance
     matrix stays HBM-resident at any train-set size.
 """
@@ -97,10 +101,14 @@ class KnnModel(_KnnParams, Model):
         xt = jnp.asarray(self._features)
         ids = jnp.asarray(label_ids, dtype=jnp.int32)
 
+        from flinkml_tpu import kernels
+
+        topk_backend = kernels.topk_backend()
         preds = []
         for start in range(0, x.shape[0], self.CHUNK):
             chunk = jnp.asarray(x[start : start + self.CHUNK])
-            votes = _knn_vote(chunk, xt, ids, k, len(classes))
+            votes = _knn_vote(chunk, xt, ids, k, len(classes),
+                              topk_backend)
             preds.append(np.asarray(votes))
         pred_ids = np.concatenate(preds) if preds else np.zeros(0, dtype=np.int32)
         pred = classes[pred_ids]
@@ -120,15 +128,23 @@ class KnnModel(_KnnParams, Model):
         return model
 
 
-@functools.partial(jax.jit, static_argnames=("k", "num_classes"))
-def _knn_vote(queries, train_x, train_label_ids, k: int, num_classes: int):
+@functools.partial(
+    jax.jit, static_argnames=("k", "num_classes", "topk_backend")
+)
+def _knn_vote(queries, train_x, train_label_ids, k: int, num_classes: int,
+              topk_backend: str = "xla"):
     """Top-k nearest by squared distance, then majority vote.
 
     Ties break toward the smaller class id (deterministic), matching the
     reference's priority-queue + map iteration determinism in spirit.
+    ``topk_backend`` is static (part of the jit key — the lru-keyed gate
+    idiom); both backends break distance ties toward the lower train
+    index, so the vote is backend-invariant.
     """
+    from flinkml_tpu import kernels
+
     d2 = blas.squared_distances(queries, train_x)
-    _, idx = jax.lax.top_k(-d2, k)
+    _, idx = kernels.top_k(-d2, k, backend=topk_backend)
     votes = train_label_ids[idx]  # [nq, k]
     counts = jnp.sum(jax.nn.one_hot(votes, num_classes), axis=1)
     return jnp.argmax(counts, axis=-1).astype(jnp.int32)

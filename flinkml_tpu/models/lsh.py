@@ -133,7 +133,15 @@ class MinHashLSHModel(HasInputCol, HasOutputCol, HasSeed, Model):
         dist_col: str = "distCol",
     ) -> Table:
         """Top-``k`` rows of ``dataset`` by Jaccard distance to ``key``,
-        restricted to rows sharing ≥1 hash value with it."""
+        restricted to rows sharing ≥1 hash value with it.
+
+        Candidate ranking is the device ``top_k`` idiom ``knn.py`` uses
+        (through the kernel-backend gate, :mod:`flinkml_tpu.kernels`)
+        rather than a per-row host ``np.argsort``: ``top_k(-dists, k)``
+        under x64 ranks ascending distance with ties broken toward the
+        LOWER candidate index — exactly the stable-argsort order the
+        host path produced (pinned by the parity test in
+        ``tests/test_kernels.py``)."""
         self._require()
         rows = _active_indices(dataset.column(self.get(self.INPUT_COL)))
         hashes = self._hash_rows(rows)
@@ -146,7 +154,22 @@ class MinHashLSHModel(HasInputCol, HasOutputCol, HasSeed, Model):
         dists = np.asarray([
             _jaccard_distance(rows[i], key_idx) for i in candidates
         ])
-        order = np.argsort(dists, kind="stable")[:k]
+        k_eff = min(int(k), dists.size)
+        if k_eff == 0:
+            order = np.zeros(0, dtype=np.int64)
+        else:
+            import jax
+
+            from flinkml_tpu import kernels
+
+            # x64 keeps the ranking in float64, matching the host
+            # distances exactly (no f32 rounding could reorder ties).
+            with jax.experimental.enable_x64(True):
+                _, order = kernels.top_k(
+                    jax.numpy.asarray(-dists), k_eff,
+                    backend=kernels.topk_backend(),
+                )
+            order = np.asarray(order, dtype=np.int64)
         picked = candidates[order]
         return dataset.take(picked).with_column(dist_col, dists[order])
 

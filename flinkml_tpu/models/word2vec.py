@@ -167,6 +167,16 @@ def _w2v_accum() -> str:
     return layout
 
 
+def _kernels_segsum_backend() -> str:
+    """The kernel-backend gate for the embedding-gradient scatter
+    (:mod:`flinkml_tpu.kernels`, site ``segment_sum``) — resolved at
+    fit time and threaded through the trainer's lru key, mirroring
+    :func:`_w2v_accum`."""
+    from flinkml_tpu import kernels
+
+    return kernels.segsum_backend()
+
+
 def _sgns_pair_grads(vc, uc, un, wb):
     """SGNS pair gradients from the gathered embedding rows — the ONE
     definition of the loss math, shared by the dense and vocab-sharded
@@ -185,9 +195,25 @@ def _sgns_pair_grads(vc, uc, un, wb):
 
 @functools.lru_cache(maxsize=8)
 def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int,
-                  accum: str = "scatter"):
+                  accum: str = "scatter", segsum_backend: str = "xla"):
+    from flinkml_tpu import kernels
+
     def local(centers, contexts, wl, pool, v0, u0, lr, n_steps, key):
         n_local = centers.shape[0]
+
+        def scatter_rows(table_like, ids, rows):
+            """The ``scatter`` accumulation under the kernel-backend
+            gate: ``.at[ids].add`` (XLA) or the Pallas row-payload
+            segment-sum — ``segsum_backend`` is lru-key material, so a
+            gate flip re-keys the jitted trainer."""
+            if segsum_backend == "pallas":
+                return kernels.segment_sum(
+                    rows.reshape(-1, rows.shape[-1]), ids.reshape(-1),
+                    table_like.shape[0], backend="pallas",
+                )
+            return jnp.zeros_like(table_like).at[ids.reshape(-1)].add(
+                rows.reshape(-1, rows.shape[-1])
+            )
 
         def onehot_sum(table_like, ids, rows):
             """``one_hot(ids)^T @ rows`` — the gated scatter-free
@@ -218,6 +244,15 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int,
             if accum == "onehot":
                 dv = onehot_sum(v, c, grad_vc)
                 du = onehot_sum(u, ctx, grad_uc) + onehot_sum(
+                    u, neg, grad_un
+                )
+            elif segsum_backend == "pallas":
+                # Two independent scatters summed (instead of one
+                # chained scatter) — same gradients, f32 order differs
+                # only on ctx/neg id collisions; the kernel parity test
+                # pins each scatter bitwise against its XLA twin.
+                dv = scatter_rows(v, c, grad_vc)
+                du = scatter_rows(u, ctx, grad_uc) + scatter_rows(
                     u, neg, grad_un
                 )
             else:
@@ -512,6 +547,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             trainer = _sgns_trainer(
                 mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
                 self.get(self.NUM_NEGATIVES), _w2v_accum(),
+                _kernels_segsum_backend(),
             )
             v, _u = trainer(
                 mesh.shard_batch(centers_p), mesh.shard_batch(contexts_p),
@@ -770,6 +806,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             trainer = _sgns_trainer(
                 mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
                 self.get(self.NUM_NEGATIVES), _w2v_accum(),
+                _kernels_segsum_backend(),
             )
         lr = jnp.asarray(self.get(self.LEARNING_RATE), jnp.float32)
         base_key = jax.random.PRNGKey(self.get_seed())

@@ -114,16 +114,23 @@ class BatchedCSR:
         w = jnp.asarray(w)
         return jnp.sum(self.values * w[self.indices], axis=1)
 
-    def rmatvec(self, coeffs) -> jax.Array:
+    def rmatvec(self, coeffs, backend=None) -> jax.Array:
         """Transpose product: X^T @ coeffs -> dense [dim].
 
         The sparse-gradient scatter-add (SURVEY.md §7 hard part (a)):
-        flattens to one ``segment_sum`` so XLA emits a single HBM scatter.
+        flattens to one ``segment_sum`` so XLA emits a single HBM
+        scatter. The lowering routes through the kernel-backend gate
+        (:mod:`flinkml_tpu.kernels`, site ``segment_sum``): XLA by
+        default, the Pallas streaming accumulator when the gate — or an
+        explicit ``backend=`` — selects it.
         """
+        from flinkml_tpu import kernels
+
         coeffs = jnp.asarray(coeffs)
         contrib = (self.values * coeffs[:, None]).reshape(-1)
         flat_idx = self.indices.reshape(-1)
-        return jax.ops.segment_sum(contrib, flat_idx, num_segments=self.dim)
+        return kernels.segment_sum(contrib, flat_idx, self.dim,
+                                   backend=backend)
 
     def slice_rows(self, start: int, stop: int) -> "BatchedCSR":
         return BatchedCSR(

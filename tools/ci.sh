@@ -836,6 +836,120 @@ EOF
 stage "cold-start smoke (AOT cache A/B + corrupt entry + table check)" \
     cold_start_smoke
 
+# Pallas smoke (ISSUE 13 acceptance): interpret-mode bitwise parity for
+# all three Pallas kernels (fused chain, padded-ELL segment-sum +
+# sorted specialization, bucketed top-k) against their XLA references
+# on the 8-CPU mesh; the gate's OFF default asserted (every site
+# resolves to xla with no env override — Pallas is opt-in by
+# measurement); explicit-request refusal on an unsupported dtype; then
+# the pallas_cpu bench stage must emit a finite per-site
+# kernel_vs_xla_samples_per_sec_ratio with its own parity tripwire
+# (parity_bitwise == 1 or the stage refuses to emit).
+pallas_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 300 python - <<'EOF' || return 1
+import numpy as np
+import jax
+import jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+
+from flinkml_tpu import kernels, pipeline_fusion
+from flinkml_tpu.table import Table
+
+# Gate-off default: every site resolves to XLA (the committed table's
+# cpu/cpu/8 kernel_backend_* entries are xla — interpret-mode pallas
+# must never be a silent default).
+for site in kernels.SITES:
+    assert kernels.backend_for(site) == "xla", site
+
+rng = np.random.default_rng(0)
+
+# segment-sum: unsorted + sorted-specialized, flat + row payloads.
+ids = jnp.asarray(rng.integers(0, 257, 2_048), jnp.int32)
+vals = jnp.asarray(rng.normal(size=2_048).astype(np.float32))
+a = np.asarray(jax.ops.segment_sum(vals, ids, num_segments=257))
+b = np.asarray(kernels.segment_sum(vals, ids, 257, backend="pallas"))
+assert a.tobytes() == b.tobytes(), "unsorted segment_sum parity"
+sids = jnp.sort(ids)
+a = np.asarray(jax.ops.segment_sum(vals, sids, num_segments=257,
+                                   indices_are_sorted=True))
+b = np.asarray(kernels.segment_sum(vals, sids, 257,
+                                   indices_are_sorted=True,
+                                   backend="pallas"))
+assert a.tobytes() == b.tobytes(), "sorted segment_sum parity"
+rows = jnp.asarray(rng.normal(size=(512, 8)).astype(np.float32))
+a = np.asarray(jax.ops.segment_sum(rows, ids[:512], num_segments=257))
+b = np.asarray(kernels.segment_sum(rows, ids[:512], 257, backend="pallas"))
+assert a.tobytes() == b.tobytes(), "row-payload segment_sum parity"
+
+# top-k: tied values, non-tile-multiple rows, 1-D.
+x = jnp.asarray(rng.normal(size=(37, 129)).astype(np.float32))
+x = x.at[0, 5].set(x[0, 2])
+rv, ri = jax.lax.top_k(x, 9)
+pv, pi = kernels.top_k(x, 9, backend="pallas")
+assert np.asarray(rv).tobytes() == np.asarray(pv).tobytes()
+assert np.asarray(ri).tobytes() == np.asarray(pi).tobytes()
+
+# fused chain: the canonical scaler->logistic chain through the REAL
+# fused executor under each backend, bitwise per column per bucket.
+from flinkml_tpu.models.logistic_regression import LogisticRegression
+from flinkml_tpu.models.scalers import StandardScaler, MinMaxScaler
+from flinkml_tpu.pipeline import PipelineModel
+import os
+xs = rng.normal(size=(200, 5))
+ys = (xs @ np.arange(1.0, 6.0) > 0).astype(np.float64)
+t = Table({"features": xs, "label": ys})
+sc = StandardScaler().set(StandardScaler.INPUT_COL, "features") \
+    .set(StandardScaler.OUTPUT_COL, "s1").fit(t)
+(st,) = sc.transform(t)
+mm = MinMaxScaler().set(MinMaxScaler.INPUT_COL, "s1") \
+    .set(MinMaxScaler.OUTPUT_COL, "s2").fit(st)
+(mt,) = mm.transform(st)
+lr = LogisticRegression().set(LogisticRegression.FEATURES_COL, "s2") \
+    .set(LogisticRegression.LABEL_COL, "label").set_max_iter(2).fit(mt)
+pm = PipelineModel([sc, mm, lr])
+for rows_n in (6, 200):
+    sub = Table({"features": xs[:rows_n], "label": ys[:rows_n]})
+    pipeline_fusion.reset_cache()
+    (ref,) = pm.transform(sub)
+    cols = [c for c in ref.column_names if c not in ("features", "label")]
+    ref_cols = {c: np.asarray(ref.column(c)) for c in cols}
+    os.environ["FLINKML_TPU_KERNELS"] = "fused_chain=pallas"
+    pipeline_fusion.reset_cache()
+    (got,) = pm.transform(sub)
+    del os.environ["FLINKML_TPU_KERNELS"]
+    for c in cols:
+        assert ref_cols[c].tobytes() == np.asarray(got.column(c)).tobytes(), \
+            (rows_n, c)
+
+# loud refusal on an explicitly-requested unsupported dtype.
+try:
+    kernels.top_k(jnp.arange(10), 3, backend="pallas")
+    raise SystemExit("integer top_k was not refused")
+except kernels.KernelUnsupportedError:
+    pass
+print("pallas smoke: 3-kernel interpret parity bitwise, gate defaults",
+      "off, unsupported dtype refused loudly")
+EOF
+    local out
+    out=$(_FLINKML_BENCH_INNER=pallas_cpu timeout 560 python bench.py) \
+        || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, math, sys
+rec = json.loads(sys.stdin.read())
+assert rec['parity_bitwise'] == 1, rec
+ratios = rec['kernel_vs_xla_samples_per_sec_ratio']
+assert {'fused_chain', 'segment_sum', 'topk'} <= set(ratios), ratios
+assert all(math.isfinite(v) and v > 0 for v in ratios.values()), ratios
+assert rec['interpret'] == 1, rec
+print('pallas smoke: kernel_vs_xla_samples_per_sec_ratio:', ratios,
+      '(interpret-mode pallas; device stage queued in bench stage_order)')
+"
+}
+stage "pallas smoke (3-kernel interpret parity + gate-off + bench ratio)" \
+    pallas_smoke
+
 example_smoke() {
     local ex
     for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
