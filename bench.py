@@ -1119,6 +1119,109 @@ def _inner_sharded_train_cpu() -> dict:
     return _sharded_train_stage()
 
 
+def _sharded_embedding_stage(vocab=1 << 20, dim=16, batch=1 << 13,
+                             reps=8, budget=24 << 20) -> dict:
+    """Stage: sharded-embedding lookup+update rows/s (ISSUE 14).
+
+    The vocab is chosen to PROVABLY exceed the per-device budget
+    replicated (table + one optimizer slot = 2 x vocab x dim x 4 B >
+    ``budget``) AND under fsdp-only row sharding (/4 on the 8-device
+    mesh still exceeds it), so the stage first proves the contract:
+    FML503 refuses the replicated placement, ``infer_plan`` routes past
+    fsdp to the embedding plan (the full fsdp x tp product), and the
+    per-shard slice fits. Then each exchange strategy's
+    lookup and update rates are measured through the real
+    ``EmbeddingTable`` programs, with the analytic per-step exchange
+    traffic emitted next to them — linear in ``batch``, independent of
+    vocab (the number that makes "never a vocab-sized psum" auditable;
+    the dense placement's psum bytes are emitted for contrast)."""
+    import jax
+
+    from flinkml_tpu.analysis.sharding_check import check_plan
+    from flinkml_tpu.embeddings import EmbeddingTable
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.sharding import EMBEDDING, REPLICATED, infer_plan
+
+    rng = np.random.default_rng(0)
+    mesh = DeviceMesh.for_plan(EMBEDDING)
+    param = {"bench/embedding": (vocab, dim)}
+    replicated_bytes = vocab * dim * 4 * 2
+    assert replicated_bytes > budget, "vocab does not exceed the budget"
+    refusal = check_plan(REPLICATED, mesh, param_shapes=param,
+                         hbm_budget_bytes=budget, optimizer_slots=1)
+    assert any(f.rule == "FML503" for f in refusal), \
+        "FML503 must refuse the replicated placement"
+    plan = infer_plan(mesh, param, budget, optimizer_slots=1)
+    assert plan.name == "embedding", plan.name
+
+    ids = rng.integers(0, vocab, batch).astype(np.int32)
+    delta = (rng.normal(size=(batch, dim)) * 1e-3).astype(np.float32)
+    lookup_rates, update_rates, traffic = {}, {}, {}
+    table = None
+    for strategy in ("ring", "all_to_all"):
+        table = EmbeddingTable(
+            "bench", vocab, dim, mesh=mesh, plan=plan,
+            hbm_budget_bytes=budget, optimizer_slots=1, scale=0.01,
+        )
+        np.asarray(table.lookup(ids))                     # compile
+        table.scatter_add(ids, delta, strategy=strategy)  # compile
+        start = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(table.lookup(ids))
+        lookup_rates[strategy] = round(
+            batch * reps / (time.perf_counter() - start), 1)
+        start = time.perf_counter()
+        for _ in range(reps):
+            table.scatter_add(ids, delta, strategy=strategy)
+        np.asarray(table.lookup(ids[:1]))                 # sync
+        update_rates[strategy] = round(
+            batch * reps / (time.perf_counter() - start), 1)
+        traffic[strategy] = table.exchange_bytes_per_step(batch, strategy)
+        _log(f"sharded_embedding[{strategy}]: lookup "
+             f"{lookup_rates[strategy]} rows/s, update "
+             f"{update_rates[strategy]} rows/s "
+             f"({len(jax.devices())} devices)")
+    assert np.isfinite(table.to_host()).all()
+    return {
+        "embedding_lookup_rows_per_sec": lookup_rates,
+        "embedding_update_rows_per_sec": update_rates,
+        "exchange_bytes_per_step": traffic,
+        "exchange_bytes_per_row": {
+            s: round(b / batch, 1) for s, b in traffic.items()
+        },
+        "dense_psum_bytes_per_step": 2 * vocab * dim * 4,
+        "vocab": vocab,
+        "dim": dim,
+        "batch": batch,
+        "per_device_budget_bytes": budget,
+        "replicated_bytes": replicated_bytes,
+        "per_shard_bytes": table.per_device_bytes(),
+        "plan": plan.name,
+        "n_shards": table.n_shards,
+        "devices": len(jax.devices()),
+    }
+
+
+def _inner_sharded_embedding() -> dict:
+    """The DEVICE sharded-embedding measurement (queued in stage_order
+    for the tunnel's return — real ICI is what decides ring vs
+    all_to_all; the CPU mesh number stands alone until then)."""
+    _setup_jax_cache()
+    return _sharded_embedding_stage()
+
+
+def _inner_sharded_embedding_cpu() -> dict:
+    """Tunnel-immune 8-virtual-device CPU-mesh variant — what CI's
+    ``embedding smoke`` stage parses."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    return _sharded_embedding_stage()
+
+
 def _recovery_stage(n_batches=24, rows=16_384, dim=256, reps=5) -> dict:
     """Stage: numerics-sentinel overhead + time-to-recover (ISSUE 9).
 
@@ -1799,6 +1902,8 @@ _INNER_STAGES = {
     "input_pipeline_cpu": _inner_input_pipeline_cpu,
     "sharded_train": _inner_sharded_train,
     "sharded_train_cpu": _inner_sharded_train_cpu,
+    "sharded_embedding": _inner_sharded_embedding,
+    "sharded_embedding_cpu": _inner_sharded_embedding_cpu,
     "precision": _inner_precision,
     "precision_cpu": _inner_precision_cpu,
     "cold_start": _inner_cold_start,
@@ -1958,9 +2063,9 @@ def main():
         # (it runs while a watcher capture may hold the device).
         if inner in ("converge_cpu", "pipeline_fused_cpu", "serving_cpu",
                      "serving_scaleout_cpu", "input_pipeline_cpu",
-                     "sharded_train_cpu", "precision_cpu",
-                     "cold_start_cpu", "cold_start_child", "autotune_cpu",
-                     "pallas_cpu"):
+                     "sharded_train_cpu", "sharded_embedding_cpu",
+                     "precision_cpu", "cold_start_cpu", "cold_start_child",
+                     "autotune_cpu", "pallas_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():
@@ -2032,8 +2137,8 @@ def main():
     stage_order = ["dense", "dense_bf16", "svc", "converge", "ftrl",
                    "kmeans", "kmeans_mnist", "pipeline_fused",
                    "feed_overlap", "input_pipeline", "sharded_train",
-                   "precision", "cold_start", "autotune", "pallas",
-                   "gbt", "als", "word2vec",
+                   "sharded_embedding", "precision", "cold_start",
+                   "autotune", "pallas", "gbt", "als", "word2vec",
                    "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two

@@ -116,11 +116,15 @@ def check_plan(
                 seen_axes.add(axis)
 
     # -- FML502 + FML503: shape-aware checks -------------------------------
+    from flinkml_tpu.sharding.plan import is_embedding_param
+
     for name, shape in _plan_params(plan, param_shapes):
         if shape is None:
             continue
         spec = plan.spec_for(name, ndim=len(shape))
+        embedding = is_embedding_param(name)
         sharded_factor = 1
+        sharded_axes: List[str] = []
         for dim_idx, entry in enumerate(spec):
             axes = entry_axes(entry)
             if not axes:
@@ -129,33 +133,64 @@ def check_plan(
             for axis in axes:
                 factor *= sizes.get(axis, 1)
             sharded_factor *= factor
+            sharded_axes.extend(axes)
             if shape[dim_idx] % factor != 0:
                 findings.append(Finding(
                     "FML502",
                     f"plan {plan.name!r} shards {name!r} dim {dim_idx} "
                     f"(extent {shape[dim_idx]}) over axes {axes} of total "
-                    f"size {factor}, which does not divide it",
+                    f"size {factor}, which does not divide it"
+                    + (" (the embedding family's vocab axis must divide "
+                       "the shard product — EmbeddingTable pads its vocab "
+                       "to a multiple automatically; a hand-written plan "
+                       "must pad too)" if embedding and dim_idx == 0
+                       else ""),
                     stage=plan.name, column=name, location=location,
                     fix_hint="pad the dimension to a multiple of the axis "
                              "size, or shard a different dim",
                 ))
-        if hbm_budget_bytes is not None and sharded_factor == 1:
-            n_elems = 1
-            for d in shape:
-                n_elems *= int(d)
-            footprint = n_elems * dtype_bytes * (1 + optimizer_slots)
-            if footprint > int(hbm_budget_bytes):
-                findings.append(Finding(
-                    "FML503",
-                    f"plan {plan.name!r} replicates {name!r} "
-                    f"({tuple(shape)}): {footprint} B of parameter + "
-                    f"optimizer state per device exceeds the HBM budget "
-                    f"of {int(hbm_budget_bytes)} B",
-                    stage=plan.name, column=name, location=location,
-                    fix_hint="shard the family over an fsdp (or fsdp,tp) "
-                             "axis, or use infer_plan to pick a fitting "
-                             "preset",
-                ))
+        if hbm_budget_bytes is not None:
+            # Per-DEVICE footprint of parameter + optimizer state: the
+            # LARGEST slice (per-dim ceil — the same model infer_plan
+            # and EmbeddingTable's padded placement use, so the three
+            # can never disagree at a budget boundary; the replicated
+            # case is factor == 1). Embedding-family tables are the
+            # reason the sharded branch exists: a 100M-row vocab's
+            # PER-SHARD slice plus its same-layout optimizer slots must
+            # fit, not just divide (the original FML503 only caught the
+            # replicated case, so an under-sharded embedding plan OOM'd
+            # inside XLA instead of failing here).
+            from flinkml_tpu.sharding.plan import shard_slice_elems
+
+            per_device = shard_slice_elems(plan, sizes, name, shape) \
+                * dtype_bytes * (1 + optimizer_slots)
+            if per_device > int(hbm_budget_bytes):
+                if sharded_factor == 1:
+                    findings.append(Finding(
+                        "FML503",
+                        f"plan {plan.name!r} replicates {name!r} "
+                        f"({tuple(shape)}): {per_device} B of parameter + "
+                        f"optimizer state per device exceeds the HBM "
+                        f"budget of {int(hbm_budget_bytes)} B",
+                        stage=plan.name, column=name, location=location,
+                        fix_hint="shard the family over an fsdp (or "
+                                 "fsdp,tp) axis, or use infer_plan to "
+                                 "pick a fitting preset",
+                    ))
+                else:
+                    findings.append(Finding(
+                        "FML503",
+                        f"plan {plan.name!r} shards {name!r} "
+                        f"({tuple(shape)}) over axes {sharded_axes} "
+                        f"(product {sharded_factor}), but the per-device "
+                        f"shard still costs {per_device} B of parameter + "
+                        f"optimizer state against the HBM budget of "
+                        f"{int(hbm_budget_bytes)} B",
+                        stage=plan.name, column=name, location=location,
+                        fix_hint="grow the shard axes (a larger fsdp×tp "
+                                 "product), shrink the table, or raise "
+                                 "the budget",
+                    ))
     return findings
 
 

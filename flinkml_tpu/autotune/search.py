@@ -51,6 +51,7 @@ STATIC_DEFAULTS: Dict[str, Any] = {
     "kernel_backend_fused_chain": "xla",
     "kernel_backend_segment_sum": "xla",
     "kernel_backend_topk": "xla",
+    "embedding_exchange": "ring",
 }
 
 
@@ -526,6 +527,85 @@ def measure_kernel_backend_topk(quick: bool = False) -> Dict[str, float]:
     return out
 
 
+def measure_embedding_exchange(quick: bool = False) -> Dict[str, float]:
+    """Lookup+update rows/s per embedding-exchange candidate on a
+    mid-size sharded table (one scatter-exchange + one lookup per
+    measured step — the SGNS/table-update shape). ``ring`` and
+    ``all_to_all`` run the real sharded exchange over the
+    EMBEDDING-shaped mesh; ``dense_psum`` runs the below-threshold
+    placement's real cost — a replicated table with one vocab-sized
+    gradient psum per step over the data mesh — so the committed
+    candidates show exactly where the dense path stops paying (the
+    number behind the subsumed W2V threshold)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from flinkml_tpu.embeddings import EmbeddingTable
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.sharding import EMBEDDING
+
+    vocab, dim, batch = ((1 << 13, 16, 1 << 11) if quick
+                         else (1 << 17, 32, 1 << 13))
+    reps = 3 if quick else 10
+    rng = np.random.default_rng(0)
+    rows0 = rng.normal(size=(vocab, dim)).astype(np.float32)
+    ids = rng.integers(0, vocab, batch).astype(np.int32)
+    delta = (rng.normal(size=(batch, dim)) * 1e-3).astype(np.float32)
+    out: Dict[str, float] = {}
+
+    mesh = DeviceMesh.for_plan(EMBEDDING)
+    for strategy in ("ring", "all_to_all"):
+        table = EmbeddingTable("tune", vocab, dim, mesh=mesh,
+                               plan=EMBEDDING, rows=rows0)
+        table.scatter_add(ids, delta, strategy=strategy)   # compile
+        np.asarray(table.lookup(ids))
+
+        def rate() -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                table.scatter_add(ids, delta, strategy=strategy)
+                np.asarray(table.lookup(ids))
+            return batch * reps / (time.perf_counter() - t0)
+
+        out[strategy] = _timed_rate(rate)
+
+    dmesh = DeviceMesh()
+    p = dmesh.axis_size()
+    axis = DeviceMesh.DATA_AXIS
+
+    def dense_local(table, ids_l, delta_l):
+        upd = jnp.zeros_like(table).at[ids_l].add(delta_l)
+        return table + jax.lax.psum(upd, axis)
+
+    dense_step = jax.jit(jax.shard_map(
+        dense_local, mesh=dmesh.mesh,
+        in_specs=(P(), P(axis), P(axis)), out_specs=P(),
+    ))
+    dense_lookup = jax.jit(lambda table, i: table[i])
+    pad = (-batch) % p
+    ids_p = np.concatenate([ids, np.zeros(pad, np.int32)])
+    delta_p = np.concatenate(
+        [delta, np.zeros((pad, dim), np.float32)]
+    )
+    rows_dev = jnp.asarray(rows0)
+    rows_dev = dense_step(rows_dev, dmesh.shard_batch(ids_p),
+                          dmesh.shard_batch(delta_p))   # compile
+    np.asarray(dense_lookup(rows_dev, ids))
+
+    def dense_rate() -> float:
+        nonlocal rows_dev
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            rows_dev = dense_step(rows_dev, dmesh.shard_batch(ids_p),
+                                  dmesh.shard_batch(delta_p))
+            np.asarray(dense_lookup(rows_dev, ids))
+        return batch * reps / (time.perf_counter() - t0)
+
+    out["dense_psum"] = _timed_rate(dense_rate)
+    return out
+
+
 # -- the search harness ------------------------------------------------------
 
 MEASURERS: Dict[str, Callable[[bool], Dict[str, float]]] = {
@@ -539,6 +619,7 @@ MEASURERS: Dict[str, Callable[[bool], Dict[str, float]]] = {
     "kernel_backend_fused_chain": measure_kernel_backend_fused_chain,
     "kernel_backend_segment_sum": measure_kernel_backend_segment_sum,
     "kernel_backend_topk": measure_kernel_backend_topk,
+    "embedding_exchange": measure_embedding_exchange,
 }
 
 

@@ -74,6 +74,11 @@ KNOWN_KNOBS: Dict[str, str] = {
     "kernel_backend_fused_chain": "rows_per_sec",
     "kernel_backend_segment_sum": "cells_per_sec",
     "kernel_backend_topk": "queries_per_sec",
+    # The sharded-embedding exchange (flinkml_tpu.embeddings): ring vs
+    # all_to_all row routing, with dense_psum (replicated table, dense
+    # gradient psum) as the below-threshold candidate — the knob that
+    # subsumed W2V's static _shard_vocab_threshold.
+    "embedding_exchange": "lookup_update_rows_per_sec",
 }
 
 _CACHE_LOCK = threading.Lock()

@@ -1,0 +1,170 @@
+"""Serving a sharded embedding table through the replica pool.
+
+:class:`EmbeddingLookupModel` is a transformer stage (the serving
+engine's contract: ``transform(Table) -> (Table,)``) that maps a column
+of fixed-width id rows to pooled embedding vectors. Two properties make
+it the subsystem's serving consumer:
+
+- **Mesh-bindable** — the model carries HOST rows only; ``for_mesh``
+  returns a bound clone whose :class:`~flinkml_tpu.embeddings.table.
+  EmbeddingTable` is placed on THAT mesh. The serving engine calls it at
+  install time when ``ServingConfig.mesh`` is set, so a
+  :class:`~flinkml_tpu.serving.pool.ReplicaPool` built over
+  ``slice_meshes(n)`` places one independent shard layout per replica
+  slice — the table loads sharded through the pool, each replica's
+  dispatches hold its slice lock (FML303-auditable), and no replica ever
+  materializes the full table when its slice cannot hold it.
+- **Bitwise-stable predictions** — the lookup is the exchange layer's
+  :func:`~flinkml_tpu.embeddings.exchange.psum_lookup` (exactly one
+  shard contributes per id), so every replica, every world size, and
+  every resharded resume serves identical bytes for identical requests.
+
+``precision`` (default the ``mixed_inference`` preset) gates the pooling
+compute: gathered rows cast to ``policy.compute`` (bf16), the mean
+accumulates at ``policy.accum`` (f32), and the output is emitted at the
+accum width — the same step-boundary-cast contract as the fused
+executor's policy scope (``docs/development/precision.md``).
+
+Input convention: ``input_col`` holds ``[n, L]`` int id rows padded with
+``-1`` (ignored by the pooling mask; an all-padding row maps to the zero
+vector) or ``[n]`` single ids; ``output_col`` receives the ``[n, dim]``
+pooled vectors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from flinkml_tpu.table import Table
+
+
+@functools.lru_cache(maxsize=64)
+def _pooled_lookup_program(mesh, row_entry, shard_rows: int,
+                           compute_dtype: str, accum_dtype: str):
+    """Jitted sharded pooled lookup: masked psum gather + policy-gated
+    mean pool, one program per (mesh, layout, policy) identity."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from flinkml_tpu.embeddings import exchange
+    from flinkml_tpu.sharding.plan import entry_axes
+
+    axes = entry_axes(row_entry)
+    axes_arg = axes if len(axes) > 1 else axes[0]
+    cdt = jnp.dtype(compute_dtype)
+    adt = jnp.dtype(accum_dtype)
+
+    def local(table_shard, ids):
+        mask = ids >= 0
+        safe = jnp.where(mask, ids, 0)
+        rows = exchange.psum_lookup(
+            table_shard, safe, axes=axes_arg, shard_rows=shard_rows
+        )                                             # [n, L, dim]
+        rows_c = jnp.where(mask[..., None], rows.astype(cdt), 0)
+        total = jnp.sum(rows_c, axis=1, dtype=adt)    # accum at policy.accum
+        count = jnp.maximum(jnp.sum(mask, axis=1), 1).astype(adt)
+        return total / count[:, None]
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P(row_entry), P()), out_specs=P(),
+    ))
+
+
+class EmbeddingLookupModel:
+    """See module docstring. Build UNBOUND from host rows; the engine
+    (or a caller) binds a mesh via :meth:`for_mesh`. Unbound transforms
+    run the same math single-device (the parity reference)."""
+
+    def __init__(
+        self,
+        rows: np.ndarray,
+        *,
+        input_col: str = "ids",
+        output_col: str = "vector",
+        precision="mixed_inference",
+        plan=None,
+        hbm_budget_bytes: Optional[int] = None,
+        name: str = "serving",
+    ):
+        from flinkml_tpu.precision import resolve_policy
+
+        self._rows = np.asarray(rows, np.float32)
+        if self._rows.ndim != 2:
+            raise ValueError(f"rows must be [vocab, dim], got "
+                             f"{self._rows.shape}")
+        self.input_col = input_col
+        self.output_col = output_col
+        self.name = name
+        self.plan = plan
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.policy = resolve_policy(precision)
+        self._table = None  # set by for_mesh
+
+    # -- engine protocol ---------------------------------------------------
+    def for_mesh(self, mesh) -> "EmbeddingLookupModel":
+        """A clone bound to ``mesh``: shares the host rows, owns a
+        table placed (plan-validated, budget-checked) on that mesh —
+        what the serving engine calls per replica slice at install."""
+        from flinkml_tpu.embeddings.table import EmbeddingTable
+
+        bound = EmbeddingLookupModel(
+            self._rows, input_col=self.input_col,
+            output_col=self.output_col, precision=self.policy,
+            plan=self.plan, hbm_budget_bytes=self.hbm_budget_bytes,
+            name=self.name,
+        )
+        bound._table = EmbeddingTable(
+            self.name, self._rows.shape[0], self._rows.shape[1],
+            mesh=mesh, plan=self.plan,
+            hbm_budget_bytes=self.hbm_budget_bytes, rows=self._rows,
+        )
+        return bound
+
+    # -- dtype plumbing ----------------------------------------------------
+    def _dtypes(self) -> Tuple[str, str]:
+        if self.policy is not None and self.policy.mixed:
+            return self.policy.compute_dtype, self.policy.accum_dtype
+        return "float32", "float32"
+
+    def _ids(self, table: Table) -> np.ndarray:
+        ids = np.asarray(table.column(self.input_col))
+        if ids.ndim == 1:
+            ids = ids[:, None]
+        if ids.ndim != 2:
+            raise ValueError(
+                f"column {self.input_col!r} must hold [n] or [n, L] int "
+                f"ids, got shape {ids.shape}"
+            )
+        return ids.astype(np.int32)
+
+    # -- transform ---------------------------------------------------------
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        import jax.numpy as jnp
+
+        (table,) = inputs
+        ids = self._ids(table)
+        cdt, adt = self._dtypes()
+        if self._table is not None and self._table.sharded:
+            program = _pooled_lookup_program(
+                self._table.mesh.mesh, self._table.row_entry,
+                self._table.shard_rows, cdt, adt,
+            )
+            out = program(self._table.rows, jnp.asarray(ids))
+        else:
+            rows = (self._table.rows if self._table is not None
+                    else jnp.asarray(self._rows))
+            mask = ids >= 0
+            safe = np.where(mask, ids, 0)
+            gathered = rows[jnp.asarray(safe)]
+            rows_c = jnp.where(
+                jnp.asarray(mask)[..., None],
+                gathered.astype(jnp.dtype(cdt)), 0,
+            )
+            total = jnp.sum(rows_c, axis=1, dtype=jnp.dtype(adt))
+            count = jnp.maximum(mask.sum(axis=1), 1).astype(adt)
+            out = total / jnp.asarray(count)[:, None]
+        return (table.with_column(self.output_col, np.asarray(out)),)

@@ -321,8 +321,37 @@ class ALS(StreamingEstimatorMixin, _ALSParams, Estimator):
     # nnz×k² intermediate to chunk×k² per device.
     CHUNK = 1 << 16
 
+    #: The knob is ACCEPTED at construction so the fit-time refusal can
+    #: explain WHY the embedding-sharded primitive does not apply to
+    #: ALS training (see :meth:`_refuse_sharded_fit`), instead of the
+    #: mixin's generic constructor refusal.
+    _SHARDING_PLAN_AWARE = True
+
+    def _refuse_sharded_fit(self) -> None:
+        """ALS's wall is NOT factor storage — it is the half-step's
+        normal-equation buffers: every user half-step materializes
+        ``A [n_users, k, k]`` / ``b [n_users, k]`` before the batched
+        Cholesky, a vocab-sized working set that row-sharding the
+        factor tables alone cannot cap (the sparse lookup/exchange
+        primitive moves factor ROWS; it has nothing to say about A/b).
+        Refuse loudly — the honest wiring — and point at what DOES
+        exist: :meth:`ALSModel.factor_tables` serves fitted factors
+        sharded, and the streamed fit bounds the COO (not A/b)."""
+        if self.sharding_plan is not None:
+            raise ValueError(
+                "ALS.fit does not thread a sharding_plan: the per-half-"
+                "step normal-equation buffers (A [n, k, k] / b [n, k]) "
+                "are vocab-sized regardless of how the factor tables "
+                "shard, so an embedding-sharded plan would not cap the "
+                "working set it promises to cap. Partition the id space "
+                "upstream (or shrink rank) to fit the half-step; fitted "
+                "factors CAN be served sharded — see "
+                "ALSModel.factor_tables and docs/development/"
+                "embeddings.md."
+            )
 
     def fit(self, *inputs) -> "ALSModel":
+        self._refuse_sharded_fit()
         (table,) = inputs
         if not isinstance(table, Table):
             return self._fit_stream(table)
@@ -768,6 +797,33 @@ class ALSModel(_ALSParams, Model):
         )
         pred = np.where(u_ok & i_ok, pred, np.nan)
         return (table.with_column(self.get(self.PREDICTION_COL), pred),)
+
+    def factor_tables(self, mesh=None, plan=None,
+                      hbm_budget_bytes=None):
+        """The fitted factors as row-sharded
+        :class:`~flinkml_tpu.embeddings.EmbeddingTable`\\ s
+        ``(user_table, item_table)`` — the serving-scale export: a
+        100M-user factor matrix that cannot replicate onto one chip
+        serves sharded (``table.lookup`` is bitwise stable at every
+        world size, and an
+        :class:`~flinkml_tpu.embeddings.serving.EmbeddingLookupModel`
+        built from ``model.item_factors`` rides the ReplicaPool's slice
+        meshes). Plan/budget resolution is EmbeddingTable's (explicit
+        plan > ``infer_plan`` under a budget > replicated)."""
+        from flinkml_tpu.embeddings import EmbeddingTable
+
+        self._require()
+        kw = dict(mesh=mesh, plan=plan, hbm_budget_bytes=hbm_budget_bytes)
+        return (
+            EmbeddingTable(
+                "als/user", *self._user_factors.shape,
+                rows=self._user_factors.astype(np.float32), **kw,
+            ),
+            EmbeddingTable(
+                "als/item", *self._item_factors.shape,
+                rows=self._item_factors.astype(np.float32), **kw,
+            ),
+        )
 
     def recommend_for_all_users(self, num_items: int):
         """Top ``num_items`` items per user: one [users, k] @ [k, items]

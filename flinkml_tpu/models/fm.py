@@ -13,11 +13,13 @@ intercept), scaled per-minibatch like the loss.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from flinkml_tpu.api import Estimator, Model
 from flinkml_tpu.models._streaming import StreamingEstimatorMixin
@@ -89,6 +91,132 @@ def _fm_squared_loss_builder():
     return local_loss
 
 
+# -- the embedding-sharded factor path ---------------------------------------
+#
+# FM's factor matrix V [d, k] IS an embedding table over the feature
+# space — the first wall recsys-scale FM hits (100M hashed features x
+# k factors x 3 Adam-state copies). The sharded fit stores V, w, and
+# their Adam m/v slots row-sharded per an EMBEDDING-family ShardingPlan
+# (rows whole, dim intact — "optimizer state shards like its table"),
+# with the feature COLUMNS of x sharded to match, so both FM matmuls
+# (x·V and x²·V²) contract locally and one batch-sized psum of the
+# [bs, k] partials completes the margins. The sparse lookup/exchange
+# primitive does NOT apply here — FM features are dense vectors, not
+# ids — and the fit refuses plans that split factor rows loudly; what
+# the subsystem contributes is the layout, validation, and checkpoint
+# family.
+
+#: Parameter names of the sharded-FM state — the ``*embedding*``
+#: suffixes land V and w (and, via the shared family rule, their Adam
+#: slots) in the plan's EMBEDDING family.
+_FM_V_PARAM = "fm/v_embedding"
+_FM_W_PARAM = "fm/w_embedding"
+
+
+@functools.lru_cache(maxsize=16)
+def _fm_sharded_trainer(mesh, row_entry, n_shards: int, emu_bs: int,
+                        logistic: bool):
+    """Whole-run Adam trainer with V/w (+ their m/v slots) row-sharded
+    over ``row_entry``'s axes and x column-sharded to match.
+
+    Reproduces the dense :func:`~flinkml_tpu.models._adam.
+    make_adam_trainer` SAMPLING trajectory for a data world of
+    ``n_shards``: the same per-step ``fold_in`` key draws the same
+    ``emu_bs`` local row positions, applied to each of the ``n_shards``
+    contiguous row blocks (exactly the rows the dense trainer's devices
+    would sample from their shards). Per-step margins and gradients
+    agree with the dense trainer up to f32 summation order (pinned
+    against autodiff in ``tests/test_embeddings.py``); per-COORDINATE
+    parameter parity over many steps is deliberately NOT pinned — Adam's
+    first-order update is ``±lr·sign(ĝ)``, which amplifies summation-
+    order noise on near-zero gradients into full ``lr``-sized jumps, so
+    the end-model pin is quality parity (loss/accuracy/prediction
+    agreement), the same contract the convergence-parity suite uses.
+    Gradients are the closed-form FM gradients (the scaffold's
+    no-collectives-inside-grad discipline, by construction)."""
+    from flinkml_tpu.sharding.plan import entry_axes
+
+    axes = entry_axes(row_entry)
+    axes_arg = axes if len(axes) > 1 else axes[0]
+
+    def local(x, y, wt, w0, w_sh, v_sh, reg, lr, max_iter, tol, key):
+        n_rows = x.shape[0]
+        n_block = n_rows // n_shards
+
+        def mb_step(params, m, v, step):
+            w0_, w_, v_ = params
+            k = jax.random.fold_in(key, step)
+            idx = jax.random.randint(k, (emu_bs,), 0, n_block)
+            gidx = (
+                idx[None, :] + (jnp.arange(n_shards) * n_block)[:, None]
+            ).reshape(-1)                       # the dense global batch
+            xb = x[gidx]                        # [B, cols_local]
+            yb, wb = y[gidx], wt[gidx]
+            xv = jax.lax.psum(xb @ v_, axes_arg)              # [B, k]
+            x2v2 = jax.lax.psum((xb * xb) @ (v_ * v_), axes_arg)
+            lin = jax.lax.psum(xb @ w_, axes_arg)             # [B]
+            margin = w0_[0] + lin + 0.5 * jnp.sum(xv * xv - x2v2, axis=1)
+            if logistic:
+                nll = jnp.logaddexp(0.0, margin) - yb * margin
+                g = (jax.nn.sigmoid(margin) - yb) * wb
+            else:
+                err = margin - yb
+                nll = 0.5 * err * err
+                g = err * wb
+            total_w = jnp.maximum(jnp.sum(wb), 1e-12)
+            sq = jax.lax.psum(jnp.sum(w_ * w_) + jnp.sum(v_ * v_),
+                              axes_arg)
+            loss = (jnp.sum(nll * wb)
+                    + reg[0] * sq * jnp.sum(wb)) / total_w
+            # Closed-form FM gradients (all local once the [B, k]
+            # forward partials are psum'd).
+            gw0 = jnp.sum(g)[None] / total_w
+            gw = (xb.T @ g + 2.0 * reg[0] * w_ * jnp.sum(wb)) / total_w
+            gv = (xb.T @ (g[:, None] * xv)
+                  - ((xb * xb).T @ g)[:, None] * v_
+                  + 2.0 * reg[0] * v_ * jnp.sum(wb)) / total_w
+            grads = (gw0, gw, gv)
+            t = (step + 1).astype(jnp.float32)
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree.map(lambda a, gg: b1 * a + (1 - b1) * gg,
+                             m, grads)
+            v2 = jax.tree.map(lambda a, gg: b2 * a + (1 - b2) * gg * gg,
+                              v, grads)
+            params = jax.tree.map(
+                lambda pp, mm, vv: pp - lr * (mm / (1 - b1 ** t))
+                / (jnp.sqrt(vv / (1 - b2 ** t)) + eps),
+                params, m, v2,
+            )
+            return params, m, v2, loss
+
+        params0 = (w0, w_sh, v_sh)
+        m0 = jax.tree.map(jnp.zeros_like, params0)
+        v0 = jax.tree.map(jnp.zeros_like, params0)
+
+        def cond(state):
+            step, _, _, _, prev, cur = state
+            return (step < max_iter) & (jnp.abs(prev - cur) > tol)
+
+        def body(state):
+            step, params, m, v, _, last = state
+            params, m, v, loss = mb_step(params, m, v, step)
+            return step + 1, params, m, v, last, loss
+
+        inf = jnp.asarray(jnp.inf, jnp.float32)
+        state = (jnp.asarray(0, jnp.int32), params0, m0, v0, inf, -inf)
+        step, params, m, v, _, loss = jax.lax.while_loop(cond, body, state)
+        return params, step, loss
+
+    col_sh = P(None, row_entry)
+    param_specs = (P(), P(row_entry), P(row_entry, None))
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(col_sh, P(), P(), P(), P(row_entry), P(row_entry, None),
+                  P(), P(), P(), P(), P()),
+        out_specs=(param_specs, P(), P()),
+    ))
+
+
 class _FMBase(StreamingEstimatorMixin, _FMParams, Estimator):
     """``fit`` also accepts an iterable of batch Tables or a sealed
     :class:`~flinkml_tpu.iteration.datacache.DataCache` — the
@@ -101,6 +229,9 @@ class _FMBase(StreamingEstimatorMixin, _FMParams, Estimator):
 
     _LOGISTIC = True
 
+    #: The FM trainers thread an EMBEDDING-family ShardingPlan through
+    #: the factor matrix (see the sharded-factor section above).
+    _SHARDING_PLAN_AWARE = True
 
     def _loss_builder(self):
         return (
@@ -135,6 +266,20 @@ class _FMBase(StreamingEstimatorMixin, _FMParams, Estimator):
         strength rides as the frozen params-tuple tail, exactly as in
         the in-RAM path."""
         from flinkml_tpu.models._adam import run_streamed_adam
+
+        if self.sharding_plan is not None:
+            # Loud refusal (the embedding subsystem's contract): the
+            # streamed runner replays cache chunks through the shared
+            # replicated-params Adam trainer — silently dropping the
+            # plan would replicate the factor matrix, exactly the OOM
+            # the plan was configured to avoid.
+            raise ValueError(
+                f"{type(self).__name__} streamed fit does not thread a "
+                "sharding_plan yet: the cache-replay trainer keeps "
+                "factors replicated. Use the in-RAM fit (which shards "
+                "V/w + Adam slots per the plan's embedding family), or "
+                "drop the plan."
+            )
 
         features_col = self.get(self.FEATURES_COL)
         label_col = self.get(self.LABEL_COL)
@@ -176,6 +321,72 @@ class _FMBase(StreamingEstimatorMixin, _FMParams, Estimator):
         )
         return self._make_model(params)
 
+    def _fit_sharded(self, x, y, w):
+        """The embedding-sharded factor fit (see the module section):
+        V/w + Adam slots row-sharded per ``self.sharding_plan``, x
+        column-sharded to match; refuses loudly where the layout cannot
+        host the trainer."""
+        from flinkml_tpu.parallel import DeviceMesh
+        from flinkml_tpu.sharding.apply import validate_plan
+        from flinkml_tpu.sharding.plan import entry_axes
+
+        plan = self.sharding_plan
+        spec = plan.spec_for(_FM_V_PARAM, ndim=2)
+        row_entry = spec[0] if spec else None
+        if any(entry_axes(e) for e in spec[1:]):
+            raise ValueError(
+                f"plan {plan.name!r} shards the FM factor matrix's "
+                "factor dim (dim 1): the sharded trainer keeps factor "
+                "rows whole (the embedding-family layout). Use the "
+                "EMBEDDING or FSDP preset."
+            )
+        if not entry_axes(row_entry):
+            raise ValueError(
+                f"plan {plan.name!r} leaves the FM factor family "
+                f"({_FM_V_PARAM!r}) replicated — pass a plan whose "
+                "embedding family shards rows (EMBEDDING/FSDP), or drop "
+                "sharding_plan to train replicated."
+            )
+        mesh = self.mesh or DeviceMesh.for_plan(plan)
+        sizes = dict(mesh.mesh.shape)
+        n_shards = 1
+        for axis in entry_axes(row_entry):
+            n_shards *= int(sizes.get(axis, 1))
+        d = x.shape[1]
+        k = self.get(self.FACTOR_SIZE)
+        d_pad = -(-d // n_shards) * n_shards
+        validate_plan(
+            plan, mesh,
+            param_shapes={_FM_V_PARAM: (d_pad, k), _FM_W_PARAM: (d_pad,)},
+            optimizer_slots=2,  # Adam m/v shard like their table
+        )
+        n_pad = -(-x.shape[0] // n_shards) * n_shards
+        xp = np.zeros((n_pad, d_pad), np.float32)
+        xp[: x.shape[0], :d] = x
+        yp = np.zeros(n_pad, np.float32)
+        yp[: x.shape[0]] = y
+        wp = np.zeros(n_pad, np.float32)
+        wp[: x.shape[0]] = w[: x.shape[0]]
+        w0_0, _, v0, reg = self._params0(d)
+        v0p = np.zeros((d_pad, k), np.float32)
+        v0p[:d] = np.asarray(v0)
+        emu_bs = max(1, self.get(self.GLOBAL_BATCH_SIZE) // n_shards)
+        trainer = _fm_sharded_trainer(
+            mesh.mesh, row_entry, n_shards, emu_bs, self._LOGISTIC
+        )
+        f32 = lambda val: jnp.asarray(val, jnp.float32)
+        (w0, w_sh, v_sh), steps, loss = trainer(
+            xp, yp, wp, np.asarray(w0_0), np.zeros(d_pad, np.float32),
+            v0p, np.asarray(reg),
+            f32(self.get(self.LEARNING_RATE)),
+            jnp.asarray(self.get(self.MAX_ITER), jnp.int32),
+            f32(self.get(self.TOL)),
+            jax.random.fold_in(jax.random.PRNGKey(self.get_seed()), 321),
+        )
+        return self._make_model((
+            np.asarray(w0), np.asarray(w_sh)[:d], np.asarray(v_sh)[:d],
+        ))
+
     def fit(self, *inputs):
         (table,) = inputs
         if not isinstance(table, Table):
@@ -187,6 +398,8 @@ class _FMBase(StreamingEstimatorMixin, _FMParams, Estimator):
         )
         if self._LOGISTIC:
             check_binary_labels(y, type(self).__name__)
+        if self.sharding_plan is not None:
+            return self._fit_sharded(x, y, w)
         d = x.shape[1]
         mesh = self.mesh or DeviceMesh()
         p = mesh.axis_size()

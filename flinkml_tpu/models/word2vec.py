@@ -295,111 +295,45 @@ def _sgns_trainer(mesh, axis: str, local_bs: int, n_neg: int,
 
 @functools.lru_cache(maxsize=8)
 def _sgns_trainer_sharded(mesh, axis: str, local_bs: int, n_neg: int,
-                          shard_rows: int):
-    """Vocab-sharded SGNS trainer: the scale path above
-    ``_shard_vocab_threshold`` (VERDICT r4 weak #6 — the dense trainer
-    psums a full ``[vocab, dim]`` gradient every step, quadratically
-    painful at the 1M+ vocabs the Spark-family operator serves).
+                          shard_rows: int, strategy: str = "ring",
+                          segsum_backend: str = "xla"):
+    """Vocab-sharded SGNS trainer: the scale path above the embedding
+    dense-psum threshold (VERDICT r4 weak #6 — the dense trainer psums
+    a full ``[vocab, dim]`` gradient every step, quadratically painful
+    at the 1M+ vocabs the Spark-family operator serves).
 
-    Both embedding tables shard over the mesh axis (``shard_rows`` rows
-    per device); per-step communication is the BATCH's activation and
-    gradient rows riding a ring (``ppermute``), never a vocab-sized
-    array:
+    Re-expressed on the :mod:`flinkml_tpu.embeddings.exchange`
+    primitives (this trainer is where they were born — the ring loops
+    moved there verbatim, so the ``ring`` strategy is bit-identical to
+    the pre-subsystem trainer): both embedding tables shard over the
+    mesh axis (``shard_rows`` rows per device); per-step communication
+    is the BATCH's activation and gradient rows riding the
+    strategy-gated exchange, never a vocab-sized array:
 
-      1. ONE lookup ring — each device's minibatch ids for BOTH tables
-         (center ids against v; context + negative ids against u) ride
-         together with their row accumulators; every visited device
-         adds the rows whose ids land in its shard (masked gather).
-         P hops return the payload home complete.
+      1. ONE exchange gather — each device's minibatch ids for BOTH
+         tables (center ids against v; context + negative ids against
+         u) resolve to complete rows (``ppermute`` ring hops, or one
+         ``all_to_all`` under the gated strategy).
       2. local pair math — :func:`_sgns_pair_grads`, shared with the
          dense trainer.
-      3. ONE update ring — the scaled gradient rows for both tables
-         make the same loop; every visited device scatter-adds the rows
-         it owns.
+      3. ONE exchange scatter — the scaled gradient rows for both
+         tables route home; the ``all_to_all`` scatter rides the PR 12
+         padded-ELL ``segment_sum`` kernel gate (``segsum_backend`` is
+         lru-key material, like the dense trainer's).
 
-    Per step, per device: 2·P hops x ``(2 + n_neg)·local_bs·dim`` floats
-    = ``2·(2 + n_neg)·global_bs·dim`` floats total — independent of
-    vocab AND of P. Numerics match the dense trainer up to f32
-    summation order (pinned in ``tests/test_word2vec.py``)."""
+    Per step, per device: ``2·(2 + n_neg)·global_bs·dim`` floats total
+    regardless of strategy — independent of vocab AND of P. Numerics
+    match the dense trainer up to f32 summation order; the strategies
+    match each other bitwise on the gather and up to summation order on
+    the scatter (both pinned in ``tests/test_word2vec.py`` /
+    ``tests/test_embeddings.py``)."""
+    from flinkml_tpu.embeddings import exchange
 
     p = dict(mesh.shape)[axis]
-    ring = [(i, (i + 1) % p) for i in range(p)]
-
-    def vary(x):
-        """Mark ``x`` as device-varying over the ring axis if it is not
-        already (zero inits and pool-sampled negative ids enter the
-        rings replicated; batch-derived ids enter varying — the loop
-        carry type must be uniformly varying)."""
-        if axis in jax.typeof(x).vma:
-            return x
-        return jax.lax.pcast(x, axis, to="varying")
 
     def local(centers, contexts, wl, pool, v_shard, u_shard, lr, n_steps,
               key):
         n_local = centers.shape[0]
-        r = jax.lax.axis_index(axis)
-        lo = r * shard_rows
-
-        def owned(ids):
-            """(mask, safe local index) for the ids this shard owns."""
-            local_idx = ids - lo
-            mask = (local_idx >= 0) & (local_idx < shard_rows)
-            return mask, jnp.clip(local_idx, 0, shard_rows - 1)
-
-        def ring_gather(pairs):
-            """Rows of the axis-sharded tables for each ``(table, ids)``
-            in ``pairs`` — ONE ring loop carries every payload (the ring
-            latency is paid once, not per table)."""
-            idss = tuple(vary(ids) for _, ids in pairs)
-            accs = tuple(
-                vary(jnp.zeros(ids.shape + (t.shape[1],), t.dtype))
-                for (t, _), ids in zip(pairs, idss)
-            )
-
-            def hop(_, carry):
-                idss_c, accs_c = carry
-                out = []
-                for (table, _), ids_c, acc_c in zip(pairs, idss_c, accs_c):
-                    mask, safe = owned(ids_c)
-                    out.append(acc_c + jnp.where(
-                        mask[..., None], table[safe], 0.0
-                    ))
-                return (
-                    tuple(jax.lax.ppermute(i, axis, ring) for i in idss_c),
-                    tuple(jax.lax.ppermute(a, axis, ring) for a in out),
-                )
-
-            _, accs_out = jax.lax.fori_loop(0, p, hop, (idss, accs))
-            return accs_out  # p hops: payloads are back home, complete
-
-        def ring_scatter_add(tables, triples):
-            """Scatter-add each ``(table_slot, ids, rows)`` in
-            ``triples`` into ``tables`` (a tuple of axis-sharded
-            tables), again via ONE ring loop for every payload."""
-            idss = tuple(vary(ids) for _, ids, _ in triples)
-            rowss = tuple(vary(rows) for _, _, rows in triples)
-
-            def hop(_, carry):
-                idss_c, rowss_c, tabs = carry
-                tabs = list(tabs)
-                for (slot, _, _), ids_c, rows_c in zip(
-                    triples, idss_c, rowss_c
-                ):
-                    mask, safe = owned(ids_c)
-                    tabs[slot] = tabs[slot].at[safe.reshape(-1)].add(
-                        jnp.where(mask[..., None], rows_c, 0.0)
-                        .reshape(-1, rows_c.shape[-1])
-                    )
-                return (
-                    tuple(jax.lax.ppermute(i, axis, ring) for i in idss_c),
-                    tuple(jax.lax.ppermute(x, axis, ring) for x in rowss_c),
-                    tuple(tabs),
-                )
-
-            _, _, tables = jax.lax.fori_loop(
-                0, p, hop, (idss, rowss, tables)
-            )
-            return tables
 
         def body(state):
             step, v, u = state
@@ -412,17 +346,23 @@ def _sgns_trainer_sharded(mesh, axis: str, local_bs: int, n_neg: int,
             neg = pool[jax.random.randint(
                 k2, (local_bs, n_neg), 0, pool.shape[0]
             )]
-            vc, uc, un = ring_gather(((v, c), (u, ctx), (u, neg)))
+            vc, uc, un = exchange.gather(
+                ((v, c), (u, ctx), (u, neg)),
+                axes=axis, n_shards=p, shard_rows=shard_rows,
+                strategy=strategy,
+            )
             grad_vc, grad_uc, grad_un = _sgns_pair_grads(vc, uc, un, wb)
             tw = jnp.maximum(jax.lax.psum(jnp.sum(wb), axis), 1e-12)
             scale = lr / tw
-            v, u = ring_scatter_add(
+            v, u = exchange.scatter_add(
                 (v, u),
                 (
                     (0, c, -scale * grad_vc),
                     (1, ctx, -scale * grad_uc),
                     (1, neg, -scale * grad_un),
                 ),
+                axes=axis, n_shards=p, shard_rows=shard_rows,
+                strategy=strategy, segsum_backend=segsum_backend,
             )
             return step + 1, v, u
 
@@ -446,11 +386,24 @@ def _sgns_trainer_sharded(mesh, axis: str, local_bs: int, n_neg: int,
 
 def _shard_vocab_threshold() -> int:
     """Vocab size above which the in-RAM fit switches to the
-    vocab-sharded ring trainer on a multi-device mesh (the dense
+    vocab-sharded exchange trainer on a multi-device mesh (the dense
     trainer's per-step [vocab, dim] gradient psum stops scaling there).
-    ``FLINKML_W2V_SHARD_VOCAB`` overrides (0 forces sharding — the test
-    hook)."""
-    return int(os.environ.get("FLINKML_W2V_SHARD_VOCAB", str(1 << 18)))
+    Now the embedding subsystem's ONE dense-psum threshold
+    (:func:`flinkml_tpu.embeddings.dense_vocab_threshold`), which
+    honors ``FLINKML_W2V_SHARD_VOCAB`` as a back-compat alias (0 forces
+    sharding — the test hook)."""
+    from flinkml_tpu.embeddings import dense_vocab_threshold
+
+    return dense_vocab_threshold()
+
+
+def _exchange_strategy() -> str:
+    """The sharded exchange algorithm for this fit — resolved once at
+    fit time (env > autotune ``embedding_exchange`` > ring) and threaded
+    through the trainer's lru key, mirroring :func:`_w2v_accum`."""
+    from flinkml_tpu.embeddings import exchange_strategy
+
+    return exchange_strategy()
 
 
 class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
@@ -532,6 +485,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             trainer = _sgns_trainer_sharded(
                 mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
                 self.get(self.NUM_NEGATIVES), shard_rows,
+                _exchange_strategy(), _kernels_segsum_backend(),
             )
             v, _u = trainer(
                 mesh.shard_batch(centers_p), mesh.shard_batch(contexts_p),
@@ -801,6 +755,7 @@ class Word2Vec(StreamingEstimatorMixin, _Word2VecParams, Estimator):
             trainer = _sgns_trainer_sharded(
                 mesh.mesh, DeviceMesh.DATA_AXIS, local_bs,
                 self.get(self.NUM_NEGATIVES), shard_rows,
+                _exchange_strategy(), _kernels_segsum_backend(),
             )
         else:
             trainer = _sgns_trainer(

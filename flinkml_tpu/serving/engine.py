@@ -393,6 +393,14 @@ class ServingEngine:
             self._install(v, model)
 
     def _install(self, version: Optional[int], model: Any) -> None:
+        if self.config.mesh is not None and hasattr(model, "for_mesh"):
+            # Mesh-bindable models (flinkml_tpu.embeddings.serving): the
+            # shared source model carries host state only; each SPMD
+            # engine binds a clone PLACED on its own mesh slice here, so
+            # a ReplicaPool over slice_meshes loads one sharded table
+            # per replica instead of racing per-replica placements on a
+            # shared object.
+            model = model.for_mesh(self.config.mesh)
         if self.config.refuse_nonfinite:
             # Refuse BEFORE warmup/flip: a follower's failed swap keeps
             # the previous (finite) model serving — the registry's own

@@ -58,13 +58,19 @@ from flinkml_tpu.utils.metrics import metrics
 _log = get_logger("serving.pool")
 
 
-def slice_meshes(n_slices: int, devices: Optional[Sequence[Any]] = None
-                 ) -> List[Any]:
-    """Cut the local devices into ``n_slices`` disjoint 1-D data meshes —
-    the per-replica placement for SPMD serving models. Disjoint slices
-    get independent ``local_execution_lock``s (replicas dispatch
+def slice_meshes(n_slices: int, devices: Optional[Sequence[Any]] = None,
+                 plan: Optional[Any] = None) -> List[Any]:
+    """Cut the local devices into ``n_slices`` disjoint meshes — the
+    per-replica placement for SPMD serving models. Disjoint slices get
+    independent ``local_execution_lock``s (replicas dispatch
     concurrently); a slice overlapping a training mesh composes every
-    intersecting lock, which is what keeps a pool safe beside training."""
+    intersecting lock, which is what keeps a pool safe beside training.
+
+    ``plan=None`` keeps the historical 1-D data slices. Passing a
+    :class:`~flinkml_tpu.sharding.plan.ShardingPlan` shapes each slice
+    for the plan's required axes via ``DeviceMesh.for_plan`` — how a
+    pool serves plan-sharded state (e.g. an ``EMBEDDING``-family table
+    whose rows shard over each slice's ``fsdp × tp`` product)."""
     import jax
 
     from flinkml_tpu.parallel import DeviceMesh
@@ -84,10 +90,11 @@ def slice_meshes(n_slices: int, devices: Optional[Sequence[Any]] = None
             f"slices; pass an explicit devices= subset"
         )
     per = len(devices) // n_slices
+    chunks = [list(devices[i * per:(i + 1) * per]) for i in range(n_slices)]
+    if plan is not None:
+        return [DeviceMesh.for_plan(plan, devices=c) for c in chunks]
     return [
-        DeviceMesh({DeviceMesh.DATA_AXIS: per},
-                   devices=list(devices[i * per:(i + 1) * per]))
-        for i in range(n_slices)
+        DeviceMesh({DeviceMesh.DATA_AXIS: per}, devices=c) for c in chunks
     ]
 
 

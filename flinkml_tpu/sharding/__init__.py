@@ -31,6 +31,8 @@ See ``docs/development/sharding.md``.
 
 from flinkml_tpu.sharding.plan import (  # noqa: F401
     BATCH_PARALLEL,
+    EMBEDDING,
+    EMBEDDING_FAMILY_PATTERNS,
     FSDP,
     FSDP_TP,
     NoFeasiblePlanError,
@@ -38,6 +40,7 @@ from flinkml_tpu.sharding.plan import (  # noqa: F401
     REPLICATED,
     ShardingPlan,
     infer_plan,
+    is_embedding_param,
     layouts_for,
     per_device_state_bytes,
 )
@@ -55,8 +58,11 @@ __all__ = [
     "BATCH_PARALLEL",
     "FSDP",
     "FSDP_TP",
+    "EMBEDDING",
+    "EMBEDDING_FAMILY_PATTERNS",
     "PRESETS",
     "infer_plan",
+    "is_embedding_param",
     "layouts_for",
     "per_device_state_bytes",
     "NoFeasiblePlanError",

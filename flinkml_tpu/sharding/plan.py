@@ -255,8 +255,44 @@ FSDP_TP = ShardingPlan(
     batch_axes=(DATA_AXIS, FSDP_AXIS),
 )
 
+#: Name patterns of the EMBEDDING parameter family: ``[vocab, dim]``
+#: tables whose rows are accessed SPARSELY (by id), so their shard
+#: layout must keep rows whole. :mod:`flinkml_tpu.embeddings` names its
+#: parameters ``<table>/embedding`` (optimizer slots
+#: ``<table>/embedding_slot<i>``) to land in this family.
+EMBEDDING_FAMILY_PATTERNS: Tuple[str, ...] = ("*embedding*",)
+
+
+def is_embedding_param(name: str) -> bool:
+    """Whether ``name`` belongs to the embedding family (matched on the
+    full ``a/b/c`` key path and on its last component, the same double
+    match :meth:`ShardingPlan.spec_for` applies)."""
+    import fnmatch as _fn
+
+    last = name.rsplit("/", 1)[-1]
+    return any(
+        _fn.fnmatchcase(name, p) or _fn.fnmatchcase(last, p)
+        for p in EMBEDDING_FAMILY_PATTERNS
+    )
+
+
+#: The embedding plan (SNIPPETS.md [1]'s ``embeddings()`` spec —
+#: ``PS((fsdp, tp), None)``): embedding-family tables shard their VOCAB
+#: dim over the ``fsdp × tp`` PRODUCT with rows kept whole (the sparse
+#: lookup/exchange primitives of :mod:`flinkml_tpu.embeddings` move
+#: whole rows between shards); every other family shards FSDP×TP-style.
+EMBEDDING = ShardingPlan(
+    "embedding",
+    rules=(
+        ("*embedding*", ((FSDP_AXIS, TP_AXIS),)),
+        ("*", (FSDP_AXIS, TP_AXIS)),
+    ),
+    batch_axes=(DATA_AXIS, FSDP_AXIS),
+)
+
 PRESETS: Dict[str, ShardingPlan] = {
-    p.name: p for p in (REPLICATED, BATCH_PARALLEL, FSDP, FSDP_TP)
+    p.name: p
+    for p in (REPLICATED, BATCH_PARALLEL, FSDP, FSDP_TP, EMBEDDING)
 }
 
 
@@ -278,14 +314,24 @@ def _axis_sizes(mesh) -> Dict[str, int]:
     )
 
 
-def _shard_factor(plan: ShardingPlan, axis_sizes: Mapping[str, int],
-                  name: str, shape: Sequence[int]) -> int:
-    """The product of mesh-axis sizes sharding parameter ``name`` —
-    what its per-device footprint divides by."""
-    factor = 1
-    for axis in plan.param_axes(name, ndim=len(shape)):
-        factor *= int(axis_sizes.get(axis, 1))
-    return factor
+def shard_slice_elems(plan: ShardingPlan, axis_sizes: Mapping[str, int],
+                      name: str, shape: Sequence[int]) -> int:
+    """Elements of parameter ``name``'s LARGEST per-device slice under
+    ``plan``: the product over dims of ``ceil(extent / axis product)``.
+    Per-DIM ceil (not ceil of total/factor) because an unevenly sharded
+    dim pads to its ceiling — this is exactly the padded layout
+    :class:`~flinkml_tpu.embeddings.EmbeddingTable` places, so the
+    footprint ``infer_plan`` accepts and the FML503 check the table
+    runs over its padded shape agree at every budget."""
+    spec = plan.spec_for(name, ndim=len(shape))
+    elems = 1
+    for dim_idx, extent in enumerate(shape):
+        factor = 1
+        if dim_idx < len(spec):
+            for axis in entry_axes(spec[dim_idx]):
+                factor *= int(axis_sizes.get(axis, 1))
+        elems *= math.ceil(int(extent) / factor)
+    return elems
 
 
 def per_device_state_bytes(
@@ -299,25 +345,36 @@ def per_device_state_bytes(
     under ``plan``. ``optimizer_slots`` counts same-shaped optimizer
     companions per parameter (1 for SGD momentum, 2 for Adam m/v) —
     they shard exactly like their parameter, so the multiplier applies
-    uniformly. Ceil-divides per parameter (an uneven shard's largest
-    slice is what must fit)."""
+    uniformly. Ceil-divides per sharded DIM (an uneven shard's largest
+    slice is what must fit — see :func:`shard_slice_elems`)."""
     axis_sizes = _axis_sizes(mesh)
     slots = 1 + int(optimizer_slots)
     total = 0
     for name, shape in param_shapes.items():
-        n = int(np.prod(shape, dtype=np.int64)) if len(shape) else 1
-        factor = _shard_factor(plan, axis_sizes, name, shape)
-        total += math.ceil(n / factor) * dtype_bytes * slots
+        total += shard_slice_elems(plan, axis_sizes, name, shape) \
+            * dtype_bytes * slots
     return total
 
 
 #: The static candidate order: ascending communication cost (data
 #: parallel's one psum < FSDP's all-gather/reduce-scatter pair <
-#: FSDP×TP's extra tp collectives) — what :func:`infer_plan` uses when
-#: the tuning table has no measured order for the current mesh.
+#: FSDP×TP's extra tp collectives < EMBEDDING's per-step sparse row
+#: exchange) — what :func:`infer_plan` uses when the tuning table has
+#: no measured order for the current mesh.
 STATIC_CANDIDATE_ORDER: Tuple[ShardingPlan, ...] = (
-    BATCH_PARALLEL, FSDP, FSDP_TP,
+    BATCH_PARALLEL, FSDP, FSDP_TP, EMBEDDING,
 )
+
+
+def _splits_embedding_rows(plan: ShardingPlan, name: str,
+                           shape: Sequence[int]) -> bool:
+    """Whether ``plan`` would shard a NON-leading dim of embedding-family
+    parameter ``name`` — a layout the sparse lookup/exchange primitives
+    cannot host (they move whole rows between shards), so
+    :func:`infer_plan` must skip it for embedding params even when the
+    footprint fits."""
+    spec = plan.spec_for(name, ndim=len(shape))
+    return any(entry_axes(e) for e in spec[1:])
 
 
 def _tuned_candidates() -> Tuple[ShardingPlan, ...]:
@@ -361,11 +418,30 @@ def infer_plan(
         candidates = _tuned_candidates()
     axis_sizes = _axis_sizes(mesh)
     budget = int(hbm_budget_bytes)
+    embedding_params = [
+        n for n, s in param_shapes.items()
+        if is_embedding_param(n) and len(s) > 1
+    ]
     tried: List[Tuple[str, str]] = []
     for plan in candidates:
         missing = [a for a in plan.required_axes() if a not in axis_sizes]
         if missing:
             tried.append((plan.name, f"mesh lacks axes {missing}"))
+            continue
+        split = [
+            n for n in embedding_params
+            if _splits_embedding_rows(plan, n, param_shapes[n])
+        ]
+        if split:
+            # A plan that splits an embedding table's ROW payload (e.g.
+            # FSDP_TP's dim-1 tp shard) cannot host the sparse
+            # lookup/exchange primitives — skip it for this parameter
+            # universe even though its footprint would fit.
+            tried.append((
+                plan.name,
+                f"splits embedding rows of {split} across a non-leading "
+                "dim (the sparse exchange moves whole rows)",
+            ))
             continue
         footprint = per_device_state_bytes(
             plan, axis_sizes, param_shapes, dtype_bytes, optimizer_slots

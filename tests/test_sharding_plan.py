@@ -98,14 +98,25 @@ def test_spec_truncates_to_parameter_rank():
 
 
 def test_presets_catalog_and_required_axes():
+    from flinkml_tpu.sharding import EMBEDDING
+
     assert set(PRESETS) == {"replicated", "batch_parallel", "fsdp",
-                            "fsdp_tp"}
+                            "fsdp_tp", "embedding"}
     assert REPLICATED.required_axes() == ()
     assert BATCH_PARALLEL.required_axes() == ("data",)
     assert FSDP.required_axes() == ("data", "fsdp")
     assert FSDP_TP.required_axes() == ("data", "fsdp", "tp")
+    assert EMBEDDING.required_axes() == ("data", "fsdp", "tp")
     assert FSDP.layout_tag("coef", ndim=1) == "sharded:0"
     assert REPLICATED.layout_tag("coef", ndim=1) == "replicated"
+    # The embedding family shards the VOCAB dim over the fsdp x tp
+    # PRODUCT with rows whole; non-family params fall through to the
+    # FSDP_TP-style rule.
+    assert EMBEDDING.spec_for("w2v/center_embedding", ndim=2) == \
+        (("fsdp", "tp"),)
+    assert EMBEDDING.spec_for("dense_w", ndim=2) == ("fsdp", "tp")
+    assert EMBEDDING.layout_tag("w2v/center_embedding", ndim=2) == \
+        "sharded:0"
 
 
 def test_plan_json_roundtrip():
@@ -259,6 +270,57 @@ def test_seeded_plan_fixtures_are_flagged(rule):
     }[rule]
     findings = check_plan_file(os.path.join(FIXTURES, path))
     assert [f.rule for f in findings] == [rule]
+
+
+def test_seeded_embedding_plan_fixture_flags_fml502_and_fml503():
+    """The embedding fixture seeds BOTH failure modes of a 100M-row
+    table: an indivisible vocab axis (FML502, with the embedding-
+    specific padding hint) and a per-SHARD footprint that still exceeds
+    the budget (the FML503 branch this subsystem added — the original
+    rule only caught replicated params)."""
+    findings = check_plan_file(
+        os.path.join(FIXTURES, "bad_plan_fml50x_embedding.plan.json")
+    )
+    assert sorted(f.rule for f in findings) == ["FML502", "FML503"]
+    by_rule = {f.rule: f for f in findings}
+    assert "pads its vocab" in by_rule["FML502"].message
+    assert "per-device shard still costs" in by_rule["FML503"].message
+
+
+def test_fml503_counts_sharded_embedding_footprint():
+    """A SHARDED embedding table whose per-shard slice (params +
+    optimizer slots) exceeds the budget is refused — sharding is not a
+    free pass, the shard itself must fit."""
+    from flinkml_tpu.sharding import EMBEDDING
+
+    mesh = {"data": 1, "fsdp": 4, "tp": 2}
+    shapes = {"big/embedding": (1 << 20, 64)}
+    per_shard = (1 << 17) * 64 * 4 * 3  # /8 rows, f32, 2 Adam slots
+    over = check_plan(EMBEDDING, mesh, param_shapes=shapes,
+                      hbm_budget_bytes=per_shard - 1, optimizer_slots=2)
+    assert [f.rule for f in over] == ["FML503"]
+    fits = check_plan(EMBEDDING, mesh, param_shapes=shapes,
+                      hbm_budget_bytes=per_shard, optimizer_slots=2)
+    assert fits == []
+
+
+def test_infer_plan_embedding_routing():
+    """An embedding-family parameter universe skips row-splitting plans
+    (FSDP_TP) and lands on the embedding preset when only the full
+    fsdp x tp product fits."""
+    from flinkml_tpu.sharding import EMBEDDING  # noqa: F401
+
+    mesh = {"data": 1, "fsdp": 4, "tp": 2}
+    shapes = {"w2v/center_embedding": (1 << 16, 16)}
+    rep_bytes = (1 << 16) * 16 * 4 * 2
+    # Fits /4: fsdp keeps its seat (rows stay whole under fsdp).
+    assert infer_plan(mesh, shapes, rep_bytes // 3).name == "fsdp"
+    # Only /8 fits: fsdp_tp would split rows -> embedding takes it.
+    assert infer_plan(mesh, shapes, rep_bytes // 6).name == "embedding"
+    # Nothing fits: the error names WHY fsdp_tp was skipped.
+    with pytest.raises(NoFeasiblePlanError,
+                       match="splits embedding rows"):
+        infer_plan(mesh, shapes, rep_bytes // 20)
 
 
 def test_cli_runs_the_sharding_pass():

@@ -630,6 +630,126 @@ print('sharding smoke: sharded_samples_per_sec per preset:', rates)
 }
 stage "sharding smoke (FSDP parity + FML5xx gate)" sharding_smoke
 
+# Sharded-embedding acceptance, device-free (ISSUE 14): an over-HBM-
+# budget synthetic vocab is (a) refused replicated by FML503, (b) routed
+# to the embedding plan by infer_plan, (c) trained sharded on the 8-CPU
+# mesh through the exchange primitive (loss must fall, numerics vs the
+# dense scatter reference), (d) snapshotted with plan-derived sharded:0
+# tags and resumed bit-equal at world 2, and (e) served through a
+# 2-replica slice-mesh pool under mixed_inference with bitwise-stable
+# predictions. Then the sharded_embedding_cpu bench stage must emit
+# finite lookup/update rows/s with per-step exchange traffic
+# proportional to batch size, not vocab size.
+embedding_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 420 python - <<'EOF' || return 1
+import json, os, tempfile
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from flinkml_tpu.analysis.sharding_check import check_plan
+from flinkml_tpu.embeddings import EmbeddingTable
+from flinkml_tpu.embeddings.serving import EmbeddingLookupModel
+from flinkml_tpu.iteration import CheckpointManager
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.serving.engine import ServingConfig
+from flinkml_tpu.serving.pool import ReplicaPool, slice_meshes
+from flinkml_tpu.sharding import EMBEDDING, REPLICATED, infer_plan
+from flinkml_tpu.table import Table
+
+rng = np.random.default_rng(0)
+vocab, dim = 300_000, 16          # deliberately not a power of two
+budget = 6 << 20                  # replicated 38.4 MB, /4 9.6 MB, /8 4.8 MB
+param = {"smoke/embedding": (vocab, dim)}
+
+# (a) replicated placement refused by FML503 ...
+mesh = DeviceMesh.for_plan(EMBEDDING)
+refusal = check_plan(REPLICATED, mesh, param_shapes=param,
+                     hbm_budget_bytes=budget, optimizer_slots=1)
+assert any(f.rule == "FML503" for f in refusal), refusal
+# ... (b) and infer_plan routes past fsdp to the embedding plan.
+plan = infer_plan(mesh, param, budget, optimizer_slots=1)
+assert plan.name == "embedding", plan.name
+
+# (c) train sharded: SGD on the exchange primitive toward random target
+# rows for a hot id subset; the sharded trajectory must match the dense
+# numpy scatter reference and the loss must fall.
+table = EmbeddingTable("smoke", vocab, dim, mesh=mesh, plan=plan,
+                       hbm_budget_bytes=budget, optimizer_slots=1)
+ref = np.zeros((vocab, dim), np.float32)
+hot = rng.integers(0, vocab, 4096).astype(np.int32)
+target = rng.normal(size=(4096, dim)).astype(np.float32)
+losses = []
+for step in range(6):
+    sel = rng.integers(0, 4096, 2048)
+    ids = hot[sel]
+    cur = np.asarray(table.lookup(ids))
+    grad = cur - target[sel]
+    losses.append(float((grad * grad).mean()))
+    table.scatter_add(ids, (-0.5 * grad).astype(np.float32))
+    np.add.at(ref, ids, -0.5 * grad)
+assert losses[-1] < losses[0], losses
+np.testing.assert_allclose(table.to_host(), ref, rtol=1e-4, atol=1e-5)
+
+with tempfile.TemporaryDirectory() as td:
+    # (d) snapshot with plan-derived tags; resume bit-equal at world 2.
+    mgr = CheckpointManager(td, rescale="reshard")
+    table.save(mgr, 6)
+    with open(os.path.join(td, "ckpt-6", "meta.json")) as fh:
+        meta = json.load(fh)
+    assert meta["layouts"] == ["sharded:0", "sharded:0"], meta["layouts"]
+    mesh2 = DeviceMesh.for_plan(EMBEDDING, devices=jax.devices()[:2])
+    table2, epoch = EmbeddingTable.restore(
+        mgr, "smoke", vocab, dim, mesh=mesh2, plan=EMBEDDING,
+        optimizer_slots=1)
+    assert epoch == 6 and table2.n_shards == 2
+    assert table2.to_host().tobytes() == table.to_host().tobytes(), \
+        "world-2 resume is not bit-equal"
+
+# (e) serve through a 2-replica slice-mesh pool, bf16 mixed_inference.
+model = EmbeddingLookupModel(table.to_host(), plan=EMBEDDING,
+                             precision="mixed_inference", name="smoke")
+qids = rng.integers(0, vocab, size=(64, 4)).astype(np.int32)
+qids[qids % 7 == 0] = -1
+pool = ReplicaPool(
+    model, Table({"ids": qids[:8]}),
+    config=ServingConfig(max_batch_rows=64, max_wait_ms=1.0),
+    meshes=slice_meshes(2, plan=EMBEDDING), output_cols=("vector",),
+    name="emb_smoke",
+).start()
+try:
+    v1 = pool.predict({"ids": qids}).columns["vector"]
+    v2 = pool.predict({"ids": qids}).columns["vector"]
+finally:
+    pool.stop()
+assert v1.tobytes() == v2.tobytes(), "pool predictions not bitwise-stable"
+assert np.isfinite(v1).all() and np.abs(v1).sum() > 0
+print("embedding smoke: FML503 refusal, infer->embedding, sharded train",
+      "parity vs dense scatter, world-2 bit-equal resume, 2-replica",
+      "bf16 pool serving bitwise-stable")
+EOF
+    local out
+    out=$(_FLINKML_BENCH_INNER=sharded_embedding_cpu timeout 420 \
+        python bench.py) || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+lk, up = rec['embedding_lookup_rows_per_sec'], rec['embedding_update_rows_per_sec']
+assert {'ring', 'all_to_all'} <= set(lk) and {'ring', 'all_to_all'} <= set(up)
+assert all(v > 0 for v in list(lk.values()) + list(up.values())), (lk, up)
+per_row = rec['exchange_bytes_per_row']
+assert all(v < rec['vocab'] for v in per_row.values()), per_row
+assert rec['plan'] == 'embedding', rec['plan']
+print('embedding smoke: lookup rows/s', lk, 'update rows/s', up,
+      'exchange B/row', per_row, '(dense psum would move',
+      rec['dense_psum_bytes_per_step'], 'B/step)')
+"
+}
+stage "embedding smoke (sharded train/resume/serve + bench)" embedding_smoke
+
 # Mixed-precision acceptance, device-free (ISSUE 10): (a) a deliberately
 # bf16-ACCUMULATING SGD step (bf16 storage under the 'mixed' policy) is
 # refused pre-compile with FML601/FML603 typed findings, (b) the
