@@ -146,7 +146,10 @@ class DispatchEvent:
 
     ``devices`` are the device ids the program's collectives span;
     ``locks`` are the tokens of the tracked locks the dispatching thread
-    held (see ``parallel.dispatch.local_execution_lock``).
+    held (see ``parallel.dispatch.local_execution_lock``); ``leases``
+    are the tokens of active slice leases that OTHER threads held over
+    these devices at dispatch time
+    (``parallel.dispatch.lease_devices`` — the FML304 audit input).
     """
 
     thread: str
@@ -154,6 +157,7 @@ class DispatchEvent:
     devices: Tuple[int, ...] = ()
     collectives: Tuple[CollectiveOp, ...] = ()
     locks: Tuple[str, ...] = ()
+    leases: Tuple[str, ...] = ()
 
     def to_map(self) -> dict:
         return {
@@ -162,6 +166,7 @@ class DispatchEvent:
             "devices": list(self.devices),
             "collectives": [c.to_map() for c in self.collectives],
             "locks": list(self.locks),
+            "leases": list(self.leases),
         }
 
     @staticmethod
@@ -174,6 +179,7 @@ class DispatchEvent:
                 CollectiveOp.from_map(c) for c in m.get("collectives", ())
             ),
             locks=tuple(str(t) for t in m.get("locks", ())),
+            leases=tuple(str(t) for t in m.get("leases", ())),
         )
 
 
@@ -209,10 +215,42 @@ def check_dispatch_trace(events: Iterable[DispatchEvent],
     concurrently registered training dispatch (or another pool's slices)
     without a shared ``local_execution_lock`` — the pool-specific fix is
     to give the replicas their slice meshes (``ServingConfig.mesh``) so
-    the per-slice locks compose with every overlapping set."""
-    multi = [e for e in events if len(e.devices) > 1]
+    the per-slice locks compose with every overlapping set.
+
+    **FML304** is the lease-aware shape (orthogonal to locking, so a
+    shared lock does NOT clear it): a pool dispatch whose event carries
+    an active foreign slice-lease token ran serving work on devices a
+    training job still OWNS — the autoscaler skipped the reclaim
+    handshake (``SliceLease.request_revoke`` + ``wait_released``) before
+    placing the replica. One finding per (program, lease) pair."""
+    events = list(events)
     findings: List[Finding] = []
     reported = set()
+    for e in events:
+        if not _is_pool_dispatch(e):
+            continue
+        for token in e.leases:
+            key = ("FML304", e.program, token)
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                "FML304",
+                f"replica-pool dispatch {e.program!r} (thread "
+                f"{e.thread!r}) runs on devices {sorted(e.devices)} "
+                f"still covered by active training lease {token!r} — "
+                "the slice was never reclaimed, so serving now steals "
+                "cycles the trainer's lease promised it (and a shared "
+                "lock only serializes the theft)",
+                stage=e.program, location=location,
+                fix_hint="reclaim before placing: "
+                         "lease.request_revoke(reason) and "
+                         "wait_released(timeout) — the trainer releases "
+                         "at its next epoch boundary — or scale onto "
+                         "unleased devices "
+                         "(parallel.dispatch.leased_device_ids)",
+            ))
+    multi = [e for e in events if len(e.devices) > 1]
     for i, a in enumerate(multi):
         for b in multi[i + 1:]:
             if a.thread == b.thread:

@@ -40,6 +40,7 @@ RULES = {
     "FML301": (ERROR, "cross-rank collective sequences diverge (rendezvous mismatch)"),
     "FML302": (ERROR, "concurrent multi-device collective dispatch without a common lock"),
     "FML303": (ERROR, "serving replica-pool mesh slice overlaps a concurrent dispatch without a shared slice lock"),
+    "FML304": (ERROR, "serving replica-pool dispatch on devices under an active training slice lease that was never reclaimed"),
     # -- 4xx: transfer / retrace guard -------------------------------------
     "FML401": (ERROR, "host<->device transfer beyond the declared budget in a guarded region"),
     "FML402": (ERROR, "compile-cache miss beyond the declared bucket policy in a guarded region"),
@@ -55,6 +56,8 @@ RULES = {
     "FML603": (ERROR, "parameter or optimizer-state leaf stored narrower than policy.params"),
     "FML604": (ERROR, "cross-rank collective runs narrower than policy.accum without an explicit pre-cast"),
     "FML605": (ERROR, "sharding-plan HBM math assumed a parameter width different from policy.params"),
+    "FML606": (ERROR, "quantized (int8) parameters accumulate at integer width without a dequant scale"),
+    "FML607": (ERROR, "int8-quantized parameter leaf served under a non-quantized policy (degraded params republished as the full-width tier)"),
 }
 
 

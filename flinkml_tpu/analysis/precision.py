@@ -102,6 +102,20 @@ def _is_float(dtype) -> bool:
         return False
 
 
+def _is_int8(dtype) -> bool:
+    try:
+        return np.dtype(dtype) == np.dtype(np.int8)
+    except TypeError:
+        return False
+
+
+def _is_integer(dtype) -> bool:
+    try:
+        return np.dtype(dtype).kind in "iu"
+    except TypeError:
+        return False
+
+
 def _bits(dtype) -> int:
     return significand_bits(dtype)
 
@@ -201,6 +215,31 @@ class _Flow:
                         "down EXPLICITLY right before the collective to "
                         "declare the bandwidth-for-precision trade",
                 )
+
+        # FML606 — quantized params accumulated at integer width. The
+        # int8 tier's contract is dequant-THEN-accumulate: a reduction
+        # or dot accumulator whose operands include int8 param/carry
+        # state and whose output is still integer ran the accumulation
+        # unscaled — an int8 accumulator wraps at ±127, and even a
+        # widened int32 sum is missing its per-column scales (the values
+        # are dimensionless codes until multiplied by scale).
+        if (
+            (name in REDUCTION_PRIMITIVES or name == "dot_general")
+            and out_dt is not None and _is_integer(out_dt)
+            and (joined & _PARAMISH)
+            and any(_is_int8(self._dtype(a)) for a in eqn.invars)
+        ):
+            self._add(
+                "FML606", ("FML606", name, str(out_dt)),
+                f"{name} accumulates int8-quantized parameters at "
+                f"{out_dt} without a dequant scale — int8 accumulation "
+                "wraps at ±127, and unscaled integer codes are not "
+                "values",
+                fix="dequantize first (q.astype(policy.compute) * scale, "
+                    "the sanctioned int8-tier shape — "
+                    "flinkml_tpu.precision.quantize_absmax) so the "
+                    "accumulation runs at policy.accum on scaled floats",
+            )
 
         # FML601(a/b) — reductions and dot accumulators.
         if out_is_float and _bits(out_dt) < accum_bits:
@@ -364,6 +403,7 @@ def check_closed_jaxpr(
 
     flow = _Flow(policy, program, location)
     params_bits = _bits(policy.params)
+    quant = getattr(policy, "quant", None)
     for var, role, name in zip(jaxpr.invars, roles, names):
         dt = var.aval.dtype
         if role == "param" and _is_float(dt) and _bits(dt) < params_bits:
@@ -374,6 +414,23 @@ def check_closed_jaxpr(
                 fix="keep master weights and optimizer moments at "
                     "policy.params; cast to policy.compute only at the "
                     "step boundary (to_bf16/to_fp32)",
+                column=name,
+            )
+        # FML607 — int8-quantized params under a policy that never
+        # declared quantization: the values are absmax-degraded codes,
+        # and serving them as the full-width tier republishes the
+        # quality loss without the policy paper trail.
+        if role == "param" and _is_int8(dt) and quant is None:
+            flow._add(
+                "FML607", ("FML607", name),
+                f"parameter leaf {name!r} is stored as int8 but policy "
+                f"{policy.name!r} declares no quantization scheme — "
+                "quantized params are republished as the full-width "
+                f"({policy.params}) tier",
+                fix="serve quantized models under the int8 tier "
+                    "(PrecisionPolicy quant='int8', preset "
+                    "'int8_inference') or republish the full-width "
+                    "master weights",
                 column=name,
             )
     flow.walk(
@@ -540,9 +597,32 @@ def _example_program(spec: Mapping):
 
         return (grad_sync, (jax.ShapeDtypeStruct((dim,), dtype),), (),
                 [(axis, int(spec.get("axis_size", 8)))])
+    if name == "int8_unscaled_matmul":
+        # The FML606 shape: int8-quantized weights matmul'd while still
+        # integer codes — the accumulator wraps and the scales never
+        # apply. The good twin dequantizes first (see
+        # docs/development/precision.md).
+        import jax.numpy as jnp
+
+        def unscaled(q, x):
+            return jnp.dot(x, q)
+
+        q = jax.ShapeDtypeStruct((dim, dim), np.int8)
+        x = jax.ShapeDtypeStruct((rows, dim), np.int8)
+        return unscaled, (q, x), (0,), None
+    if name == "int8_state_passthrough":
+        # The FML607 shape: int8-STORED params under whatever policy the
+        # file declares — flagged unless the policy declares quant.
+        def ident(state):
+            return state
+
+        state = {"coef_q": jax.ShapeDtypeStruct((dim, dim), np.int8),
+                 "coef_scale": jax.ShapeDtypeStruct((dim,), np.float32)}
+        return ident, (state,), (0,), None
     raise ValueError(
         f"unknown example program {name!r} (known: sgd_step, adam_step, "
-        "stray_constant_chain, state_passthrough, psum_gradient)"
+        "stray_constant_chain, state_passthrough, psum_gradient, "
+        "int8_unscaled_matmul, int8_state_passthrough)"
     )
 
 

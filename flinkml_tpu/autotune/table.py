@@ -79,6 +79,17 @@ KNOWN_KNOBS: Dict[str, str] = {
     # gradient psum) as the below-threshold candidate — the knob that
     # subsumed W2V's static _shard_vocab_threshold.
     "embedding_exchange": "lookup_update_rows_per_sec",
+    # The autoscaler's scale-up backlog threshold (queued rows as a
+    # fraction of per-replica queue capacity): candidates measured by
+    # the wall-clock time for the pool's backlog EWMA to recover under
+    # a closed-loop load triple — lower is better, so the committed
+    # candidates store 1/recovery_s (higher-is-better keeps the
+    # settle() hysteresis rule uniform across knobs).
+    "serving_scale_up_backlog": "inverse_recovery_s",
+    # The int8 tier's minimum constant size worth quantizing (elements):
+    # below it, per-column scales + dequant overhead outweigh the
+    # bandwidth saved on tiny vectors.
+    "int8_min_const_elems": "rows_per_sec",
 }
 
 _CACHE_LOCK = threading.Lock()

@@ -220,8 +220,11 @@ class KMeansModel(_KMeansParams, Model):
             from flinkml_tpu import pipeline_fusion
 
             pol = pipeline_fusion.active_policy()
+            # Mixed OR quantized policies declare the compute width (the
+            # int8 tier's distances run at its f32 compute, not the
+            # captured f64).
             kdt = jnp.dtype(pol.compute_dtype) \
-                if pol is not None and pol.mixed else dt
+                if pol is not None and (pol.mixed or pol.quant) else dt
             x = cols[fcol]
             if x.ndim == 1:
                 x = x.reshape(-1, 1)

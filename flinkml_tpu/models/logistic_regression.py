@@ -349,9 +349,12 @@ class LogisticRegressionModel(CoefficientModelMixin, _LogisticRegressionParams, 
             from flinkml_tpu import pipeline_fusion
 
             pol = pipeline_fusion.active_policy()
-            mixed = pol is not None and pol.mixed
-            kdt = jnp.dtype(pol.compute_dtype) if mixed else dt
-            adt = jnp.dtype(pol.accum_dtype) if mixed else None
+            # A mixed OR quantized policy declares the compute width
+            # (the int8 tier runs f32 dequant-fused math — re-widening
+            # to the captured f64 would silently double its bandwidth).
+            declared = pol is not None and (pol.mixed or pol.quant)
+            kdt = jnp.dtype(pol.compute_dtype) if declared else dt
+            adt = jnp.dtype(pol.accum_dtype) if declared else None
             x = cols[fcol]
             if x.ndim == 1:
                 x = x.reshape(-1, 1)

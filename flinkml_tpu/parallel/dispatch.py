@@ -218,6 +218,130 @@ def local_execution_lock(mesh=None):
     return lock
 
 
+# -- slice leases ----------------------------------------------------------
+#
+# Training/serving colocation (ROADMAP item 3): a training job LEASES the
+# mesh slice it runs on, so the serving autoscaler can see which devices
+# are spoken for — and reclaim them under load. A lease is a cooperative
+# contract, not a lock: the holder keeps dispatching (under its own
+# local_execution_lock) until it observes `revoke_requested()` at a safe
+# boundary (an epoch edge), releases the slice, and the reclaimer places
+# serving work on the freed devices. Dispatch-trace events record any
+# ACTIVE lease whose devices a *foreign* thread dispatches over, which is
+# what the analyzer's FML304 check audits: serving-pool work landing on a
+# still-leased slice means the reclaim handshake was skipped.
+
+_LEASES: dict = {}  # token -> SliceLease
+_LEASES_GUARD = threading.Lock()
+
+
+class SliceLease:
+    """One training job's claim on a device slice (see above). Create
+    via :func:`lease_devices`; use as a context manager (releases on
+    exit) or call :meth:`release` explicitly at the safe boundary."""
+
+    def __init__(self, holder: str, device_ids):
+        self.holder = str(holder)
+        self.devices = frozenset(int(i) for i in device_ids)
+        self.token = (
+            f"lease:{self.holder}:"
+            + ",".join(str(i) for i in sorted(self.devices))
+        )
+        self._revoke = threading.Event()
+        self._released = threading.Event()
+        self.revoke_reason: Optional[str] = None
+        self._holder_thread = threading.get_ident()
+
+    # -- holder side -------------------------------------------------------
+    @property
+    def active(self) -> bool:
+        return not self._released.is_set()
+
+    def revoke_requested(self) -> bool:
+        """Poll at safe boundaries (epoch edges): True once a reclaimer
+        asked for the slice back — finish the boundary, checkpoint, and
+        :meth:`release`."""
+        return self._revoke.is_set()
+
+    def release(self) -> None:
+        """Give the slice back (idempotent). Unregisters the lease, so
+        later dispatches over these devices stop carrying its token."""
+        with _LEASES_GUARD:
+            _LEASES.pop(self.token, None)
+        self._released.set()
+
+    def __enter__(self) -> "SliceLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- reclaimer side ----------------------------------------------------
+    def request_revoke(self, reason: str = "") -> None:
+        """Ask the holder to vacate (sets the flag the holder polls);
+        the reclaimer then :meth:`wait_released` with a bound."""
+        if reason and self.revoke_reason is None:
+            self.revoke_reason = reason
+        self._revoke.set()
+
+    def wait_released(self, timeout: Optional[float] = None) -> bool:
+        return self._released.wait(timeout)
+
+    def snapshot(self) -> dict:
+        return {
+            "token": self.token,
+            "holder": self.holder,
+            "devices": sorted(self.devices),
+            "active": self.active,
+            "revoke_requested": self.revoke_requested(),
+            "revoke_reason": self.revoke_reason,
+        }
+
+
+def lease_devices(mesh, holder: str) -> SliceLease:
+    """Register a :class:`SliceLease` for ``mesh``'s device set (a
+    ``DeviceMesh``, raw mesh, or plain device/id sequence — the same
+    subjects :func:`local_execution_lock` accepts)."""
+    lease = SliceLease(holder, _device_id_set(mesh))
+    with _LEASES_GUARD:
+        if lease.token in _LEASES:
+            raise ValueError(
+                f"lease {lease.token!r} is already registered; release "
+                "the existing lease before re-leasing the slice"
+            )
+        _LEASES[lease.token] = lease
+    return lease
+
+
+def active_leases() -> tuple:
+    """Every currently registered (unreleased) lease."""
+    with _LEASES_GUARD:
+        return tuple(_LEASES.values())
+
+
+def leased_device_ids() -> frozenset:
+    """Union of every active lease's device ids — the autoscaler's
+    'spoken for' set when choosing a placement."""
+    with _LEASES_GUARD:
+        out: set = set()
+        for lease in _LEASES.values():
+            out |= lease.devices
+        return frozenset(out)
+
+
+def _foreign_lease_tokens(ids) -> tuple:
+    """Tokens of active leases overlapping ``ids`` held by OTHER
+    threads — the holder's own dispatches are its business; anyone
+    else's on a leased slice is the FML304 shape."""
+    me = threading.get_ident()
+    dev = set(ids)
+    with _LEASES_GUARD:
+        return tuple(
+            l.token for l in _LEASES.values()
+            if l._holder_thread != me and (l.devices & dev)
+        )
+
+
 # -- dispatch trace observers ----------------------------------------------
 #
 # Training loops report their collective dispatches here (cheap: a list
@@ -257,6 +381,10 @@ def record_collective_dispatch(program: str, devices, collectives=()) -> None:
         "devices": ids,
         "collectives": list(collectives),
         "locks": held_lock_tokens(),
+        # Active leases OTHER threads hold over these devices: a
+        # serving-pool program carrying one here is the FML304 shape
+        # (dispatching on a slice training still owns).
+        "leases": _foreign_lease_tokens(ids),
     }
     for cb in list(_DISPATCH_OBSERVERS):
         cb(event)

@@ -103,9 +103,10 @@ compilation via :data:`on_compile`.
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Sequence, Tuple
 
 import numpy as np
 
@@ -198,6 +199,8 @@ def reset_cache() -> None:
     artifacts (the persistent cache) survive."""
     with _LOCK:
         _CACHE.clear()
+    with _QUANT_LOCK:
+        _QUANT_CONST_CACHE.clear()
     from flinkml_tpu import compile_cache
 
     store = compile_cache.active_store()
@@ -216,6 +219,75 @@ def row_bucket(n: int) -> int:
     """Padded row count for ``n`` rows: next power of two, floored at
     :data:`MIN_ROW_BUCKET`."""
     return max(MIN_ROW_BUCKET, next_pow2(n))
+
+
+class QuantizedConst(NamedTuple):
+    """One int8 post-training-quantized model constant as the fused
+    program receives it: the per-column absmax-scaled int8 buffer plus
+    its float32 scales (:func:`flinkml_tpu.precision.quantize_absmax`).
+    A NamedTuple so it rides the constant pytrees through jit/eval_shape
+    unchanged; the chain body dequantizes it to ``policy.compute`` width
+    in-program, where XLA fuses the two ops into the consumer."""
+
+    q: Any
+    scale: Any
+
+
+def _quant_min_elems() -> int:
+    """The int8 tier's minimum-constant-size threshold, with the
+    standard gate precedence: explicit ``FLINKML_TPU_INT8_MIN_CONST``
+    env var > the mesh-keyed ``int8_min_const_elems`` autotune knob >
+    the static default — degraded to the static default on a
+    non-numeric/non-positive value (the serving-knob contract: a table
+    typo must not take the executor down; a bad EXPLICIT value is
+    degraded too, logged by the table layer)."""
+    from flinkml_tpu.autotune import tuned_default
+    from flinkml_tpu.precision import INT8_MIN_CONST_ELEMS
+
+    env = os.environ.get("FLINKML_TPU_INT8_MIN_CONST")
+    if env is not None:
+        try:
+            v = int(env)
+        except ValueError:
+            v = 0
+        if v >= 1:
+            return v
+        # An EXPLICIT-but-invalid override degrades to the STATIC
+        # default (never silently to the table's value — that would be
+        # a third party neither the operator nor the docs named),
+        # logged once.
+        if env not in _QUANT_ENV_WARNED:
+            _QUANT_ENV_WARNED.add(env)
+            from flinkml_tpu.utils.logging import get_logger
+
+            get_logger("pipeline.fusion").warning(
+                "FLINKML_TPU_INT8_MIN_CONST=%r is not a positive "
+                "integer; using the static default %d",
+                env, INT8_MIN_CONST_ELEMS,
+            )
+        return INT8_MIN_CONST_ELEMS
+    try:
+        v = int(tuned_default("int8_min_const_elems", INT8_MIN_CONST_ELEMS))
+    except (TypeError, ValueError):
+        return INT8_MIN_CONST_ELEMS
+    return v if v >= 1 else INT8_MIN_CONST_ELEMS
+
+
+_QUANT_ENV_WARNED: set = set()
+
+# Quantized-constant memo: model constants are immutable per fitted
+# model, but execute_kernel_chain runs per DISPATCH — re-running the
+# absmax passes (abs/max/divide/rint/clip over every weight) on the
+# serving hot path would tax exactly the tier sold as a bandwidth
+# optimization. Keyed by the host array's identity (the strong ref in
+# the value pins the object alive, so an id can never be reused while
+# its entry exists); a refreshed model is a NEW array object and
+# misses. Bounded TRUE LRU (hits refresh recency) — a hot model's
+# constants stay resident while old models' entries (and their device
+# buffers) age out.
+_QUANT_CONST_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_QUANT_CONST_MAX = 128
+_QUANT_LOCK = threading.Lock()
 
 
 def warmup_transform(
@@ -361,20 +433,44 @@ def _chain_fn(kernels: Sequence[ColumnKernel], ext_names: Sequence[str],
     ``policy.compute`` at the program boundary (the sanctioned
     step-boundary down-cast the FML6xx walker recognizes) and builds the
     validity mask at ``policy.compute`` so the mask multiply doesn't
-    silently promote the whole chain back to f32."""
+    silently promote the whole chain back to f32. A quantized policy
+    (``policy.quant == "int8"``) receives eligible model constants as
+    :class:`QuantizedConst` pairs and dequantizes them to
+    ``policy.compute`` here — int8 in HBM/transfer, float in the math,
+    never an integer accumulation (the FML606 contract)."""
     import jax
     import jax.numpy as jnp
 
     kernels = tuple(kernels)
     ext_names = tuple(ext_names)
     out_names = tuple(out_names)
-    mixed = policy is not None and policy.mixed
-    mask_dt = jnp.dtype(policy.compute_dtype) if mixed else jnp.float32
+    # A mixed policy (compute narrower than params) casts every float
+    # boundary value to compute; the QUANTIZED tier does too (its
+    # declared compute width is where the dequant-fused math runs —
+    # under x64, f64 activations must come down to f32 or the tier
+    # silently runs double-width). A plain FULL/None policy stays
+    # inert (the PR 10 contract: no policy, no change).
+    casts = policy is not None and (policy.mixed or policy.quant is not None)
+    mask_dt = (
+        jnp.dtype(policy.compute_dtype) if casts else jnp.float32
+    )
+    compute_dt = (
+        jnp.dtype(policy.compute_dtype) if policy is not None
+        else jnp.float32
+    )
 
     def _to_compute(v):
-        if mixed and jnp.issubdtype(v.dtype, jnp.floating):
+        if casts and jnp.issubdtype(v.dtype, jnp.floating) \
+                and v.dtype != mask_dt:
             return v.astype(mask_dt)
         return v
+
+    def _const_to_compute(v):
+        if isinstance(v, QuantizedConst):
+            # Dequant at compute width, in-program: XLA fuses the
+            # convert+mul into the consuming matmul/elementwise op.
+            return v.q.astype(compute_dt) * v.scale.astype(compute_dt)
+        return _to_compute(v)
 
     def run(ext_vals, const_vals, n_valid):
         # Kernels resolve active_policy() at TRACE time, and this body
@@ -389,7 +485,7 @@ def _chain_fn(kernels: Sequence[ColumnKernel], ext_names: Sequence[str],
             valid = (jnp.arange(bucket) < n_valid).astype(mask_dt)
             ext_vals = tuple(_to_compute(v) for v in ext_vals)
             const_vals = tuple(
-                tuple(_to_compute(v) for v in cv) for cv in const_vals
+                tuple(_const_to_compute(v) for v in cv) for cv in const_vals
             )
             cols = dict(zip(ext_names, ext_vals))
             last = len(kernels) - 1
@@ -451,6 +547,15 @@ def _chain_support_checked(kernels, ext_names, out_names, bucket, policy,
 
     from flinkml_tpu.kernels import _gate
     from flinkml_tpu.kernels import chain as _pchain
+
+    if policy is not None and policy.quant is not None:
+        # The Pallas chain body has no dequant path for QuantizedConst
+        # pairs; an int8-tier program lowers through XLA.
+        return _gate.refuse_or_fallback(
+            "fused_chain", explicit,
+            f"quantized ({policy.quant}) model constants are not "
+            "supported by the pallas chain backend",
+        )
 
     with jax.experimental.enable_x64(True):
         reason = _pchain.unsupported_reason(
@@ -683,29 +788,65 @@ def execute_kernel_chain(table: Table, kernels: Sequence[ColumnKernel]) -> Table
             ext_vals.append(arr)
             ext_specs.append((name, str(arr.dtype), tuple(arr.shape[1:])))
 
-        const_vals = tuple(
-            tuple(jnp.asarray(k.constants[c]) for c in sorted(k.constants))
-            for k in kernels
+        # The active policy is key material AND decides the constant
+        # representation: under a quantized (int8) tier, eligible model
+        # constants upload as per-column absmax int8 + f32 scales — the
+        # bandwidth tier — and dequantize inside the program.
+        policy = active_policy()
+        quant_min = (
+            _quant_min_elems()
+            if policy is not None and policy.quant == "int8" else None
         )
+
+        def _const_entry(name, raw):
+            if quant_min is not None:
+                from flinkml_tpu import precision as _precision
+
+                host = np.asarray(raw)
+                if _precision.quantizable(host, quant_min):
+                    key = (id(host), host.shape, str(host.dtype),
+                           quant_min, name)
+                    with _QUANT_LOCK:
+                        hit = _QUANT_CONST_CACHE.get(key)
+                        if hit is not None and hit[0] is host:
+                            _QUANT_CONST_CACHE.move_to_end(key)
+                            return hit[1], hit[2]
+                    q, s = _precision.quantize_absmax(host)
+                    val = QuantizedConst(jnp.asarray(q), jnp.asarray(s))
+                    # The spec names the QUANTIZED representation (plus
+                    # the original shape): a genuinely-int8 constant can
+                    # never alias a quantized-float one, and the autotune
+                    # threshold changing which constants quantize re-keys
+                    # the program through these specs.
+                    spec = (name, "int8[absmax]", False,
+                            tuple(host.shape))
+                    with _QUANT_LOCK:
+                        _QUANT_CONST_CACHE[key] = (host, val, spec)
+                        _QUANT_CONST_CACHE.move_to_end(key)
+                        while len(_QUANT_CONST_CACHE) > _QUANT_CONST_MAX:
+                            _QUANT_CONST_CACHE.popitem(last=False)
+                    return val, spec
+            v = jnp.asarray(raw)
+            return v, (name, str(v.dtype),
+                       bool(getattr(v, "weak_type", False)),
+                       tuple(v.shape))
+
         # weak_type is part of the spec: a python-scalar constant
         # (float64 weak) and an array constant (float64 strong) promote
         # DIFFERENTLY inside the program (weak * f32 -> f32, strong * f32
         # -> f64), so two chains differing only there must not alias one
         # cached executable.
-        const_specs = tuple(
-            tuple(
-                (c, str(v.dtype), bool(getattr(v, "weak_type", False)),
-                 tuple(v.shape))
-                for c, v in zip(sorted(k.constants), cv)
-            )
-            for k, cv in zip(kernels, const_vals)
+        const_pairs = tuple(
+            tuple(_const_entry(c, k.constants[c]) for c in sorted(k.constants))
+            for k in kernels
         )
+        const_vals = tuple(tuple(v for v, _ in kc) for kc in const_pairs)
+        const_specs = tuple(tuple(s for _, s in kc) for kc in const_pairs)
 
         # Abstract trace (no compile, no compute): padded shape/dtype of
         # every output, for lazy-column construction and the bytes-avoided
         # accounting. Cached alongside the programs. The active policy is
         # key material here too: a mixed program's outputs ARE narrower.
-        policy = active_policy()
         spec_key = (
             tuple(k.fingerprint for k in kernels),
             tuple(ext_specs),

@@ -97,21 +97,44 @@ class PrecisionValidationError(ValueError):
         self.findings = list(findings)
 
 
+#: Quantization schemes a policy may declare for model constants.
+_QUANT_SCHEMES = ("int8",)
+
+
 @dataclasses.dataclass(frozen=True)
 class PrecisionPolicy:
     """The declared (compute, accum, params) dtype contract — see module
     docstring. Frozen + hashable (compile-cache key material), JSON
-    round-trippable (``*.policy.json`` fixtures)."""
+    round-trippable (``*.policy.json`` fixtures).
+
+    ``quant`` declares a post-training-quantization scheme for model
+    constants below the float tiers: ``"int8"`` stores/transfers every
+    eligible model constant as per-column absmax-scaled int8
+    (:func:`quantize_absmax`) and dequantizes to ``compute`` width
+    INSIDE the fused program, so the dequant fuses into the consuming
+    matmul/elementwise op. Accumulation still runs at ``accum`` — raw
+    int8 accumulation (which wraps at ±127) is refused by FML606, and
+    serving int8-stored params under a quant-less policy is refused by
+    FML607 (the degraded values must never republish as the full-width
+    tier)."""
 
     name: str = "custom"
     compute: str = "float32"
     accum: str = "float32"
     params: str = "float32"
+    quant: Optional[str] = None
 
     def __post_init__(self):
         object.__setattr__(self, "compute", float_name(self.compute))
         object.__setattr__(self, "accum", float_name(self.accum))
         object.__setattr__(self, "params", float_name(self.params))
+        if not self.quant:  # "" and None both mean "no quantization"
+            object.__setattr__(self, "quant", None)
+        elif self.quant not in _QUANT_SCHEMES:
+            raise ValueError(
+                f"policy {self.name!r}: unknown quantization scheme "
+                f"{self.quant!r} (one of {_QUANT_SCHEMES}, or None)"
+            )
         if is_narrower(self.accum, self.compute):
             raise ValueError(
                 f"policy {self.name!r}: accum ({self.accum}) narrower than "
@@ -145,16 +168,21 @@ class PrecisionPolicy:
 
     # -- serialization -----------------------------------------------------
     def to_json_dict(self) -> dict:
-        return {"name": self.name, "compute": self.compute,
-                "accum": self.accum, "params": self.params}
+        out = {"name": self.name, "compute": self.compute,
+               "accum": self.accum, "params": self.params}
+        if self.quant is not None:
+            out["quant"] = self.quant
+        return out
 
     @staticmethod
     def from_json_dict(d: Mapping) -> "PrecisionPolicy":
+        quant = d.get("quant")
         return PrecisionPolicy(
             name=str(d.get("name", "custom")),
             compute=str(d.get("compute", "float32")),
             accum=str(d.get("accum", "float32")),
             params=str(d.get("params", "float32")),
+            quant=None if quant in (None, "") else str(quant),
         )
 
 
@@ -191,7 +219,23 @@ MIXED_INFERENCE = PrecisionPolicy(
     "mixed_inference", "bfloat16", "bfloat16", "float32"
 )
 
-PRESET_POLICIES = {p.name: p for p in (FULL, MIXED, MIXED_INFERENCE)}
+#: The post-training-quantized serving tier BELOW ``mixed_inference``:
+#: eligible model constants are stored and transferred as per-column
+#: absmax-scaled int8 (+ one float32 scale per column) and dequantized
+#: to float32 inside the fused program, where XLA fuses the dequant into
+#: the consuming matmul — compute and accumulation stay at float32, so
+#: nothing integer ever accumulates (FML606 refuses exactly that shape).
+#: On CPU meshes this tier also beats bf16 ``mixed_inference`` rows/s
+#: outright: bf16 is software-emulated there while the dequantized
+#: program runs native f32 — the tunnel-immune half of the measurement
+#: (the device stage re-measures both when the tunnel returns).
+INT8_INFERENCE = PrecisionPolicy(
+    "int8_inference", "float32", "float32", "float32", quant="int8"
+)
+
+PRESET_POLICIES = {
+    p.name: p for p in (FULL, MIXED, MIXED_INFERENCE, INT8_INFERENCE)
+}
 
 
 def resolve_policy(policy) -> Optional[PrecisionPolicy]:
@@ -209,6 +253,60 @@ def resolve_policy(policy) -> Optional[PrecisionPolicy]:
     if isinstance(policy, Mapping):
         return PrecisionPolicy.from_json_dict(policy)
     raise TypeError(f"cannot interpret {policy!r} as a PrecisionPolicy")
+
+
+# -- post-training quantization (the int8 tier's storage transform) ----------
+
+#: Constants smaller than this many elements are left at float width by
+#: the int8 tier: per-column scales plus dequant overhead outweigh the
+#: bandwidth saved on tiny vectors. Overridable per mesh via the
+#: ``int8_min_const_elems`` autotune knob (consulted by the fused
+#: executor at key-construction time — the resolved set of quantized
+#: constants is cache-key material through the constant specs).
+INT8_MIN_CONST_ELEMS = 16
+
+
+def quantizable(arr, min_elems: int = INT8_MIN_CONST_ELEMS) -> bool:
+    """Whether the int8 tier quantizes this model constant: a float
+    array with at least ``min_elems`` elements. Integer/bool constants
+    (lookup sizes, category counts) and tiny vectors pass through at
+    their storage width."""
+    a = np.asarray(arr)
+    try:
+        float_name(a.dtype)
+    except ValueError:
+        return False
+    return a.size >= int(min_elems) and a.ndim >= 1
+
+
+def quantize_absmax(arr):
+    """Per-column absmax int8 quantization of one model constant.
+
+    For a rank-``n >= 2`` array the scale is per LAST-axis column
+    (absmax over every leading axis — the per-output-column scheme for a
+    ``[in, out]`` matmul weight); a 1-D vector gets one per-tensor
+    scale. Returns ``(q, scale)`` with ``q`` int8 in ``[-127, 127]`` and
+    ``scale`` float32 such that ``q * scale ≈ arr``; an all-zero column
+    gets scale 1.0 (quantizes to zeros exactly). Symmetric around zero —
+    ``-128`` is never produced, so negation round-trips."""
+    a = np.asarray(arr)
+    if a.ndim >= 2:
+        absmax = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)))
+    else:
+        absmax = np.max(np.abs(a)) if a.size else np.float64(0.0)
+    scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(
+        np.rint(a / scale.astype(a.dtype)), -127, 127
+    ).astype(np.int8)
+    return q, scale
+
+
+def dequantize_absmax(q, scale, dtype="float32"):
+    """The inverse transform at ``dtype`` width (host-side reference;
+    the fused executor performs the same two ops in-program so XLA fuses
+    them into the consumer)."""
+    dt = np.dtype(dtype)
+    return np.asarray(q).astype(dt) * np.asarray(scale).astype(dt)
 
 
 def cast_floats(tree, dtype):

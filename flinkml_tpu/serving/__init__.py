@@ -19,6 +19,14 @@ model rollout, and a horizontally scaled replica-pool front. The pieces:
   ``local_execution_lock``) behind least-outstanding-rows routing with
   deadline-aware admission, per-replica overload degradation, automatic
   failover, and rolling (one-replica-at-a-time) registry hot-swaps.
+- :class:`PoolAutoscaler` — the closed control loop over the pool's
+  own metrics: hysteretic scale-up/-down (the autotune 1.10x
+  decisive-win idiom), chaos replacement, compile-cache-warm scale-up
+  replicas, and training slice-lease reclaim (FML304-audited).
+- :class:`MultiModelPool` + :class:`SLOClass` — N registries over one
+  pool with per-class deadline budgets and admission share caps
+  (weighted admission: a batch job can never starve the interactive
+  tier; refusals are the typed :class:`SLOAdmissionError`).
 - :class:`ModelRegistry` — versioned, fingerprint-verified model store
   with an atomic "current" pointer; ``publish`` / ``get`` / ``rollback``.
 - :class:`SnapshotPublisher` — an ``IterationListener`` that turns a
@@ -33,6 +41,7 @@ See ``docs/operators/serving.md`` for lifecycle, knobs, and semantics
 for the end-to-end fit → publish → serve → hot-swap flow.
 """
 
+from flinkml_tpu.serving.autoscaler import AutoscaleConfig, PoolAutoscaler
 from flinkml_tpu.serving.batcher import (
     AdaptiveMicroBatcher,
     BatchSegment,
@@ -54,8 +63,15 @@ from flinkml_tpu.serving.errors import (
     ServingOverloadError,
     ServingSchemaError,
     ServingTimeoutError,
+    SLOAdmissionError,
 )
 from flinkml_tpu.serving.health import HealthPolicy, ReplicaHealth, ReplicaState
+from flinkml_tpu.serving.multiplex import (
+    BATCH,
+    INTERACTIVE,
+    MultiModelPool,
+    SLOClass,
+)
 from flinkml_tpu.serving.pool import Replica, ReplicaPool, slice_meshes
 from flinkml_tpu.serving.publisher import SnapshotPublisher
 from flinkml_tpu.serving.registry import ModelRegistry
@@ -63,10 +79,17 @@ from flinkml_tpu.serving.router import Router
 
 __all__ = [
     "AdaptiveMicroBatcher",
+    "AutoscaleConfig",
+    "BATCH",
     "BatchSegment",
     "ContinuousBatcher",
     "EngineStoppedError",
     "HealthPolicy",
+    "INTERACTIVE",
+    "MultiModelPool",
+    "PoolAutoscaler",
+    "SLOAdmissionError",
+    "SLOClass",
     "ModelIntegrityError",
     "ModelRegistry",
     "ModelVersionNotFoundError",

@@ -262,13 +262,13 @@ class ServingEngine:
         self._follow_swap_lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
-        self._latencies: collections.deque = collections.deque(
-            maxlen=self.config.latency_window
+        # Fed by the dispatcher AND by shedding caller threads — the
+        # shared window serializes them and publishes p50/p99 gauges.
+        from flinkml_tpu.utils.metrics import LatencyWindow
+
+        self._latency_window = LatencyWindow(
+            self._metrics, self.config.latency_window
         )
-        # Appended by the dispatcher AND by shedding caller threads;
-        # iterating a deque during a concurrent append raises, so both
-        # sides go through _record_latency/_update_latency_gauges.
-        self._lat_lock = threading.Lock()
         self._following = False       # listener currently registered
         self._follow_requested = False  # survives stop(): restart re-follows
 
@@ -292,6 +292,20 @@ class ServingEngine:
     def active_version(self) -> Optional[int]:
         active = self._active
         return active.version if active else None
+
+    @property
+    def queued_rows(self) -> int:
+        """Rows currently queued in the batcher — the public backlog
+        signal (the pool autoscaler and the multi-model scale target
+        both consume it; don't reach for ``_batcher``)."""
+        return self._batcher.queued_rows
+
+    @property
+    def observed_p99_ms(self) -> Optional[float]:
+        """The latest p99 latency gauge (None before any completion) —
+        the public latency signal for autoscaling."""
+        p99 = self._metrics.snapshot()["gauges"].get("p99_ms")
+        return float(p99) if isinstance(p99, (int, float)) else None
 
     def start(self) -> "ServingEngine":
         """Load the model (registry: current version), precompile every
@@ -673,15 +687,13 @@ class ServingEngine:
                 continue
             completions.append((seg.request, *outcome))
         if completions:
-            with self._lat_lock:  # one acquisition for the whole batch
-                self._latencies.extend(
-                    (now - req.enqueued_at) * 1000.0
-                    for req, _, _ in completions
-                )
             # Gauges first, completions second: a client reading stats
             # right after its predict() returns sees its own request
-            # reflected.
-            self._update_latency_gauges()
+            # reflected. One lock acquisition + one sort for the batch.
+            self._latency_window.record(*(
+                (now - req.enqueued_at) * 1000.0
+                for req, _, _ in completions
+            ))
         for req, result, version in completions:
             req.complete(result, version)
 
@@ -715,18 +727,7 @@ class ServingEngine:
         return (jax.devices()[0].id,)
 
     def _record_latency(self, latency_ms: float) -> None:
-        with self._lat_lock:
-            self._latencies.append(latency_ms)
-        self._update_latency_gauges()
-
-    def _update_latency_gauges(self) -> None:
-        with self._lat_lock:
-            if not self._latencies:
-                return
-            arr = np.asarray(self._latencies)
-        p50, p99 = np.percentile(arr, [50, 99])  # one sort for both
-        self._metrics.gauge("p50_ms", float(p50))
-        self._metrics.gauge("p99_ms", float(p99))
+        self._latency_window.record(latency_ms)
 
     def _check_running(self) -> None:
         if not self.running:

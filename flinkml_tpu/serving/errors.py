@@ -39,6 +39,16 @@ class ServingSchemaError(ServingError, ValueError):
     trailing shapes) fixed by the warmup example at load time."""
 
 
+class SLOAdmissionError(ServingOverloadError):
+    """A multi-tenant request was refused at CLASS admission: its SLO
+    class's share of pool capacity (``SLOClass.max_queue_share``) is
+    fully in flight. A :class:`ServingOverloadError` subclass — the
+    remedy is the same (back off and retry) — but named so a batch
+    client can tell "my class budget is spent" from "the whole pool is
+    saturated": the former is working as designed (the interactive tier
+    keeps its headroom), the latter is a capacity page."""
+
+
 class PoolUnavailableError(ServingError):
     """The replica pool has no healthy replica left to route to — every
     replica is unhealthy or draining. Distinct from
@@ -58,6 +68,7 @@ class ModelVersionNotFoundError(RegistryError, KeyError):
 __all__ = [
     "ModelIntegrityError",
     "PoolUnavailableError",
+    "SLOAdmissionError",
     "ServingError",
     "ServingOverloadError",
     "ServingTimeoutError",

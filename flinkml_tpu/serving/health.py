@@ -158,12 +158,31 @@ class ReplicaHealth:
 
     def revive(self) -> None:
         """Operator-driven UNHEALTHY -> HEALTHY (after the pool restarted
-        the engine)."""
+        the engine). Resets the LATENCY/backlog stats too: the revived
+        engine starts with an empty queue and fresh programs, so ranking
+        it by its pre-failure EWMA (often inflated by the very death
+        throes that retired it) would mis-order it until the stale
+        history washed out — the pool re-seeds from healthy siblings
+        right after (:meth:`seed_ewma`)."""
         with self._lock:
             self._consecutive_errors = 0
             self._consecutive_overloads = 0
             self._last_error = None
+            self.outstanding_rows = 0
+            self.ewma_ms_per_row = None
             self._transition(ReplicaState.HEALTHY)
+
+    def seed_ewma(self, ms_per_row: Optional[float]) -> None:
+        """Seed the latency estimate of a replica that has served
+        nothing yet (fresh scale-up, or just revived) from its healthy
+        siblings' median, so the router's deadline ordering treats it as
+        a known-latency candidate immediately instead of letting it
+        settle late. Never clobbers a real observation."""
+        if ms_per_row is None:
+            return
+        with self._lock:
+            if self.ewma_ms_per_row is None:
+                self.ewma_ms_per_row = float(ms_per_row)
 
     def snapshot(self) -> dict:
         with self._lock:

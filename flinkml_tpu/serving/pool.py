@@ -100,13 +100,16 @@ def slice_meshes(n_slices: int, devices: Optional[Sequence[Any]] = None,
 
 @dataclasses.dataclass
 class Replica:
-    """One pool slot: a named engine plus its health ledger."""
+    """One pool slot: a named engine plus its health ledger.
+    ``model_id`` is set by multi-model pools (each replica serves ONE
+    model; the router filters candidates by it)."""
 
     name: str
     engine: ServingEngine
     health: ReplicaHealth
     device: Optional[Any] = None
     mesh: Optional[Any] = None
+    model_id: Optional[str] = None
 
 
 class ReplicaPool:
@@ -153,13 +156,15 @@ class ReplicaPool:
         # compiles once, every other replica loads the retargeted
         # artifact — installing a process-local memory store when no
         # persistent one is configured.
-        self._share_compiles = bool(share_compiles)
-        self.name = name
-        self._registry = source if isinstance(source, ModelRegistry) else None
-        base = config or ServingConfig()
+        self._init_core(
+            source, example, config=config, output_cols=output_cols,
+            name=name, health_policy=health_policy,
+            share_compiles=share_compiles,
+        )
         placements: List[Dict[str, Any]]
         if meshes is not None:
             placements = [{"mesh": m} for m in meshes]
+            self._device_universe = None  # scale-up needs explicit meshes
         else:
             if devices is None:
                 import jax
@@ -171,39 +176,35 @@ class ReplicaPool:
             placements = [
                 {"device": devices[i % len(devices)]} for i in range(n)
             ]
+            # The placement universe scale-ups draw from (round-robin,
+            # continuing the initial assignment).
+            self._device_universe = list(devices)
+        for place in placements:
+            self.replicas.append(self._make_replica(place, source))
+
+    def _init_core(self, source: Any, example: Table, *,
+                   config: Optional[ServingConfig], output_cols,
+                   name: str, health_policy: Optional[HealthPolicy],
+                   share_compiles: bool) -> None:
+        """Everything a pool is besides its initial replica set — shared
+        with :class:`~flinkml_tpu.serving.multiplex.MultiModelPool`,
+        which starts EMPTY and grows replicas per registered model."""
+        self._share_compiles = bool(share_compiles)
+        self.name = name
+        self._source = source
+        self._registry = source if isinstance(source, ModelRegistry) else None
+        self._base_config = config or ServingConfig()
+        self._device_universe: Optional[List[Any]] = None
         self._schema = {
             c: (np.asarray(example.column(c)).dtype,
                 np.asarray(example.column(c)).shape[1:])
             for c in example.column_names
         }
-        policy = health_policy or HealthPolicy()
+        self._example = example
+        self._output_cols = output_cols
+        self._health_policy = health_policy or HealthPolicy()
         self.replicas: List[Replica] = []
-        for i, place in enumerate(placements):
-            rname = f"r{i}"
-            cfg = dataclasses.replace(
-                base,
-                device=place.get("device"),
-                mesh=place.get("mesh"),
-                metrics_name=name,
-                metrics_labels={"replica": rname},
-                dispatch_tag=f"serving.pool/{name}/{rname}",
-                # Replicas never shed to the caller's host path: shedding
-                # would serve the request slowly on the ROUTER thread and
-                # hide the queue-full signal the per-replica degradation
-                # (failover -> DRAINING -> pool overload) is built on.
-                # The pool's shed path IS failover to a less-loaded
-                # replica.
-                shed_on_overload=False,
-            )
-            engine = ServingEngine(
-                source, example, cfg, output_cols=output_cols,
-                name=f"{name}/{rname}",
-            )
-            self.replicas.append(Replica(
-                name=rname, engine=engine,
-                health=ReplicaHealth(rname, policy),
-                device=place.get("device"), mesh=place.get("mesh"),
-            ))
+        self._next_index = 0
         self._metrics = metrics.group(f"serving.{name}.router")
         self._router = Router(
             self.replicas, self._rows_of, self._metrics,
@@ -212,6 +213,39 @@ class ReplicaPool:
         self._roll_lock = threading.RLock()
         self._following = False
         self._started = False
+
+    def _make_replica(self, place: Dict[str, Any], source: Any,
+                      model_id: Optional[str] = None) -> Replica:
+        """Build (but do not start) one replica slot; advances the name
+        counter so scale-ups continue the ``r<i>`` numbering."""
+        i = self._next_index
+        self._next_index += 1
+        rname = f"r{i}"
+        cfg = dataclasses.replace(
+            self._base_config,
+            device=place.get("device"),
+            mesh=place.get("mesh"),
+            metrics_name=self.name,
+            metrics_labels={"replica": rname},
+            dispatch_tag=f"serving.pool/{self.name}/{rname}",
+            # Replicas never shed to the caller's host path: shedding
+            # would serve the request slowly on the ROUTER thread and
+            # hide the queue-full signal the per-replica degradation
+            # (failover -> DRAINING -> pool overload) is built on.
+            # The pool's shed path IS failover to a less-loaded
+            # replica.
+            shed_on_overload=False,
+        )
+        engine = ServingEngine(
+            source, self._example, cfg, output_cols=self._output_cols,
+            name=f"{self.name}/{rname}",
+        )
+        return Replica(
+            name=rname, engine=engine,
+            health=ReplicaHealth(rname, self._health_policy),
+            device=place.get("device"), mesh=place.get("mesh"),
+            model_id=model_id,
+        )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ReplicaPool":
@@ -223,7 +257,7 @@ class ReplicaPool:
             from flinkml_tpu import compile_cache
 
             compile_cache.ensure_store()
-        for replica in self.replicas:
+        for replica in list(self.replicas):  # scaling mutates the list
             replica.engine.start()
         self._started = True
         self._metrics.gauge("replicas", float(len(self.replicas)))
@@ -235,7 +269,10 @@ class ReplicaPool:
         if self._following and self._registry is not None:
             self._registry.remove_listener(self._on_registry_change)
             self._following = False
-        for replica in self.replicas:
+        # Snapshot: a still-running autoscaler removing a replica
+        # mid-iteration would shift the list and skip one — leaving its
+        # dispatcher running after stop() returned.
+        for replica in list(self.replicas):
             replica.engine.stop(drain=drain, timeout=timeout)
         self._started = False
 
@@ -284,17 +321,154 @@ class ReplicaPool:
 
     def revive(self, replica_name: str) -> None:
         """Operator path: restart a retired replica and rejoin rotation
-        (re-synced to the registry's current version when following)."""
+        (re-synced to the registry's current version when following).
+        Health stats reset on revive — a revived replica must not be
+        ranked by its pre-failure latency/backlog history — and the
+        EWMA re-seeds from healthy siblings like a fresh scale-up."""
         replica = self._replica(replica_name)
         replica.engine.start()
         replica.health.revive()
+        self._seed_ewma(replica)
         self._update_health_gauge()
         if self._following:
             self._roll_to_current()
 
+    # -- elastic membership (the autoscaler's surface) ---------------------
+    def _seed_ewma(self, replica: Replica) -> None:
+        """Seed a fresh/revived replica's latency EWMA from the median
+        of its healthy siblings, so the router's deadline-aware ordering
+        treats it as a known quantity and sends it load immediately
+        instead of letting the estimate settle late."""
+        values = [
+            r.health.ewma_ms_per_row
+            for r in self.replicas
+            if r is not replica
+            and r.health.state is ReplicaState.HEALTHY
+            and r.health.ewma_ms_per_row is not None
+        ]
+        if values:
+            replica.health.seed_ewma(float(np.median(values)))
+
+    def add_replica(self, device: Optional[Any] = None,
+                    mesh: Optional[Any] = None,
+                    source: Optional[Any] = None,
+                    model_id: Optional[str] = None) -> Replica:
+        """Grow the pool by one replica (the autoscaler's scale-up).
+
+        Placement: an explicit ``device`` or ``mesh``, else the next
+        device of the pool's placement universe (round-robin,
+        continuing the constructor's assignment; mesh-placed pools must
+        pass a mesh). On a started pool the new replica starts — and
+        warms — BEFORE joining the routing table, and its warmup rides
+        the shared compile-cache store (``share_compiles``): the
+        programs the siblings already compiled retarget-load onto the
+        new placement, so scale-up pays artifact I/O, not XLA compiles.
+        Its latency EWMA seeds from the healthy siblings' median so it
+        takes load immediately."""
+        if device is None and mesh is None:
+            if self._device_universe is None:
+                raise ValueError(
+                    "mesh-placed pool: pass add_replica(mesh=...) (build "
+                    "slices with slice_meshes)"
+                )
+            device = self._device_universe[
+                self._next_index % len(self._device_universe)
+            ]
+        place = {"device": device, "mesh": mesh}
+        replica = self._make_replica(
+            place, source if source is not None else self._source,
+            model_id=model_id,
+        )
+        if self._started:
+            if self._share_compiles:
+                from flinkml_tpu import compile_cache
+
+                compile_cache.ensure_store()
+            replica.engine.start()
+        self._seed_ewma(replica)
+        # Join rotation only once warmed: the router iterates the live
+        # list, so the append IS the go-live.
+        self.replicas.append(replica)
+        self._metrics.counter("replicas_added")
+        self._metrics.gauge("replicas", float(len(self.replicas)))
+        self._update_health_gauge()
+        _log.info("pool %s scaled UP: replica %s on %s (now %d)",
+                  self.name, replica.name,
+                  device if device is not None else mesh,
+                  len(self.replicas))
+        return replica
+
+    def remove_replica(self, replica_name: Optional[str] = None,
+                       drain: bool = True,
+                       timeout: Optional[float] = None) -> str:
+        """Shrink the pool by one replica (the autoscaler's scale-down):
+        take it out of rotation FIRST (new requests stop routing to it),
+        then stop it — with ``drain`` (default) its queued requests
+        finish before the engine dies, so scale-down loses nothing.
+        Default victim: the healthy replica with the least outstanding
+        work (never the last healthy one)."""
+        if replica_name is not None:
+            replica = self._replica(replica_name)
+        else:
+            replica = self._scale_down_victim()
+        self.replicas.remove(replica)  # out of rotation before the stop
+        replica.engine.stop(drain=drain, timeout=timeout)
+        self._metrics.counter("replicas_removed")
+        self._finish_remove(replica)
+        return replica.name
+
+    def prune_retired(self) -> List[str]:
+        """Drop UNHEALTHY (retired, already-stopped) replicas from the
+        pool. The autoscaler calls this after REPLACING a retirement:
+        keeping the dead slot around would leak one stopped engine per
+        failure under a flapping fault (and inflate capacity-based
+        accounting); an operator who wants the dead engine back instead
+        uses :meth:`revive` BEFORE the replacement lands. Returns the
+        pruned names."""
+        retired = [
+            r for r in self.replicas
+            if r.health.state is ReplicaState.UNHEALTHY
+        ]
+        for replica in retired:
+            self.replicas.remove(replica)
+            # Retirement already stopped the engine (without drain);
+            # belt-and-braces for an engine retired mid-stop.
+            try:
+                replica.engine.stop(drain=False, timeout=1.0)
+            except Exception:  # noqa: BLE001 — already dead; log only
+                _log.exception("stopping pruned replica %s", replica.name)
+        if retired:
+            self._metrics.counter("replicas_pruned", float(len(retired)))
+            self._metrics.gauge("replicas", float(len(self.replicas)))
+            self._update_health_gauge()
+            _log.info("pool %s pruned retired replicas: %s", self.name,
+                      [r.name for r in retired])
+        return [r.name for r in retired]
+
+    def _scale_down_victim(self) -> Replica:
+        """Default victim choice: the healthy replica with the least
+        outstanding work, never the last healthy one (multi-model pools
+        additionally keep every model's last replica)."""
+        healthy = [
+            r for r in self.replicas
+            if r.health.state is ReplicaState.HEALTHY
+        ]
+        if len(healthy) <= 1:
+            raise ValueError(
+                f"pool {self.name}: refusing to remove the last "
+                "healthy replica"
+            )
+        return min(healthy, key=lambda r: r.health.outstanding_rows)
+
+    def _finish_remove(self, replica: Replica) -> None:
+        self._metrics.gauge("replicas", float(len(self.replicas)))
+        self._update_health_gauge()
+        _log.info("pool %s scaled DOWN: replica %s removed (now %d)",
+                  self.name, replica.name, len(self.replicas))
+
     def healthy_replicas(self) -> List[Replica]:
         return [
-            r for r in self.replicas
+            r for r in list(self.replicas)
             if r.health.state is not ReplicaState.UNHEALTHY
         ]
 
@@ -306,7 +480,7 @@ class ReplicaPool:
 
     def _update_health_gauge(self) -> None:
         healthy = sum(
-            1 for r in self.replicas
+            1 for r in list(self.replicas)
             if r.health.state is ReplicaState.HEALTHY
         )
         self._metrics.gauge("healthy_replicas", float(healthy))
@@ -330,7 +504,7 @@ class ReplicaPool:
 
     def _roll_to_current(self) -> None:
         with self._roll_lock:
-            for replica in self.replicas:
+            for replica in list(self.replicas):  # scaling mutates the list
                 if replica.health.state is ReplicaState.UNHEALTHY:
                     continue  # revive() re-syncs it
                 # Re-read CURRENT per step: a rollback racing this roll
@@ -346,11 +520,11 @@ class ReplicaPool:
 
     # -- observability -----------------------------------------------------
     def versions(self) -> Dict[str, Optional[int]]:
-        return {r.name: r.engine.active_version for r in self.replicas}
+        return {r.name: r.engine.active_version for r in list(self.replicas)}
 
     def stats(self) -> Dict[str, Any]:
         per_replica = {}
-        for r in self.replicas:
+        for r in list(self.replicas):
             snap = r.engine._metrics.snapshot()
             per_replica[r.name] = {
                 **r.health.snapshot(),
@@ -365,7 +539,7 @@ class ReplicaPool:
             "name": self.name,
             "replicas": len(self.replicas),
             "healthy": len([
-                r for r in self.replicas
+                r for r in list(self.replicas)
                 if r.health.state is ReplicaState.HEALTHY
             ]),
             "router": self._metrics.snapshot()["counters"],

@@ -66,11 +66,15 @@ class Router:
         self._on_retire = on_retire
 
     # -- candidate selection -----------------------------------------------
-    def _candidates(self, tried: set) -> List[Any]:
+    def _candidates(self, tried: set,
+                    model_id: Optional[str] = None) -> List[Any]:
         out = []
-        for replica in self._replicas:
+        for replica in list(self._replicas):  # snapshot: scaling mutates
             if replica.name in tried:
                 continue
+            if (model_id is not None
+                    and getattr(replica, "model_id", None) != model_id):
+                continue  # multi-model pools: route within the model
             health = replica.health
             if not health.routable():
                 # Inline DRAINING -> HEALTHY recovery: rejoin once the
@@ -99,7 +103,8 @@ class Router:
         return fits + tight
 
     # -- the request path --------------------------------------------------
-    def predict(self, features: Any, timeout_ms: Optional[float] = None):
+    def predict(self, features: Any, timeout_ms: Optional[float] = None,
+                model_id: Optional[str] = None):
         t0 = time.monotonic()
         deadline = t0 + timeout_ms / 1000.0 if timeout_ms is not None else None
         rows = self._rows_of(features)
@@ -119,7 +124,9 @@ class Router:
                 None if deadline is None
                 else (deadline - time.monotonic()) * 1000.0
             )
-            candidates = self._order(self._candidates(tried), remaining_ms)
+            candidates = self._order(
+                self._candidates(tried, model_id), remaining_ms
+            )
             if not candidates:
                 break
             replica = candidates[0]

@@ -297,6 +297,25 @@ class Table:
                 # (rows past the logical count are unspecified either way;
                 # kernels see only what the validity mask admits).
                 self._device_cache[key] = raw.buf
+            elif (isinstance(raw, np.ndarray) and raw.dtype != object
+                    and name not in self._device_cache
+                    and int(rows) > raw.shape[0]):
+                # Host-resident source: pad on HOST (one memcpy) and
+                # upload the padded buffer — a pure transfer. The old
+                # device-side jnp.concatenate pad compiled one XLA
+                # program PER (rows, pad) shape pair; a serving replica
+                # flushing partial batches of arbitrary sizes (the
+                # underloaded-pool shape) hit a fresh ~50 ms compile on
+                # almost every dispatch, collapsing multi-replica
+                # throughput. Bit-identical to the device pad: zeros are
+                # zeros.
+                import jax
+                import jax.numpy as jnp
+
+                buf = np.zeros((int(rows),) + raw.shape[1:], raw.dtype)
+                buf[:raw.shape[0]] = raw
+                with jax.experimental.enable_x64(True):
+                    self._device_cache[key] = jnp.asarray(buf)
             else:
                 import jax
                 import jax.numpy as jnp

@@ -102,6 +102,35 @@ class MetricGroup:
             }
 
 
+class LatencyWindow:
+    """Sliding per-request latency ring publishing ``p50_ms``/``p99_ms``
+    gauges into a group — the ONE implementation of the percentile-
+    gauge semantics shared by the serving engine's per-engine window
+    and the multi-tenant pool's per-SLO-class windows (a divergent copy
+    would let two dashboards disagree about the same traffic).
+    Thread-safe; ``record`` takes any number of samples so batch
+    completions pay one lock acquisition and one sort."""
+
+    def __init__(self, group: MetricGroup, window: int = 2048):
+        self._group = group
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=int(window)
+        )
+
+    def record(self, *latencies_ms: float) -> None:
+        import numpy as np
+
+        with self._lock:
+            self._ring.extend(latencies_ms)
+            if not self._ring:
+                return
+            arr = np.asarray(self._ring)
+        p50, p99 = np.percentile(arr, [50, 99])  # one sort for both
+        self._group.gauge("p50_ms", float(p50))
+        self._group.gauge("p99_ms", float(p99))
+
+
 class MetricsRegistry:
     """Process-wide registry of metric groups.
 

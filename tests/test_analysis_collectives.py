@@ -10,6 +10,8 @@ checker certifies safe — the lock is analyzer-verified, not assumed.
 
 import threading
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -367,3 +369,80 @@ def test_threaded_train_kmeans_stream_trace_is_analyzer_safe(mesh):
     assert all(e.locks for e in trace), "epochs must dispatch under a lock"
     # ...and the recorded shape is the safe one.
     assert check_dispatch_trace(trace) == []
+
+
+# ---------------------------------------------------------------------------
+# FML304 — slice leases (training/serving colocation, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+LEASE_TRACE = "tests/analysis_fixtures/pool_lease_unreclaimed.trace.json"
+
+
+def test_pool_lease_unreclaimed_fixture_fml304():
+    """The seeded fixture: a pool dispatch on a still-leased slice is
+    FML304 even though it HOLDS the shared slice lock — leases are a
+    capacity contract, orthogonal to rendezvous locking."""
+    events = load_trace(LEASE_TRACE)
+    findings = check_dispatch_trace(events, location=LEASE_TRACE)
+    assert [f.rule for f in findings] == ["FML304"]
+    assert "lease:trainer:0,1" in findings[0].message
+    assert "request_revoke" in (findings[0].fix_hint or "")
+
+
+def test_fml304_live_lease_recording_and_release():
+    """Live shape: dispatch events record active FOREIGN leases over
+    their devices; the holder's own dispatches do not carry the token;
+    releasing the lease clears later events (the reclaim handshake's
+    observable end state)."""
+    lease = dispatch.lease_devices([0, 1], holder="trainer304")
+    events = []
+    dispatch.add_dispatch_observer(events.append)
+    try:
+        # Holder thread: its own dispatch carries no foreign lease.
+        dispatch.record_collective_dispatch("train_step", [0, 1])
+
+        def pool_dispatch():
+            dispatch.record_collective_dispatch(
+                "serving.pool/p304/r0.batch", [1, 2]
+            )
+
+        t = threading.Thread(target=pool_dispatch)
+        t.start()
+        t.join()
+        lease.release()
+        t2 = threading.Thread(target=pool_dispatch)
+        t2.start()
+        t2.join()
+    finally:
+        dispatch.remove_dispatch_observer(events.append)
+        lease.release()
+    assert events[0]["leases"] == ()
+    assert events[1]["leases"] == (lease.token,)
+    assert events[2]["leases"] == ()  # released: reclaimed slice is clean
+    trace = [DispatchEvent.from_map(e) for e in events]
+    rules = [f.rule for f in check_dispatch_trace(trace)]
+    assert rules.count("FML304") == 1
+
+
+def test_fml304_non_pool_dispatch_on_lease_not_flagged():
+    """A second TRAINER overlapping a lease is a scheduling question,
+    not the serving-steals-leased-slice shape — FML304 is pool-only
+    (FML302 still covers the locking side)."""
+    events = [
+        DispatchEvent(thread="t1", program="train_a", devices=(0, 1),
+                      locks=("lock:mesh:0,1",),
+                      leases=("lease:other:0,1",)),
+    ]
+    assert [f.rule for f in check_dispatch_trace(events)] == []
+
+
+def test_lease_registry_duplicate_refused():
+    lease = dispatch.lease_devices([4, 5], holder="dup")
+    try:
+        with pytest.raises(ValueError, match="already registered"):
+            dispatch.lease_devices([4, 5], holder="dup")
+    finally:
+        lease.release()
+    # Released: the same slice can be leased again.
+    again = dispatch.lease_devices([4, 5], holder="dup")
+    again.release()

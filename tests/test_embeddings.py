@@ -396,12 +396,19 @@ def test_fm_sharded_first_step_margins_match_dense():
 
     t, x, y = _fm_data(seed=2)
     # tol=inf-ish via 1 step: compare the one-step w0 (a pure function
-    # of the first batch's margins) between the layouts.
+    # of the first batch's margins) between the layouts. The label is
+    # SHIFTED so the mean margin is decisively nonzero: Adam's first
+    # step is ±lr·g/(|g|+eps) — with a near-zero g (the unshifted
+    # x[:, 0] label for this seed) the w0 SIGN becomes a coin flip on
+    # the two layouts' reduction order, a full-suite flake observed
+    # once (shard -0.0999992 vs dense +0.0999993); the margins
+    # themselves (the contract under test) match either way.
+    label = x[:, 0] + 1.0
     dense = FMRegressor().set_max_iter(1).set_global_batch_size(256)\
-        .fit(Table({"features": x, "label": x[:, 0]}))
+        .fit(Table({"features": x, "label": label}))
     shard = FMRegressor(sharding_plan=FSDP).set_max_iter(1)\
         .set_global_batch_size(256)\
-        .fit(Table({"features": x, "label": x[:, 0]}))
+        .fit(Table({"features": x, "label": label}))
     np.testing.assert_allclose(shard._w0, dense._w0, rtol=1e-4,
                                atol=1e-6)
 
