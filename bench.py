@@ -2007,8 +2007,8 @@ def _inner_autotune_cpu() -> dict:
 
 
 def _pallas_stage() -> dict:
-    """Kernel-vs-XLA A/B for the three Pallas sites (ROADMAP item 2 /
-    ISSUE 13): per-site ``pallas/xla`` throughput ratio through the same
+    """Kernel-vs-XLA A/B for the four Pallas sites (ROADMAP item 2 /
+    ISSUEs 13, 16): per-site ``pallas/xla`` throughput ratio through the same
     measurers the autotune search commits from, gated by a bitwise
     parity probe per site — a wrong kernel must never emit a ratio. On
     the CPU mesh the Pallas candidates run under the interpreter
@@ -2026,6 +2026,7 @@ def _pallas_stage() -> dict:
         _serving_model,
         measure_kernel_backend_fused_chain,
         measure_kernel_backend_segment_sum,
+        measure_kernel_backend_spmv,
         measure_kernel_backend_topk,
     )
     from flinkml_tpu.table import Table
@@ -2043,6 +2044,17 @@ def _pallas_stage() -> dict:
     b = np.asarray(kernels.segment_sum(
         vals, sids, 512, indices_are_sorted=True, backend="pallas"))
     assert a.tobytes() == b.tobytes(), "sorted segment_sum parity violation"
+    sib = jnp.asarray(rng.integers(0, 512, (256, 16)), jnp.int32)
+    svb = jnp.asarray(rng.normal(size=(256, 16)).astype(np.float32))
+    sw = jnp.asarray(rng.normal(size=512).astype(np.float32))
+    # Parity contract is vs the JITTED reference (the product path is
+    # always jitted; eager XLA's unfused reduce can differ in the last
+    # f32 bit).
+    a = np.asarray(jax.jit(
+        lambda i, v, w: jnp.sum(v * jnp.take(w, i, axis=0), axis=1)
+    )(sib, svb, sw))
+    b = np.asarray(kernels.spmv(sib, svb, sw, backend="pallas"))
+    assert a.tobytes() == b.tobytes(), "spmv parity violation"
     xq = jnp.asarray(rng.normal(size=(64, 512)).astype(np.float32))
     rv, ri = jax.lax.top_k(xq, 8)
     pv, pi = kernels.top_k(xq, 8, backend="pallas")
@@ -2071,6 +2083,7 @@ def _pallas_stage() -> dict:
     sites = {
         "fused_chain": measure_kernel_backend_fused_chain,
         "segment_sum": measure_kernel_backend_segment_sum,
+        "spmv": measure_kernel_backend_spmv,
         "topk": measure_kernel_backend_topk,
     }
     ratios, rates = {}, {}
@@ -2107,6 +2120,95 @@ def _inner_pallas_cpu() -> dict:
     return _pallas_stage()
 
 
+def _sparse_hot_loops_stage() -> dict:
+    """Sorted-by-design sparse hot loops (ISSUE 16): sparse-LR rows/s
+    through the SortedSparseColumn stream (prefetcher pack + gated SpMV
+    forward + sorted segment-sum gradient, zero densify / zero runtime
+    sort) against the PRODUCT densified baseline (the same batches as
+    ``[n, dim]`` through the dense stream trainer). Moderate ``dim`` so
+    the densified baseline is feasible to run at all; the ratio is the
+    headline — CI's ``sparse smoke`` trips if the sorted path ever
+    loses to densification (>= 1.0 expected: the sparse step moves and
+    multiplies O(nnz), the dense one O(n*dim))."""
+    import numpy as np
+
+    from flinkml_tpu.data.prefetch import pad_place_table
+    from flinkml_tpu.linalg import SparseVector
+    from flinkml_tpu.models._linear_sgd import (
+        train_linear_model_sorted_stream,
+        train_linear_model_stream,
+    )
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.table import Table
+
+    n_batches, batch, dim, nnz = 8, 512, 4_096, 16
+    epochs = 3
+    rng = np.random.default_rng(0)
+    host_tables, dense_batches = [], []
+    for _ in range(n_batches):
+        vecs = np.empty(batch, object)
+        xd = np.zeros((batch, dim), np.float32)
+        for i in range(batch):
+            idx = np.sort(rng.choice(dim, size=nnz, replace=False))
+            val = rng.normal(size=nnz).astype(np.float32)
+            vecs[i] = SparseVector(dim, idx, val)
+            xd[i, idx] = val
+        y = (rng.random(batch) > 0.5).astype(np.float32)
+        w = np.ones(batch, np.float32)
+        host_tables.append(Table({"features": vecs, "y": y, "w": w}))
+        dense_batches.append({"x": xd, "y": y, "w": w})
+    dev_tables = [pad_place_table(t) for t in host_tables]
+    mesh = DeviceMesh()
+    hyper = dict(loss="logistic", learning_rate=0.5, reg=1e-4,
+                 elastic_net=0.0, tol=0.0)
+
+    def sorted_fit(iters):
+        return train_linear_model_sorted_stream(
+            list(dev_tables), "features", "y", "w", max_iter=iters, **hyper,
+        )
+
+    def dense_fit(iters):
+        return train_linear_model_stream(
+            iter([dict(b) for b in dense_batches]), mesh=mesh,
+            max_iter=iters, **hyper,
+        )
+
+    rows = n_batches * batch
+    out = {"dim": dim, "nnz_per_row": nnz, "rows_per_epoch": rows,
+           "epochs_timed": epochs}
+    for name, fit in (("sparse_sorted", sorted_fit),
+                      ("densified", dense_fit)):
+        fit(1)  # compile + warm (module-level stepper caches persist)
+        t0 = time.perf_counter()
+        fit(epochs)
+        out[f"{name}_rows_per_sec"] = round(
+            rows * epochs / (time.perf_counter() - t0), 1
+        )
+    out["sparse_vs_densified_ratio"] = round(
+        out["sparse_sorted_rows_per_sec"] / out["densified_rows_per_sec"], 4
+    )
+    return out
+
+
+def _inner_sparse_hot_loops() -> dict:
+    """The DEVICE sorted-sparse measurement (queued in stage_order):
+    the sorted-column stream vs densification on real hardware."""
+    _setup_jax_cache()
+    return _sparse_hot_loops_stage()
+
+
+def _inner_sparse_hot_loops_cpu() -> dict:
+    """Tunnel-immune CPU-mesh variant — what CI's ``sparse smoke``
+    stage parses for the no-regression tripwire."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    return _sparse_hot_loops_stage()
+
+
 _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
@@ -2140,6 +2242,8 @@ _INNER_STAGES = {
     "autotune_cpu": _inner_autotune_cpu,
     "pallas": _inner_pallas,
     "pallas_cpu": _inner_pallas_cpu,
+    "sparse_hot_loops": _inner_sparse_hot_loops,
+    "sparse_hot_loops_cpu": _inner_sparse_hot_loops_cpu,
     "recovery": _inner_recovery,
     "recovery_cpu": _inner_recovery_cpu,
     "converge": _inner_converge,
@@ -2293,7 +2397,7 @@ def main():
                      "input_pipeline_cpu",
                      "sharded_train_cpu", "sharded_embedding_cpu",
                      "precision_cpu", "cold_start_cpu", "cold_start_child",
-                     "autotune_cpu", "pallas_cpu"):
+                     "autotune_cpu", "pallas_cpu", "sparse_hot_loops_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():
@@ -2366,7 +2470,8 @@ def main():
                    "kmeans", "kmeans_mnist", "pipeline_fused",
                    "feed_overlap", "input_pipeline", "sharded_train",
                    "sharded_embedding", "precision", "cold_start",
-                   "autotune", "pallas", "serving_autoscale", "gbt",
+                   "autotune", "pallas", "sparse_hot_loops",
+                   "serving_autoscale", "gbt",
                    "als", "word2vec", "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two

@@ -21,6 +21,12 @@ any host):
      shard, replicated-but-huge family vs the HBM budget, conflicting
      cross-plan collective orders); consumes live plans or
      ``*.plan.json`` fixtures.
+  6. **Sorted-scatter provenance** (:mod:`.sorted_scatter`) — FML404:
+     walks jaxprs propagating the pack-time sorted guarantee
+     (:class:`~flinkml_tpu.table.SortedSparseColumn`) and flags any
+     scatter-add traced with ``indices_are_sorted=False`` over
+     sorted-provenance indices — the silent re-pay-the-sort-every-step
+     shape; consumes live functions or ``*.scatter.json`` probes.
   5. **Precision-flow validation** (:mod:`.precision`) — FML6xx:
      abstract-interprets jaxprs tracking per-value dtype provenance
      against a declared
@@ -80,4 +86,10 @@ from flinkml_tpu.analysis.precision import (  # noqa: F401
     check_precision_fn,
     promotion_findings,
     validate_precision,
+)
+from flinkml_tpu.analysis.sorted_scatter import (  # noqa: F401
+    ORDER_PRESERVING,
+    check_scatter_file,
+    check_sorted_scatter_fn,
+    check_sorted_scatter_jaxpr,
 )

@@ -15,6 +15,10 @@ Runs all three analysis passes device-free over the given targets:
      PrecisionPolicy, optionally with an example program and a plan
      width, see ``docs/development/precision.md``) runs the
      precision-flow pass — FML601-605;
+  2d. *sorted-scatter provenance*: every ``*.scatter.json`` target (a
+     declarative scatter probe with a declared pack-time sorted
+     guarantee, see :mod:`flinkml_tpu.analysis.sorted_scatter`) runs
+     the FML404 walk;
   3. *transfer/retrace self-check*: a representative fused scaler→
      predictor chain is executed at several row counts inside one bucket
      under :class:`~flinkml_tpu.analysis.guard.TransferRetraceGuard` —
@@ -81,6 +85,14 @@ def _pass_policies(policy_targets, report: Report) -> None:
     _pin_cpu()  # example programs trace jaxprs (abstract, device-free)
     for path in policy_targets:
         report.extend(check_policy_file(path))
+
+
+def _pass_scatters(scatter_targets, report: Report) -> None:
+    from flinkml_tpu.analysis.sorted_scatter import check_scatter_file
+
+    _pin_cpu()  # probe programs trace jaxprs (abstract, device-free)
+    for path in scatter_targets:
+        report.extend(check_scatter_file(path))
 
 
 def _pass_retrace_selfcheck(report: Report) -> None:
@@ -157,8 +169,9 @@ def main(argv=None) -> int:
     parser.add_argument(
         "targets", nargs="*",
         help=".py files / directories to lint, *.trace.json dispatch "
-             "traces, *.plan.json sharding plans, and *.policy.json "
-             "precision policies to check",
+             "traces, *.plan.json sharding plans, *.policy.json "
+             "precision policies, and *.scatter.json sorted-scatter "
+             "probes to check",
     )
     parser.add_argument(
         "--fail-on-findings", action="store_true",
@@ -191,6 +204,7 @@ def main(argv=None) -> int:
         return 0
 
     py_targets, trace_targets, plan_targets, policy_targets = [], [], [], []
+    scatter_targets = []
     for t in args.targets:
         if t.endswith(".trace.json"):
             trace_targets.append(t)
@@ -198,6 +212,8 @@ def main(argv=None) -> int:
             plan_targets.append(t)
         elif t.endswith(".policy.json"):
             policy_targets.append(t)
+        elif t.endswith(".scatter.json"):
+            scatter_targets.append(t)
         else:
             py_targets.append(t)
             if os.path.isdir(t):
@@ -214,6 +230,10 @@ def main(argv=None) -> int:
                         os.path.join(root, n) for n in sorted(names)
                         if n.endswith(".policy.json")
                     )
+                    scatter_targets.extend(
+                        os.path.join(root, n) for n in sorted(names)
+                        if n.endswith(".scatter.json")
+                    )
 
     report = Report()
     if py_targets:
@@ -224,6 +244,8 @@ def main(argv=None) -> int:
         _pass_plans(plan_targets, report)
     if policy_targets:
         _pass_policies(policy_targets, report)
+    if scatter_targets:
+        _pass_scatters(scatter_targets, report)
     if not args.no_selfcheck:
         _pass_retrace_selfcheck(report)
 

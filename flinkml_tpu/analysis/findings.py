@@ -45,6 +45,7 @@ RULES = {
     "FML401": (ERROR, "host<->device transfer beyond the declared budget in a guarded region"),
     "FML402": (ERROR, "compile-cache miss beyond the declared bucket policy in a guarded region"),
     "FML403": (ERROR, "two compiles share input specs and bucket but differ in chain fingerprint"),
+    "FML404": (ERROR, "scatter-add traced with indices_are_sorted=False over indices carrying the pack-time sorted guarantee (re-pays the sort every step)"),
     # -- 5xx: sharding plans -----------------------------------------------
     "FML501": (ERROR, "sharding plan references an unknown mesh axis (or uses one illegally)"),
     "FML502": (ERROR, "mesh axis size does not divide the parameter dimension it shards"),

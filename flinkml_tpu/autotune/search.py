@@ -50,6 +50,7 @@ STATIC_DEFAULTS: Dict[str, Any] = {
     "serving_window_ms": 2.0,
     "kernel_backend_fused_chain": "xla",
     "kernel_backend_segment_sum": "xla",
+    "kernel_backend_spmv": "xla",
     "kernel_backend_topk": "xla",
     "embedding_exchange": "ring",
     "serving_scale_up_backlog": 0.5,
@@ -624,6 +625,38 @@ def measure_kernel_backend_segment_sum(quick: bool = False
     return out
 
 
+def measure_kernel_backend_spmv(quick: bool = False) -> Dict[str, float]:
+    """Sparse forward-margin rows/s per SpMV backend at the sparse
+    trainer's per-step shape (padded-ELL ``[rows, width]`` block
+    against a dense ``[dim]`` coefficient)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flinkml_tpu import kernels
+
+    rows, width, dim = (1 << 11, 16, 1 << 14) if quick \
+        else (1 << 13, 32, 1 << 16)
+    reps = 5 if quick else 20
+    rng = np.random.default_rng(0)
+    ib = jnp.asarray(rng.integers(0, dim, (rows, width)), jnp.int32)
+    vb = jnp.asarray(rng.normal(size=(rows, width)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32))
+    out: Dict[str, float] = {}
+    for backend in ("xla", "pallas"):
+        fn = jax.jit(functools.partial(kernels.spmv, backend=backend))
+        np.asarray(fn(ib, vb, w))  # compile + warmup
+
+        def rate() -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                r = fn(ib, vb, w)
+            np.asarray(r)
+            return rows * reps / (time.perf_counter() - t0)
+
+        out[backend] = _timed_rate(rate)
+    return out
+
+
 def measure_kernel_backend_topk(quick: bool = False) -> Dict[str, float]:
     """KNN-shaped queries/s per top-k backend (``[nq, n]`` distance
     matrix, k of the bench's neighbor-query size)."""
@@ -743,6 +776,7 @@ MEASURERS: Dict[str, Callable[[bool], Dict[str, float]]] = {
     "serving_window_ms": measure_serving_window_ms,
     "kernel_backend_fused_chain": measure_kernel_backend_fused_chain,
     "kernel_backend_segment_sum": measure_kernel_backend_segment_sum,
+    "kernel_backend_spmv": measure_kernel_backend_spmv,
     "kernel_backend_topk": measure_kernel_backend_topk,
     "embedding_exchange": measure_embedding_exchange,
     "serving_scale_up_backlog": measure_serving_scale_up_backlog,

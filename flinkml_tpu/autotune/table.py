@@ -73,6 +73,7 @@ KNOWN_KNOBS: Dict[str, str] = {
     # `pallas`) is what can flip these.
     "kernel_backend_fused_chain": "rows_per_sec",
     "kernel_backend_segment_sum": "cells_per_sec",
+    "kernel_backend_spmv": "rows_per_sec",
     "kernel_backend_topk": "queries_per_sec",
     # The sharded-embedding exchange (flinkml_tpu.embeddings): ring vs
     # all_to_all row routing, with dense_psum (replicated table, dense

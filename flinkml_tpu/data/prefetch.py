@@ -51,10 +51,17 @@ def pad_place_table(table: Table, place=None) -> Table:
     and upload: each becomes a bucket-height
     :class:`~flinkml_tpu.table.PaddedDeviceColumn` with the logical row
     count intact (dtype preserved exactly — the fused executor's
-    bit-parity contract). Object (ragged) columns have no device
-    representation and stay host-resident."""
+    bit-parity contract). Object columns whose rows are all
+    ``SparseVector`` become bucket-height
+    :class:`~flinkml_tpu.table.SortedSparseColumn`\\ s — the padded-ELL
+    CSR layout plus pack-time global sort tables, built HERE on the
+    worker thread (the sort overlaps compute; downstream scatters run
+    ``indices_are_sorted=True`` with no runtime sort). Other object
+    (ragged) columns have no device representation and stay
+    host-resident."""
     import jax
 
+    from flinkml_tpu.linalg import SparseVector
     from flinkml_tpu.pipeline_fusion import row_bucket
 
     if place is None:
@@ -66,7 +73,16 @@ def pad_place_table(table: Table, place=None) -> Table:
         for name in table.column_names:
             arr = table.column(name)
             if arr.dtype == object:
-                cols[name] = arr
+                if n and all(isinstance(v, SparseVector) for v in arr):
+                    from flinkml_tpu.ops.sparse import (
+                        pack_sorted_sparse_column,
+                    )
+
+                    cols[name] = pack_sorted_sparse_column(
+                        arr, bucket=bucket, place=place
+                    )
+                else:
+                    cols[name] = arr
                 continue
             pad = bucket - n
             if pad:

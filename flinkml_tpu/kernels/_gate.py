@@ -2,13 +2,16 @@
 (:func:`flinkml_tpu.models._linear_sgd._sparse_layout`) applied to the
 choice between XLA's lowering and the hand-written Pallas kernels.
 
-Three *sites* exist, one per hot inner loop:
+Four *sites* exist, one per hot inner loop:
 
 - ``fused_chain``  — the fused pipeline executor's per-bucket chain
   program (:mod:`flinkml_tpu.kernels.chain`),
 - ``segment_sum``  — the padded-ELL sparse gradient scatter-accumulate
   shared by the linear SGD trainers, ``BatchedCSR.rmatvec``, and the
   Word2Vec embedding accumulator (:mod:`flinkml_tpu.kernels.segsum`),
+- ``spmv``         — the padded-ELL CSR matvec behind the sparse
+  trainers' forward margins and ``BatchedCSR.matvec``
+  (:mod:`flinkml_tpu.kernels.spmv`),
 - ``topk``         — the bucketed top-k behind KNN voting and LSH
   candidate ranking (:mod:`flinkml_tpu.kernels.topk`).
 
@@ -42,8 +45,8 @@ from flinkml_tpu.utils.logging import get_logger
 
 _log = get_logger("kernels")
 
-#: The three gated sites (one per hot inner loop — module docstring).
-SITES = ("fused_chain", "segment_sum", "topk")
+#: The four gated sites (one per hot inner loop — module docstring).
+SITES = ("fused_chain", "segment_sum", "spmv", "topk")
 
 #: Known backends. ``xla`` is the static default everywhere; ``pallas``
 #: must win a measured A/B (the autotune ``kernel_backend_*`` knobs) or

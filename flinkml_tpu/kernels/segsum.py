@@ -18,11 +18,16 @@ output block:
   ``runs`` predicated stores. Left-to-right addition within a run keeps
   bit-parity with the sorted XLA scatter.
 
-Single-block kernel by design: the whole padded flat array and the
-``[num_segments, k]`` output live in one block, which is exactly right
-for the interpreter (CI) and for trainer shapes whose output is the
-VMEM-resident ``[dim]`` gradient; the supported-shape ceiling below
-refuses sizes that could not fit VMEM on a real device rather than
+The CELL axis streams through a grid: up to ``BLOCK_CELLS`` cells per
+grid step, with the output block revisited (constant index map) so the
+accumulator persists across steps — TPU grids iterate sequentially, so
+element-order addition is preserved and parity stays bitwise at any
+cell count. The sorted run-flush carry rides two tiny extra output refs
+(current id + accumulator row) between grid steps, so a run spanning a
+block boundary is still added left-to-right and flushed exactly once.
+The remaining supported-shape ceiling (``MAX_COMPILED_CELLS``) is the
+OUTPUT block ``num_segments * k``, which must stay VMEM-resident for
+the whole pass; the compiled path refuses sizes past it rather than
 compiling something that spills. The device re-tune (bench stage
 ``pallas``) decides whether this beats XLA's scatter on hardware — the
 gate (:mod:`flinkml_tpu.kernels._gate`) keeps XLA the default until a
@@ -31,11 +36,18 @@ measured win is committed.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
-#: Supported-shape ceiling for the COMPILED (non-interpret) path: cells
-#: beyond this cannot stream through one VMEM block on current TPUs.
+#: Supported-shape ceiling for the COMPILED (non-interpret) path, in
+#: cells of the OUTPUT block (``num_segments * k``): the segment axis
+#: must fit one VMEM block; the cell axis streams through the grid and
+#: has no ceiling.
 MAX_COMPILED_CELLS = 1 << 22
+
+#: Cells per grid step. One block up to here (the committed-measurement
+#: shape); larger inputs grid over ``ceil(cells / BLOCK_CELLS)`` steps.
+BLOCK_CELLS = 1 << 19
 
 _FLOAT_KINDS = "f"  # jnp dtype.kind for floating
 
@@ -64,9 +76,13 @@ def unsupported_reason(values, ids, num_segments: int,
     if not interpret:
         if v.dtype == jnp.float64:
             return "float64 is interpreter-only (TPU has no f64 lanes)"
-        if v.shape[0] > MAX_COMPILED_CELLS:
-            return (f"{v.shape[0]} cells exceed the one-block compiled "
-                    f"ceiling of {MAX_COMPILED_CELLS}")
+        k = 1 if v.ndim == 1 else v.shape[1]
+        if num_segments * k > MAX_COMPILED_CELLS:
+            return (f"output block num_segments*k = {num_segments * k} "
+                    f"exceeds the one-block compiled ceiling of "
+                    f"{MAX_COMPILED_CELLS} (MAX_COMPILED_CELLS); the "
+                    "grid streams the cell axis, but the segment axis "
+                    "must fit one VMEM-resident block")
     return None
 
 
@@ -117,6 +133,92 @@ def _sorted_body(ids_ref, val_ref, out_ref):
     out_ref[pl.ds(cur, 1), :] = out_ref[pl.ds(cur, 1), :] + acc[None, :]
 
 
+def _unsorted_grid_body(ids_ref, val_ref, out_ref, *, total_cells: int):
+    # Multi-block variant: the output block has a constant index map, so
+    # it stays resident while the grid walks cell blocks sequentially —
+    # addition order is still element order, parity stays bitwise. The
+    # padded tail cells (last block only) are predicated off entirely
+    # instead of relying on id-0/value-0 no-op adds, which could flip a
+    # -0.0 accumulator to +0.0.
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    block = val_ref.shape[0]
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    def body(j, carry):
+        @pl.when(i * block + j < total_cells)
+        def _():
+            idx = ids_ref[j]
+            out_ref[pl.ds(idx, 1), :] = (
+                out_ref[pl.ds(idx, 1), :] + val_ref[pl.ds(j, 1), :]
+            )
+        return carry
+
+    jax.lax.fori_loop(0, block, body, 0)
+
+
+def _sorted_grid_body(ids_ref, val_ref, out_ref, carry_id_ref,
+                      carry_acc_ref, *, total_cells: int):
+    # Multi-block run-flush: the (current id, accumulator) carry lives in
+    # two tiny revisited output refs between grid steps, so a run that
+    # spans a block boundary keeps accumulating left-to-right and is
+    # flushed exactly once — the per-cell op tree is identical to the
+    # single-block body, which keeps parity with the sorted XLA scatter
+    # bitwise. The last block does the final flush; earlier blocks park
+    # the carry instead.
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    block = val_ref.shape[0]
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+        carry_id_ref[0, 0] = ids_ref[0]
+        carry_acc_ref[0, :] = jnp.zeros_like(carry_acc_ref[0, :])
+
+    def body(j, carry):
+        cur, acc = carry
+        valid = i * block + j < total_cells
+        idx = ids_ref[j]
+        v = val_ref[pl.ds(j, 1), :][0]
+        flush = (idx != cur) & valid
+
+        @pl.when(flush)
+        def _():
+            out_ref[pl.ds(cur, 1), :] = (
+                out_ref[pl.ds(cur, 1), :] + acc[None, :]
+            )
+
+        ncur = jnp.where(valid, idx, cur)
+        nacc = jnp.where(valid, jnp.where(flush, v, acc + v), acc)
+        return ncur, nacc
+
+    cur, acc = jax.lax.fori_loop(
+        0, block, body, (carry_id_ref[0, 0], carry_acc_ref[0, :])
+    )
+
+    @pl.when(i == last)
+    def _():
+        out_ref[pl.ds(cur, 1), :] = (
+            out_ref[pl.ds(cur, 1), :] + acc[None, :]
+        )
+
+    @pl.when(i != last)
+    def _():
+        carry_id_ref[0, 0] = cur
+        carry_acc_ref[0, :] = acc
+
+
 def pallas_segment_sum(values, ids, num_segments: int, *,
                        indices_are_sorted: bool = False,
                        interpret: Optional[bool] = None):
@@ -124,7 +226,9 @@ def pallas_segment_sum(values, ids, num_segments: int, *,
     as ``jax.ops.segment_sum(values, ids, num_segments,
     indices_are_sorted=...)`` for in-range ids; out-of-range ids are the
     caller's bug on both backends (padding rides the ELL convention:
-    index 0 / value 0 is a no-op add)."""
+    index 0 / value 0 is a no-op add). Unsupported operands raise
+    :class:`KernelUnsupportedError` — direct callers get the same typed
+    refusal as the gated dispatcher, with the same wording."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -133,21 +237,67 @@ def pallas_segment_sum(values, ids, num_segments: int, *,
 
     if interpret is None:
         interpret = _gate.interpret_mode()
+    reason = unsupported_reason(values, ids, num_segments, interpret)
+    if reason is not None:
+        raise _gate.KernelUnsupportedError(
+            f"kernels[segment_sum]: pallas_segment_sum cannot run these "
+            f"operands: {reason}"
+        )
     flat = values.ndim == 1
     v2 = values[:, None] if flat else values
     cells, k = v2.shape
     ids32 = ids.astype(jnp.int32)
-    body = _sorted_body if indices_are_sorted else _unsorted_body
-    out = pl.pallas_call(
-        body,
-        in_specs=[
-            pl.BlockSpec((cells,), lambda: (0,)),
-            pl.BlockSpec((cells, k), lambda: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((num_segments, k), lambda: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((num_segments, k), v2.dtype),
-        interpret=interpret,
-    )(ids32, v2)
+    if cells <= BLOCK_CELLS:
+        body = _sorted_body if indices_are_sorted else _unsorted_body
+        out = pl.pallas_call(
+            body,
+            in_specs=[
+                pl.BlockSpec((cells,), lambda: (0,)),
+                pl.BlockSpec((cells, k), lambda: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((num_segments, k), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((num_segments, k), v2.dtype),
+            interpret=interpret,
+        )(ids32, v2)
+        return out[:, 0] if flat else out
+    grid = pl.cdiv(cells, BLOCK_CELLS)
+    pad = grid * BLOCK_CELLS - cells
+    if pad:
+        # Padding is predicated off inside the bodies (total_cells);
+        # zeros here only square up the block shape.
+        ids32 = jnp.concatenate([ids32, jnp.zeros((pad,), jnp.int32)])
+        v2 = jnp.concatenate([v2, jnp.zeros((pad, k), v2.dtype)])
+    in_specs = [
+        pl.BlockSpec((BLOCK_CELLS,), lambda i: (i,)),
+        pl.BlockSpec((BLOCK_CELLS, k), lambda i: (i, 0)),
+    ]
+    out_spec = pl.BlockSpec((num_segments, k), lambda i: (0, 0))
+    if indices_are_sorted:
+        out, _, _ = pl.pallas_call(
+            functools.partial(_sorted_grid_body, total_cells=cells),
+            grid=(grid,),
+            in_specs=in_specs,
+            out_specs=(
+                out_spec,
+                pl.BlockSpec((1, 1), lambda i: (0, 0)),
+                pl.BlockSpec((1, k), lambda i: (0, 0)),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((num_segments, k), v2.dtype),
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((1, k), v2.dtype),
+            ),
+            interpret=interpret,
+        )(ids32, v2)
+    else:
+        out = pl.pallas_call(
+            functools.partial(_unsorted_grid_body, total_cells=cells),
+            grid=(grid,),
+            in_specs=in_specs,
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((num_segments, k), v2.dtype),
+            interpret=interpret,
+        )(ids32, v2)
     return out[:, 0] if flat else out
 
 

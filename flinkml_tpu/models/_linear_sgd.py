@@ -102,6 +102,15 @@ def _segsum_backend() -> str:
     return kernels.segsum_backend()
 
 
+def _spmv_backend() -> str:
+    """The kernel-backend gate for the forward ELL matvec
+    (:mod:`flinkml_tpu.kernels`, site ``spmv``) — same fit-time
+    resolution and lru-key threading as :func:`_segsum_backend`."""
+    from flinkml_tpu import kernels
+
+    return kernels.spmv_backend()
+
+
 def _soft_threshold(x, t):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
 
@@ -164,13 +173,15 @@ def make_dense_step(loss: str, local_bs: int, axis: str):
 
 
 def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int,
-                     segsum_backend: str = "xla"):
+                     segsum_backend: str = "xla",
+                     spmv_backend: str = "xla"):
     """Sparse (padded-ELL) variant: gather forward, segment-sum gradient.
 
-    ``segsum_backend`` selects the scatter-accumulate lowering (XLA's
-    ``segment_sum`` or the Pallas kernel, :mod:`flinkml_tpu.kernels`);
-    resolved ONCE at fit time and threaded through the trainer
-    factories' lru keys so a gate flip re-keys the jitted step."""
+    ``segsum_backend`` selects the scatter-accumulate lowering and
+    ``spmv_backend`` the forward matvec lowering (XLA or the Pallas
+    kernels, :mod:`flinkml_tpu.kernels`); each resolved ONCE at fit
+    time and threaded through the trainer factories' lru keys so a
+    gate flip re-keys the jitted step."""
     from flinkml_tpu import kernels
 
     def step(coef, epoch, idxl, vall, yl, wl, learning_rate, reg_l2, reg_l1):
@@ -179,7 +190,7 @@ def make_sparse_step(loss: str, local_bs: int, axis: str, dim: int,
         yb = _window(yl, epoch, local_bs)
         wb = _window(wl, epoch, local_bs)
         acc = _acc_dt(vb.dtype)
-        dot = jnp.sum(vb * coef[ib], axis=1)
+        dot = kernels.spmv(ib, vb, coef, backend=spmv_backend)
         mult, per_ex = _margin_grad(loss, dot, yb, wb)
         contrib = (vb * mult[:, None]).reshape(-1)
         grad_local = kernels.segment_sum(
@@ -206,7 +217,8 @@ _SPARSE_ARGS_PER_BUCKET = {"unsorted": 4, "sorted": 6, "cumsum": 8}
 def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
                               axis: str, dim: int,
                               layout: str = "unsorted",
-                              segsum_backend: str = "xla"):
+                              segsum_backend: str = "xla",
+                              spmv_backend: str = "xla"):
     """nnz-bucketed sparse step: one window per bucket, fused scatters.
 
     The batch is stratified across the nnz buckets (``ops.sparse.
@@ -257,7 +269,7 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
             vb = _window(vall, epoch, local_bs)
             yb = _window(yl, epoch, local_bs)
             wb = _window(wl, epoch, local_bs)
-            dot = jnp.sum(vb * coef[ib], axis=1)
+            dot = kernels.spmv(ib, vb, coef, backend=spmv_backend)
             mult, per_ex = _margin_grad(loss, dot, yb, wb)
             if layout == "sorted":
                 contrib = (vb * mult[:, None]).reshape(-1)
@@ -308,15 +320,16 @@ def make_sparse_step_bucketed(loss: str, local_bss: Tuple[int, ...],
 def _sparse_trainer_bucketed(mesh, loss: str, local_bss: Tuple[int, ...],
                              axis: str, dim: int,
                              layout: str = "unsorted",
-                             segsum_backend: str = "xla"):
+                             segsum_backend: str = "xla",
+                             spmv_backend: str = "xla"):
     """Bucketed counterpart of :func:`_sparse_trainer` — same carry-style
     contract; the data args are ``k·len(local_bss)`` sharded arrays where
     ``k = _SPARSE_ARGS_PER_BUCKET[layout]`` (indices, values, y, w, plus
-    the layout's pack-time tables). ``segsum_backend`` is lru-key
-    material: an XLA-scatter trainer and a Pallas-scatter trainer never
-    alias one jitted program."""
+    the layout's pack-time tables). ``segsum_backend`` and
+    ``spmv_backend`` are lru-key material: an XLA-kernel trainer and a
+    Pallas-kernel trainer never alias one jitted program."""
     local_step = make_sparse_step_bucketed(
-        loss, local_bss, axis, dim, layout, segsum_backend
+        loss, local_bss, axis, dim, layout, segsum_backend, spmv_backend
     )
     n_args = _SPARSE_ARGS_PER_BUCKET[layout] * len(local_bss)
 
@@ -391,11 +404,14 @@ def _dense_trainer(mesh, loss: str, local_bs: int, axis: str):
 
 @functools.lru_cache(maxsize=128)
 def _sparse_trainer(mesh, loss: str, local_bs: int, axis: str, dim: int,
-                    segsum_backend: str = "xla"):
+                    segsum_backend: str = "xla",
+                    spmv_backend: str = "xla"):
     """Sparse counterpart of :func:`_dense_trainer` — same carry-style
     contract (see there for the chunked-checkpointing rationale).
-    ``segsum_backend`` is lru-key material (kernel gate idiom)."""
-    local_step = make_sparse_step(loss, local_bs, axis, dim, segsum_backend)
+    ``segsum_backend``/``spmv_backend`` are lru-key material (kernel
+    gate idiom)."""
+    local_step = make_sparse_step(loss, local_bs, axis, dim,
+                                  segsum_backend, spmv_backend)
 
     def per_device(coef, epoch, cur_loss, idxl, vall, yl, wl,
                    learning_rate, reg_l2, reg_l1, tol, epoch_end):
@@ -675,7 +691,7 @@ def train_linear_model_sparse(
     local_bs = min(max(1, math.ceil(global_batch_size / p_size)), n_local)
     trainer = _sparse_trainer(
         mesh.mesh, loss, local_bs, DeviceMesh.DATA_AXIS, int(dim),
-        _segsum_backend(),
+        _segsum_backend(), _spmv_backend(),
     )
     return _run_chunked(
         trainer, (idxd, vald, yd, wd), int(dim), vald.dtype,
@@ -892,7 +908,7 @@ def train_linear_model_sparse_csr(
     )
     trainer = _sparse_trainer_bucketed(
         mesh.mesh, loss, tuple(local_bss), DeviceMesh.DATA_AXIS, int(dim),
-        layout, _segsum_backend(),
+        layout, _segsum_backend(), _spmv_backend(),
     )
     return _run_chunked(
         trainer, tuple(data_args), int(dim), jnp.dtype(dtype),
@@ -1143,7 +1159,7 @@ def _train_linear_sparse_stream_multiprocess(
     row_tile = p_size * 8
     axis = DeviceMesh.DATA_AXIS
     stepper = _sparse_stream_stepper(mesh.mesh, loss, axis, int(sparse_dim),
-                                 _segsum_backend())
+                                 _segsum_backend(), _spmv_backend())
     l2 = reg * (1.0 - elastic_net)
     l1 = reg * elastic_net
 
@@ -1346,6 +1362,21 @@ def streamed_linear_fit(
         raise ValueError("training stream is empty") from None
     tables = itertools.chain([first_t], it)
 
+    from flinkml_tpu.table import SortedSparseColumn, Table
+
+    if (
+        isinstance(first_t, Table)
+        and features_col in first_t.column_names
+        and isinstance(first_t._raw_column(features_col), SortedSparseColumn)
+    ):
+        # Device-resident sorted-layout stream (DevicePrefetcher output):
+        # train directly on the pack-time-sorted tables — no host
+        # round-trip, no densify, no runtime sort.
+        return train_linear_model_sorted_stream(
+            tables, features_col, label_col, weight_col,
+            label_check=label_check, **kwargs,
+        )
+
     if sparse_features(first_t, features_col) is not None:
         indptr0, indices0, values0, dim0, y0, w0 = labeled_sparse_data(
             first_t, features_col, label_col, weight_col
@@ -1489,19 +1520,20 @@ def _stream_stepper(mesh, loss: str, axis: str):
 
 @functools.lru_cache(maxsize=64)
 def _sparse_stream_stepper(mesh, loss: str, axis: str, dim: int,
-                           segsum_backend: str = "xla"):
+                           segsum_backend: str = "xla",
+                           spmv_backend: str = "xla"):
     """Sparse sibling of :func:`_stream_stepper`: the batch arrives as a
     sharded padded-ELL block (indices/values), the dense ``[dim]``
-    coefficient stays replicated. Gather forward + one ``segment_sum``
+    coefficient stays replicated. SpMV forward + one ``segment_sum``
     gradient scatter (the streamed path has no static windows, so the
     pack-time-sorted ``cumsum`` layout cannot apply here — each batch's
     cells are seen once per epoch in stream order). ``segsum_backend``
-    is lru-key material (kernel gate idiom)."""
+    and ``spmv_backend`` are lru-key material (kernel gate idiom)."""
     from flinkml_tpu import kernels
 
     def per_device(coef, ib, vb, yb, wb, learning_rate, reg_l2, reg_l1):
         acc = _acc_dt(vb.dtype)
-        dot = jnp.sum(vb * coef[ib], axis=1)
+        dot = kernels.spmv(ib, vb, coef, backend=spmv_backend)
         mult, per_ex = _margin_grad(loss, dot, yb, wb)
         contrib = (vb * mult[:, None]).reshape(-1)
         grad = jax.lax.psum(
@@ -1529,6 +1561,211 @@ def _sparse_stream_stepper(mesh, loss: str, axis: str, dim: int,
             out_specs=(P(), P(), P()),
         )
     )
+
+
+@functools.lru_cache(maxsize=64)
+def _sorted_column_stepper(loss: str, dim: int,
+                           segsum_backend: str = "xla",
+                           spmv_backend: str = "xla"):
+    """Step factory for :func:`train_linear_model_sorted_stream`: one
+    SGD step over a prefetched :class:`~flinkml_tpu.table
+    .SortedSparseColumn` batch. Pure ``jax.jit`` — the column's global
+    sort tables (``perm``/``segment_ids``) index the FULL flat cell
+    block, which does not shard by rows, so the replicated single-
+    program step is the correct shape here (psum-free).
+
+    The forward is the gated SpMV over the padded-ELL block; the
+    gradient scatter replays the pack-time sort —
+    ``segment_sum(take(contrib, perm), segment_ids,
+    indices_are_sorted=True)`` — so the step contains ZERO runtime
+    sorts (the argsort already ran once on the prefetch worker
+    thread). Row-bucket padding is neutralized in-jit: the weight
+    column is masked by the traced ``n_valid`` row count (weight 0 ⇒
+    exact zero contribution to grad/loss/wsum), so batch-size jitter
+    inside a bucket never retraces. Backends are lru-key material
+    (kernel gate idiom)."""
+    from flinkml_tpu import kernels
+
+    def step(coef, ib, vb, perm, seg, yb, wb, n_valid, learning_rate,
+             reg_l2, reg_l1):
+        acc = _acc_dt(vb.dtype)
+        yb = yb.astype(vb.dtype)
+        wb = jnp.where(
+            jnp.arange(wb.shape[0]) < n_valid,
+            wb.astype(vb.dtype),
+            jnp.zeros((), vb.dtype),
+        )
+        dot = kernels.spmv(ib, vb, coef, backend=spmv_backend)
+        mult, per_ex = _margin_grad(loss, dot, yb, wb)
+        contrib = (vb * mult[:, None]).reshape(-1)
+        grad = kernels.segment_sum(
+            jnp.take(contrib, perm), seg, dim,
+            indices_are_sorted=True, backend=segsum_backend,
+        ) + 2.0 * reg_l2 * coef
+        loss_sum = jnp.sum(per_ex.astype(acc)) + (
+            reg_l2 * jnp.sum(jnp.square(coef.astype(acc)))
+        )
+        wsum = jnp.sum(wb.astype(acc))
+        step_size = learning_rate.astype(acc) / wsum
+        new_coef = _soft_threshold(
+            coef - step_size.astype(coef.dtype) * grad,
+            step_size.astype(coef.dtype) * reg_l1,
+        )
+        return new_coef, loss_sum, wsum
+
+    return jax.jit(step)
+
+
+def train_linear_model_sorted_stream(
+    tables,
+    features_col: str,
+    label_col: str,
+    weight_col: Optional[str] = None,
+    *,
+    loss: str,
+    max_iter: int,
+    learning_rate: float,
+    reg: float,
+    elastic_net: float,
+    tol: float,
+    mesh=None,
+    label_check=None,
+    listeners=(),
+    dtype=np.float32,
+    cache_dir=None,
+    memory_budget_bytes=None,
+    checkpoint_manager=None,
+    checkpoint_interval: int = 0,
+    resume: bool = False,
+    prefetch_depth: int = 2,
+    validate=None,
+) -> np.ndarray:
+    """Train a linear model from a stream of DEVICE-resident Tables
+    whose feature column is a :class:`~flinkml_tpu.table
+    .SortedSparseColumn` (the :class:`~flinkml_tpu.data.prefetch
+    .DevicePrefetcher` output format): the sorted-by-design fast path —
+    the fit never densifies to ``[n, dim]`` and never sorts at step
+    time; the pack-time tables carry ``indices_are_sorted=True``
+    straight into the gradient scatter.
+
+    Epoch 0 trains batch-by-batch while collecting the device Tables
+    into a list; later epochs replay that list — the batches are
+    ALREADY in HBM (O(nnz) per batch), so the replay cache is the
+    tables themselves and ``cache_dir`` / ``memory_budget_bytes`` /
+    ``prefetch_depth`` are accepted for call-compatibility but unused.
+    ``mesh`` likewise: the column's global sort tables index the full
+    flat cell block and do not shard by rows, so the step is a
+    replicated single-program jit (see :func:`_sorted_column_stepper`).
+    Checkpoint/resume is not wired for this path yet — pass batches
+    through the CSR stream (:func:`train_linear_model_stream` with
+    ``sparse_dim``) if you need durable mid-fit state."""
+    del mesh, cache_dir, memory_budget_bytes, prefetch_depth
+    from flinkml_tpu.iteration.runtime import TerminateOnMaxIterOrTol
+    from flinkml_tpu.table import SortedSparseColumn
+
+    if loss not in _LOSS_KEYS:
+        raise ValueError(f"loss must be one of {_LOSS_KEYS}, got {loss!r}")
+    if checkpoint_manager is not None or resume or checkpoint_interval:
+        raise ValueError(
+            "checkpoint/resume is not supported on the sorted-column "
+            "stream path; use the CSR stream (sparse_dim=...) for "
+            "durable fits"
+        )
+    dt = jnp.dtype(dtype)
+    l2 = reg * (1.0 - elastic_net)
+    l1 = reg * elastic_net
+    hy = (
+        jnp.asarray(learning_rate, dt),
+        jnp.asarray(l2, dt),
+        jnp.asarray(l1, dt),
+    )
+    criterion = TerminateOnMaxIterOrTol(max_iter, tol)
+
+    stepper = None
+    coef = None
+    dim = None
+    ones_cache = {}  # bucket -> device ones, for weightless streams
+
+    def step_table(t, coef, first_pass: bool):
+        nonlocal stepper, dim
+        col = t._raw_column(features_col)
+        if not isinstance(col, SortedSparseColumn):
+            raise ValueError(
+                f"sorted-column stream: feature column {features_col!r} "
+                "is not a SortedSparseColumn (feed the stream through "
+                "data.prefetch.DevicePrefetcher)"
+            )
+        if dim is None:
+            dim = col.dim
+            stepper = _sorted_column_stepper(
+                loss, dim, _segsum_backend(), _spmv_backend()
+            )
+            coef = jnp.zeros(dim, dt)
+        elif col.dim != dim:
+            raise ValueError(
+                f"stream batch feature dimension {col.dim} != first "
+                f"batch's {dim}"
+            )
+        yraw = t._raw_column(label_col)
+        yb = yraw.buf if hasattr(yraw, "buf") else jnp.asarray(yraw)
+        if first_pass and label_check is not None:
+            label_check(np.asarray(yb)[: col.rows])
+        if weight_col is not None and weight_col in t.column_names:
+            wraw = t._raw_column(weight_col)
+            wb = wraw.buf if hasattr(wraw, "buf") else jnp.asarray(wraw)
+        else:
+            bucket = col.buf.shape[0]
+            wb = ones_cache.get(bucket)
+            if wb is None:
+                wb = ones_cache.setdefault(bucket, jnp.ones(bucket, dt))
+        if first_pass:
+            if validate is not None:
+                validate(t)
+            if col.rows == 0 or float(np.asarray(wb)[: col.rows].sum()) == 0:
+                raise ValueError(
+                    "stream batch has zero total weight (empty batch or "
+                    "all weights 0); drop such batches before training"
+                )
+        n_valid = jnp.asarray(col.rows, jnp.int32)
+        return stepper(coef, col.indices, col.buf, col.perm,
+                       col.segment_ids, yb, wb, n_valid, *hy)
+
+    epoch = 0
+    cur_loss = math.inf
+    cache = []
+
+    def run_epoch(batch_iter, coef, first_pass):
+        loss_acc = jnp.zeros((), dt)
+        wsum_acc = jnp.zeros((), dt)
+        n_batches = 0
+        for t in batch_iter:
+            if first_pass:
+                cache.append(t)
+            coef, ls, ws = step_table(t, coef, first_pass)
+            loss_acc = loss_acc + ls
+            wsum_acc = wsum_acc + ws
+            n_batches += 1
+        if n_batches == 0:
+            raise ValueError("training stream is empty")
+        return coef, float(loss_acc) / float(wsum_acc)
+
+    def after_epoch():
+        coef_host = np.asarray(coef)
+        for listener in listeners:
+            listener.on_epoch_watermark_incremented(epoch - 1, coef_host)
+
+    coef, cur_loss = run_epoch(tables, coef, True)
+    epoch = 1
+    after_epoch()
+    while not criterion.should_terminate(epoch - 1, cur_loss):
+        coef, cur_loss = run_epoch(cache, coef, False)
+        epoch += 1
+        after_epoch()
+
+    result = np.asarray(coef)
+    for listener in listeners:
+        listener.on_iteration_terminated(result)
+    return result
 
 
 def _ell_width_for(max_nnz: int) -> int:
@@ -1911,7 +2148,7 @@ def train_linear_model_stream(
     axis = DeviceMesh.DATA_AXIS
     stepper = (
         _sparse_stream_stepper(mesh.mesh, loss, axis, int(sparse_dim),
-                               _segsum_backend())
+                               _segsum_backend(), _spmv_backend())
         if sparse_dim is not None
         else _stream_stepper(mesh.mesh, loss, axis)
     )

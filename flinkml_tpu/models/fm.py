@@ -456,10 +456,45 @@ class _FMModelBase(_FMParams, Model):
             raise ValueError("Model data is not set; fit or set_model_data first")
 
     def _margin(self, table: Table) -> np.ndarray:
+        from flinkml_tpu.models._data import sparse_features
+
+        vecs = sparse_features(table, self.get(self.FEATURES_COL))
+        if vecs is not None:
+            return self._margin_sparse(vecs)
         x = features_matrix(table, self.get(self.FEATURES_COL))
         xv = x @ self._v
         x2v2 = (x * x) @ (self._v * self._v)
         return self._w0 + x @ self._w + 0.5 * (xv * xv - x2v2).sum(axis=1)
+
+    def _margin_sparse(self, vecs) -> np.ndarray:
+        """O(nnz·k) sparse margin over a padded-ELL block — the FM
+        identity only ever touches the nonzero columns, so an all-
+        SparseVector column never densifies to ``[n, dim]`` (ruinous at
+        hashed-feature dims). Linear term rides the gated SpMV kernel;
+        the pairwise term gathers factor rows (``v[indices]`` is
+        O(nnz·k)) and contracts with two einsums. ELL padding (index 0
+        / value 0) is exact: value 0 zeroes both the gather product and
+        the squared term. Runs under x64 so the float64 model
+        parameters keep full precision, matching the dense path."""
+        import jax
+
+        from flinkml_tpu import kernels
+        from flinkml_tpu.ops.sparse import BatchedCSR
+
+        ib, vb, d = BatchedCSR.pack_sparse_vectors(vecs, dtype=np.float64)
+        if d != self._w.shape[0]:
+            raise ValueError(
+                f"sparse features have dim {d}, model expects "
+                f"{self._w.shape[0]}"
+            )
+        if vb.shape[1] == 0:  # all-empty rows: margin is the intercept
+            return np.full(vb.shape[0], self._w0)
+        with jax.experimental.enable_x64(True):
+            linear = np.asarray(kernels.spmv(ib, vb, self._w))
+        gathered = self._v[ib]                       # [n, s, k]
+        xv = np.einsum("ns,nsk->nk", vb, gathered)
+        x2v2 = np.einsum("ns,nsk->nk", vb * vb, gathered * gathered)
+        return self._w0 + linear + 0.5 * (xv * xv - x2v2).sum(axis=1)
 
     def save(self, path: str) -> None:
         self._require()

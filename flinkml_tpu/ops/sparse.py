@@ -54,11 +54,18 @@ class BatchedCSR:
     # -- construction ------------------------------------------------------
     @staticmethod
     def pack_sparse_vectors(
-        vectors: Iterable[SparseVector], max_nnz: int = None, dtype=np.float32
+        vectors: Iterable[SparseVector], max_nnz: int = None,
+        dtype=np.float32, sort: bool = False,
     ):
         """Host-side ELL packing: returns numpy ``(indices, values, dim)``
         WITHOUT device placement — callers that shard (training) use this to
-        avoid staging the full dataset in one device's HBM."""
+        avoid staging the full dataset in one device's HBM.
+
+        ``sort=True`` additionally returns the pack-time global sort
+        tables ``(indices, values, dim, perm, segment_ids)`` (see
+        :func:`ell_sort_tables`) — the sorted-layout contract: sortedness
+        is bought once at pack time, so every downstream gradient scatter
+        runs with ``indices_are_sorted=True`` and no runtime sort."""
         vectors = list(vectors)
         if not vectors:
             raise ValueError("empty batch")
@@ -74,6 +81,9 @@ class BatchedCSR:
             k = min(v.indices.size, width)
             indices[i, :k] = v.indices[:k]
             values[i, :k] = v.values[:k]
+        if sort:
+            perm, segment_ids = ell_sort_tables(indices)
+            return indices, values, dim, perm, segment_ids
         return indices, values, dim
 
     @staticmethod
@@ -109,10 +119,20 @@ class BatchedCSR:
         rows = jnp.repeat(jnp.arange(n), self.max_nnz)
         return out.at[rows, self.indices.reshape(-1)].add(self.values.reshape(-1))
 
-    def matvec(self, w) -> jax.Array:
-        """Row-wise sparse dot against a dense vector: [n]."""
+    def matvec(self, w, backend=None) -> jax.Array:
+        """Row-wise sparse dot against a dense vector: [n].
+
+        Routes through the kernel-backend gate
+        (:mod:`flinkml_tpu.kernels`, site ``spmv``): the XLA
+        gather-multiply-reduce by default, the row-tiled Pallas kernel —
+        which bounds the gathered block to VMEM instead of materializing
+        the whole ``[n, max_nnz]`` gather — when the gate or an explicit
+        ``backend=`` selects it.
+        """
+        from flinkml_tpu import kernels
+
         w = jnp.asarray(w)
-        return jnp.sum(self.values * w[self.indices], axis=1)
+        return kernels.spmv(self.indices, self.values, w, backend=backend)
 
     def rmatvec(self, coeffs, backend=None) -> jax.Array:
         """Transpose product: X^T @ coeffs -> dense [dim].
@@ -136,6 +156,101 @@ class BatchedCSR:
         return BatchedCSR(
             self.indices[start:stop], self.values[start:stop], self.dim
         )
+
+    def sorted(self, nnz=None, place=None):
+        """This batch as a :class:`~flinkml_tpu.table.SortedSparseColumn`
+        — the pipeline-guaranteed sorted layout (pack-time global sort
+        tables, ``indices_are_sorted`` recorded on the column).
+
+        ``nnz`` optionally gives the true per-row nnz for the CSR
+        ``indptr``; without it every cell counts (padding cells are the
+        ELL index-0/value-0 no-op convention either way, so compute is
+        unaffected — only host reconstruction of explicit zeros
+        differs). ``place`` is the device placement (default
+        ``jax.device_put``)."""
+        from flinkml_tpu.table import SortedSparseColumn
+
+        if place is None:
+            place = jax.device_put
+        idx = np.asarray(self.indices)
+        n, width = idx.shape
+        if nnz is None:
+            nnz = np.full(n, width, dtype=np.int64)
+        indptr = np.zeros(n + 1, dtype=np.int32)
+        indptr[1:] = np.cumsum(np.asarray(nnz, dtype=np.int64))
+        perm, segment_ids = ell_sort_tables(idx)
+        return SortedSparseColumn(
+            place(self.values), place(self.indices), place(indptr),
+            place(perm), place(segment_ids), self.dim, n,
+        )
+
+
+def ell_sort_tables(indices: np.ndarray):
+    """Pack-time global sort tables for a padded-ELL index block:
+    ``(perm, segment_ids)``, both flat ``[rows * width] int32``.
+
+    ``perm`` is a STABLE argsort of the flattened index block;
+    ``segment_ids = flat[perm]`` is ascending by construction. A
+    consumer's gradient scatter becomes
+    ``segment_sum(take(contrib, perm), segment_ids,
+    indices_are_sorted=True)`` — the sort is paid once here (on the
+    prefetch worker thread, overlapped with compute), never at step
+    time. Padding cells (index 0 / value 0) sort to the front as
+    segment-0 no-op adds, so the tables cover the full padded block and
+    are independent of the batch's logical row count."""
+    flat = np.asarray(indices, dtype=np.int32).reshape(-1)
+    perm = np.argsort(flat, kind="stable").astype(np.int32)
+    return perm, flat[perm]
+
+
+def pack_sorted_sparse_column(vectors: Sequence[SparseVector],
+                              bucket: int = None, place=None,
+                              dtype=np.float32):
+    """Pack SparseVector rows into a
+    :class:`~flinkml_tpu.table.SortedSparseColumn` (the prefetcher's
+    sparse emission path — see that class for the layout contract).
+
+    Rows are zero-padded to ``bucket`` (default: the fused executor's
+    power-of-two row bucket) and the ELL width is quantized to the next
+    power of two, so batch-size and nnz jitter inside a bucket reuse
+    one compiled program downstream (zero retraces). ``place`` is the
+    device placement (default ``jax.device_put``)."""
+    from flinkml_tpu.pipeline_fusion import row_bucket
+    from flinkml_tpu.table import SortedSparseColumn
+
+    vectors = list(vectors)
+    if not vectors:
+        raise ValueError("empty batch")
+    if place is None:
+        place = jax.device_put
+    n = len(vectors)
+    if bucket is None:
+        bucket = row_bucket(n)
+    if bucket < n:
+        raise ValueError(f"bucket {bucket} < {n} rows")
+    dim = vectors[0].size()
+    nnzs = np.fromiter((v.indices.size for v in vectors), dtype=np.int64,
+                       count=n)
+    width = next_pow2(max(int(nnzs.max()), 1))
+    indices = np.zeros((bucket, width), dtype=np.int32)
+    values = np.zeros((bucket, width), dtype=dtype)
+    indptr = np.zeros(bucket + 1, dtype=np.int32)
+    for i, v in enumerate(vectors):
+        if v.size() != dim:
+            raise ValueError(f"row {i} has dim {v.size()}, expected {dim}")
+        k = v.indices.size
+        indices[i, :k] = v.indices
+        values[i, :k] = v.values
+    indptr[1:n + 1] = np.cumsum(nnzs)
+    indptr[n + 1:] = indptr[n]
+    perm, segment_ids = ell_sort_tables(indices)
+    host = np.empty(n, dtype=object)
+    for i, v in enumerate(vectors):
+        host[i] = v
+    return SortedSparseColumn(
+        place(values), place(indices), place(indptr), place(perm),
+        place(segment_ids), dim, n, host_rows=host,
+    )
 
 
 # Elements per scoring dispatch (~64 MB of f32 working set); module-level
@@ -193,9 +308,9 @@ def sparse_margins(vectors: Sequence[SparseVector], coef,
                     jnp.einsum("rs,rsk->rk", vb, coef_dev[ib])
                 )
             else:
-                out[rows[sl]] = np.asarray(
-                    jnp.sum(vb * coef_dev[ib], axis=1)
-                )
+                from flinkml_tpu import kernels
+
+                out[rows[sl]] = np.asarray(kernels.spmv(ib, vb, coef_dev))
     return out
 
 

@@ -80,6 +80,11 @@ class PaddedDeviceColumn:
     def dtype(self):
         return self.buf.dtype
 
+    def to_host(self) -> np.ndarray:
+        """The logical rows as a host numpy array (one device→host
+        transfer; :meth:`Table.column` caches the result per table)."""
+        return np.asarray(self.buf)[: self.rows]
+
 
 class LazyDeviceColumn(PaddedDeviceColumn):
     """A :class:`PaddedDeviceColumn` whose buffer is not computed yet.
@@ -133,6 +138,93 @@ class LazyDeviceColumn(PaddedDeviceColumn):
     @property
     def dtype(self):
         return self._dtype
+
+
+class SortedSparseColumn(PaddedDeviceColumn):
+    """A device-resident SPARSE column in the pipeline-guaranteed sorted
+    layout: CSR-style ``indptr`` over padded-ELL ``indices``/``values``
+    blocks (zero-padded to the fused executor's power-of-two row bucket,
+    exactly like every dense :class:`PaddedDeviceColumn`), plus the
+    pack-time global sort tables that make the gradient scatter's
+    ``indices_are_sorted=True`` fast path FREE at step time:
+
+    - ``buf``          — ``[bucket, width]`` float values (the inherited
+      padded buffer; ``width`` is quantized to a power of two so batch
+      nnz jitter inside a bucket causes zero retraces),
+    - ``indices``      — ``[bucket, width]`` int32 column ids, per-row
+      ascending (``SparseVector`` construction guarantees it); padding
+      cells carry index 0 / value 0 (the ELL no-op convention),
+    - ``indptr``       — ``[bucket + 1]`` int32 CSR row pointers over
+      the LOGICAL nnz (padding rows contribute 0),
+    - ``perm`` / ``segment_ids`` — ``[bucket * width]`` int32: a stable
+      argsort of the flat index block, computed ONCE on the prefetch
+      worker thread. A consumer's scatter is
+      ``segment_sum(take(contrib, perm), segment_ids,
+      indices_are_sorted=True)`` with no runtime sort.
+
+    ``indices_are_sorted`` is recorded on the column — downstream
+    kernels assert the guarantee from provenance instead of trusting a
+    caller flag (the FML404 contract). Who sorts: the packer (pack
+    time, worker thread). Who asserts: the consumer, by reading this
+    attribute. Padding semantics: padded cells sort to the front as
+    segment 0 / value 0 no-op adds, so the tables cover the FULL padded
+    block and are batch-size independent.
+    """
+
+    __slots__ = ("indices", "indptr", "perm", "segment_ids", "dim",
+                 "indices_are_sorted", "_host_rows")
+
+    def __init__(self, values, indices, indptr, perm, segment_ids,
+                 dim: int, rows: int, host_rows=None):
+        super().__init__(values, rows)
+        if tuple(indices.shape) != tuple(values.shape):
+            raise ValueError(
+                f"indices shape {tuple(indices.shape)} != values shape "
+                f"{tuple(values.shape)}"
+            )
+        bucket, width = values.shape
+        if indptr.shape != (bucket + 1,):
+            raise ValueError(
+                f"indptr shape {tuple(indptr.shape)} != ({bucket + 1},)"
+            )
+        if perm.shape != (bucket * width,) or \
+                segment_ids.shape != (bucket * width,):
+            raise ValueError(
+                "perm/segment_ids must be flat [bucket * width] tables"
+            )
+        self.indices = indices
+        self.indptr = indptr
+        self.perm = perm
+        self.segment_ids = segment_ids
+        self.dim = int(dim)
+        self.indices_are_sorted = True
+        self._host_rows = host_rows
+
+    def to_host(self) -> np.ndarray:
+        """The logical rows as the object array of ``SparseVector``s the
+        column was packed from (kept by the packer; reconstructed from
+        the CSR buffers when the column was built device-side)."""
+        if self._host_rows is not None:
+            return self._host_rows
+        from flinkml_tpu.linalg import SparseVector
+
+        vals = np.asarray(self.buf)
+        idx = np.asarray(self.indices)
+        ptr = np.asarray(self.indptr)
+        out = np.empty(self.rows, dtype=object)
+        for r in range(self.rows):
+            k = int(ptr[r + 1] - ptr[r])
+            # Columns built without a true per-row nnz count every ELL
+            # cell, so index-0 padding duplicates — fold duplicates by
+            # sum (the no-op padding convention makes that exact).
+            ui, inv = np.unique(idx[r, :k], return_inverse=True)
+            uv = np.zeros(ui.size, dtype=np.float64)
+            np.add.at(uv, inv, vals[r, :k].astype(np.float64))
+            out[r] = SparseVector._from_sorted(
+                self.dim, ui.astype(np.int64), uv
+            )
+        self._host_rows = out
+        return out
 
 
 def _is_device_backed(x: Any) -> bool:
@@ -236,7 +328,7 @@ class Table:
             return col
         if name not in self._host_cache:
             if isinstance(col, PaddedDeviceColumn):
-                host = np.asarray(col.buf)[: col.rows]
+                host = col.to_host()
             else:
                 host = np.asarray(col)
             group = _materialization_metrics()

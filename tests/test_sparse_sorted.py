@@ -1,0 +1,414 @@
+"""Sorted-by-design sparse hot loops (ISSUE 16): the multi-block
+segment-sum grid above the retired one-block input ceiling, the CSR
+SpMV chain kernel (parity contract: the JITTED XLA twin), the
+SortedSparseColumn pack/prefetch format with zero retraces across
+buckets, the sorted-column stream fit's bitwise parity with the CSR
+stream, and the FML404 sorted-scatter provenance gate."""
+
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flinkml_tpu import kernels
+from flinkml_tpu.kernels import ENV_VAR, KernelUnsupportedError
+from flinkml_tpu.kernels import segsum as _segsum
+
+# The package re-exports the spmv DISPATCHER under the submodule's
+# name; import the module itself for ROW_TILE / MAX_COMPILED_DIM.
+_spmv = importlib.import_module("flinkml_tpu.kernels.spmv")
+from flinkml_tpu.linalg import SparseVector
+from flinkml_tpu.table import SortedSparseColumn, Table
+
+
+def _sparse_table(rng, rows, dim, nnz, weight=True):
+    vecs = np.empty(rows, object)
+    for i in range(rows):
+        idx = np.sort(rng.choice(dim, size=nnz, replace=False))
+        vecs[i] = SparseVector(
+            dim, idx, rng.normal(size=nnz).astype(np.float32)
+        )
+    cols = {"features": vecs,
+            "y": (rng.random(rows) > 0.5).astype(np.float32)}
+    if weight:
+        cols["w"] = rng.uniform(0.5, 1.5, rows).astype(np.float32)
+    return Table(cols)
+
+
+# -- multi-block segment-sum -------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("sorted_", [False, True])
+def test_segsum_multiblock_above_old_input_ceiling(dtype, sorted_):
+    """cells just ABOVE the retired one-block input ceiling
+    (MAX_COMPILED_CELLS used to refuse this shape outright): the grid
+    streams ceil(cells / BLOCK_CELLS) blocks and stays bitwise with
+    ``jax.ops.segment_sum`` — the carry between blocks adds in the same
+    left-to-right element order XLA's CPU scatter uses."""
+    rng = np.random.default_rng(0)
+    cells = _segsum.MAX_COMPILED_CELLS + 1000
+    nseg = 1 << 10
+    ids = rng.integers(0, nseg, cells)
+    if sorted_:
+        ids = np.sort(ids)
+    ids = jnp.asarray(ids, jnp.int32)
+    vals = jnp.asarray(rng.normal(size=cells)).astype(dtype)
+    ref = jax.ops.segment_sum(vals, ids, num_segments=nseg,
+                              indices_are_sorted=sorted_)
+    out = kernels.segment_sum(vals, ids, nseg, indices_are_sorted=sorted_,
+                              backend="pallas")
+    assert out.dtype == ref.dtype
+    assert np.asarray(ref).tobytes() == np.asarray(out).tobytes()
+
+
+def test_segsum_multiblock_just_below_old_ceiling_row_payload():
+    """The [cells, k] embedding-exchange shape with cells*k straddling
+    the old ceiling: one flat-size below, one above — both bitwise (the
+    ceiling no longer depends on the INPUT size at all)."""
+    rng = np.random.default_rng(1)
+    k, nseg = 8, 512
+    for cells in (_segsum.MAX_COMPILED_CELLS // k - 16,
+                  _segsum.MAX_COMPILED_CELLS // k + 16):
+        ids = jnp.asarray(rng.integers(0, nseg, cells), jnp.int32)
+        rows = jnp.asarray(rng.normal(size=(cells, k)).astype(np.float32))
+        ref = jax.ops.segment_sum(rows, ids, num_segments=nseg)
+        out = kernels.segment_sum(rows, ids, nseg, backend="pallas")
+        assert np.asarray(ref).tobytes() == np.asarray(out).tobytes()
+
+
+def test_segsum_multiblock_ragged_tail_parity():
+    """cells one past a block boundary — the final grid step is almost
+    entirely zero-padding; padding cells must be exact no-op adds."""
+    rng = np.random.default_rng(2)
+    cells = _segsum.BLOCK_CELLS + 1
+    ids = jnp.asarray(np.sort(rng.integers(0, 100, cells)), jnp.int32)
+    vals = jnp.asarray(rng.normal(size=cells).astype(np.float32))
+    ref = jax.ops.segment_sum(vals, ids, num_segments=100,
+                              indices_are_sorted=True)
+    out = kernels.segment_sum(vals, ids, 100, indices_are_sorted=True,
+                              backend="pallas")
+    assert np.asarray(ref).tobytes() == np.asarray(out).tobytes()
+
+
+def test_segsum_output_ceiling_refusal_names_constant(monkeypatch):
+    """The ONLY remaining compiled-path ceiling is the OUTPUT block
+    (num_segments * k): an explicit pallas request above it refuses
+    typed, naming MAX_COMPILED_CELLS — through the dispatcher AND the
+    direct kernel entry point."""
+    monkeypatch.setenv(kernels.ENV_INTERPRET_VAR, "0")
+    vals = jnp.ones(8, jnp.float32)
+    ids = jnp.zeros(8, jnp.int32)
+    over = _segsum.MAX_COMPILED_CELLS + 1
+    with pytest.raises(KernelUnsupportedError, match="MAX_COMPILED_CELLS"):
+        kernels.segment_sum(vals, ids, over, backend="pallas")
+    with pytest.raises(KernelUnsupportedError, match="MAX_COMPILED_CELLS"):
+        _segsum.pallas_segment_sum(vals, ids, over, interpret=False)
+    # ... while the interpreter (no VMEM) accepts any num_segments.
+    assert _segsum.unsupported_reason(vals, ids, over, interpret=True) is None
+
+
+def test_segsum_exchange_shape_above_old_ceiling_accepted_compiled():
+    """The embedding-exchange scatter at production shard sizes: an
+    input block far above the old input ceiling with a modest output
+    block is now COMPILED-path eligible (unsupported_reason is None) —
+    checked abstractly via ShapeDtypeStruct, no 128 MB allocation."""
+    cells, k, shard_rows = 1 << 21, 16, 1 << 14   # cells*k = 8x old cap
+    vals = jax.ShapeDtypeStruct((cells, k), jnp.float32)
+    ids = jax.ShapeDtypeStruct((cells,), jnp.int32)
+    assert cells * k > _segsum.MAX_COMPILED_CELLS
+    assert _segsum.unsupported_reason(
+        vals, ids, shard_rows, interpret=False) is None
+    # the output ceiling still applies to the same shape:
+    assert "MAX_COMPILED_CELLS" in _segsum.unsupported_reason(
+        vals, ids, (_segsum.MAX_COMPILED_CELLS // k) + 1, interpret=False)
+
+
+# -- CSR SpMV ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "bfloat16"])
+def test_spmv_parity_vs_jitted_twin(dtype):
+    """Bitwise vs the JITTED XLA reference (the parity contract — an
+    eager reference can differ in the last f32 bit because XLA's
+    unfused reduce uses a different association tree), including a row
+    count that is not a multiple of ROW_TILE."""
+    rng = np.random.default_rng(3)
+    rows, width, dim = _spmv.ROW_TILE * 4 + 3, 16, 512
+    ib = jnp.asarray(rng.integers(0, dim, (rows, width)), jnp.int32)
+    vb = jnp.asarray(rng.normal(size=(rows, width))).astype(dtype)
+    w = jnp.asarray(rng.normal(size=dim)).astype(dtype)
+    twin = jax.jit(
+        lambda i, v, ww: jnp.sum(v * jnp.take(ww, i, axis=0), axis=1)
+    )
+    ref = twin(ib, vb, w)
+    out = kernels.spmv(ib, vb, w, backend="pallas")
+    assert out.dtype == ref.dtype
+    assert np.asarray(ref).tobytes() == np.asarray(out).tobytes()
+
+
+def test_spmv_refusals(monkeypatch):
+    ib = jnp.zeros((4, 2), jnp.int32)
+    vb = jnp.ones((4, 2), jnp.float32)
+    with pytest.raises(KernelUnsupportedError, match="not floating"):
+        kernels.spmv(ib, jnp.ones((4, 2), jnp.int32),
+                     jnp.ones(8, jnp.int32), backend="pallas")
+    with pytest.raises(KernelUnsupportedError, match="!= w dtype"):
+        kernels.spmv(ib, vb, jnp.ones(8, jnp.float64), backend="pallas")
+    # the one-block weight ceiling holds on the compiled path only,
+    # named after its constant (checked abstractly — no 32 MB alloc).
+    big_w = jax.ShapeDtypeStruct((_spmv.MAX_COMPILED_DIM + 1,), jnp.float32)
+    reason = _spmv.unsupported_reason(ib, vb, big_w, interpret=False)
+    assert reason is not None and "MAX_COMPILED_DIM" in reason
+    assert _spmv.unsupported_reason(ib, vb, big_w, interpret=True) is None
+
+
+def test_spmv_gate_threaded_vs_explicit(tmp_path, monkeypatch):
+    """The lru-key idiom for the 4th site: a TABLE-chosen pallas
+    threaded through ``backend=`` keeps warn-and-fallback on
+    unsupported operands; a backend DISAGREEING with the gate is an
+    explicit request and refuses loudly."""
+    from flinkml_tpu.autotune import TuningTable, mesh_key
+    from flinkml_tpu.autotune.table import ENV_TABLE_VAR
+
+    table = TuningTable()
+    table.set_knob(mesh_key(), "kernel_backend_spmv", "pallas",
+                   candidates={"xla": 1.0, "pallas": 2.0}, source="test")
+    path = str(tmp_path / "table.json")
+    table.save(path)
+    monkeypatch.setenv(ENV_TABLE_VAR, path)
+    monkeypatch.setenv(kernels.ENV_INTERPRET_VAR, "0")  # f64 unsupported
+    rng = np.random.default_rng(4)
+    ib = jnp.asarray(rng.integers(0, 32, (4, 3)), jnp.int32)
+    vb = jnp.asarray(rng.normal(size=(4, 3)))            # float64
+    w = jnp.asarray(rng.normal(size=32))
+    assert vb.dtype == jnp.float64
+    threaded = kernels.spmv_backend()
+    assert threaded == "pallas"
+    ref = jax.jit(
+        lambda i, v, ww: jnp.sum(v * jnp.take(ww, i, axis=0), axis=1)
+    )(ib, vb, w)
+    out = kernels.spmv(ib, vb, w, backend=threaded)      # degrades
+    assert np.asarray(ref).tobytes() == np.asarray(out).tobytes()
+    monkeypatch.setenv(ENV_VAR, "spmv=xla")              # gate says xla
+    with pytest.raises(KernelUnsupportedError):
+        kernels.spmv(ib, vb, w, backend="pallas")        # arg disagrees
+
+
+def test_spmv_in_gate_sites_and_factory():
+    assert "spmv" in kernels.SITES
+    assert kernels.spmv_backend() == "xla"   # opt-in by measurement
+
+
+# -- SortedSparseColumn pack + prefetch --------------------------------------
+
+
+def test_pad_place_table_emits_sorted_columns_round_trip():
+    """The prefetcher's pack step: all-SparseVector object columns
+    become SortedSparseColumns — power-of-two bucket/width, recorded
+    ``indices_are_sorted``, pack-time sort tables covering the FULL
+    padded block, and a to_host() that reconstructs the vectors."""
+    from flinkml_tpu.data.prefetch import pad_place_table
+
+    rng = np.random.default_rng(5)
+    t = _sparse_table(rng, rows=11, dim=256, nnz=6)
+    dev = pad_place_table(t)
+    col = dev._raw_column("features")
+    assert isinstance(col, SortedSparseColumn)
+    assert col.indices_are_sorted is True
+    assert col.dim == 256 and col.rows == 11
+    bucket, width = col.buf.shape
+    assert bucket & (bucket - 1) == 0 and width & (width - 1) == 0
+    assert col.indptr.shape == (bucket + 1,)
+    assert col.perm.shape == col.segment_ids.shape == (bucket * width,)
+    # the sort tables really are sorted — the scatter's entitlement.
+    seg = np.asarray(col.segment_ids)
+    assert np.all(np.diff(seg) >= 0)
+    # round trip: host view reconstructs every vector exactly.
+    for vec, orig in zip(dev.column("features"), t.column("features")):
+        np.testing.assert_array_equal(vec.indices, orig.indices)
+        np.testing.assert_array_equal(vec.values, orig.values)
+    # dense siblings keep the plain padded contract.
+    assert dev._raw_column("y").rows == 11
+
+
+@pytest.mark.no_retrace(allow_compiles=3)
+def test_prefetcher_sorted_columns_zero_retraces_across_buckets():
+    """ISSUE 16 acceptance: the prefetch feed emits SortedSparseColumns
+    across three row buckets and the sorted-column step compiles once
+    per bucket and NEVER again — batch-size jitter inside a bucket is
+    neutralized by the traced n_valid mask, and the pack-time tables
+    are bucket-shaped, not batch-shaped. The budget of 3 is exactly the
+    per-bucket warmup (8, 16, 32); the guarded replay must add zero."""
+    from flinkml_tpu.data.prefetch import DevicePrefetcher
+    from flinkml_tpu.models._linear_sgd import _sorted_column_stepper
+
+    rng = np.random.default_rng(6)
+    dim, nnz = 128, 4
+    # rows hitting buckets 8, 16, 32; two row counts per bucket.
+    tables = [_sparse_table(rng, rows, dim, nnz)
+              for rows in (5, 8, 12, 16, 20, 31)]
+    step = _sorted_column_stepper("logistic", dim)
+    hy = (jnp.float32(0.5), jnp.float32(1e-4), jnp.float32(0.0))
+    coef = jnp.zeros(dim, jnp.float32)
+
+    def drive(coef):
+        batches = list(DevicePrefetcher(iter(tables), depth=2))
+        assert len(batches) == 6
+        for t in batches:
+            col = t._raw_column("features")
+            assert isinstance(col, SortedSparseColumn)
+            coef, _, _ = step(
+                coef, col.indices, col.buf, col.perm, col.segment_ids,
+                t._raw_column("y").buf, t._raw_column("w").buf,
+                jnp.asarray(col.rows, jnp.int32), *hy,
+            )
+        return coef.block_until_ready()
+
+    coef = drive(coef)       # warmup: one compile per bucket (3 total)
+    drive(coef)              # guarded replay: zero new compiles
+
+
+def test_sorted_stream_fit_bitwise_matches_csr_stream():
+    """End-to-end acceptance: the sorted-column stream (device Tables
+    from pad_place_table, zero densify / zero step-time sort) produces
+    the BIT-IDENTICAL model to the CSR stream reference over a
+    multi-epoch weighted elastic-net logistic fit."""
+    from flinkml_tpu.data.prefetch import pad_place_table
+    from flinkml_tpu.models._linear_sgd import (
+        streamed_linear_fit,
+        train_linear_model_sorted_stream,
+    )
+    from flinkml_tpu.parallel import DeviceMesh
+
+    rng = np.random.default_rng(7)
+    dim, nnz = 512, 8
+    tabs = [_sparse_table(rng, rows, dim, nnz) for rows in (24, 48, 33)]
+    hyper = dict(loss="logistic", max_iter=4, learning_rate=0.5, reg=1e-3,
+                 elastic_net=0.3, tol=0.0)
+    # The contract is at the pipeline's f32 dtype on a single-device
+    # reference mesh: the conftest's global x64 flag and 8-device psum
+    # order would each perturb the CSR reference in the last bit.
+    mesh1 = DeviceMesh(devices=jax.devices()[:1])
+    with jax.experimental.disable_x64():
+        ref = streamed_linear_fit(
+            list(tabs), features_col="features", label_col="y",
+            weight_col="w", mesh=mesh1, **hyper,
+        )
+        dev = [pad_place_table(t) for t in tabs]
+        got = train_linear_model_sorted_stream(dev, "features", "y", "w",
+                                               **hyper)
+        assert np.asarray(ref, np.float32).tobytes() == \
+            np.asarray(got, np.float32).tobytes()
+        # routing: streamed_linear_fit recognizes the device tables too.
+        routed = streamed_linear_fit(
+            [t for t in dev], features_col="features", label_col="y",
+            weight_col="w", mesh=mesh1, **hyper,
+        )
+        assert np.asarray(routed, np.float32).tobytes() == \
+            np.asarray(got, np.float32).tobytes()
+
+
+def test_sorted_stream_refuses_checkpointing():
+    from flinkml_tpu.models._linear_sgd import (
+        train_linear_model_sorted_stream,
+    )
+
+    with pytest.raises(ValueError, match="checkpoint"):
+        train_linear_model_sorted_stream(
+            [], "features", "y", loss="logistic", max_iter=1,
+            learning_rate=0.1, reg=0.0, elastic_net=0.0, tol=0.0,
+            checkpoint_interval=2,
+        )
+
+
+# -- FML404: sorted-scatter provenance ---------------------------------------
+
+
+def test_fml404_fires_on_unsorted_flag_over_sorted_input():
+    from flinkml_tpu.analysis import check_sorted_scatter_fn
+
+    def bad(v, i):
+        return jax.ops.segment_sum(v, i, num_segments=16,
+                                   indices_are_sorted=False)
+
+    args = (jnp.zeros(64, jnp.float32), jnp.zeros(64, jnp.int32))
+    findings = check_sorted_scatter_fn(bad, args, sorted_argnums=(1,))
+    assert [f.rule for f in findings] == ["FML404"]
+    assert "sorted" in findings[0].message
+
+
+def test_fml404_clean_when_flag_asserted_or_no_provenance():
+    from flinkml_tpu.analysis import check_sorted_scatter_fn
+
+    def good(v, i):
+        return jax.ops.segment_sum(v, i, num_segments=16,
+                                   indices_are_sorted=True)
+
+    def bad(v, i):
+        return jax.ops.segment_sum(v, i, num_segments=16,
+                                   indices_are_sorted=False)
+
+    args = (jnp.zeros(64, jnp.float32), jnp.zeros(64, jnp.int32))
+    assert check_sorted_scatter_fn(good, args, sorted_argnums=(1,)) == []
+    # unsorted flag over ids WITHOUT provenance is legitimate.
+    assert check_sorted_scatter_fn(bad, args, sorted_argnums=()) == []
+
+
+def test_fml404_walks_through_pjit():
+    """The trainers wrap their scatters in jit — the walk must recurse
+    one call level or every real consumer would be false-clean."""
+    from flinkml_tpu.analysis import check_sorted_scatter_fn
+
+    @jax.jit
+    def bad(v, i):
+        return jax.ops.segment_sum(v, i, num_segments=16,
+                                   indices_are_sorted=False)
+
+    args = (jnp.zeros(64, jnp.float32), jnp.zeros(64, jnp.int32))
+    findings = check_sorted_scatter_fn(bad, args, sorted_argnums=(1,))
+    assert [f.rule for f in findings] == ["FML404"]
+
+
+def test_fml404_sorted_column_stepper_traces_clean():
+    """The acceptance trace: the production sorted-column SGD step,
+    with the column's perm/segment_ids declared sorted-provenance, has
+    ZERO FML404 findings — the pipeline never re-pays the sort."""
+    from flinkml_tpu.analysis import check_sorted_scatter_fn
+    from flinkml_tpu.models._linear_sgd import _sorted_column_stepper
+
+    dim, bucket, width = 64, 16, 8
+    step = _sorted_column_stepper("logistic", dim)
+    args = (
+        jnp.zeros(dim, jnp.float32),                 # coef
+        jnp.zeros((bucket, width), jnp.int32),       # ib
+        jnp.zeros((bucket, width), jnp.float32),     # vb
+        jnp.zeros(bucket * width, jnp.int32),        # perm
+        jnp.zeros(bucket * width, jnp.int32),        # segment_ids
+        jnp.zeros(bucket, jnp.float32),              # yb
+        jnp.ones(bucket, jnp.float32),               # wb
+        jnp.asarray(12, jnp.int32),                  # n_valid
+        jnp.float32(0.5), jnp.float32(1e-4), jnp.float32(0.0),
+    )
+    assert check_sorted_scatter_fn(step, args, sorted_argnums=(3, 4)) == []
+
+
+def test_fml404_scatter_fixture_files():
+    from flinkml_tpu.analysis import check_scatter_file
+
+    bad = check_scatter_file(
+        "tests/analysis_fixtures/"
+        "bad_scatter_fml404_unsorted_flag_on_sorted_input.scatter.json"
+    )
+    assert [f.rule for f in bad] == ["FML404"]
+    good = check_scatter_file(
+        "tests/analysis_fixtures/"
+        "good_scatter_sorted_flag_on_sorted_input.scatter.json"
+    )
+    assert good == []
+    malformed = check_scatter_file("tests/analysis_fixtures/nope.json")
+    assert [f.rule for f in malformed] == ["FML404"]
+    assert "unreadable or malformed" in malformed[0].message

@@ -1070,6 +1070,107 @@ print('pallas smoke: kernel_vs_xla_samples_per_sec_ratio:', ratios,
 stage "pallas smoke (3-kernel interpret parity + gate-off + bench ratio)" \
     pallas_smoke
 
+# Sparse smoke (ISSUE 16 acceptance): interpret-mode bitwise parity for
+# the two sorted-hot-loop kernels — the multi-block segment-sum on a
+# grid with cells > BLOCK_CELLS (above the retired one-block ceiling)
+# and the CSR SpMV chain kernel vs its JITTED XLA twin (the parity
+# contract — eager XLA fuses the reduce tree differently in the last
+# f32 bit; docs/development/kernels.md); the typed ceiling refusal must
+# name MAX_COMPILED_CELLS; the FML404 sorted-scatter fixtures must be
+# flagged (bad) and pass (good) by name; then the sparse_hot_loops_cpu
+# bench stage is parsed with a >=1.0x no-regression tripwire on sorted
+# sparse-LR rows/s vs the densified baseline (measured ~16x on an idle
+# box — the floor only guards against the sparse path LOSING to
+# densification on a starved CI host).
+sparse_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 420 python - <<'EOF' || return 1
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+
+from flinkml_tpu import kernels
+from flinkml_tpu.kernels import segsum as _segsum
+
+rng = np.random.default_rng(0)
+
+# Multi-block segment-sum: cells > BLOCK_CELLS grids over >1 block with
+# a ragged tail; unsorted + sorted-specialized, bitwise vs XLA.
+cells = _segsum.BLOCK_CELLS + 1000
+nseg = 1 << 10
+ids = jnp.asarray(np.sort(rng.integers(0, nseg, cells)), jnp.int32)
+uids = jnp.asarray(rng.integers(0, nseg, cells), jnp.int32)
+vals = jnp.asarray(rng.normal(size=cells).astype(np.float32))
+a = np.asarray(jax.ops.segment_sum(vals, uids, num_segments=nseg))
+b = np.asarray(kernels.segment_sum(vals, uids, nseg, backend="pallas"))
+assert a.tobytes() == b.tobytes(), "multi-block unsorted segsum parity"
+a = np.asarray(jax.ops.segment_sum(vals, ids, num_segments=nseg,
+                                   indices_are_sorted=True))
+b = np.asarray(kernels.segment_sum(vals, ids, nseg,
+                                   indices_are_sorted=True,
+                                   backend="pallas"))
+assert a.tobytes() == b.tobytes(), "multi-block sorted segsum parity"
+
+# CSR SpMV vs the JITTED XLA twin, bitwise.
+ib = jnp.asarray(rng.integers(0, 512, size=(256, 16)), jnp.int32)
+vb = jnp.asarray(rng.normal(size=(256, 16)).astype(np.float32))
+w = jnp.asarray(rng.normal(size=512).astype(np.float32))
+twin = jax.jit(lambda i, v, w: jnp.sum(v * jnp.take(w, i, axis=0), axis=1))
+a = np.asarray(twin(ib, vb, w))
+b = np.asarray(kernels.spmv(ib, vb, w, backend="pallas"))
+assert a.tobytes() == b.tobytes(), "spmv parity vs jitted XLA twin"
+
+# Typed ceiling refusal on the compiled path: the OUTPUT ceiling
+# (num_segments * k > MAX_COMPILED_CELLS) must refuse loudly, naming
+# the constant — never a silent fallback for an explicit request.
+os.environ[kernels.ENV_INTERPRET_VAR] = "0"
+try:
+    kernels.segment_sum(vals[:8], ids[:8],
+                        _segsum.MAX_COMPILED_CELLS + 1, backend="pallas")
+    raise SystemExit("over-ceiling explicit pallas was not refused")
+except kernels.KernelUnsupportedError as e:
+    assert "MAX_COMPILED_CELLS" in str(e), e
+finally:
+    del os.environ[kernels.ENV_INTERPRET_VAR]
+print("sparse smoke: multi-block segsum + spmv interpret parity bitwise,"
+      " ceiling refusal typed and named")
+EOF
+    # The FML404 sorted-scatter gate has teeth: the seeded fixture must
+    # be flagged by name, and the policy-correct twin must pass clean.
+    if env JAX_PLATFORMS=cpu python -m flinkml_tpu.analysis \
+        tests/analysis_fixtures/bad_scatter_fml404_unsorted_flag_on_sorted_input.scatter.json \
+        --no-selfcheck --fail-on-findings >/dev/null 2>&1; then
+        echo "FML404 sorted-scatter fixture was NOT flagged"
+        return 1
+    fi
+    env JAX_PLATFORMS=cpu python -m flinkml_tpu.analysis \
+        tests/analysis_fixtures/good_scatter_sorted_flag_on_sorted_input.scatter.json \
+        --no-selfcheck --fail-on-findings || return 1
+    local out
+    out=$(_FLINKML_BENCH_INNER=sparse_hot_loops_cpu timeout 560 \
+        python bench.py) || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, math, sys
+rec = json.loads(sys.stdin.read())
+assert {'sparse_sorted_rows_per_sec', 'densified_rows_per_sec',
+        'sparse_vs_densified_ratio'} <= set(rec), rec
+assert all(math.isfinite(rec[k]) and rec[k] > 0 for k in
+           ('sparse_sorted_rows_per_sec', 'densified_rows_per_sec')), rec
+assert rec['sparse_vs_densified_ratio'] >= 1.0, (
+    'sorted sparse hot loop lost to the densified baseline', rec)
+print('sparse smoke: sorted sparse-LR', rec['sparse_sorted_rows_per_sec'],
+      'rows/s vs densified', rec['densified_rows_per_sec'],
+      'rows/s (', rec['sparse_vs_densified_ratio'], 'x ) at dim',
+      rec['dim'], 'nnz/row', rec['nnz_per_row'])
+"
+}
+stage "sparse smoke (multi-block segsum + spmv parity + FML404 + bench)" \
+    sparse_smoke
+
 # Autoscale smoke (ISSUE 15 acceptance, device-free): (1) closed-loop
 # load triple → the autoscaler scales up on its own, scale-up replicas
 # join warm, zero requests lost, the backlog signal recovers, and p99
