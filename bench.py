@@ -2209,6 +2209,123 @@ def _inner_sparse_hot_loops_cpu() -> dict:
     return _sparse_hot_loops_stage()
 
 
+def _memory_stage(n=8192, d=32, state_dim=65536) -> dict:
+    """Stage: memory-model calibration — the pass-7 static peak-live
+    estimate (flinkml_tpu.analysis.memory) measured against XLA's own
+    ``Compiled.memory_analysis()`` (temp + argument + output bytes) on
+    two real programs: (1) the bench's fused 5-stage chain math
+    (4 scalers + logistic head, the ``pipeline_fused`` spine) and
+    (2) the plan-sharded SGD step on the 8-way mesh. CI pins both
+    ratios inside a 0.5x-2.0x band, so the static model is measured,
+    not guessed. Also demonstrates the FML703 donation finding LIVE on
+    the real (deliberately undonated) step, and its absence once the
+    state buffer is donated."""
+    import jax
+    import jax.numpy as jnp
+
+    from flinkml_tpu.analysis.memory import (
+        check_memory_fn,
+        estimate_fn_memory,
+    )
+    from flinkml_tpu.parallel import DeviceMesh
+    from flinkml_tpu.sharding import FSDP
+    from flinkml_tpu.sharding.apply import (
+        batch_sharding,
+        init_linear_state,
+        linear_step_fn,
+        state_shardings,
+    )
+
+    def _xla_bytes(compiled):
+        ma = compiled.memory_analysis()
+        return (int(ma.temp_size_in_bytes)
+                + int(ma.argument_size_in_bytes)
+                + int(ma.output_size_in_bytes))
+
+    # -- twin 1: the fused 5-stage chain (single device) -------------------
+    def chain(x, mean, std, dmin, dmax, maxabs, median, rng_, coef):
+        h = (x - mean) / std
+        h = (h - dmin) / (dmax - dmin)
+        h = h / maxabs
+        h = (h - median) / rng_
+        return jax.nn.sigmoid(h @ coef)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    row = np.ones((1, d), np.float32)
+    coef = rng.normal(size=(d,)).astype(np.float32)
+    chain_args = (x, row, row, 0 * row, row, row, 0 * row, row, coef)
+    chain_actual = _xla_bytes(jax.jit(chain).lower(*chain_args).compile())
+    chain_est = estimate_fn_memory(chain, *chain_args).peak_bytes
+
+    # -- twin 2: the plan-sharded SGD step (8-way mesh) --------------------
+    mesh = DeviceMesh.for_plan(FSDP)
+    step = linear_step_fn(
+        loss="logistic", optimizer="sgd", dtype_name="float32",
+        learning_rate=0.1, momentum=0.9, reg_l2=0.0, reg_l1=0.0,
+    )
+    state = init_linear_state(state_dim, "sgd", np.float32)
+    bs = 256
+    xb = rng.normal(size=(bs, state_dim)).astype(np.float32)
+    yb = (rng.random(bs) > 0.5).astype(np.float32)
+    wb = np.ones(bs, np.float32)
+    b_shard = batch_sharding(FSDP, mesh)
+    compiled = jax.jit(
+        step,
+        in_shardings=(state_shardings(FSDP, mesh, state),
+                      b_shard, b_shard, b_shard),
+        donate_argnums=(0,),
+    ).lower(state, xb, yb, wb).compile()
+    axes = dict(mesh.mesh.shape)
+    sgd_actual = _xla_bytes(compiled)
+    sgd_est = estimate_fn_memory(
+        step, state, xb, yb, wb, plan=FSDP, mesh=axes,
+        param_argnums=(0,), donate_argnums=(0,),
+    ).peak_bytes
+
+    # -- FML703 live: the same step, donated vs not ------------------------
+    undonated = check_memory_fn(
+        step, state, xb, yb, wb, plan=FSDP, mesh=axes,
+        param_argnums=(0,), program="sgd_step",
+    )
+    donated = check_memory_fn(
+        step, state, xb, yb, wb, plan=FSDP, mesh=axes,
+        param_argnums=(0,), donate_argnums=(0,), program="sgd_step",
+    )
+    return {
+        "memory_calibration_ratio": {
+            "fused_chain": round(chain_est / chain_actual, 3),
+            "sgd_step": round(sgd_est / sgd_actual, 3),
+        },
+        "memory_estimate_bytes": {
+            "fused_chain": int(chain_est), "sgd_step": int(sgd_est),
+        },
+        "xla_memory_analysis_bytes": {
+            "fused_chain": int(chain_actual), "sgd_step": int(sgd_actual),
+        },
+        "fml703_live_finding": sorted(
+            f.column for f in undonated if f.rule == "FML703"
+        ),
+        "fml703_after_donation": sorted(
+            f.column for f in donated if f.rule == "FML703"
+        ),
+        "rows": n,
+        "state_dim": state_dim,
+    }
+
+
+def _inner_memory_cpu() -> dict:
+    """Tunnel-immune CPU-mesh calibration — what CI's ``memory smoke``
+    stage parses for the 0.5x-2.0x ratio tripwire."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    return _memory_stage()
+
+
 _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
@@ -2244,6 +2361,7 @@ _INNER_STAGES = {
     "pallas_cpu": _inner_pallas_cpu,
     "sparse_hot_loops": _inner_sparse_hot_loops,
     "sparse_hot_loops_cpu": _inner_sparse_hot_loops_cpu,
+    "memory_cpu": _inner_memory_cpu,
     "recovery": _inner_recovery,
     "recovery_cpu": _inner_recovery_cpu,
     "converge": _inner_converge,
@@ -2397,7 +2515,8 @@ def main():
                      "input_pipeline_cpu",
                      "sharded_train_cpu", "sharded_embedding_cpu",
                      "precision_cpu", "cold_start_cpu", "cold_start_child",
-                     "autotune_cpu", "pallas_cpu", "sparse_hot_loops_cpu"):
+                     "autotune_cpu", "pallas_cpu", "sparse_hot_loops_cpu",
+                     "memory_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():

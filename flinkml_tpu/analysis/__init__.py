@@ -35,6 +35,16 @@ any host):
      parameters, narrow collectives, policy/plan width conflicts);
      consumes live functions pre-compile or ``*.policy.json``
      fixtures, and hosts the shared dtype-flow walk behind FML106.
+  7. **Memory liveness** (:mod:`.memory`) — FML7xx: walks jaxprs
+     device-free computing a per-device peak-live-bytes estimate under
+     a ``(ShardingPlan, quant tier)`` pair — per-leaf param widths,
+     optimizer slots from the actual state, activation liveness with
+     last-use frees, sharded extents via the same ceil math the padded
+     runtime layout uses; flags over-budget peaks (FML701),
+     vocab-scale hot-path intermediates (FML702), undonated same-shape
+     state updates (FML703), and a quant ladder with no fitting rung
+     (FML704); consumes live functions or ``*.memory.json`` targets,
+     and backs the serving engine's load-time budget gate.
 
 CLI: ``python -m flinkml_tpu.analysis <paths...> [--fail-on-findings]``
 (see :mod:`.__main__`); rule catalog in :data:`.findings.RULES` and
@@ -92,4 +102,12 @@ from flinkml_tpu.analysis.sorted_scatter import (  # noqa: F401
     check_scatter_file,
     check_sorted_scatter_fn,
     check_sorted_scatter_jaxpr,
+)
+from flinkml_tpu.analysis.memory import (  # noqa: F401
+    MemoryEstimate,
+    check_memory_file,
+    check_memory_fn,
+    check_tier_ladder,
+    estimate_fn_memory,
+    estimate_serving_bytes,
 )

@@ -19,6 +19,10 @@ Runs all three analysis passes device-free over the given targets:
      declarative scatter probe with a declared pack-time sorted
      guarantee, see :mod:`flinkml_tpu.analysis.sorted_scatter`) runs
      the FML404 walk;
+  2e. *memory liveness*: every ``*.memory.json`` target (a mesh + plan
+     + HBM budget + probe program and/or quant-tier ladder, see
+     :mod:`flinkml_tpu.analysis.memory`) runs the peak-live-bytes
+     pass — FML701-704;
   3. *transfer/retrace self-check*: a representative fused scaler→
      predictor chain is executed at several row counts inside one bucket
      under :class:`~flinkml_tpu.analysis.guard.TransferRetraceGuard` —
@@ -93,6 +97,28 @@ def _pass_scatters(scatter_targets, report: Report) -> None:
     _pin_cpu()  # probe programs trace jaxprs (abstract, device-free)
     for path in scatter_targets:
         report.extend(check_scatter_file(path))
+
+
+def _pass_memory(memory_targets, report: Report) -> None:
+    from flinkml_tpu.analysis.memory import check_memory_file
+
+    _pin_cpu()  # probe programs trace jaxprs (abstract, device-free)
+    for path in memory_targets:
+        report.extend(check_memory_file(path))
+
+
+#: extension -> pass runner. Adding a fixture type is ONE row here: the
+#: CLI arg split and the directory walk both iterate this table, so a
+#: new extension can never be routed by one and silently missed by the
+#: other (the four copy-pasted walk loops this replaced did exactly
+#: that dance by hand).
+_FIXTURE_PASSES = (
+    (".trace.json", _pass_traces),
+    (".plan.json", _pass_plans),
+    (".policy.json", _pass_policies),
+    (".scatter.json", _pass_scatters),
+    (".memory.json", _pass_memory),
+)
 
 
 def _pass_retrace_selfcheck(report: Report) -> None:
@@ -170,8 +196,9 @@ def main(argv=None) -> int:
         "targets", nargs="*",
         help=".py files / directories to lint, *.trace.json dispatch "
              "traces, *.plan.json sharding plans, *.policy.json "
-             "precision policies, and *.scatter.json sorted-scatter "
-             "probes to check",
+             "precision policies, *.scatter.json sorted-scatter "
+             "probes, and *.memory.json memory-liveness targets to "
+             "check",
     )
     parser.add_argument(
         "--fail-on-findings", action="store_true",
@@ -203,49 +230,29 @@ def main(argv=None) -> int:
             print(f"{rule} [{sev}] {desc}")
         return 0
 
-    py_targets, trace_targets, plan_targets, policy_targets = [], [], [], []
-    scatter_targets = []
+    py_targets: list = []
+    buckets: dict = {ext: [] for ext, _runner in _FIXTURE_PASSES}
     for t in args.targets:
-        if t.endswith(".trace.json"):
-            trace_targets.append(t)
-        elif t.endswith(".plan.json"):
-            plan_targets.append(t)
-        elif t.endswith(".policy.json"):
-            policy_targets.append(t)
-        elif t.endswith(".scatter.json"):
-            scatter_targets.append(t)
+        for ext, _runner in _FIXTURE_PASSES:
+            if t.endswith(ext):
+                buckets[ext].append(t)
+                break
         else:
             py_targets.append(t)
             if os.path.isdir(t):
                 for root, _dirs, names in os.walk(t):
-                    trace_targets.extend(
-                        os.path.join(root, n) for n in sorted(names)
-                        if n.endswith(".trace.json")
-                    )
-                    plan_targets.extend(
-                        os.path.join(root, n) for n in sorted(names)
-                        if n.endswith(".plan.json")
-                    )
-                    policy_targets.extend(
-                        os.path.join(root, n) for n in sorted(names)
-                        if n.endswith(".policy.json")
-                    )
-                    scatter_targets.extend(
-                        os.path.join(root, n) for n in sorted(names)
-                        if n.endswith(".scatter.json")
-                    )
+                    for n in sorted(names):
+                        for ext, _runner in _FIXTURE_PASSES:
+                            if n.endswith(ext):
+                                buckets[ext].append(os.path.join(root, n))
+                                break
 
     report = Report()
     if py_targets:
         _pass_lint(py_targets, report)
-    if trace_targets:
-        _pass_traces(trace_targets, report)
-    if plan_targets:
-        _pass_plans(plan_targets, report)
-    if policy_targets:
-        _pass_policies(policy_targets, report)
-    if scatter_targets:
-        _pass_scatters(scatter_targets, report)
+    for ext, runner in _FIXTURE_PASSES:
+        if buckets[ext]:
+            runner(buckets[ext], report)
     if not args.no_selfcheck:
         _pass_retrace_selfcheck(report)
 

@@ -10,7 +10,7 @@ Rule ids are permanent: a released id is never reused for a different
 check, so suppression lists stay meaningful across versions. Add new
 rules at the end of their band (1xx schema, 2xx graph wiring, 3xx
 collectives, 4xx transfer/retrace, 5xx sharding plans, 6xx precision
-flow).
+flow, 7xx memory liveness).
 """
 
 from __future__ import annotations
@@ -59,6 +59,11 @@ RULES = {
     "FML605": (ERROR, "sharding-plan HBM math assumed a parameter width different from policy.params"),
     "FML606": (ERROR, "quantized (int8) parameters accumulate at integer width without a dequant scale"),
     "FML607": (ERROR, "int8-quantized parameter leaf served under a non-quantized policy (degraded params republished as the full-width tier)"),
+    # -- 7xx: memory liveness ----------------------------------------------
+    "FML701": (ERROR, "estimated per-device peak live bytes exceed the HBM budget"),
+    "FML702": (ERROR, "vocab-scale intermediate materialized on the hot path (the embedding contract promises batch-sized payloads)"),
+    "FML703": (WARNING, "same-shape parameter/carry update whose input buffer is not donated (missed donate_argnums doubles peak at the worst moment)"),
+    "FML704": (ERROR, "no quant tier in the f32 -> bf16 -> int8 ladder fits the per-device HBM budget"),
 }
 
 

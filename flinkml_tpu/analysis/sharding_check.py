@@ -160,7 +160,10 @@ def check_plan(
             # fit, not just divide (the original FML503 only caught the
             # replicated case, so an under-sharded embedding plan OOM'd
             # inside XLA instead of failing here).
-            from flinkml_tpu.sharding.plan import shard_slice_elems
+            from flinkml_tpu.sharding.plan import (
+                human_bytes,
+                shard_slice_elems,
+            )
 
             per_device = shard_slice_elems(plan, sizes, name, shape) \
                 * dtype_bytes * (1 + optimizer_slots)
@@ -169,9 +172,10 @@ def check_plan(
                     findings.append(Finding(
                         "FML503",
                         f"plan {plan.name!r} replicates {name!r} "
-                        f"({tuple(shape)}): {per_device} B of parameter + "
-                        f"optimizer state per device exceeds the HBM "
-                        f"budget of {int(hbm_budget_bytes)} B",
+                        f"({tuple(shape)}): {human_bytes(per_device)} of "
+                        f"parameter + optimizer state per device exceeds "
+                        f"the HBM budget of "
+                        f"{human_bytes(hbm_budget_bytes)}",
                         stage=plan.name, column=name, location=location,
                         fix_hint="shard the family over an fsdp (or "
                                  "fsdp,tp) axis, or use infer_plan to "
@@ -183,9 +187,9 @@ def check_plan(
                         f"plan {plan.name!r} shards {name!r} "
                         f"({tuple(shape)}) over axes {sharded_axes} "
                         f"(product {sharded_factor}), but the per-device "
-                        f"shard still costs {per_device} B of parameter + "
-                        f"optimizer state against the HBM budget of "
-                        f"{int(hbm_budget_bytes)} B",
+                        f"shard still costs {human_bytes(per_device)} of "
+                        f"parameter + optimizer state against the HBM "
+                        f"budget of {human_bytes(hbm_budget_bytes)}",
                         stage=plan.name, column=name, location=location,
                         fix_hint="grow the shard axes (a larger fsdp×tp "
                                  "product), shrink the table, or raise "

@@ -60,6 +60,7 @@ from flinkml_tpu.serving.errors import (
     PoolUnavailableError,
     RegistryError,
     ServingError,
+    ServingMemoryError,
     ServingOverloadError,
     ServingSchemaError,
     ServingTimeoutError,
@@ -103,6 +104,7 @@ __all__ = [
     "ServingConfig",
     "ServingEngine",
     "ServingError",
+    "ServingMemoryError",
     "ServingOverloadError",
     "ServingRequest",
     "ServingResponse",

@@ -67,6 +67,7 @@ from flinkml_tpu.serving.batcher import (
 from flinkml_tpu.serving.errors import (
     EngineStoppedError,
     RegistryError,
+    ServingMemoryError,
     ServingOverloadError,
     ServingSchemaError,
     ServingTimeoutError,
@@ -162,6 +163,13 @@ class ServingConfig:
     # exists to avoid the fused executor entirely); see
     # docs/development/precision.md.
     precision: Optional[Any] = None
+    # Per-device HBM budget for the load-time memory gate: a model whose
+    # estimated footprint (learned arrays at this engine's precision
+    # tier + batch buffers at the largest dispatch bucket; see
+    # analysis.memory.estimate_serving_bytes) exceeds the budget is
+    # refused with ServingMemoryError BEFORE the active-model flip —
+    # the refuse_nonfinite idiom applied to capacity. None disables.
+    hbm_budget_bytes: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -426,6 +434,31 @@ class ServingEngine:
                 model,
                 where=f"serve (engine {self.name!r}, version {version})",
             )
+        if self.config.hbm_budget_bytes is not None:
+            # Budget gate, also BEFORE warmup/flip: estimate the model's
+            # per-device footprint at this engine's precision tier and
+            # refuse a model that cannot fit — a follower's refused swap
+            # keeps the old (fitting) model serving instead of OOMing
+            # the replica mid-swap.
+            from flinkml_tpu.analysis.memory import estimate_serving_bytes
+            from flinkml_tpu.sharding.plan import human_bytes
+
+            budget = int(self.config.hbm_budget_bytes)
+            est = estimate_serving_bytes(
+                model, self._schema, self.config.max_batch_rows,
+                policy=self._policy,
+            )
+            if est > budget:
+                raise ServingMemoryError(
+                    f"engine {self.name!r} refuses model version "
+                    f"{version}: estimated per-device footprint "
+                    f"{human_bytes(est)} exceeds hbm_budget_bytes="
+                    f"{human_bytes(budget)} (learned arrays at the "
+                    f"{self._policy.name if self._policy else 'full'} "
+                    f"tier + 3 batch buffers at max_batch_rows="
+                    f"{self.config.max_batch_rows}); the previous model "
+                    "keeps serving"
+                )
         # Warmup dispatches real transforms: SPMD engines (config.mesh)
         # must hold the mesh lock here too, or the load/swap path would
         # interleave collective rendezvous with a concurrent trainer —

@@ -39,6 +39,16 @@ class ServingSchemaError(ServingError, ValueError):
     trailing shapes) fixed by the warmup example at load time."""
 
 
+class ServingMemoryError(ServingError):
+    """A model was refused at load/swap time because its estimated
+    per-device HBM footprint (learned arrays at the engine's precision
+    tier, plus batch buffers at the largest dispatch bucket — see
+    :func:`flinkml_tpu.analysis.memory.estimate_serving_bytes`) exceeds
+    ``ServingConfig.hbm_budget_bytes``. Raised BEFORE the active-model
+    flip, so a follower's refused swap keeps the previous model serving
+    — the ``refuse_nonfinite`` idiom applied to capacity."""
+
+
 class SLOAdmissionError(ServingOverloadError):
     """A multi-tenant request was refused at CLASS admission: its SLO
     class's share of pool capacity (``SLOClass.max_queue_share``) is
@@ -74,6 +84,7 @@ __all__ = [
     "ServingTimeoutError",
     "EngineStoppedError",
     "ServingSchemaError",
+    "ServingMemoryError",
     "RegistryError",
     "ModelVersionNotFoundError",
 ]

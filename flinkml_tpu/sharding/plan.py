@@ -299,6 +299,21 @@ PRESETS: Dict[str, ShardingPlan] = {
 # -- footprint model + inference -------------------------------------------
 
 
+_BYTE_UNITS = (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10))
+
+
+def human_bytes(n: int) -> str:
+    """``n`` in human units with the raw byte count in parens —
+    ``"12.00 MiB (12582912 B)"``. Operators diff the MiB, machines diff
+    the parens; every footprint/budget message renders through here so
+    no gate ever prints a bare ten-digit byte string again."""
+    n = int(n)
+    for unit, div in _BYTE_UNITS:
+        if n >= div:
+            return f"{n / div:.2f} {unit} ({n} B)"
+    return f"{n} B"
+
+
 def _axis_sizes(mesh) -> Dict[str, int]:
     """Normalize a mesh spec — a ``DeviceMesh``, a ``jax.sharding.Mesh``,
     or a plain ``{axis: size}`` dict — to axis sizes."""
@@ -356,6 +371,73 @@ def per_device_state_bytes(
     return total
 
 
+#: The quantization-tier ladder :func:`infer_plan`'s memory-aware mode
+#: walks, widest first: full float32, bf16 storage, then the int8
+#: post-training-quantized tier (ROADMAP item 3's "re-run the footprint
+#: against the quantized width so infer_plan can CHOOSE quantization to
+#: fit a budget"). Each tier maps to the per-leaf width model of
+#: :func:`per_device_state_bytes_tiered`.
+QUANT_TIER_LADDER: Tuple[str, ...] = ("float32", "bfloat16", "int8")
+
+
+def _tier_leaf_bytes(name: str, shape: Sequence[int], slice_elems: int,
+                     tier: str, optimizer_slots: int) -> int:
+    """Per-device bytes of one parameter leaf (plus its same-layout
+    optimizer slots) under a quant tier. The int8 tier mirrors the fused
+    executor's PTQ eligibility rule (:func:`flinkml_tpu.precision
+    .quantizable`): float leaves of at least ``INT8_MIN_CONST_ELEMS``
+    elements store 1 B/elem codes plus one float32 scale per last-axis
+    column (replicated — scales are dim-sized, noise next to the codes);
+    smaller leaves stay float32. Optimizer slots are never quantized —
+    they hold running accumulators, not servable constants — so they
+    cost the tier's FLOAT width (float32 for the int8 tier)."""
+    from flinkml_tpu.precision import INT8_MIN_CONST_ELEMS
+
+    total_elems = 1
+    for d in shape:
+        total_elems *= int(d)
+    if tier == "float32":
+        param, slot = 4 * slice_elems, 4 * slice_elems
+    elif tier == "bfloat16":
+        param, slot = 2 * slice_elems, 2 * slice_elems
+    elif tier == "int8":
+        if total_elems >= INT8_MIN_CONST_ELEMS and len(shape) >= 1:
+            scale_cols = int(shape[-1]) if len(shape) >= 2 else 1
+            param = 1 * slice_elems + 4 * scale_cols
+        else:
+            param = 4 * slice_elems
+        slot = 4 * slice_elems
+    else:
+        raise ValueError(
+            f"unknown quant tier {tier!r} (ladder: {QUANT_TIER_LADDER})"
+        )
+    return param + slot * int(optimizer_slots)
+
+
+def per_device_state_bytes_tiered(
+    plan: ShardingPlan,
+    mesh,
+    param_shapes: Mapping[str, Sequence[int]],
+    tier: str = "float32",
+    optimizer_slots: int = 1,
+) -> int:
+    """Per-device parameter + optimizer-state bytes under ``plan`` AND a
+    quantization tier — the per-leaf-width generalization of
+    :func:`per_device_state_bytes`'s scalar ``dtype_bytes`` (which stays
+    as the fast FML503 screen). Sharded extents use the same per-dim
+    ceil as :func:`shard_slice_elems`, so this model, the FML503 check,
+    and the :class:`~flinkml_tpu.embeddings.EmbeddingTable` padded
+    layout agree at every budget boundary."""
+    axis_sizes = _axis_sizes(mesh)
+    total = 0
+    for name, shape in param_shapes.items():
+        slice_elems = shard_slice_elems(plan, axis_sizes, name, shape)
+        total += _tier_leaf_bytes(
+            name, shape, slice_elems, tier, optimizer_slots
+        )
+    return total
+
+
 #: The static candidate order: ascending communication cost (data
 #: parallel's one psum < FSDP's all-gather/reduce-scatter pair <
 #: FSDP×TP's extra tp collectives < EMBEDDING's per-step sparse row
@@ -400,7 +482,8 @@ def infer_plan(
     dtype_bytes: int = 4,
     optimizer_slots: int = 1,
     candidates: Optional[Sequence[ShardingPlan]] = None,
-) -> ShardingPlan:
+    quant_tiers: Optional[Sequence[str]] = None,
+) -> Union[ShardingPlan, Tuple[ShardingPlan, str]]:
     """The best plan whose per-device parameter + optimizer-state
     footprint fits ``hbm_budget_bytes`` on ``mesh``.
 
@@ -413,46 +496,77 @@ def infer_plan(
     are skipped (a 1-D ``data`` mesh cannot host FSDP). Raises
     :class:`NoFeasiblePlanError` with every candidate's footprint when
     nothing fits.
+
+    **Memory-aware mode**: ``quant_tiers`` (``True`` for the full
+    :data:`QUANT_TIER_LADDER`, or an explicit subsequence of it) makes
+    the search tier-major — every candidate at float32 first, then at
+    bf16 storage, then at the int8 PTQ tier — and the return value
+    becomes ``(plan, quant_tier)``: a parameter universe that is budget-
+    infeasible at f32 routes to a fitting quantized tier instead of
+    refusing. Footprints then come from the per-leaf width model
+    (:func:`per_device_state_bytes_tiered`) instead of the scalar
+    ``dtype_bytes``. When NO tier fits, the :class:`NoFeasiblePlanError`
+    lists every tier's footprint per candidate — the FML704 shape.
     """
     if candidates is None:
         candidates = _tuned_candidates()
     axis_sizes = _axis_sizes(mesh)
     budget = int(hbm_budget_bytes)
+    tiered = quant_tiers is not None
+    tiers: Sequence[Optional[str]] = (
+        (tuple(QUANT_TIER_LADDER) if quant_tiers is True
+         else tuple(quant_tiers)) if tiered else (None,)
+    )
     embedding_params = [
         n for n, s in param_shapes.items()
         if is_embedding_param(n) and len(s) > 1
     ]
-    tried: List[Tuple[str, str]] = []
-    for plan in candidates:
-        missing = [a for a in plan.required_axes() if a not in axis_sizes]
-        if missing:
-            tried.append((plan.name, f"mesh lacks axes {missing}"))
-            continue
-        split = [
-            n for n in embedding_params
-            if _splits_embedding_rows(plan, n, param_shapes[n])
-        ]
-        if split:
-            # A plan that splits an embedding table's ROW payload (e.g.
-            # FSDP_TP's dim-1 tp shard) cannot host the sparse
-            # lookup/exchange primitives — skip it for this parameter
-            # universe even though its footprint would fit.
-            tried.append((
-                plan.name,
-                f"splits embedding rows of {split} across a non-leading "
-                "dim (the sparse exchange moves whole rows)",
-            ))
-            continue
-        footprint = per_device_state_bytes(
-            plan, axis_sizes, param_shapes, dtype_bytes, optimizer_slots
-        )
-        if footprint <= budget:
-            return plan
-        tried.append((plan.name, f"{footprint} B/device > budget"))
+    tried: List[str] = []
+    skipped: set = set()
+    for tier in tiers:
+        for plan in candidates:
+            if plan.name in skipped:
+                continue
+            missing = [a for a in plan.required_axes()
+                       if a not in axis_sizes]
+            if missing:
+                tried.append(f"{plan.name}: mesh lacks axes {missing}")
+                skipped.add(plan.name)
+                continue
+            split = [
+                n for n in embedding_params
+                if _splits_embedding_rows(plan, n, param_shapes[n])
+            ]
+            if split:
+                # A plan that splits an embedding table's ROW payload
+                # (e.g. FSDP_TP's dim-1 tp shard) cannot host the sparse
+                # lookup/exchange primitives — skip it for this
+                # parameter universe even though its footprint would fit.
+                tried.append(
+                    f"{plan.name}: splits embedding rows of {split} "
+                    "across a non-leading dim (the sparse exchange "
+                    "moves whole rows)"
+                )
+                skipped.add(plan.name)
+                continue
+            if tier is None:
+                footprint = per_device_state_bytes(
+                    plan, axis_sizes, param_shapes, dtype_bytes,
+                    optimizer_slots,
+                )
+            else:
+                footprint = per_device_state_bytes_tiered(
+                    plan, axis_sizes, param_shapes, tier, optimizer_slots
+                )
+            if footprint <= budget:
+                return (plan, tier) if tiered else plan
+            label = plan.name if tier is None else f"{plan.name}@{tier}"
+            tried.append(f"{label}: {human_bytes(footprint)}/device")
     raise NoFeasiblePlanError(
-        f"no sharding plan fits hbm_budget_bytes={budget} on mesh "
-        f"{axis_sizes}: "
-        + "; ".join(f"{name}: {why}" for name, why in tried)
+        f"no sharding plan fits hbm_budget_bytes={human_bytes(budget)} "
+        f"on mesh {axis_sizes}"
+        + (" at any quant tier" if tiered else "")
+        + ": " + "; ".join(tried)
         + ". Add an fsdp/tp mesh axis, shrink the model, or raise the "
         "budget."
     )

@@ -358,6 +358,18 @@ def test_graph_unproduced_output_fml202():
     assert "FML202" in _rules(analyze_graph(g))
 
 
+def test_graph_duplicate_output_claim_fml203():
+    builder = GraphBuilder().set_max_output_table_num(1)
+    src = builder.create_table_id()
+    (o1,) = builder.add_estimator(StandardScaler(), src)
+    builder.add_estimator(StandardScaler(), src)
+    g = builder.build_estimator([src], [o1])
+    # Seed the defect _execute_nodes would hit at runtime: the second
+    # node rewired to claim the first node's output id.
+    g._nodes[1].output_ids = list(g._nodes[0].output_ids)
+    assert "FML203" in _rules(analyze_graph(g))
+
+
 # ---------------------------------------------------------------------------
 # AST lint
 # ---------------------------------------------------------------------------

@@ -567,3 +567,47 @@ def test_over_budget_model_trains_under_fsdp_and_resumes_elsewhere(tmp_path):
         checkpoint_manager=mgr, checkpoint_interval=4, resume=True,
     )
     np.testing.assert_allclose(coef2, coef8, rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# shard_slice_elems at uneven-shard budget boundaries (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_uneven_shard_boundary_accepted_exactly_at_budget():
+    """An UNEVEN vocab (1001 rows over 8 shards -> ceil to 126-row
+    slices) whose padded slice fits EXACTLY at the budget must be
+    accepted by infer_plan — the static footprint model uses the same
+    per-dim ceil the runtime padded layout allocates, so the boundary
+    cannot be off by one padded row."""
+    from flinkml_tpu.sharding import EMBEDDING
+    from flinkml_tpu.sharding.plan import shard_slice_elems
+
+    mesh = {"data": 1, "fsdp": 4, "tp": 2}
+    vocab, dim = 1001, 16
+    name = "emb/embedding"
+    slice_elems = shard_slice_elems(EMBEDDING, mesh, name, (vocab, dim))
+    assert slice_elems == 126 * dim  # ceil(1001 / 8), not 1001 // 8
+    exact = slice_elems * 4 * 2  # f32, 1 optimizer slot
+    plan = infer_plan(mesh, {name: (vocab, dim)}, exact)
+    assert plan.name == "embedding"
+    with pytest.raises(NoFeasiblePlanError):
+        infer_plan(mesh, {name: (vocab, dim)}, exact - 1)
+
+
+def test_uneven_shard_bytes_match_embedding_table_padded_layout():
+    """The static model's bytes ARE the EmbeddingTable padded layout's
+    bytes: ceil-divided rows x dim x width x (1 + slots), so FML503,
+    infer_plan, and the runtime placement agree at every boundary."""
+    from flinkml_tpu.embeddings import EmbeddingTable
+    from flinkml_tpu.sharding import EMBEDDING
+    from flinkml_tpu.sharding.plan import shard_slice_elems
+
+    vocab, dim, slots = 1001, 16, 1
+    table = EmbeddingTable("emb", vocab, dim, plan=EMBEDDING,
+                           optimizer_slots=slots)
+    axis_sizes = dict(table.mesh.mesh.shape)
+    static = shard_slice_elems(
+        EMBEDDING, axis_sizes, table.param_name, (vocab, dim)
+    ) * table.dtype.itemsize * (1 + slots)
+    assert table.per_device_bytes() == static
+    assert table.padded_vocab == 126 * table.n_shards

@@ -1369,6 +1369,200 @@ print('autoscale smoke: rows/s', rec['serving_autoscale_rows_per_sec'],
 stage "autoscale smoke (load-triple scale-up + SLO admission + int8 tier)" \
     autoscale_smoke
 
+# Memory-pass acceptance, device-free (ISSUE 17): (a) the seeded
+# FML70{1..4} fixtures are each flagged by rule id via --format json;
+# (b) an embedding config over budget at f32 is FML701-refused
+# pre-compile, rerouted by memory-aware infer_plan to an int8 tier
+# that fits, served under that tier with >=99% label identity, and an
+# over-budget hot-swap is refused while the old model keeps serving;
+# (c) FML703 fires live on a real undonated carry-update and goes
+# quiet once the state is donated; (d) the --rules catalog and the
+# docs rule table agree row-for-row; (e) the bench memory_cpu stage's
+# static estimate sits inside the pinned 0.5x-2.0x band of XLA's
+# Compiled.memory_analysis() on BOTH calibration twins.
+memory_smoke() {
+    local fx rule
+    for rule in fml701 fml702 fml703 fml704; do
+        fx=$(ls tests/analysis_fixtures/bad_memory_${rule}_*.memory.json) \
+            || return 1
+        # --fail-on-findings: FML703 is a warning, which alone would
+        # exit 0 under the errors-only default.
+        JAX_PLATFORMS=cpu python -m flinkml_tpu.analysis "$fx" \
+            --no-selfcheck --fail-on-findings --format json \
+            > /tmp/ci_mem_${rule}.json
+        if [ $? -ne 1 ]; then
+            echo "memory fixture $fx did not exit 1"
+            return 1
+        fi
+        python - "$rule" "/tmp/ci_mem_${rule}.json" <<'PY' || return 1
+import json, sys
+with open(sys.argv[2]) as fh:
+    rules = {f["rule"] for f in json.load(fh)}
+want = sys.argv[1].upper()
+assert want in rules, (want, rules)
+print("memory smoke: fixture flagged", want)
+PY
+    done
+
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 300 python - <<'EOF' || return 1
+import os
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from flinkml_tpu.analysis.memory import check_memory_fn
+from flinkml_tpu.parallel import DeviceMesh
+from flinkml_tpu.sharding.plan import FSDP, infer_plan
+
+# -- (b) over-budget at f32 -> FML701 pre-compile -> int8 reroute ------------
+axes = {"data": 1, "fsdp": 8}
+shapes = {"emb/embedding": (1 << 16, 64)}
+budget = 700_000  # int8 slice ~512 KiB fits; bf16 1 MiB and f32 2 MiB do not
+state = {"emb/embedding": jnp.zeros(shapes["emb/embedding"], jnp.float32)}
+
+def decay(state):
+    return {"emb/embedding": state["emb/embedding"] * 0.99}
+
+findings = check_memory_fn(
+    decay, state, plan=FSDP, mesh=axes, hbm_budget_bytes=budget,
+    param_argnums=(0,), donate_argnums=(0,), program="emb_decay",
+)
+rules = {f.rule for f in findings}
+assert "FML701" in rules, rules  # refused before any compile
+
+plan, tier = infer_plan(axes, shapes, budget, optimizer_slots=0,
+                        quant_tiers=True)
+assert tier == "int8", (plan.name, tier)
+
+# -- (b cont.) serve under the routed tier: >=99% label identity -------------
+from flinkml_tpu import pipeline_fusion
+from flinkml_tpu.models.logistic_regression import (
+    LogisticRegression, LogisticRegressionModel)
+from flinkml_tpu.models.scalers import StandardScaler
+from flinkml_tpu.pipeline import PipelineModel
+from flinkml_tpu.table import Table
+
+os.environ["FLINKML_TPU_INT8_MIN_CONST"] = "16"
+rng = np.random.default_rng(17)
+dim, n = 32, 512
+x = rng.normal(size=(n, dim))
+y = (x @ rng.normal(size=dim) > 0).astype(np.float64)
+t = Table({"features": x, "label": y})
+sc = StandardScaler().set(StandardScaler.INPUT_COL, "features") \
+                     .set(StandardScaler.OUTPUT_COL, "scaled").fit(t)
+(st,) = sc.transform(t)
+lr = LogisticRegression().set(LogisticRegression.FEATURES_COL, "scaled") \
+                         .set(LogisticRegression.LABEL_COL, "label") \
+                         .set(LogisticRegression.SEED, 17) \
+                         .set_max_iter(5).fit(st)
+pm = PipelineModel([sc, lr])
+(o32,) = pm.transform(t)
+p32 = np.asarray(o32.column("prediction"))
+with pipeline_fusion.precision_scope("int8_inference"):
+    (oq,) = pm.transform(t)
+    pq = np.asarray(oq.column("prediction"))
+agree = float(np.mean(p32 == pq))
+assert agree >= 0.99, agree
+
+# -- (b cont.) over-budget swap refused, old model keeps serving -------------
+import tempfile
+
+from flinkml_tpu.serving import (
+    ModelRegistry, ServingConfig, ServingEngine, ServingMemoryError)
+
+big = LogisticRegressionModel().set(
+    LogisticRegressionModel.FEATURES_COL, "features")
+big.set_model_data(Table({"coefficient": np.ones((1, 1 << 20))}))
+with tempfile.TemporaryDirectory() as tmp:
+    reg = ModelRegistry(os.path.join(tmp, "reg"))
+    small = LogisticRegression().set(
+        LogisticRegression.FEATURES_COL, "features"
+    ).set(LogisticRegression.LABEL_COL, "label").set_max_iter(3).fit(t)
+    v1 = reg.publish(small)
+    eng = ServingEngine(
+        reg, Table({"features": x[:4]}),
+        ServingConfig(max_batch_rows=64, warmup_row_counts=(4,),
+                      hbm_budget_bytes=1 << 20),
+        output_cols=("prediction",),
+    ).start()
+    try:
+        assert eng.predict(Table({"features": x[:4]})).version == v1
+        v2 = reg.publish(big)
+        try:
+            eng.swap_to(v2)
+            raise SystemExit("over-budget swap was not refused")
+        except ServingMemoryError:
+            pass
+        assert eng.predict(Table({"features": x[:4]})).version == v1
+    finally:
+        eng.stop()
+
+# -- (c) FML703 live on a real undonated carry-update ------------------------
+from flinkml_tpu.sharding.apply import init_linear_state, linear_step_fn
+
+mesh = DeviceMesh.for_plan(FSDP)
+lstate = init_linear_state(2048, "sgd", np.float32)
+step = linear_step_fn(loss="logistic", optimizer="sgd",
+                      dtype_name="float32", learning_rate=0.1,
+                      momentum=0.9, reg_l2=0.0, reg_l1=0.0)
+args = (lstate, jnp.zeros((n, 2048), jnp.float32),
+        jnp.asarray(y, jnp.float32), jnp.ones((n,), jnp.float32))
+undonated = {f.rule for f in check_memory_fn(
+    step, *args, plan=FSDP, mesh=mesh, param_argnums=(0,))}
+assert "FML703" in undonated, undonated
+donated = {f.rule for f in check_memory_fn(
+    step, *args, plan=FSDP, mesh=mesh, param_argnums=(0,),
+    donate_argnums=(0,))}
+assert "FML703" not in donated, donated
+
+print("memory smoke: FML701 pre-compile refusal, infer_plan ->",
+      f"({plan.name!r}, {tier!r}), int8 label agreement {agree:.3f},",
+      "over-budget swap refused (old model kept serving), FML703",
+      "live+donation-quiet")
+EOF
+
+    # (d) --rules catalog and docs rule table agree row-for-row.
+    JAX_PLATFORMS=cpu python - <<'EOF' || return 1
+import re, subprocess, sys
+
+out = subprocess.run(
+    [sys.executable, "-m", "flinkml_tpu.analysis", "--rules"],
+    stdout=subprocess.PIPE, text=True, check=True).stdout
+cli = set(re.findall(r"^(FML\d{3})\b", out, re.MULTILINE))
+docs = set(re.findall(
+    r"^\|\s*(FML\d{3})\s*\|",
+    open("docs/development/static_analysis.md").read(), re.MULTILINE))
+assert cli == docs, (sorted(cli - docs), sorted(docs - cli))
+print(f"memory smoke: --rules vs docs table: {len(cli)} rules, in sync")
+EOF
+
+    # (e) calibration tripwire: the pinned 0.5x-2.0x band vs XLA's
+    # Compiled.memory_analysis() on both twins, plus the live FML703
+    # demo the stage re-runs on every CI invocation.
+    local out
+    out=$(_FLINKML_BENCH_INNER=memory_cpu timeout 560 python bench.py) \
+        || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+ratios = rec['memory_calibration_ratio']
+assert {'fused_chain', 'sgd_step'} <= set(ratios), ratios
+for name, r in ratios.items():
+    assert 0.5 <= r <= 2.0, (name, r, rec['memory_estimate_bytes'],
+                             rec['xla_memory_analysis_bytes'])
+assert rec['fml703_live_finding'], rec
+assert not rec['fml703_after_donation'], rec
+print('memory smoke: calibration ratios', ratios,
+      'FML703 live leaves', rec['fml703_live_finding'])
+"
+}
+stage "memory smoke (FML70x gate + int8 reroute + calibration band)" \
+    memory_smoke
+
 example_smoke() {
     local ex
     for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
