@@ -2326,6 +2326,106 @@ def _inner_memory_cpu() -> dict:
     return _memory_stage()
 
 
+def _feature_freshness_stage() -> dict:
+    """Stage: the streaming feature platform's train-to-serve freshness
+    loop end-to-end (ISSUE 18). A hashed-id FM trainer consumes a
+    synthetic click stream, publishes incremental row deltas, and a
+    2-replica pool follows the registry through in-place row patches.
+    Reports trainer throughput, the delta-vs-snapshot payload ratio, and
+    the time-to-freshness distribution (publish call until EVERY replica
+    serves the new version — the roll is synchronous in the publishing
+    thread, so each sample times the full save + patch fan-out)."""
+    _setup_jax_cache()
+    import tempfile
+
+    from flinkml_tpu.features import (
+        DeltaPublisher,
+        StreamingHashedFMTrainer,
+        hash_buckets,
+    )
+    from flinkml_tpu.serving.engine import ServingConfig
+    from flinkml_tpu.serving.pool import ReplicaPool
+    from flinkml_tpu.serving.registry import ModelRegistry
+    from flinkml_tpu.table import Table
+    from flinkml_tpu.utils.metrics import metrics
+
+    num_buckets, rows, length, publishes = 1 << 16, 512, 4, 32
+    rng = np.random.default_rng(0)
+    trainer = StreamingHashedFMTrainer(
+        num_buckets=num_buckets, factor_size=16, hash_seed=7,
+        learning_rate=0.05,
+    )
+
+    def batch():
+        keys = rng.integers(0, 1 << 22, size=(rows, length))
+        ids = hash_buckets(
+            keys.reshape(-1), seed=7, num_buckets=num_buckets,
+        ).reshape(rows, length)
+        labels = (keys.sum(axis=1) % 2).astype(np.float32)
+        return ids, labels
+
+    with tempfile.TemporaryDirectory() as root:
+        registry = ModelRegistry(os.path.join(root, "reg"))
+        publisher = DeltaPublisher(
+            registry, trainer, every_n_batches=1, max_depth=publishes + 1,
+            name="bench_freshness",
+        )
+        ids, labels = batch()
+        trainer.fit_batch(ids, labels)
+        publisher.publish_now()  # the base snapshot
+        example = Table({"hashed_ids": np.zeros((2, length), np.int32)})
+        pool = ReplicaPool(
+            registry, example,
+            config=ServingConfig(max_batch_rows=256, max_wait_ms=1.0),
+            n_replicas=2, name="bench_freshness",
+        ).start().follow_registry()
+        try:
+            t_train = 0.0
+            fresh_ms = []
+            for _ in range(publishes):
+                ids, labels = batch()
+                t0 = time.perf_counter()
+                trainer.fit_batch(ids, labels)
+                t_train += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                publisher.publish_now()  # delta + synchronous 2-replica roll
+                fresh_ms.append((time.perf_counter() - t0) * 1e3)
+            lag = pool.freshness_lag(trainer.watermark)
+        finally:
+            pool.stop()
+        reg_counters = registry._metrics.snapshot()["counters"]
+    gauges = metrics.group(
+        "features.publisher", labels={"publisher": "bench_freshness"},
+    ).snapshot()["gauges"]
+    return {
+        "train_rows_per_sec": round(rows * publishes / t_train, 1),
+        "delta_publishes": int(reg_counters.get("delta_publishes", 0)),
+        "full_publishes": int(reg_counters.get("full_publishes", 0)),
+        "delta_bytes": int(gauges["delta_bytes"]),
+        "full_snapshot_bytes": int(gauges["full_bytes"]),
+        "delta_ratio": round(float(gauges["delta_ratio"]), 4),
+        "time_to_freshness_ms_p50": round(
+            float(np.percentile(fresh_ms, 50)), 2),
+        "time_to_freshness_ms_p99": round(
+            float(np.percentile(fresh_ms, 99)), 2),
+        "freshness_lag_batches": lag,
+        "num_buckets": num_buckets,
+    }
+
+
+def _inner_feature_freshness() -> dict:
+    return _feature_freshness_stage()
+
+
+def _inner_feature_freshness_cpu() -> dict:
+    """Tunnel-immune CPU variant — what CI's ``freshness smoke`` bench
+    companion parses. The trainer/publisher/pool path is host-resident,
+    so this IS the product path, not a proxy; the device variant exists
+    to time the roll when replicas hold device-placed tables."""
+    _force_cpu()
+    return _feature_freshness_stage()
+
+
 _INNER_STAGES = {
     "probe": _inner_probe,
     "dense": _inner_dense,
@@ -2362,6 +2462,8 @@ _INNER_STAGES = {
     "sparse_hot_loops": _inner_sparse_hot_loops,
     "sparse_hot_loops_cpu": _inner_sparse_hot_loops_cpu,
     "memory_cpu": _inner_memory_cpu,
+    "feature_freshness": _inner_feature_freshness,
+    "feature_freshness_cpu": _inner_feature_freshness_cpu,
     "recovery": _inner_recovery,
     "recovery_cpu": _inner_recovery_cpu,
     "converge": _inner_converge,
@@ -2516,7 +2618,7 @@ def main():
                      "sharded_train_cpu", "sharded_embedding_cpu",
                      "precision_cpu", "cold_start_cpu", "cold_start_child",
                      "autotune_cpu", "pallas_cpu", "sparse_hot_loops_cpu",
-                     "memory_cpu"):
+                     "memory_cpu", "feature_freshness_cpu"):
             out = _INNER_STAGES[inner]()
         else:
             with device_client_lock():
@@ -2590,7 +2692,7 @@ def main():
                    "feed_overlap", "input_pipeline", "sharded_train",
                    "sharded_embedding", "precision", "cold_start",
                    "autotune", "pallas", "sparse_hot_loops",
-                   "serving_autoscale", "gbt",
+                   "serving_autoscale", "feature_freshness", "gbt",
                    "als", "word2vec", "converge_sparse", "sparse"]
     results = {}
     # Hold the single-tenant device mutex across ALL device stages: two
@@ -2721,6 +2823,12 @@ def main():
         # queued kernel-backend device re-tune (ROADMAP item 2 /
         # ISSUE 13; workload on _pallas_stage).
         extras["pallas"] = results["pallas"]
+    if results.get("feature_freshness") is not None:
+        # Streaming feature platform: hashed-FM train rows/s, delta-vs-
+        # snapshot payload ratio, and time-to-freshness p50/p99 through
+        # the registry's row-delta fan-out (ISSUE 18; workload on
+        # _feature_freshness_stage).
+        extras["feature_freshness"] = results["feature_freshness"]
     if results.get("converge") is not None:
         # Epochs + wall to fixed tol on device — the second half of
         # BASELINE.json's "samples/sec/chip + epochs-to-converge".

@@ -99,6 +99,13 @@ def _pass_scatters(scatter_targets, report: Report) -> None:
         report.extend(check_scatter_file(path))
 
 
+def _pass_features(features_targets, report: Report) -> None:
+    from flinkml_tpu.analysis.features_check import check_features_file
+
+    for path in features_targets:
+        report.extend(check_features_file(path))
+
+
 def _pass_memory(memory_targets, report: Report) -> None:
     from flinkml_tpu.analysis.memory import check_memory_file
 
@@ -118,6 +125,7 @@ _FIXTURE_PASSES = (
     (".policy.json", _pass_policies),
     (".scatter.json", _pass_scatters),
     (".memory.json", _pass_memory),
+    (".features.json", _pass_features),
 )
 
 

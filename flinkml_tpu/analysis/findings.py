@@ -51,6 +51,7 @@ RULES = {
     "FML502": (ERROR, "mesh axis size does not divide the parameter dimension it shards"),
     "FML503": (ERROR, "replicated parameter (+ optimizer state) exceeds the per-device HBM budget"),
     "FML504": (ERROR, "two sharding plans in one program imply conflicting collective orders"),
+    "FML505": (ERROR, "hash front-end num_buckets does not match the embedding table's vocab rows"),
     # -- 6xx: precision flow -------------------------------------------------
     "FML601": (ERROR, "reduction/accumulation (sum, dot accumulator, state update) runs narrower than policy.accum"),
     "FML602": (ERROR, "silent upcast in the compute region: a strong wide constant promotes policy.compute work"),

@@ -20,6 +20,7 @@ from flinkml_tpu.data.dataset import Dataset, DatasetIterator
 from flinkml_tpu.data.elastic import ElasticFeed, ElasticFeedIterator
 from flinkml_tpu.data.ops import (
     FilterOp,
+    HashOp,
     MapOp,
     Op,
     RebatchOp,
@@ -62,6 +63,7 @@ __all__ = [
     "Op",
     "MapOp",
     "FilterOp",
+    "HashOp",
     "RebatchOp",
     "WindowOp",
     "ShuffleOp",

@@ -126,6 +126,24 @@ class Dataset:
     def shuffle(self, buffer_batches: int, seed: int = 0) -> "Dataset":
         return self._with_op(ShuffleOp(buffer_batches, seed))
 
+    def hash_column(self, input_col: str, *, seed: int, num_buckets: int,
+                    output_col: str = "hashed_ids",
+                    **kwargs) -> "Dataset":
+        """Hash the raw string/int keys of ``input_col`` into
+        ``output_col`` embedding-row ids (seeded, process-stable — see
+        :mod:`flinkml_tpu.features.hashing`): the vocabulary-free front
+        end that lets an unbounded stream feed ``EmbeddingTable``
+        training directly. Extra kwargs reach
+        :class:`~flinkml_tpu.features.hashing.HashedFeature`
+        (``pad_key``, ``track_collisions``, ...)."""
+        from flinkml_tpu.data.ops import HashOp
+        from flinkml_tpu.features.hashing import HashedFeature
+
+        return self._with_op(HashOp(HashedFeature(
+            seed, num_buckets, input_col=input_col, output_col=output_col,
+            **kwargs,
+        )))
+
     def prefetch(self, depth: int = 2, place=None,
                  metrics_group: str = "data.prefetch") -> "Dataset":
         """Append the async host→device tail (see

@@ -194,3 +194,29 @@ class ShuffleOp(Op):
 
     def describe(self):
         return f"shuffle({self.buffer_batches}, seed={self.seed})"
+
+
+class HashOp(Op):
+    """Seeded feature hash per batch (1:1, so skip-transparent): the
+    wrapped :class:`~flinkml_tpu.features.hashing.HashedFeature` turns
+    the raw-key ``input_col`` into an ``output_col`` of embedding-row
+    bucket ids. The hash is process-stable (murmur-style over canonical
+    key bytes, never Python ``hash()``), so a cursor-resumed replay
+    re-hashes every batch to bit-identical ids — the same determinism
+    contract MapOp demands of its fn, here guaranteed by construction."""
+
+    skip_transparent = True
+
+    def __init__(self, hashed_feature):
+        self.hashed = hashed_feature
+
+    def apply(self, it, ctx):
+        hashed = self.hashed
+        for batch in it:
+            yield hashed(batch)
+
+    def describe(self):
+        return (
+            f"hash({self.hashed.input_col}->{self.hashed.output_col}, "
+            f"buckets={self.hashed.num_buckets}, seed={self.hashed.seed})"
+        )

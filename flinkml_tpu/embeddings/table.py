@@ -36,6 +36,7 @@ in the ``EMBEDDING`` preset and to the embedding-aware branches of
 
 from __future__ import annotations
 
+import copy
 import functools
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -114,6 +115,33 @@ def _scatter_program(mesh, row_entry, n_shards: int, shard_rows: int,
     return jax.jit(jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(row_entry), P(row_entry), P(row_entry)),
+        out_specs=P(row_entry),
+    ))
+
+
+@functools.lru_cache(maxsize=64)
+def _patch_program(mesh, row_entry, n_shards: int, shard_rows: int):
+    """Jitted replicated-ids row SET over a row-sharded table (the
+    incremental-publish path): each shard overwrites exactly the rows it
+    owns and drops the rest by routing their indices out of range
+    (``mode="drop"``). A SET — not an add of a difference — so the
+    patched table is bitwise equal to a fresh placement of the patched
+    host array, which is what makes delta-published predictions
+    bit-identical to a full-snapshot publish."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    axes = _entry_axes_tuple(row_entry)
+    axes_arg = axes if len(axes) > 1 else axes[0]
+
+    def local(table_shard, ids, values):
+        mask, safe = exchange.owned(ids, axes_arg, shard_rows)
+        idx = jnp.where(mask, safe, shard_rows)  # OOB → dropped
+        return table_shard.at[idx].set(values, mode="drop")
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P(row_entry), P(), P()),
         out_specs=P(row_entry),
     ))
 
@@ -307,6 +335,57 @@ class EmbeddingTable:
     def to_host(self) -> np.ndarray:
         """The UNPADDED global ``[vocab, dim]`` host array."""
         return np.asarray(self.rows)[: self.vocab]
+
+    # -- incremental row patch (the features delta-publish path) -----------
+    def _patched_rows(self, ids, values):
+        import jax.numpy as jnp
+
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        values = np.asarray(values, self.dtype)
+        if values.shape != (ids.shape[0], self.dim):
+            raise ValueError(
+                f"row values shape {values.shape} != "
+                f"({ids.shape[0]}, {self.dim})"
+            )
+        if ids.shape[0] != np.unique(ids).shape[0]:
+            raise ValueError(
+                f"row delta for table {self.name!r} has duplicate ids — "
+                "SET semantics require one value per row"
+            )
+        if ids.size and (int(ids.min()) < 0 or int(ids.max()) >= self.vocab):
+            raise ValueError(
+                f"row delta ids out of range [0, {self.vocab}) for table "
+                f"{self.name!r}"
+            )
+        if not ids.size:
+            return self.rows
+        if not self.sharded:
+            return self.rows.at[jnp.asarray(ids)].set(jnp.asarray(values))
+        program = _patch_program(
+            self.mesh.mesh, self.row_entry, self.n_shards, self.shard_rows
+        )
+        return program(self.rows, jnp.asarray(ids), jnp.asarray(values))
+
+    def apply_row_delta(self, ids, values) -> "EmbeddingTable":
+        """``rows[ids] = values`` (exact SET, unique ids, plan-respecting:
+        sharded tables patch each row on its owning shard only). Rebinds
+        ``self.rows`` and returns self — the TRAINER-side form. Serving
+        replicas must use :meth:`clone_with_row_delta` instead so a model
+        reference snapshotted by an in-flight batch keeps its rows."""
+        self.rows = self._patched_rows(ids, values)
+        return self
+
+    def clone_with_row_delta(self, ids, values) -> "EmbeddingTable":
+        """Functional patch: a shallow clone whose ``rows`` is the
+        patched array; slots and layout are shared with self. Device
+        buffers are immutable, so the old table — and any in-flight
+        batch holding it through the engine's active-model snapshot —
+        serves exactly its own version (the PR 8 contract, extended to
+        row patches)."""
+        patched = self._patched_rows(ids, values)
+        clone = copy.copy(self)
+        clone.rows = patched
+        return clone
 
     # -- footprint ---------------------------------------------------------
     def per_device_bytes(self) -> int:

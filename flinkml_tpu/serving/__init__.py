@@ -54,6 +54,7 @@ from flinkml_tpu.serving.engine import (
     ServingResponse,
 )
 from flinkml_tpu.serving.errors import (
+    DeltaChainError,
     EngineStoppedError,
     ModelIntegrityError,
     ModelVersionNotFoundError,
@@ -84,6 +85,7 @@ __all__ = [
     "BATCH",
     "BatchSegment",
     "ContinuousBatcher",
+    "DeltaChainError",
     "EngineStoppedError",
     "HealthPolicy",
     "INTERACTIVE",

@@ -376,6 +376,10 @@ class ServingEngine:
             raise RegistryError(
                 "swap_to requires a ModelRegistry-backed engine"
             )
+        target = (int(version) if version is not None
+                  else self._registry.current_version())
+        if target is not None and self._try_delta_swap(target):
+            return target
         v, model = self._registry.get(version)
         self._install(v, model)
         return v
@@ -411,8 +415,65 @@ class ServingEngine:
             active = self._active
             if active is not None and active.version == current:
                 return
+            if self._try_delta_swap(current):
+                return
             v, model = self._registry.get(current)
             self._install(v, model)
+
+    def _try_delta_swap(self, target: int) -> bool:
+        """The incremental-publish fast path: when the registry holds an
+        unbroken delta chain from the ACTIVE version to ``target`` and
+        the active model is delta-capable, patch a clone in place —
+        no full model load, no warmup (row patches keep every shape, so
+        the compiled dispatch programs are reused as-is) — and flip it
+        atomically. The old model object is untouched, so an in-flight
+        batch that snapshotted it still serves exactly one version (the
+        PR 8 contract). Returns False (caller falls back to a verified
+        full load) on any miss: registry-less engine, no active model,
+        no chain, fingerprint mismatch, or a lost race with a concurrent
+        full install."""
+        active = self._active
+        if (self._registry is None or active is None
+                or active.version is None
+                or not hasattr(active.model, "apply_delta")
+                or not hasattr(active.model, "delta_state")):
+            return False
+        chain = self._registry.delta_chain(active.version, target)
+        if not chain:
+            return False
+        from flinkml_tpu.io.read_write import content_fingerprint
+
+        try:
+            # One cheap link check anchors the chain to the live model:
+            # chain-internal links were verified at publish/get time, so
+            # version linkage plus this base fingerprint makes the
+            # patched state bitwise what a full load would produce.
+            if chain[0].base_fingerprint != content_fingerprint(
+                    active.model.delta_state()):
+                return False
+            model = active.model
+            for d in chain:
+                model = model.apply_delta(d)
+            if self.config.refuse_nonfinite:
+                from flinkml_tpu.recovery.sentinel import check_stage_finite
+
+                check_stage_finite(
+                    model,
+                    where=(f"serve (engine {self.name!r}, delta swap to "
+                           f"version {target})"),
+                )
+        except Exception:
+            # Any resolution/patch failure falls back to the fully
+            # verified load path, which raises the typed error.
+            return False
+        with self._swap_lock:
+            if self._active is not active:
+                return False  # a concurrent install won; let it stand
+            self._active = _ActiveModel(target, model)
+        self._metrics.counter("swaps")
+        self._metrics.counter("delta_swaps")
+        self._metrics.gauge("active_version", target)
+        return True
 
     def _install(self, version: Optional[int], model: Any) -> None:
         if self.config.mesh is not None and hasattr(model, "for_mesh"):
@@ -473,6 +534,9 @@ class ServingEngine:
         with self._swap_lock:
             first = self._active is None
             self._active = _ActiveModel(version, model)
+        # Full (load+warmup) installs are counted so the freshness loop
+        # can assert the hot path never re-ships the whole model.
+        self._metrics.counter("full_loads")
         if not first:
             self._metrics.counter("swaps")
         if version is not None:

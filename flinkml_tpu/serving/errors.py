@@ -75,6 +75,15 @@ class ModelVersionNotFoundError(RegistryError, KeyError):
     registry has no published versions yet)."""
 
 
+class DeltaChainError(RegistryError):
+    """An incremental (delta) version cannot be resolved to a model: its
+    base version is pruned, a fingerprint along the chain does not match
+    the state it claims to patch, or the base is not delta-capable. The
+    message names the exact broken link (``version N -> base M``) — the
+    registry NEVER silently falls back to a stale or fresh model (the
+    ``restore_latest`` contract, extended to delta chains)."""
+
+
 __all__ = [
     "ModelIntegrityError",
     "PoolUnavailableError",
@@ -87,4 +96,5 @@ __all__ = [
     "ServingMemoryError",
     "RegistryError",
     "ModelVersionNotFoundError",
+    "DeltaChainError",
 ]

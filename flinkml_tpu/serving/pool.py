@@ -206,6 +206,9 @@ class ReplicaPool:
         self.replicas: List[Replica] = []
         self._next_index = 0
         self._metrics = metrics.group(f"serving.{name}.router")
+        # Freshness lag gauges: trainer watermark vs what replicas serve
+        # (batch counts, no wall clock) — see freshness_lag().
+        self._freshness_metrics = metrics.group(f"serving.{name}.freshness")
         self._router = Router(
             self.replicas, self._rows_of, self._metrics,
             on_retire=self._retire,
@@ -517,8 +520,44 @@ class ReplicaPool:
                 if replica.engine.active_version != current:
                     replica.engine.swap_to(current)
                     self._metrics.counter("rolled_swaps")
+            self.freshness_lag()
 
     # -- observability -----------------------------------------------------
+    def freshness_lag(
+        self, trainer_watermark: Optional[int] = None,
+    ) -> Optional[int]:
+        """How stale the pool is, in source batches: the trainer-side
+        edge minus the OLDEST watermark any healthy replica currently
+        serves (the worst answer a client can get). The edge is the live
+        ``trainer_watermark`` when given (batches the trainer has
+        consumed, published or not), else the registry's newest stamped
+        watermark. Publishes the ``serving.<pool>.freshness`` gauges
+        (``lag_batches`` / ``latest_watermark`` / ``served_watermark_min``)
+        and returns the lag — None when the pool is not registry-backed
+        or no stamped watermarks exist yet. Deterministic by
+        construction: watermarks are batch counts, never wall clocks."""
+        if self._registry is None:
+            return None
+        latest = (int(trainer_watermark) if trainer_watermark is not None
+                  else self._registry.latest_watermark())
+        if latest is None:
+            return None
+        served = []
+        for r in self.healthy_replicas():
+            v = r.engine.active_version
+            if v is None:
+                continue
+            mark = self._registry.watermark_of(v)
+            if mark is not None:
+                served.append(mark)
+        if not served:
+            return None
+        lag = int(latest) - int(min(served))
+        self._freshness_metrics.gauge("latest_watermark", int(latest))
+        self._freshness_metrics.gauge("served_watermark_min",
+                                      int(min(served)))
+        self._freshness_metrics.gauge("lag_batches", lag)
+        return lag
     def versions(self) -> Dict[str, Optional[int]]:
         return {r.name: r.engine.active_version for r in list(self.replicas)}
 
@@ -543,5 +582,6 @@ class ReplicaPool:
                 if r.health.state is ReplicaState.HEALTHY
             ]),
             "router": self._metrics.snapshot()["counters"],
+            "freshness_lag": self.freshness_lag(),
             "per_replica": per_replica,
         }

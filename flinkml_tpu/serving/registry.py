@@ -40,6 +40,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import flinkml_tpu.faults as faults
 from flinkml_tpu.io import read_write
 from flinkml_tpu.serving.errors import (
+    DeltaChainError,
     ModelVersionNotFoundError,
     RegistryError,
 )
@@ -51,6 +52,7 @@ _log = get_logger("serving.registry")
 CURRENT_FILE = "CURRENT"
 VERSIONS_DIR = "versions"
 PUBLISH_TAG_FILE = "PUBLISH_TAG"
+WATERMARK_FILE = "WATERMARK"
 _TMP_PREFIX = ".tmp-"
 
 
@@ -79,6 +81,9 @@ class ModelRegistry:
         # idempotence survives the process that published dying).
         self._dedupe_keys: dict = {}
         self._dedupe_scanned: set = set()
+        # version -> source-batch watermark (immutable once published, so
+        # plain memoization; None is cached for unstamped versions).
+        self._watermarks: dict = {}
 
     # -- introspection -----------------------------------------------------
     def versions(self) -> List[int]:
@@ -129,7 +134,8 @@ class ModelRegistry:
     # -- writes ------------------------------------------------------------
     def publish(self, stage: Any, version: Optional[int] = None,
                 dedupe_key: Optional[str] = None,
-                check_finite: bool = True) -> int:
+                check_finite: bool = True,
+                watermark: Optional[int] = None) -> int:
         """Save ``stage`` as a new version and repoint ``CURRENT`` at it.
 
         ``check_finite`` (default on) refuses a model whose learned
@@ -156,7 +162,15 @@ class ModelRegistry:
         fingerprint — see :class:`~flinkml_tpu.serving.publisher.
         SnapshotPublisher`), that version is returned and NOTHING is
         written — the resume-then-republish path cannot grow duplicate
-        versions."""
+        versions.
+
+        ``watermark`` stamps the version with its source-batch watermark
+        (a ``WATERMARK`` file that rides the same atomic rename as the
+        save) — the freshness currency :meth:`watermark_of` and the
+        pool's ``serving.<pool>.freshness`` gauge read. Stages that are
+        incremental deltas (``is_model_delta``) are counted separately
+        (``delta_publishes``) and resolved against their base chain at
+        :meth:`get` time."""
         if check_finite:
             # Outside the lock (pure read of the stage), before the seam:
             # a refused publish never counts as a fault-plan event.
@@ -204,6 +218,9 @@ class ModelRegistry:
                     # same atomic rename as the version itself.
                     with open(os.path.join(tmp, PUBLISH_TAG_FILE), "w") as f:
                         json.dump({"dedupeKey": dedupe_key}, f)
+                if watermark is not None:
+                    with open(os.path.join(tmp, WATERMARK_FILE), "w") as f:
+                        json.dump({"watermark": int(watermark)}, f)
                 # POSIX rename onto an existing EMPTY directory: the
                 # claimed placeholder becomes the complete save in one
                 # atomic step.
@@ -218,8 +235,14 @@ class ModelRegistry:
             if dedupe_key is not None:
                 self._dedupe_keys[v] = dedupe_key
                 self._dedupe_scanned.add(v)
+            if watermark is not None:
+                self._watermarks[v] = int(watermark)
             self._set_current(v)
             self._metrics.counter("publishes")
+            if getattr(stage, "is_model_delta", False):
+                self._metrics.counter("delta_publishes")
+            else:
+                self._metrics.counter("full_publishes")
             self._metrics.gauge("current_version", v)
             _log.info("published version %d to %s%s", v, self.root,
                       f" (key {dedupe_key!r})" if dedupe_key else "")
@@ -249,7 +272,26 @@ class ModelRegistry:
         Loading goes through the standard reflective stage loader, so
         every model with a recorded content fingerprint is verified
         (:class:`~flinkml_tpu.io.read_write.ModelIntegrityError` on
-        mismatch)."""
+        mismatch).
+
+        When the version is an incremental delta, the chain is resolved
+        here: walk ``base_version`` links down to a full snapshot, then
+        apply upward verifying every fingerprint against the state it
+        chains over — so the returned stage is always a complete,
+        servable model, bitwise equal to a full-snapshot publish of the
+        same trainer state. A pruned base or any fingerprint mismatch is
+        a :class:`~flinkml_tpu.serving.errors.DeltaChainError` naming
+        the broken link — never a silently wrong model."""
+        v, stage = self._load_raw(version)
+        if getattr(stage, "is_model_delta", False):
+            stage = self._resolve_delta(v, stage)
+            self._metrics.counter("delta_loads")
+        self._metrics.counter("loads")
+        return v, stage
+
+    def _load_raw(self, version: Optional[int] = None) -> Tuple[int, Any]:
+        """One version's stage exactly as persisted (deltas stay
+        deltas)."""
         with self._lock:
             v = int(version) if version is not None else self.current_version()
             if v is None:
@@ -263,9 +305,104 @@ class ModelRegistry:
                     f"version {v} not in registry {self.root} "
                     f"(has {self.versions()})"
                 )
-        stage = read_write.load_stage(path)
-        self._metrics.counter("loads")
-        return v, stage
+        return v, read_write.load_stage(path)
+
+    def _resolve_delta(self, version: int, delta: Any) -> Any:
+        """Walk ``version``'s chain down to its full-snapshot base and
+        apply every delta back up, fingerprint-verified at each link."""
+        chain = [(version, delta)]  # target-first
+        v, stage = version, delta
+        while getattr(stage, "is_model_delta", False):
+            base_v = stage.base_version
+            try:
+                base_v, base_stage = self._load_raw(base_v)
+            except ModelVersionNotFoundError:
+                raise DeltaChainError(
+                    f"delta version {v} chains to base version {base_v}, "
+                    f"which is not in registry {self.root} (pruned?); "
+                    f"the chain for version {version} cannot be resolved"
+                ) from None
+            v, stage = base_v, base_stage
+            if getattr(stage, "is_model_delta", False):
+                chain.append((v, stage))
+        base_version, model = v, stage
+        if not (hasattr(model, "apply_delta")
+                and hasattr(model, "delta_state")):
+            raise DeltaChainError(
+                f"delta chain for version {version} bottoms out at "
+                f"version {base_version} ({type(model).__name__}), which "
+                "is not delta-capable (no delta_state/apply_delta)"
+            )
+        fp = read_write.content_fingerprint(model.delta_state())
+        prev_v = base_version
+        for dv, d in reversed(chain):
+            if d.base_fingerprint != fp:
+                raise DeltaChainError(
+                    f"delta version {dv} -> base {prev_v}: base "
+                    f"fingerprint mismatch (delta expects "
+                    f"{d.base_fingerprint[:12]}…, base state is "
+                    f"{fp[:12]}…) — the chain for version {version} is "
+                    "broken at this link"
+                )
+            model = model.apply_delta(d)
+            fp = read_write.content_fingerprint(model.delta_state())
+            if d.result_fingerprint != fp:
+                raise DeltaChainError(
+                    f"delta version {dv} applied on base {prev_v} does "
+                    f"not reproduce its recorded result fingerprint "
+                    f"({d.result_fingerprint[:12]}… != {fp[:12]}…) — the "
+                    f"chain for version {version} is broken at this link"
+                )
+            prev_v = dv
+        self._metrics.gauge("delta_chain_depth", len(chain))
+        return model
+
+    def delta_chain(self, base_version: int,
+                    target_version: int) -> Optional[List[Any]]:
+        """The ordered deltas that carry ``base_version`` to
+        ``target_version``, or None when the target does not chain back
+        to exactly that base (it IS the base, is a full snapshot, or
+        chains past/around it). The serving engine's fast-swap probe:
+        a non-None result means the active model can be patched in place
+        with no full load."""
+        try:
+            v, stage = self._load_raw(target_version)
+        except ModelVersionNotFoundError:
+            return None
+        chain: List[Any] = []
+        while getattr(stage, "is_model_delta", False):
+            chain.append(stage)
+            base_v = stage.base_version
+            if base_v == int(base_version):
+                chain.reverse()
+                return chain
+            try:
+                v, stage = self._load_raw(base_v)
+            except ModelVersionNotFoundError:
+                return None
+        return None
+
+    # -- freshness ---------------------------------------------------------
+    def watermark_of(self, version: int) -> Optional[int]:
+        """The source-batch watermark ``version`` was published with, or
+        None for unstamped versions."""
+        v = int(version)
+        if v not in self._watermarks:
+            try:
+                with open(os.path.join(self.path_of(v),
+                                       WATERMARK_FILE)) as f:
+                    self._watermarks[v] = int(json.load(f)["watermark"])
+            except (OSError, ValueError, KeyError):
+                self._watermarks[v] = None
+        return self._watermarks[v]
+
+    def latest_watermark(self) -> Optional[int]:
+        """The newest stamped watermark across all versions — the
+        trainer-side edge the pool's freshness lag is measured
+        against."""
+        marks = [self.watermark_of(v) for v in self.versions()]
+        marks = [m for m in marks if m is not None]
+        return max(marks) if marks else None
 
     # -- change notification -----------------------------------------------
     def add_listener(self, callback: Callable[[int], None]) -> None:

@@ -1,0 +1,233 @@
+"""The train-to-serve freshness loop, live (ISSUE 18 acceptance).
+
+An unbounded hashed click stream trains a :class:`StreamingHashedFMTrainer`
+whose row deltas reach a 2-replica pool through the registry:
+
+- **delta-only hot path** — after the base version, every publish is a
+  :class:`ModelDelta` and every replica swap is an in-place row patch
+  (``delta_swaps``); ``full_loads`` stays at exactly the one start-up
+  install per replica.
+- **bounded staleness, deterministically** — watermarks are batch
+  counts, so every lag assertion is an exact integer; no wall-clock
+  sleeps anywhere in the accounting tests.
+- **chaos** — a ReplicaDown mid-patch loses zero requests (failover),
+  and the revived replica converges to the current version.
+- **bitwise parity** — predictions served off the delta chain equal a
+  full-snapshot publish of the same trainer state, bit for bit.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+import flinkml_tpu.faults as faults
+from flinkml_tpu.features import (
+    DeltaPublisher,
+    StreamingHashedFMTrainer,
+    hash_buckets,
+)
+from flinkml_tpu.serving.engine import ServingConfig
+from flinkml_tpu.serving.pool import ReplicaPool
+from flinkml_tpu.serving.registry import ModelRegistry
+from flinkml_tpu.table import Table
+from flinkml_tpu.utils.metrics import metrics
+
+_B, _L = 128, 3          # hash space / ids per row
+_SEED = 11
+
+
+def _stream(rng, n=16):
+    """One synthetic click batch: raw int keys → hashed id rows."""
+    keys = rng.integers(0, 5000, size=(n, _L))
+    ids = hash_buckets(keys.reshape(-1), seed=_SEED,
+                       num_buckets=_B).reshape(n, _L)
+    labels = (keys.sum(axis=1) % 2).astype(np.float32)
+    return ids, labels
+
+
+def _loop(tmp_path, name, n_replicas=2, every_n=1, max_depth=32):
+    rng = np.random.default_rng(3)
+    trainer = StreamingHashedFMTrainer(
+        num_buckets=_B, factor_size=4, hash_seed=_SEED, learning_rate=0.1)
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    publisher = DeltaPublisher(registry, trainer, every_n_batches=every_n,
+                               max_depth=max_depth)
+    ids, labels = _stream(rng)
+    trainer.fit_batch(ids, labels)
+    publisher.publish_now()              # the base snapshot
+    example = Table({"hashed_ids": np.zeros((2, _L), np.int32)})
+    pool = ReplicaPool(
+        registry, example, config=ServingConfig(max_batch_rows=64,
+                                                max_wait_ms=1.0),
+        n_replicas=n_replicas, name=name,
+    ).start().follow_registry()
+    return rng, trainer, registry, publisher, pool
+
+
+def test_live_freshness_scenario_delta_only_hot_path(tmp_path):
+    rng, tr, reg, pub, pool = _loop(tmp_path, "fresh_pool")
+    try:
+        # serving.registry is one process-global metrics group — count
+        # from here (base snapshot already published) so the assertions
+        # hold in any suite order.
+        reg_base = dict(reg._metrics.snapshot()["counters"])
+        n_publishes = 8
+        for _ in range(n_publishes):
+            ids, labels = _stream(rng)
+            tr.fit_batch(ids, labels)
+            assert pub.maybe_publish() is not None
+        full = tr.make_model()           # the same state, as a snapshot
+        current = reg.current_version()
+
+        # Every replica rolled to current through row patches alone.
+        assert pool.versions() == {"r0": current, "r1": current}
+        for r in pool.replicas:
+            counters = r.engine._metrics.snapshot()["counters"]
+            assert counters["full_loads"] == 1, (r.name, counters)
+            assert counters["delta_swaps"] == n_publishes, (r.name, counters)
+        reg_counters = reg._metrics.snapshot()["counters"]
+        assert (reg_counters.get("delta_publishes", 0)
+                - reg_base.get("delta_publishes", 0)) == n_publishes
+        # Zero full republishes after the base version.
+        assert (reg_counters.get("full_publishes", 0)
+                - reg_base.get("full_publishes", 0)) == 0
+
+        # Freshness: fully caught up, exactly.
+        assert pool.freshness_lag(tr.watermark) == 0
+
+        # Bitwise parity: pool predictions (served off the patched
+        # clones) == the full snapshot's transform of the same state.
+        ids, _ = _stream(rng, n=8)
+        resp = pool.predict({"hashed_ids": ids})
+        assert resp.version == current
+        (want,) = full.transform(Table({"hashed_ids": ids}))
+        np.testing.assert_array_equal(
+            resp.column("prediction"),
+            np.asarray(want.column("prediction")))
+        np.testing.assert_array_equal(
+            resp.column("rawPrediction"),
+            np.asarray(want.column("rawPrediction")))
+    finally:
+        pool.stop()
+
+
+def test_staleness_accounting_is_deterministic(tmp_path):
+    """The lag gauge is exact integer batch math — pinned without a
+    single sleep. Bound contract: with publish cadence ``every_n`` and a
+    synchronous roll, lag right after ``maybe_publish`` is always 0 and
+    never exceeds ``every_n - 1`` between publishes."""
+    every_n = 3
+    rng, tr, reg, pub, pool = _loop(tmp_path, "stale_pool",
+                                    every_n=every_n)
+    try:
+        for step in range(1, 8):
+            ids, labels = _stream(rng)
+            tr.fit_batch(ids, labels)
+            published = pub.maybe_publish()
+            lag = pool.freshness_lag(tr.watermark)
+            if published is not None:
+                assert lag == 0, step
+            else:
+                assert 0 < lag <= every_n - 1, (step, lag)
+        snap = metrics.group("serving.stale_pool.freshness").snapshot()
+        assert snap["gauges"]["lag_batches"] == lag
+        assert snap["gauges"]["latest_watermark"] == tr.watermark
+        # The registry-side edge (no live trainer handle) is the newest
+        # stamped publish.
+        assert pool.freshness_lag() == 0
+    finally:
+        pool.stop()
+
+
+def test_chaos_kill_mid_patch_loses_zero_requests(tmp_path):
+    """A replica dies while deltas roll across the pool: every client
+    request still succeeds (failover), the survivor keeps taking row
+    patches, and the revived replica converges to the current version."""
+    rng, tr, reg, pub, pool = _loop(tmp_path, "chaos_fresh")
+    errors, served = [], [0]
+    stop = threading.Event()
+
+    def client(tid):
+        crng = np.random.default_rng(100 + tid)
+        try:
+            while not stop.is_set():
+                n = int(crng.integers(1, 6))
+                keys = crng.integers(0, 5000, size=(n, _L))
+                ids = hash_buckets(keys.reshape(-1), seed=_SEED,
+                                   num_buckets=_B).reshape(n, _L)
+                resp = pool.predict({"hashed_ids": ids})
+                assert resp.columns["prediction"].shape == (n,)
+                served[0] += 1
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    try:
+        with faults.armed(faults.FaultPlan(
+                faults.ReplicaDown("r0", at_batch=2))) as plan:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            # Keep publishing deltas while traffic flows and r0 dies.
+            for _ in range(6):
+                ids, labels = _stream(rng)
+                tr.fit_batch(ids, labels)
+                pub.maybe_publish()
+            # Drive requests until the kill has landed, then stop.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (pool.stats()["per_replica"]["r0"]["state"]
+                        == "unhealthy"):
+                    break
+                time.sleep(0.05)
+            served_at_kill = served[0]
+            time.sleep(0.3)  # pool must keep serving after the kill
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        assert not errors, errors[:3]
+        assert served[0] > served_at_kill, "pool stopped serving after kill"
+        st = pool.stats()
+        assert st["per_replica"]["r0"]["state"] == "unhealthy"
+        assert any(site == "serving.replica" for site, _, _ in plan.log)
+        current = reg.current_version()
+        assert pool.versions()["r1"] == current
+
+        # More deltas while degraded: the survivor keeps patching.
+        ids, labels = _stream(rng)
+        tr.fit_batch(ids, labels)
+        pub.maybe_publish()
+        current = reg.current_version()
+        assert pool.versions()["r1"] == current
+
+        # The revived replica converges to the current version.
+        pool.revive("r0")
+        assert pool.versions() == {"r0": current, "r1": current}
+        assert pool.freshness_lag(tr.watermark) == 0
+    finally:
+        stop.set()
+        pool.stop()
+
+
+def test_engine_falls_back_to_full_load_off_chain(tmp_path):
+    """A replica that cannot be reached by the delta chain (its active
+    version was compacted over) falls back to a verified full load —
+    correctness never depends on the fast path being available."""
+    rng, tr, reg, pub, pool = _loop(tmp_path, "fallback_pool",
+                                    n_replicas=1, max_depth=2)
+    try:
+        # depth cap 2: publishes go d1, d2, FULL, ... — the full
+        # snapshot at depth cap breaks the patch chain on purpose.
+        for _ in range(3):
+            ids, labels = _stream(rng)
+            tr.fit_batch(ids, labels)
+            pub.publish_now()
+        (replica,) = pool.replicas
+        counters = replica.engine._metrics.snapshot()["counters"]
+        assert counters["delta_swaps"] == 2
+        assert counters["full_loads"] == 2  # start + the compacted swap
+        assert replica.engine.active_version == reg.current_version()
+    finally:
+        pool.stop()

@@ -1563,6 +1563,152 @@ print('memory smoke: calibration ratios', ratios,
 stage "memory smoke (FML70x gate + int8 reroute + calibration band)" \
     memory_smoke
 
+# Freshness smoke, device-free (ISSUE 18 acceptance): a hashed-id FM
+# trained from an unbounded stream reaches a 2-replica pool via row
+# deltas only — zero full republishes after the base version, staleness
+# lag pinned at 0 after every synchronous roll (batch-count watermarks,
+# no wall clock), delta-published predictions bitwise-equal to a full
+# snapshot of the same state, and a mid-patch ReplicaDown loses zero
+# client requests. Then: the seeded FML505 fixture must be flagged
+# (hash/vocab width gate has teeth) and the feature_freshness_cpu bench
+# stage must emit rows/s, the delta-vs-snapshot ratio, and the
+# time-to-freshness distribution.
+freshness_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 420 python - <<'EOF' || return 1
+import tempfile, threading, time
+
+import numpy as np
+
+from flinkml_tpu import faults
+from flinkml_tpu.features import (
+    DeltaPublisher, StreamingHashedFMTrainer, hash_buckets,
+)
+from flinkml_tpu.serving import ModelRegistry, ReplicaPool, ServingConfig
+from flinkml_tpu.table import Table
+
+B, L, SEED = 256, 3, 5
+rng = np.random.default_rng(1)
+
+def batch(n=32):
+    keys = rng.integers(0, 10_000, size=(n, L))
+    ids = hash_buckets(keys.reshape(-1), seed=SEED,
+                       num_buckets=B).reshape(n, L)
+    return ids, (keys.sum(axis=1) % 2).astype(np.float32)
+
+tr = StreamingHashedFMTrainer(num_buckets=B, factor_size=4,
+                              hash_seed=SEED, learning_rate=0.1)
+with tempfile.TemporaryDirectory() as td:
+    reg = ModelRegistry(td)
+    pub = DeltaPublisher(reg, tr, every_n_batches=1, max_depth=64,
+                         name="ci_freshness")
+    ids, labels = batch()
+    tr.fit_batch(ids, labels)
+    pub.publish_now()  # the base snapshot
+    pool = ReplicaPool(
+        reg, Table({"hashed_ids": np.zeros((2, L), np.int32)}),
+        config=ServingConfig(max_batch_rows=64, max_wait_ms=1.0),
+        n_replicas=2, name="ci_freshness",
+    ).start().follow_registry()
+    try:
+        N = 12
+        for _ in range(N):
+            ids, labels = batch()
+            tr.fit_batch(ids, labels)
+            assert pub.maybe_publish() is not None
+            lag = pool.freshness_lag(tr.watermark)
+            assert lag == 0, lag  # bound held after every roll
+        cur = reg.current_version()
+        assert pool.versions() == {"r0": cur, "r1": cur}
+        for r in pool.replicas:  # zero full republishes after the base
+            c = r.engine._metrics.snapshot()["counters"]
+            assert c["full_loads"] == 1 and c["delta_swaps"] == N, (r.name, c)
+        rc = reg._metrics.snapshot()["counters"]
+        assert rc["full_publishes"] == 1 and rc["delta_publishes"] == N, rc
+        # Delta-chain predictions bitwise == a full snapshot's.
+        full = tr.make_model()
+        ids, _ = batch(8)
+        resp = pool.predict({"hashed_ids": ids})
+        (want,) = full.transform(Table({"hashed_ids": ids}))
+        np.testing.assert_array_equal(
+            resp.column("prediction"),
+            np.asarray(want.column("prediction")))
+        # Chaos variant: r0 dies mid-patch, clients lose zero requests.
+        errors, stop = [], threading.Event()
+
+        def client(tid):
+            crng = np.random.default_rng(50 + tid)
+            try:
+                while not stop.is_set():
+                    keys = crng.integers(0, 10_000, size=(4, L))
+                    cid = hash_buckets(keys.reshape(-1), seed=SEED,
+                                       num_buckets=B).reshape(4, L)
+                    out = pool.predict({"hashed_ids": cid})
+                    assert out.columns["prediction"].shape == (4,)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        with faults.armed(faults.FaultPlan(
+                faults.ReplicaDown("r0", at_batch=2))):
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for _ in range(4):
+                ids, labels = batch()
+                tr.fit_batch(ids, labels)
+                pub.maybe_publish()
+            deadline = time.monotonic() + 60
+            while (time.monotonic() < deadline and
+                   pool.stats()["per_replica"]["r0"]["state"]
+                   != "unhealthy"):
+                time.sleep(0.05)
+            time.sleep(0.3)  # must keep serving after the kill
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        assert not errors, errors[:3]
+        assert pool.stats()["per_replica"]["r0"]["state"] == "unhealthy"
+        cur = reg.current_version()
+        assert pool.versions()["r1"] == cur  # survivor kept patching
+        pool.revive("r0")
+        assert pool.versions() == {"r0": cur, "r1": cur}
+        assert pool.freshness_lag(tr.watermark) == 0
+    finally:
+        pool.stop()
+print("freshness loop: %d delta publishes, zero full republishes after "
+      "base; lag 0 held; chaos kill lost zero requests" % N)
+EOF
+    # The seeded FML505 fixture must be flagged (the hash/vocab mismatch
+    # gate has teeth) — the dir-walk fixture gate covers it too; this is
+    # the named assert.
+    if env JAX_PLATFORMS=cpu python -m flinkml_tpu.analysis \
+        tests/analysis_fixtures/bad_hash_fml505_bucket_vocab_mismatch.features.json \
+        --no-selfcheck --fail-on-findings >/dev/null 2>&1; then
+        echo "FML505 fixture was NOT flagged"
+        return 1
+    fi
+    local out
+    out=$(_FLINKML_BENCH_INNER=feature_freshness_cpu timeout 420 \
+        python bench.py) || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+assert rec['full_publishes'] == 1 and rec['delta_publishes'] >= 16, rec
+assert 0 < rec['delta_ratio'] < 0.5, rec
+assert rec['freshness_lag_batches'] == 0, rec
+assert rec['time_to_freshness_ms_p99'] >= rec['time_to_freshness_ms_p50'] > 0, rec
+print('freshness smoke: train rows/s', rec['train_rows_per_sec'],
+      'delta ratio', rec['delta_ratio'],
+      'ttf p50/p99 ms', rec['time_to_freshness_ms_p50'],
+      rec['time_to_freshness_ms_p99'],
+      '(device stage queued in bench stage_order)')
+"
+}
+stage "freshness smoke (hashed stream -> delta-only pool + chaos kill)" \
+    freshness_smoke
+
 example_smoke() {
     local ex
     for ex in parallel_primitives checkpoint_resume sparse_high_cardinality; do
