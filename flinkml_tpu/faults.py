@@ -413,6 +413,131 @@ class ReplicaDown(Fault):
         return f"ReplicaDown({self.engine}, at_batch={self.at_batch})"
 
 
+class StallDispatch(Fault):
+    """The GRAY failure: the replica is alive but frozen. From the
+    ``at_batch``-th batch this replica dispatches (1-based, per fault
+    instance) onward, every batch SLEEPS ``delay_s`` before serving
+    normally — no error is ever raised, so nothing binary (error
+    thresholds, retirement) can see it; only latency can. With
+    ``for_batches=None`` the stall never clears; a finite value stalls
+    exactly that many batches and then recovers — the
+    quarantine→canary→rejoin lifecycle's test fixture. ``engine``
+    matches like :class:`ReplicaDown` (exact name or ``/<engine>``
+    suffix)."""
+
+    site = "serving.replica"
+
+    def __init__(self, engine: str, at_batch: int = 1,
+                 delay_s: float = 0.25,
+                 for_batches: Optional[int] = None):
+        self.engine = str(engine)
+        self.at_batch = int(at_batch)
+        self.delay_s = float(delay_s)
+        self.for_batches = None if for_batches is None else int(for_batches)
+        self._seen = 0
+        self._stalled = 0
+        self.fired = False
+
+    def _matches(self, name: str) -> bool:
+        return name == self.engine or name.endswith(f"/{self.engine}")
+
+    def should_fire(self, ctx):
+        if not self._matches(str(ctx.get("engine", ""))):
+            return False
+        self._seen += 1
+        if self._seen < self.at_batch:
+            return False
+        if self.for_batches is not None and self._stalled >= self.for_batches:
+            return False  # the stall cleared: back to normal service
+        return True
+
+    def apply(self, ctx):
+        self.fired = True
+        self._stalled += 1
+        time.sleep(self.delay_s)
+
+    def describe(self):
+        span = ("forever" if self.for_batches is None
+                else f"for {self.for_batches} batches")
+        return (f"StallDispatch({self.engine}, at_batch={self.at_batch}, "
+                f"delay_s={self.delay_s}, {span})")
+
+
+class JitterDispatch(Fault):
+    """Intermittent slowness: each batch this replica dispatches sleeps
+    ``delay_s`` with probability ``p`` — the flapping gray failure that
+    a naive one-strike quarantine would thrash on. Deterministic: the
+    draw sequence derives from ``seed`` alone, so a JSON-committed repro
+    (:func:`fault_to_spec`) replays the exact same stall pattern."""
+
+    site = "serving.replica"
+
+    def __init__(self, engine: str, p: float = 0.2, delay_s: float = 0.1,
+                 seed: int = 0):
+        self.engine = str(engine)
+        self.p = float(p)
+        self.delay_s = float(delay_s)
+        self.seed = int(seed)
+        import numpy as np
+
+        self._rng = np.random.default_rng(self.seed)
+        self.fired = False
+
+    def _matches(self, name: str) -> bool:
+        return name == self.engine or name.endswith(f"/{self.engine}")
+
+    def should_fire(self, ctx):
+        if not self._matches(str(ctx.get("engine", ""))):
+            return False
+        return bool(self._rng.random() < self.p)
+
+    def apply(self, ctx):
+        self.fired = True
+        time.sleep(self.delay_s)
+
+    def describe(self):
+        return (f"JitterDispatch({self.engine}, p={self.p}, "
+                f"delay_s={self.delay_s}, seed={self.seed})")
+
+
+class SlowRamp(Fault):
+    """Gradual degradation: from ``at_batch`` onward each batch this
+    replica dispatches sleeps ``step_s`` MORE than the one before,
+    capped at ``max_s`` — the leaking-resource / thermal-throttle shape,
+    which defeats any fixed-threshold detector that only compares
+    against its own recent past (the MAD test compares against
+    SIBLINGS, so it still trips)."""
+
+    site = "serving.replica"
+
+    def __init__(self, engine: str, at_batch: int = 1,
+                 step_s: float = 0.02, max_s: float = 0.5):
+        self.engine = str(engine)
+        self.at_batch = int(at_batch)
+        self.step_s = float(step_s)
+        self.max_s = float(max_s)
+        self._seen = 0
+        self.fired = False
+
+    def _matches(self, name: str) -> bool:
+        return name == self.engine or name.endswith(f"/{self.engine}")
+
+    def should_fire(self, ctx):
+        if not self._matches(str(ctx.get("engine", ""))):
+            return False
+        self._seen += 1
+        return self._seen >= self.at_batch
+
+    def apply(self, ctx):
+        self.fired = True
+        ramp = (self._seen - self.at_batch + 1) * self.step_s
+        time.sleep(min(ramp, self.max_s))
+
+    def describe(self):
+        return (f"SlowRamp({self.engine}, at_batch={self.at_batch}, "
+                f"step_s={self.step_s}, max_s={self.max_s})")
+
+
 class FailRendezvous(Fault):
     """Raise :class:`FaultInjected` at the N-th ``rendezvous.rescale``
     seam event after arming (1-based) — the scripted failure of the
@@ -813,6 +938,10 @@ class FuzzPlan:
         horizon: the scenario's batch/epoch count — triggers are
             sampled in ``[1, horizon - 1]``.
         max_faults: most faults per schedule.
+        replicas: size of the serving pool the ``serving.replica``
+            sampler targets — drawn engine names are ``r0..r{n-1}``
+            (matched by suffix against the pool's ``<pool>/rK`` engine
+            names). Ignored unless that seam is in ``seams``.
     """
 
     DEFAULT_SEAMS = (
@@ -825,12 +954,14 @@ class FuzzPlan:
     )
 
     def __init__(self, seed: int, seams: Optional[Tuple[str, ...]] = None,
-                 budget: int = 25, horizon: int = 10, max_faults: int = 3):
+                 budget: int = 25, horizon: int = 10, max_faults: int = 3,
+                 replicas: int = 4):
         self.seed = int(seed)
         self.seams = tuple(seams) if seams is not None else self.DEFAULT_SEAMS
         self.budget = int(budget)
         self.horizon = int(horizon)
         self.max_faults = int(max_faults)
+        self.replicas = int(replicas)
         if self.horizon < 3:
             raise ValueError(f"horizon must be >= 3, got {self.horizon}")
         unknown = set(self.seams) - set(self._samplers())
@@ -874,6 +1005,26 @@ class FuzzPlan:
                 lambda rng: NaNGrad(epoch(rng)),
                 lambda rng: InfLoss(epoch(rng)),
                 lambda rng: PoisonBatch(int(rng.integers(0, h))),
+            ],
+            # Serving-pool gray failures: engine names drawn as bare
+            # "rK" match any pool's "<pool>/rK" replica by suffix.
+            "serving.replica": [
+                lambda rng: ReplicaDown(
+                    engine=f"r{int(rng.integers(0, self.replicas))}",
+                    at_batch=epoch(rng),
+                ),
+                lambda rng: StallDispatch(
+                    engine=f"r{int(rng.integers(0, self.replicas))}",
+                    at_batch=epoch(rng),
+                    delay_s=round(float(rng.uniform(0.05, 0.3)), 3),
+                    for_batches=int(rng.integers(5, 40)),
+                ),
+                lambda rng: JitterDispatch(
+                    engine=f"r{int(rng.integers(0, self.replicas))}",
+                    p=round(float(rng.uniform(0.1, 0.5)), 3),
+                    delay_s=round(float(rng.uniform(0.02, 0.15)), 3),
+                    seed=int(rng.integers(0, 2**31)),
+                ),
             ],
         }
 

@@ -23,6 +23,13 @@ model rollout, and a horizontally scaled replica-pool front. The pieces:
   own metrics: hysteretic scale-up/-down (the autotune 1.10x
   decisive-win idiom), chaos replacement, compile-cache-warm scale-up
   replicas, and training slice-lease reclaim (FML304-audited).
+- :class:`GrayFailGuard` + :class:`GrayFailPolicy` — gray-failure
+  defense for the pool: per-dispatch deadlines with true abandonment,
+  hedged requests (first completion wins, loser cancelled at the
+  queue), MAD-based latency-outlier quarantine (the ``SLOW`` health
+  state, canary-probed rejoin, autoscaler-composed replacement), and a
+  brownout ladder shedding SLO classes in declared order under
+  pool-wide degradation. See ``docs/development/fault_tolerance.md``.
 - :class:`MultiModelPool` + :class:`SLOClass` — N registries over one
   pool with per-class deadline budgets and admission share caps
   (weighted admission: a batch job can never starve the interactive
@@ -49,9 +56,15 @@ from flinkml_tpu.serving.batcher import (
     ServingRequest,
 )
 from flinkml_tpu.serving.engine import (
+    PendingPrediction,
     ServingConfig,
     ServingEngine,
     ServingResponse,
+)
+from flinkml_tpu.serving.grayfail import (
+    GrayFailGuard,
+    GrayFailPolicy,
+    ReplicaQuarantinedError,
 )
 from flinkml_tpu.serving.errors import (
     DeltaChainError,
@@ -87,6 +100,8 @@ __all__ = [
     "ContinuousBatcher",
     "DeltaChainError",
     "EngineStoppedError",
+    "GrayFailGuard",
+    "GrayFailPolicy",
     "HealthPolicy",
     "INTERACTIVE",
     "MultiModelPool",
@@ -98,9 +113,11 @@ __all__ = [
     "ModelVersionNotFoundError",
     "PoolUnavailableError",
     "RegistryError",
+    "PendingPrediction",
     "Replica",
     "ReplicaHealth",
     "ReplicaPool",
+    "ReplicaQuarantinedError",
     "ReplicaState",
     "Router",
     "ServingConfig",

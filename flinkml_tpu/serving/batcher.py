@@ -95,6 +95,15 @@ class ServingRequest:             # object, and columns hold numpy arrays
     #: the 'timeouts' counter twice. Guarded by ``_count_lock`` — use
     #: :meth:`claim_timeout_count`.
     timeout_counted: bool = False
+    #: True once the submitter stopped waiting on this request
+    #: (per-attempt deadline or a hedge race loss): any later batch
+    #: result is DISCARDED — the gray-failure abandonment contract. Set
+    #: only via :meth:`abandon`, under ``_count_lock``.
+    abandoned: bool = False
+    #: Optional shared event a router racing several attempts of one
+    #: logical request waits on; set on EVERY terminal transition
+    #: (complete/fail/abandon) so the racer wakes on the first edge.
+    race: Optional[threading.Event] = None
     _count_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
@@ -108,16 +117,48 @@ class ServingRequest:             # object, and columns hold numpy arrays
             self.timeout_counted = True
             return True
 
-    def complete(self, result: Dict[str, np.ndarray],
-                 version: Optional[int], shed: bool = False) -> None:
-        self.result = result
-        self.version = version
-        self.shed = shed
+    def _terminal(self) -> None:
+        """Caller holds ``_count_lock`` and just decided the outcome."""
         self.done.set()
+        if self.race is not None:
+            self.race.set()
 
-    def fail(self, error: BaseException) -> None:
-        self.error = error
-        self.done.set()
+    def complete(self, result: Dict[str, np.ndarray],
+                 version: Optional[int], shed: bool = False) -> bool:
+        """First terminal transition wins (CAS): False when the request
+        already completed, failed, or was ABANDONED — the caller discards
+        the straggler result instead of publishing a duplicate or
+        mis-versioned response."""
+        with self._count_lock:
+            if self.done.is_set():
+                return False
+            self.result = result
+            self.version = version
+            self.shed = shed
+            self._terminal()
+            return True
+
+    def fail(self, error: BaseException) -> bool:
+        with self._count_lock:
+            if self.done.is_set():
+                return False
+            self.error = error
+            self._terminal()
+            return True
+
+    def abandon(self) -> bool:
+        """Stop waiting on this request (per-attempt deadline expiry or a
+        lost hedge race). CAS: True for exactly one abandoner, False when
+        a result/error already landed. After abandonment the request's
+        queued tail rows are released at the batcher's next sweep and any
+        in-flight straggler result is discarded by :meth:`complete`'s
+        CAS — a late straggler can never produce a duplicate response."""
+        with self._count_lock:
+            if self.done.is_set():
+                return False
+            self.abandoned = True
+            self._terminal()
+            return True
 
     # -- segment reassembly (dispatcher thread only) -----------------------
     def add_segment(self, start: int, columns: Dict[str, np.ndarray],
@@ -125,12 +166,15 @@ class ServingRequest:             # object, and columns hold numpy arrays
         """Record one served segment. Returns ``None`` while more rows
         are outstanding, the assembled ``(columns, version)`` response
         when all rows landed on one version (the caller completes the
-        request), or the string ``"mixed"`` when segments span model
+        request), the string ``"mixed"`` when segments span model
         versions — the caller must :meth:`reset_segments` and
         re-dispatch the whole request so the response stays
-        single-version."""
-        if self.done.is_set():  # expired/failed while a segment was in flight
-            return None
+        single-version — or the string ``"discarded"`` when the request
+        reached a terminal state (abandoned, expired, failed) while the
+        segment was in flight: the straggler rows are dropped here and
+        the caller counts the discard."""
+        if self.done.is_set():  # abandoned/expired/failed mid-flight
+            return "discarded"
         self.segments.append((start, columns, version, rows))
         served = sum(r for _, _, _, r in self.segments)
         if served < self.rows:
@@ -325,9 +369,19 @@ class AdaptiveMicroBatcher:
 
     def _drop_expired(self) -> List[ServingRequest]:
         now = time.monotonic()
-        expired = [
-            r for r in self._queue if r.deadline is not None and r.deadline <= now
-        ]
+        expired, dead = [], []
+        for r in self._queue:
+            if r.done.is_set():
+                # Abandoned (or failed elsewhere) while queued: cancel at
+                # the queue — its remaining rows stop occupying admission
+                # capacity NOW, not when it reaches the head. This is the
+                # hedge-loser cancellation path.
+                dead.append(r)
+            elif r.deadline is not None and r.deadline <= now:
+                expired.append(r)
+        for r in dead:
+            self._queue.remove(r)
+            self._queued_rows -= r.rows - r.dispatched_rows
         for r in expired:
             self._queue.remove(r)
             self._queued_rows -= r.rows - r.dispatched_rows

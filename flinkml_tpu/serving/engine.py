@@ -617,6 +617,42 @@ class ServingEngine:
             latency_ms=latency_ms, shed=req.shed,
         )
 
+    def submit(
+        self,
+        features: Union[Table, Mapping[str, Any]],
+        timeout_ms: Optional[float] = None,
+    ) -> "PendingPrediction":
+        """Asynchronous prediction: enqueue and return a
+        :class:`PendingPrediction` handle instead of blocking. The
+        router's gray-failure path is built on this — it lets a caller
+        stop WAITING on a dispatch (``handle.abandon()``) without being
+        able to stop the device work, which is exactly the per-attempt
+        deadline/hedging contract. Unlike :meth:`predict`, a full queue
+        always raises the typed :class:`ServingOverloadError` (never
+        sheds to the host path — shedding is a synchronous caller-thread
+        degradation; an async caller wants the queue or a refusal)."""
+        self._check_running()
+        columns, rows = self._normalize(features)
+        t0 = time.monotonic()
+        timeout = (
+            timeout_ms if timeout_ms is not None
+            else self.config.default_timeout_ms
+        )
+        deadline = t0 + timeout / 1000.0 if timeout is not None else None
+        req = ServingRequest(
+            columns=columns, rows=rows, enqueued_at=t0, deadline=deadline
+        )
+        self._metrics.counter("requests")
+        self._metrics.counter("rows", float(rows))
+        if not self._batcher.offer(req):
+            self._metrics.counter("rejected")
+            raise ServingOverloadError(
+                f"serving queue full ({self._batcher.max_queue_rows} rows); "
+                "retry with backoff"
+            )
+        self._metrics.gauge("queue_depth", self._batcher.queue_depth)
+        return PendingPrediction(self, req, t0)
+
     def _overloaded(self, req: ServingRequest, t0: float) -> ServingResponse:
         """Queue-full policy: shed to the per-stage host path in the
         caller's thread, or reject with the typed overload error. The
@@ -769,7 +805,15 @@ class ServingEngine:
                 seg.start, sliced, active.version, seg.rows
             )
             if outcome is None:
-                continue  # more segments to come (or already failed)
+                continue  # more segments to come
+            if outcome == "discarded":
+                # The submitter abandoned this request (per-attempt
+                # deadline or lost hedge race) — or it expired/failed —
+                # while the batch was in flight: the straggler rows are
+                # DISCARDED, never surfaced as a duplicate or (after a
+                # hot swap) mis-versioned response.
+                self._metrics.counter("discarded_results")
+                continue
             if outcome == "mixed":
                 # A hot swap landed between this request's segments: one
                 # response must carry ONE version, so discard the partials
@@ -792,7 +836,13 @@ class ServingEngine:
                 for req, _, _ in completions
             ))
         for req, result, version in completions:
-            req.complete(result, version)
+            if not req.complete(result, version):
+                # The submitter abandoned this request (per-attempt
+                # deadline or lost hedge race) while the batch was in
+                # flight: the straggler result is DISCARDED here — it
+                # must never surface as a duplicate or (after a hot
+                # swap) mis-versioned response.
+                self._metrics.counter("discarded_results")
 
     @contextlib.contextmanager
     def _dispatch_guard(self):
@@ -852,6 +902,60 @@ class ServingEngine:
         from flinkml_tpu.utils.metrics import default_registry
 
         return default_registry().render_text()
+
+
+class PendingPrediction:
+    """Handle to one request submitted via :meth:`ServingEngine.submit`.
+
+    The handle owns the CLIENT side of the request only: the caller can
+    wait on it, read the response once done, or ``abandon()`` it — which
+    stops the waiting, releases the request's queued rows at the
+    batcher's next sweep, and guarantees (via :meth:`ServingRequest
+    .complete`'s CAS) that a straggler batch result is discarded rather
+    than published. The device work itself is not interruptible; that is
+    the point — gray-failure defense is about not *waiting* on a stalled
+    replica, not about pretending its work can be cancelled."""
+
+    def __init__(self, engine: ServingEngine, request: ServingRequest,
+                 t0: float):
+        self.engine = engine
+        self.request = request
+        self.t0 = t0
+
+    @property
+    def done(self) -> bool:
+        return self.request.done.is_set()
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        return self.request.done.wait(timeout_s)
+
+    def abandon(self) -> bool:
+        """Stop waiting (CAS — see :meth:`ServingRequest.abandon`).
+        True for exactly one abandoner; False when a result or error
+        already landed."""
+        if self.request.abandon():
+            self.engine._metrics.counter("abandoned")
+            return True
+        return False
+
+    def response(self) -> ServingResponse:
+        """The completed response (call after :meth:`wait` returned
+        True); raises the request's typed error if it failed, and
+        :class:`ServingTimeoutError` if it was abandoned."""
+        req = self.request
+        if not req.done.is_set():
+            raise RuntimeError("pending prediction has not completed")
+        if req.abandoned:
+            raise ServingTimeoutError(
+                "request was abandoned by its submitter"
+            )
+        if req.error is not None:
+            raise req.error
+        return ServingResponse(
+            columns=req.result, version=req.version,
+            latency_ms=(time.monotonic() - self.t0) * 1000.0,
+            shed=req.shed,
+        )
 
 
 def _all_buckets_up_to(max_rows: int) -> List[int]:

@@ -11,6 +11,15 @@ states:
   queue, and the replica rejoins automatically once its backlog falls
   under ``drain_low_water`` of capacity (checked inline on every routing
   decision — no poller thread).
+- ``SLOW`` — quarantined by the gray-failure guard
+  (:class:`~flinkml_tpu.serving.grayfail.GrayFailGuard`): the replica is
+  alive and passing dispatches but a robust latency-outlier test (its
+  attempt p99 vs the healthy-sibling median, MAD-based) says it is
+  dragging pool tail latency. Removed from routing WITHOUT being
+  killed; the guard probes it with low-rate canary dispatches and
+  rejoins it (:meth:`ReplicaHealth.clear_slow`) on sustained recovery.
+  A SLOW replica does NOT count as healthy for the autoscaler, so
+  quarantine below ``min_replicas`` triggers replacement.
 - ``UNHEALTHY`` — failed hard (``max_consecutive_errors`` dispatch
   failures, e.g. the ``serving.replica`` fault seam killing it): the
   pool retires it (stop without drain — queued requests fail fast and
@@ -19,12 +28,22 @@ states:
 
 Transitions are CAS-style under one lock so racing router threads agree
 on exactly one retirement per replica.
+
+The ledger also keeps a per-ATTEMPT latency ring (:meth:`record_attempt`
+/ :meth:`attempt_p99`): successful attempt latencies plus CENSORED
+observations for abandoned attempts (recorded at the abandonment budget
+— a stalled dispatch whose true latency is unknown still counts as "at
+least this slow"). This ring, not the engine's completion window, is
+what the gray-failure outlier test reads: it sees what the ROUTER
+experienced, including the dispatches it gave up on.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
+import math
 import threading
 import time
 from typing import Optional
@@ -33,6 +52,7 @@ from typing import Optional
 class ReplicaState(enum.Enum):
     HEALTHY = "healthy"
     DRAINING = "draining"
+    SLOW = "slow"
     UNHEALTHY = "unhealthy"
 
 
@@ -68,6 +88,10 @@ class ReplicaHealth:
         #: EWMA of observed ms per served row (queue wait included);
         #: feeds the router's deadline-aware replica ordering.
         self.ewma_ms_per_row: Optional[float] = None
+        #: Per-attempt latency ring (successes + censored abandonments)
+        #: — the gray-failure outlier test's input. Guarded by ``_lock``.
+        self._attempt_ms: collections.deque = collections.deque(maxlen=256)
+        self._abandoned_attempts = 0
 
     # -- state -------------------------------------------------------------
     @property
@@ -114,6 +138,83 @@ class ReplicaHealth:
                     per_row if self.ewma_ms_per_row is None
                     else 0.8 * self.ewma_ms_per_row + 0.2 * per_row
                 )
+
+    # -- gray-failure signal (per-attempt latency ring) --------------------
+    def record_attempt(self, latency_ms: float, abandoned: bool = False
+                       ) -> None:
+        """Record what one ROUTER attempt experienced on this replica:
+        the attempt latency on success, or a censored observation (the
+        abandonment budget — "at least this slow") when the router gave
+        up waiting."""
+        with self._lock:
+            self._attempt_ms.append(float(latency_ms))
+            if abandoned:
+                self._abandoned_attempts += 1
+
+    def attempt_p99(self, min_samples: int = 1) -> Optional[float]:
+        """p99 over the attempt ring, or None below ``min_samples``."""
+        with self._lock:
+            n = len(self._attempt_ms)
+            if n < max(1, min_samples):
+                return None
+            ordered = sorted(self._attempt_ms)
+            return ordered[min(n - 1, math.ceil(0.99 * n) - 1)]
+
+    def recent_attempt_p99(self, window: int,
+                           min_samples: int = 1) -> Optional[float]:
+        """p99 over only the newest ``window`` ring entries (None below
+        ``min_samples`` total). The quarantine REJOIN decision reads
+        this: a recovered replica's stall-era canary observations would
+        otherwise hold the whole-ring p99 high until they aged out of
+        the ring — hundreds of probes after the stall actually cleared."""
+        with self._lock:
+            if len(self._attempt_ms) < max(1, min_samples):
+                return None
+            recent = sorted(list(self._attempt_ms)[-max(1, window):])
+            n = len(recent)
+            return recent[min(n - 1, math.ceil(0.99 * n) - 1)]
+
+    def mark_slow(self) -> bool:
+        """HEALTHY -> SLOW (CAS): quarantine a latency outlier without
+        killing it. True for exactly one caller; False from any other
+        state (a DRAINING/UNHEALTHY replica already has a stronger
+        verdict). Clears the attempt ring: the rejoin decision must read
+        only POST-quarantine (canary) evidence, not the stall that
+        caused the quarantine."""
+        with self._lock:
+            if self._state is not ReplicaState.HEALTHY:
+                return False
+            self._attempt_ms.clear()
+            self._transition(ReplicaState.SLOW)
+            return True
+
+    def clear_slow(self) -> bool:
+        """SLOW -> HEALTHY (CAS) on sustained canary recovery. Clears
+        the attempt ring: the stall-era censored observations would
+        otherwise immediately re-trip the outlier test on rejoin."""
+        with self._lock:
+            if self._state is not ReplicaState.SLOW:
+                return False
+            self._attempt_ms.clear()
+            self._abandoned_attempts = 0
+            self._transition(ReplicaState.HEALTHY)
+            return True
+
+    def force_unhealthy(self, error: BaseException) -> bool:
+        """Administrative retirement (the guard escalating a quarantine
+        that never recovered): any state except UNHEALTHY -> UNHEALTHY.
+        True for exactly one caller — the same exactly-one-retirement
+        CAS as :meth:`on_error`."""
+        with self._lock:
+            if self._state is ReplicaState.UNHEALTHY:
+                return False
+            self._last_error = error
+            self._transition(ReplicaState.UNHEALTHY)
+            return True
+
+    def state_age_s(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._state_since
 
     def on_overload(self) -> bool:
         """Record one queue-full refusal; True when this trip moved the
@@ -170,6 +271,8 @@ class ReplicaHealth:
             self._last_error = None
             self.outstanding_rows = 0
             self.ewma_ms_per_row = None
+            self._attempt_ms.clear()
+            self._abandoned_attempts = 0
             self._transition(ReplicaState.HEALTHY)
 
     def seed_ewma(self, ms_per_row: Optional[float]) -> None:
@@ -194,6 +297,8 @@ class ReplicaHealth:
                 "consecutive_errors": self._consecutive_errors,
                 "consecutive_overloads": self._consecutive_overloads,
                 "ewma_ms_per_row": self.ewma_ms_per_row,
+                "attempt_samples": len(self._attempt_ms),
+                "abandoned_attempts": self._abandoned_attempts,
                 "last_error": (
                     repr(self._last_error) if self._last_error else None
                 ),

@@ -150,11 +150,12 @@ class MultiModelPool(ReplicaPool):
         name: str = "mmpool",
         health_policy: Optional[HealthPolicy] = None,
         share_compiles: bool = True,
+        grayfail: Optional[Any] = None,
     ):
         self._init_core(
             None, example, config=config, output_cols=None,
             name=name, health_policy=health_policy,
-            share_compiles=share_compiles,
+            share_compiles=share_compiles, grayfail=grayfail,
         )
         if devices is None:
             import jax
@@ -208,6 +209,17 @@ class MultiModelPool(ReplicaPool):
         class's capacity share is fully in flight."""
         entry = self._entry(model_id)
         ledger = self._ledgers[entry.slo.name]
+        if entry.slo.name in self.brownout_shed_classes:
+            # Brownout ladder: under pool-WIDE degradation the guard
+            # sheds whole SLO classes in declared order (batch first)
+            # so the surviving tiers keep their latency — the typed
+            # refusal batch clients already know how to back off from.
+            ledger.metrics.counter("brownout_rejections")
+            raise SLOAdmissionError(
+                f"SLO class {entry.slo.name!r} is shed by the pool's "
+                "brownout ladder (pool-wide degradation); back off and "
+                "retry"
+            )
         rows = self._rows_of(features)
         budget = entry.slo.max_queue_share * self._total_capacity()
         if not ledger.try_admit(rows, budget):
@@ -217,11 +229,21 @@ class MultiModelPool(ReplicaPool):
                 f"{entry.slo.max_queue_share:.0%} share of pool capacity "
                 f"({budget:.0f} rows) in flight; back off and retry"
             )
+        # Untimed requests inherit a FINITE deadline: the class default,
+        # else the pool-level knob — a stalled replica must never hold a
+        # caller (and its admission share) forever.
         timeout = (
             timeout_ms if timeout_ms is not None else entry.slo.deadline_ms
         )
+        if timeout is None:
+            timeout = self._base_config.default_timeout_ms
         t0 = time.monotonic()
         try:
+            # The ledger releases in the finally: with per-attempt
+            # abandonment this is ABANDONMENT time, not straggler
+            # completion time — router.predict returns/raises the moment
+            # it stops waiting, never when a stalled replica finishes.
+            # Hedges are admitted once (here), never per attempt.
             resp = self._router.predict(
                 features, timeout_ms=timeout, model_id=model_id
             )

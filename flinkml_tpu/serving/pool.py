@@ -145,6 +145,7 @@ class ReplicaPool:
         name: str = "pool",
         health_policy: Optional[HealthPolicy] = None,
         share_compiles: bool = True,
+        grayfail: Optional["GrayFailPolicy"] = None,
     ):
         if devices is not None and meshes is not None:
             raise ValueError("pass devices= or meshes=, not both")
@@ -159,7 +160,7 @@ class ReplicaPool:
         self._init_core(
             source, example, config=config, output_cols=output_cols,
             name=name, health_policy=health_policy,
-            share_compiles=share_compiles,
+            share_compiles=share_compiles, grayfail=grayfail,
         )
         placements: List[Dict[str, Any]]
         if meshes is not None:
@@ -185,7 +186,8 @@ class ReplicaPool:
     def _init_core(self, source: Any, example: Table, *,
                    config: Optional[ServingConfig], output_cols,
                    name: str, health_policy: Optional[HealthPolicy],
-                   share_compiles: bool) -> None:
+                   share_compiles: bool,
+                   grayfail: Optional["GrayFailPolicy"] = None) -> None:
         """Everything a pool is besides its initial replica set — shared
         with :class:`~flinkml_tpu.serving.multiplex.MultiModelPool`,
         which starts EMPTY and grows replicas per registered model."""
@@ -209,13 +211,46 @@ class ReplicaPool:
         # Freshness lag gauges: trainer watermark vs what replicas serve
         # (batch counts, no wall clock) — see freshness_lag().
         self._freshness_metrics = metrics.group(f"serving.{name}.freshness")
+        from flinkml_tpu.serving.grayfail import GrayFailPolicy
+
+        # Gray-failure defense is on by default: the policy's floors
+        # keep it inert at healthy CPU-mesh latencies, so only genuine
+        # 10x+ stalls trigger abandonment/hedging/quarantine.
+        self.grayfail_policy = grayfail or GrayFailPolicy()
+        #: SLO classes currently shed by the brownout ladder (set by a
+        #: running GrayFailGuard; multi-model admission consults it).
+        self.brownout_shed_classes: frozenset = frozenset()
         self._router = Router(
             self.replicas, self._rows_of, self._metrics,
             on_retire=self._retire,
+            grayfail=self.grayfail_policy,
+            default_timeout_ms=self._base_config.default_timeout_ms,
+            pool_name=name,
         )
         self._roll_lock = threading.RLock()
         self._following = False
         self._started = False
+
+    def set_brownout(self, shed_classes: frozenset) -> None:
+        """Install the brownout ladder's current shed set (called by
+        :class:`~flinkml_tpu.serving.grayfail.GrayFailGuard`); admission
+        for these SLO classes is refused with the typed
+        :class:`~flinkml_tpu.serving.errors.SLOAdmissionError` until the
+        ladder de-escalates."""
+        self.brownout_shed_classes = frozenset(shed_classes)
+        if shed_classes:
+            _log.warning("pool %s: brownout shedding SLO classes %s",
+                         self.name, sorted(shed_classes))
+
+    def grayfail_guard(self, policy: Optional[Any] = None,
+                       interval_s: float = 0.25):
+        """Build (not start) a gray-failure guard bound to this pool —
+        convenience mirroring ``PoolAutoscaler(pool, cfg)``."""
+        from flinkml_tpu.serving.grayfail import GrayFailGuard
+
+        return GrayFailGuard(
+            self, policy or self.grayfail_policy, interval_s=interval_s
+        )
 
     def _make_replica(self, place: Dict[str, Any], source: Any,
                       model_id: Optional[str] = None) -> Replica:
@@ -583,5 +618,6 @@ class ReplicaPool:
             ]),
             "router": self._metrics.snapshot()["counters"],
             "freshness_lag": self.freshness_lag(),
+            "brownout_shed": sorted(self.brownout_shed_classes),
             "per_replica": per_replica,
         }

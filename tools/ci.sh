@@ -214,6 +214,158 @@ print('serving scaleout smoke: rows/s', rec['serving_scaleout_rows_per_sec'],
 }
 stage "serving scaleout smoke (4-replica chaos + bench)" serving_scaleout_smoke
 
+# Gray-failure smoke (ISSUE 19 acceptance): a device-free 4-replica pool
+# under closed-loop load has ONE replica stalled ~100x per batch through
+# the serving.replica seam (StallDispatch — alive, passing dispatches,
+# dragging tail latency). The GrayFailGuard must quarantine it (SLOW, out
+# of routing WITHOUT killing it), the pool must keep serving with zero
+# lost / zero mis-served responses, p99 must recover, and the replica
+# must rejoin via canary probes once the stall clears. The new fault
+# specs are fixture-gated (JSON round-trip + deterministic jitter), then
+# the serving_grayfail_cpu bench stage must emit the pinned keys.
+grayfail_smoke() {
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 420 python - <<'EOF' || return 1
+import threading, time
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from flinkml_tpu import faults
+from flinkml_tpu.models.scalers import StandardScaler
+from flinkml_tpu.recovery.fuzz import serving_grayfail_policy
+from flinkml_tpu.serving import ReplicaPool, ServingConfig
+from flinkml_tpu.serving.health import ReplicaState
+from flinkml_tpu.table import Table
+
+# -- fixture gate: the new fault specs must survive a JSON round-trip
+# and replay deterministically (they are what soak repros commit).
+for name in ("StallDispatch", "JitterDispatch", "SlowRamp"):
+    assert name in faults.fault_types(), name
+plan = faults.FaultPlan(
+    faults.StallDispatch("r1", at_batch=2, delay_s=0.05, for_batches=3),
+    faults.JitterDispatch("r0", p=0.5, delay_s=0.0, seed=7),
+    faults.SlowRamp("r2", at_batch=1, step_s=0.01, max_s=0.1),
+)
+clone = faults.plan_from_json(faults.plan_to_json(plan))
+assert [faults.fault_to_spec(f) for f in clone.faults] == \
+    [faults.fault_to_spec(f) for f in plan.faults]
+ctx = {"engine": "pool/r0"}
+assert [plan.faults[1].should_fire(ctx) for _ in range(32)] == \
+    [clone.faults[1].should_fire(ctx) for _ in range(32)], \
+    "jitter draws not deterministic in the committed seed"
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(256, 6))
+model = (StandardScaler().set(StandardScaler.INPUT_COL, "features")
+         .set(StandardScaler.OUTPUT_COL, "scaled")
+         .fit(Table({"features": x})))
+(ref,) = model.transform(Table({"features": x}))
+expected = np.asarray(ref.column("scaled"))
+
+pool = ReplicaPool(
+    model, Table({"features": x[:4]}),
+    config=ServingConfig(max_batch_rows=64, max_queue_rows=512,
+                         max_wait_ms=1.0, default_timeout_ms=15_000.0),
+    n_replicas=4, output_cols=("scaled",), name="ci_gf_pool",
+    grayfail=serving_grayfail_policy(),
+).start()
+guard = pool.grayfail_guard(interval_s=0.05).start()
+errors, served, stop = [], [0], threading.Event()
+lat, lat_lock = [], threading.Lock()
+
+def client(tid):
+    crng = np.random.default_rng(tid)
+    try:
+        while not stop.is_set():
+            lo = int(crng.integers(0, x.shape[0] - 4))
+            t0 = time.perf_counter()
+            resp = pool.predict({"features": x[lo:lo + 4]},
+                                timeout_ms=5000.0)
+            with lat_lock:
+                lat.append((time.perf_counter(),
+                            (time.perf_counter() - t0) * 1e3))
+            np.testing.assert_array_equal(
+                np.asarray(resp.columns["scaled"]), expected[lo:lo + 4])
+            served[0] += 1
+            time.sleep(0.002)
+    except BaseException as e:
+        errors.append(e)
+
+def p99_since(t0):
+    with lat_lock:
+        vals = sorted(ms for (tc, ms) in lat if tc >= t0)
+    return vals[min(len(vals) - 1, int(np.ceil(0.99 * len(vals))) - 1)]
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+for t in threads:
+    t.start()
+base_t0 = time.perf_counter()
+time.sleep(1.0)
+p99_base = p99_since(base_t0)
+
+# ~100x a CPU batch: the scaler batch is ~2 ms, the stall is 200 ms.
+with faults.armed(faults.FaultPlan(faults.StallDispatch("r1", delay_s=0.2))):
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if pool.replicas[1].health.state is ReplicaState.SLOW:
+            break
+        time.sleep(0.02)
+    assert pool.replicas[1].health.state is ReplicaState.SLOW, \
+        "guard never quarantined the stalled replica"
+    assert pool.stats()["healthy"] == 3
+    at_quarantine = served[0]
+    time.sleep(0.5)
+    assert served[0] > at_quarantine, "pool stopped serving post-quarantine"
+
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if pool.replicas[1].health.state is ReplicaState.HEALTHY:
+        break
+    time.sleep(0.02)
+rejoin_t = time.perf_counter()
+time.sleep(0.5)
+stop.set()
+for t in threads:
+    t.join(timeout=60)
+assert not errors, errors[:3]
+assert pool.replicas[1].health.state is ReplicaState.HEALTHY, \
+    "replica never rejoined after the stall cleared"
+gc = guard._metrics.snapshot()["counters"]
+assert gc.get("quarantines_total", 0) >= 1, gc
+assert gc.get("rejoins_total", 0) >= 1, gc
+p99_after = p99_since(rejoin_t)
+assert p99_after <= max(2.0 * p99_base, p99_base + 50.0), \
+    (p99_base, p99_after)
+guard.stop()
+pool.stop(drain=False, timeout=30.0)
+print(f"grayfail smoke: {served[0]} responses, stall r1 200ms -> SLOW in "
+      f"<30s, 0 lost / 0 mis-served, rejoined; p99 {p99_base:.1f}ms -> "
+      f"{p99_after:.1f}ms")
+EOF
+    local out
+    out=$(_FLINKML_BENCH_INNER=serving_grayfail_cpu timeout 420 python bench.py) \
+        || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rec = json.loads(sys.stdin.read())
+assert {'p99_during_stall_ms', 'time_to_quarantine_s', 'hedge_win_fraction',
+        'baseline_p99_ms', 'recovered_p99_ms',
+        'quarantines_total'} <= set(rec), rec
+assert rec['quarantines_total'] >= 1, rec
+assert rec['time_to_quarantine_s'] is not None, rec
+base, recov = rec['baseline_p99_ms'], rec['recovered_p99_ms']
+assert recov is not None and recov <= max(2.0 * base, base + 50.0), rec
+print('grayfail smoke bench: stall p99', rec['p99_during_stall_ms'], 'ms,',
+      'quarantine in', rec['time_to_quarantine_s'], 's,',
+      'hedge win fraction', rec['hedge_win_fraction'],
+      f\"(recovered {recov} vs baseline {base} ms)\")
+"
+}
+stage "gray-failure smoke (stall quarantine + bench)" grayfail_smoke
+
 # Chaos smoke (ISSUE 4 acceptance): kill an online LR fit under a
 # scripted fault plan, corrupt the newest committed snapshot, resume from
 # the prior valid one, and require the final model bit-identical to the
