@@ -896,6 +896,137 @@ def _inner_serving_scaleout_cpu() -> dict:
     )
 
 
+def _multiproc_pool_stage(n_workers=2, duration_s=3.0, n=50_000, d=32,
+                          max_batch_rows=128, max_wait_ms=2.0) -> dict:
+    """Stage: multi-process worker pool vs the SAME-size in-process
+    replica pool (ISSUE 20) — what "N replicas" buys when each replica
+    is a real process with its own GIL and XLA executor pool instead of
+    a thread behind the shared ones.
+
+    Same closed-loop offered load against both shapes; emits total and
+    per-worker rows/s, the worker-vs-thread speedup ratio (acceptance:
+    >= 1.5x at 2 workers on a >= 8-core host — ``host_cpu_count`` is
+    recorded so a starved box's ratio, where transport overhead buys no
+    parallelism, is never mistaken for the acceptance measurement), and
+    a bitwise parity check across the process boundary."""
+    import threading
+
+    from flinkml_tpu.cluster import ClusterPool
+    from flinkml_tpu.serving import ReplicaPool, ServingConfig
+    from flinkml_tpu.table import Table
+
+    n_clients = 2 * n_workers
+    model, x = _five_stage_model(n, d)
+    example = Table({"features": x[:4]})
+    cfg = ServingConfig(max_batch_rows=max_batch_rows,
+                        max_wait_ms=max_wait_ms)
+
+    def run_load(predict, label):
+        stop = threading.Event()
+        rows_served = [0] * n_clients
+        lat_ms = [[] for _ in range(n_clients)]
+        errors = []
+
+        def client(tid):
+            rng = np.random.default_rng(tid)
+            try:
+                while not stop.is_set():
+                    rows = int(rng.integers(1, 33))
+                    lo = int(rng.integers(0, n - rows))
+                    t0 = time.perf_counter()
+                    predict({"features": x[lo:lo + rows]})
+                    lat_ms[tid].append((time.perf_counter() - t0) * 1e3)
+                    rows_served[tid] += rows
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        _log(f"multiproc_pool[{label}]: {n_clients} closed-loop clients "
+             f"for {duration_s}s ...")
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        elapsed = time.perf_counter() - start
+        if errors:
+            raise errors[0]
+        lats = np.concatenate([np.asarray(l) for l in lat_ms if l])
+        p50, p99 = np.percentile(lats, [50, 99])
+        return {
+            "rows_per_sec": round(sum(rows_served) / elapsed, 1),
+            "p50_ms": round(float(p50), 3),
+            "p99_ms": round(float(p99), 3),
+        }
+
+    # 1. In-process replica pool: N engines behind ONE GIL.
+    tpool = ReplicaPool(
+        model, example, config=cfg, n_replicas=n_workers,
+        output_cols=("prediction",), name="mp_threads",
+    ).start()
+    ref_out = np.asarray(
+        tpool.predict({"features": x[:32]}).columns["prediction"]
+    )
+    threaded = run_load(tpool.predict, "threads")
+    tpool.stop()
+
+    # 2. Process pool: the same router over worker processes.
+    cpool = ClusterPool(
+        model, example, config=cfg, n_workers=n_workers,
+        output_cols=("prediction",), name="mp_workers",
+    ).start()
+    pool_out = np.asarray(
+        cpool.predict({"features": x[:32]}).columns["prediction"]
+    )
+    proc = run_load(cpool.predict, "workers")
+    cpool.stop()
+
+    import jax
+
+    return {
+        "multiproc_rows_per_sec": proc["rows_per_sec"],
+        "multiproc_rows_per_sec_per_worker": round(
+            proc["rows_per_sec"] / n_workers, 1
+        ),
+        "threaded_rows_per_sec": threaded["rows_per_sec"],
+        "worker_vs_thread_speedup": round(
+            proc["rows_per_sec"] / threaded["rows_per_sec"], 2
+        ) if threaded["rows_per_sec"] else None,
+        "multiproc_p50_ms": proc["p50_ms"],
+        "multiproc_p99_ms": proc["p99_ms"],
+        "threaded_p50_ms": threaded["p50_ms"],
+        "parity_bitwise": bool(np.array_equal(ref_out, pool_out)),
+        "workers": n_workers,
+        "clients": n_clients,
+        "devices": len(jax.devices()),
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
+def _inner_multiproc_pool() -> dict:
+    _setup_jax_cache()
+    return _multiproc_pool_stage()
+
+
+def _inner_multiproc_pool_cpu() -> dict:
+    """The worker-vs-thread measurement pinned to the host CPU backend —
+    tunnel-immune (CI's cluster smoke stage parses it). The speedup
+    ratio is only meaningful with >= 8 host cores (2 workers x their
+    executor pools + clients); the record carries host_cpu_count so a
+    1-core box's ratio is read as the transport-overhead floor it is."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _force_cpu()
+    _setup_jax_cache()
+    return _multiproc_pool_stage()
+
+
 def _serving_autoscale_stage(duration_s=2.0, n=20_000, d=32,
                              max_replicas=None) -> dict:
     """Stage: autoscaling multi-tenant serving — the ROADMAP item 3 /
@@ -2597,6 +2728,8 @@ _INNER_STAGES = {
     "serving_cpu": _inner_serving_cpu,
     "serving_scaleout": _inner_serving_scaleout,
     "serving_scaleout_cpu": _inner_serving_scaleout_cpu,
+    "multiproc_pool": _inner_multiproc_pool,
+    "multiproc_pool_cpu": _inner_multiproc_pool_cpu,
     "serving_autoscale": _inner_serving_autoscale,
     "serving_autoscale_cpu": _inner_serving_autoscale_cpu,
     "serving_grayfail": _inner_serving_grayfail,
@@ -2772,7 +2905,7 @@ def main():
         # (it runs while a watcher capture may hold the device).
         if inner in ("converge_cpu", "pipeline_fused_cpu", "serving_cpu",
                      "serving_scaleout_cpu", "serving_autoscale_cpu",
-                     "serving_grayfail_cpu",
+                     "serving_grayfail_cpu", "multiproc_pool_cpu",
                      "input_pipeline_cpu",
                      "sharded_train_cpu", "sharded_embedding_cpu",
                      "precision_cpu", "cold_start_cpu", "cold_start_child",
@@ -2852,6 +2985,7 @@ def main():
                    "sharded_embedding", "precision", "cold_start",
                    "autotune", "pallas", "sparse_hot_loops",
                    "serving_autoscale", "serving_grayfail",
+                   "multiproc_pool",
                    "feature_freshness", "gbt",
                    "als", "word2vec", "converge_sparse", "sparse"]
     results = {}

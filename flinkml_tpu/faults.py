@@ -54,6 +54,19 @@ site                      where it fires
                           raises from then on — the pool must retire it
                           and respread traffic; the chaos contract of
                           the ``serving scaleout`` CI stage)
+``cluster.worker``        inside a :mod:`flinkml_tpu.cluster` worker
+                          process: before every predict dispatch of the
+                          worker harness (context: ``worker``,
+                          ``request``), and — via the fuzz soak's
+                          seam-firing feed — at every trainer batch
+                          edge (context: ``epoch``). A scripted
+                          :class:`WorkerCrash` hard-exits the PROCESS
+                          (``os._exit``), so the failure crosses a real
+                          process boundary: the serving pool must see
+                          ``WorkerDiedError`` and fail over; the fuzz
+                          orchestrator must restart the trainer child
+                          and prove resume (no silent fresh start,
+                          ledger parity) across the kill
 ``train.step``            around every training step of
                           :func:`flinkml_tpu.iteration.iterate` and
                           ``sharding.apply.train_linear_plan`` — fired
@@ -538,6 +551,59 @@ class SlowRamp(Fault):
                 f"step_s={self.step_s}, max_s={self.max_s})")
 
 
+class WorkerCrash(Fault):
+    """Hard-exit the PROCESS at a ``cluster.worker`` seam event — the
+    real process death behind the chaos stages' "kill a worker
+    mid-traffic" and the fuzz soak's orchestrator-restart-across-a-
+    process-boundary invariants. Fires when the context value under
+    ``key`` (``"request"`` for the serving worker's predict counter,
+    ``"epoch"`` for the trainer feed's batch edge) reaches ``at``;
+    ``apply`` calls ``os._exit(exit_code)`` — no cleanup, no excuses,
+    exactly like an OOM kill or a preemption.
+
+    Cross-RESTART once-semantics need state that survives the process:
+    an in-memory ``fired`` flag dies with the worker, and a restarted
+    child re-arming the same plan would crash at the same trigger
+    forever. ``marker`` (a file path, JSON-serializable with the plan)
+    is that state: the fault touches it just before exiting and never
+    fires while it exists."""
+
+    site = "cluster.worker"
+
+    def __init__(self, at: int = 1, key: str = "request",
+                 exit_code: int = 23, marker: Optional[str] = None):
+        self.at = int(at)
+        self.key = str(key)
+        self.exit_code = int(exit_code)
+        self.marker = marker
+        self.fired = False
+
+    def should_fire(self, ctx):
+        value = ctx.get(self.key)
+        if value is None or int(value) < self.at:
+            return False
+        if self.marker is not None and os.path.exists(self.marker):
+            return False
+        return not self.fired
+
+    def apply(self, ctx):
+        self.fired = True
+        _log.warning(
+            "injected worker crash (%s=%s >= %d), exiting %d",
+            self.key, ctx.get(self.key), self.at, self.exit_code,
+        )
+        if self.marker is not None:
+            with open(self.marker, "w") as f:
+                f.write(f"{self.key}={ctx.get(self.key)}\n")
+                f.flush()
+                os.fsync(f.fileno())
+        os._exit(self.exit_code)
+
+    def describe(self):
+        return (f"WorkerCrash({self.key}>={self.at}, "
+                f"exit={self.exit_code})")
+
+
 class FailRendezvous(Fault):
     """Raise :class:`FaultInjected` at the N-th ``rendezvous.rescale``
     seam event after arming (1-based) — the scripted failure of the
@@ -942,6 +1008,11 @@ class FuzzPlan:
             sampler targets — drawn engine names are ``r0..r{n-1}``
             (matched by suffix against the pool's ``<pool>/rK`` engine
             names). Ignored unless that seam is in ``seams``.
+        marker_dir: directory for :class:`WorkerCrash` once-markers
+            (the ``cluster.worker`` sampler needs crash-once-across-
+            restarts semantics; each drawn crash gets its own marker
+            file under this directory). Required when that seam is in
+            ``seams``.
     """
 
     DEFAULT_SEAMS = (
@@ -955,13 +1026,20 @@ class FuzzPlan:
 
     def __init__(self, seed: int, seams: Optional[Tuple[str, ...]] = None,
                  budget: int = 25, horizon: int = 10, max_faults: int = 3,
-                 replicas: int = 4):
+                 replicas: int = 4, marker_dir: Optional[str] = None):
         self.seed = int(seed)
         self.seams = tuple(seams) if seams is not None else self.DEFAULT_SEAMS
         self.budget = int(budget)
         self.horizon = int(horizon)
         self.max_faults = int(max_faults)
         self.replicas = int(replicas)
+        self.marker_dir = marker_dir
+        if "cluster.worker" in self.seams and not marker_dir:
+            raise ValueError(
+                "the cluster.worker seam samples WorkerCrash faults, "
+                "which need marker_dir for crash-once-across-restarts "
+                "semantics"
+            )
         if self.horizon < 3:
             raise ValueError(f"horizon must be >= 3, got {self.horizon}")
         unknown = set(self.seams) - set(self._samplers())
@@ -1005,6 +1083,20 @@ class FuzzPlan:
                 lambda rng: NaNGrad(epoch(rng)),
                 lambda rng: InfLoss(epoch(rng)),
                 lambda rng: PoisonBatch(int(rng.integers(0, h))),
+            ],
+            # Real process deaths: each drawn crash owns a distinct
+            # marker file so it fires once across orchestrator
+            # restarts (the schedule index keys the directory; the
+            # per-draw suffix keys multiple crashes in one schedule).
+            "cluster.worker": [
+                lambda rng: WorkerCrash(
+                    at=epoch(rng), key="epoch",
+                    exit_code=int(rng.integers(20, 30)),
+                    marker=os.path.join(
+                        self.marker_dir or ".",
+                        f"crash-{int(rng.integers(0, 2**31))}.marker",
+                    ),
+                ),
             ],
             # Serving-pool gray failures: engine names drawn as bare
             # "rK" match any pool's "<pool>/rK" replica by suffix.

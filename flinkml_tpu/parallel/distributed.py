@@ -91,10 +91,15 @@ def init_distributed(
     """Join the jax.distributed coordination service (DCN control plane).
 
     Call once per process before any device computation, on every host of
-    the pod slice. Arguments default from the standard environment
-    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
-    ``JAX_PROCESS_ID``, as set by most TPU launchers); with no coordinator
-    configured this is a single-process no-op.
+    the pod slice. Arguments default from the environment — first the
+    framework's own rendezvous family (``FLINKML_TPU_COORD_ADDR`` /
+    ``FLINKML_TPU_WORLD_SIZE`` / ``FLINKML_TPU_RANK``, what
+    :mod:`flinkml_tpu.cluster`'s spawned workers and operator-launched
+    processes both export, so every launcher shares ONE rendezvous
+    path), then the standard ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` set by most TPU
+    launchers; with no coordinator configured this is a single-process
+    no-op.
 
     Transient rendezvous failures (coordinator still booting, dropped
     connections, deadline overruns — the normal churn of a pod slice
@@ -111,14 +116,18 @@ def init_distributed(
 
     Returns ``(process_index, process_count)``.
     """
-    coordinator_address = coordinator_address or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
+    coordinator_address = (
+        coordinator_address
+        or os.environ.get("FLINKML_TPU_COORD_ADDR")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
     )
     num_processes = num_processes if num_processes is not None else int(
-        os.environ.get("JAX_NUM_PROCESSES", "1")
+        os.environ.get("FLINKML_TPU_WORLD_SIZE")
+        or os.environ.get("JAX_NUM_PROCESSES", "1")
     )
     process_id = process_id if process_id is not None else int(
-        os.environ.get("JAX_PROCESS_ID", "0")
+        os.environ.get("FLINKML_TPU_RANK")
+        or os.environ.get("JAX_PROCESS_ID", "0")
     )
     # The guard must not touch any backend-initializing API
     # (jax.process_count() et al. would create the XLA backend, after which

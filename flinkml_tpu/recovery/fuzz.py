@@ -50,6 +50,18 @@ guard armed (:func:`run_serving_schedule`). Invariants:
 
 Failing schedules shrink through the same :func:`shrink_schedule`
 ddmin and commit the same ``FaultPlan`` JSON repro artifact.
+
+**Worker soak** (``--worker``): the trainer soak's restart invariants
+exercised across a REAL process boundary. Each schedule draws from the
+``cluster.worker`` seam (hard ``os._exit`` mid-stream via
+:class:`~flinkml_tpu.faults.WorkerCrash` — crash-once markers keep a
+restarted child from dying at the same trigger forever) alongside the
+in-loop numerics/crash seams; the scenario runs in a CHILD process
+(:func:`run_worker_schedule`) and the parent restarts it on every
+nonzero exit exactly like an orchestrator supervising a worker pool.
+The invariants are the trainer soak's, now with nothing shared between
+incarnations but the checkpoint directory: no silent fresh start
+(model version), ledger parity, bit-exact coefficients vs golden.
 """
 
 from __future__ import annotations
@@ -391,6 +403,299 @@ def run_soak(seed: int = 7, budget: int = 25,
         budget=fuzz.budget, skipped=skipped,
     )
     _log.warning("%s", report.summary())
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Worker soak: the same invariants across a real process boundary
+# ---------------------------------------------------------------------------
+
+#: Exit code the child uses for an in-loop scripted crash
+#: (``FaultInjected``) — distinct from WorkerCrash's sampled hard-exit
+#: codes (20–29) and from real child failures.
+WORKER_RESTART_EXIT = 3
+WORKER_CHILD_TIMEOUT_S = 180.0
+
+
+def _worker_child_main(workdir: str, resume: bool) -> int:
+    """One incarnation of the soak trainer, run in its own process.
+
+    Reads ``<workdir>/plan.json``, arms it, and runs the scenario with
+    checkpoints under ``<workdir>/ckpt`` — firing the ``cluster.worker``
+    seam once per batch so a sampled :class:`WorkerCrash` is a REAL
+    ``os._exit`` mid-stream. An in-loop scripted crash
+    (``FaultInjected``) exits :data:`WORKER_RESTART_EXIT`; success
+    writes ``<workdir>/result.json`` and exits 0. The orchestrator
+    (parent) restarts on any nonzero exit."""
+    import json
+
+    with open(os.path.join(workdir, "plan.json")) as f:
+        raw = f.read()
+    plan = faults_mod.plan_from_json(raw)
+    extras = json.loads(raw)
+    data_seed = int(extras.get("data_seed", 0))
+    if extras.get("x64"):
+        # Mirror the parent's precision: the env-var form of this flag
+        # is not honored by this jax build, so the parent ships its
+        # config-level setting through the plan file.
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+    # Fired-flag persistence across INCARNATIONS: the in-process soak's
+    # armed plan object survives its restart loop, so a scripted crash
+    # fires once. Here every incarnation re-arms a fresh plan from
+    # JSON, so fired flags are carried in the workdir instead —
+    # WorkerCrash has its own marker file; the in-loop faults get this.
+    fired_path = os.path.join(workdir, "fired.json")
+    fired_idx: set = set()
+    if os.path.exists(fired_path):
+        with open(fired_path) as f:
+            fired_idx = set(json.load(f))
+    for i in fired_idx:
+        plan.faults[i].fired = True
+
+    from flinkml_tpu.iteration import CheckpointManager
+
+    manager = CheckpointManager(os.path.join(workdir, "ckpt"),
+                                max_to_keep=10)
+
+    # The per-batch worker heartbeat, as a map op so the feed STAYS a
+    # replayable Dataset (quarantine retries re-open it from the
+    # cursor): where a pool worker would be serving a request, the soak
+    # trainer is reading a batch. The counter is monotone across
+    # replays; WorkerCrash's marker keeps each crash once-per-run.
+    reads = [0]
+
+    def heartbeat(batch):
+        reads[0] += 1
+        if faults_mod.ACTIVE is not None:
+            faults_mod.fire("cluster.worker", epoch=reads[0] - 1)
+        return batch
+
+    feed = scenario_dataset(data_seed).map(heartbeat)
+    with faults_mod.armed(plan):
+        try:
+            model = _fit(feed, manager, resume=resume, self_heal=True)
+        except faults_mod.FaultInjected:
+            fired_now = fired_idx | {
+                i for i, f in enumerate(plan.faults)
+                if getattr(f, "fired", False)
+            }
+            with open(fired_path, "w") as f:
+                json.dump(sorted(fired_now), f)
+            return WORKER_RESTART_EXIT
+    summary = getattr(model, "recovery_summary", None) or {}
+    with open(os.path.join(workdir, "result.json"), "w") as f:
+        json.dump({
+            "model_version": int(model.model_version),
+            "coefficient": np.asarray(model.coefficient).tolist(),
+            "quarantined": sorted(
+                int(i) for i in summary.get("quarantined", [])
+            ),
+            "finite": bool(np.isfinite(model.coefficient).all()),
+        }, f)
+    return 0
+
+
+def run_worker_schedule(plan: "faults_mod.FaultPlan", golden: GoldenCache,
+                        data_seed: int = 0, max_restarts: int = 10
+                        ) -> Tuple[Optional[Dict[str, Any]], List[str], int]:
+    """Run one schedule with the trainer in a CHILD process and this
+    process as the orchestrator: every nonzero child exit — an in-loop
+    scripted crash OR a WorkerCrash hard ``os._exit`` — is answered
+    with a restart (``resume=True``), sharing nothing with the previous
+    incarnation but the checkpoint directory. Returns
+    ``(result_dict_or_None, invariant_failures, restarts)``."""
+    import json
+    import subprocess
+    import sys
+
+    failures: List[str] = []
+    result: Optional[Dict[str, Any]] = None
+    restarts = 0
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    with tempfile.TemporaryDirectory(prefix="fuzz-worker-") as td:
+        import jax
+
+        with open(os.path.join(td, "plan.json"), "w") as f:
+            f.write(faults_mod.plan_to_json(plan, extra={
+                "data_seed": int(data_seed),
+                "x64": bool(jax.config.jax_enable_x64),
+            }))
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = os.pathsep.join(
+            x for x in (repo_root, env.get("PYTHONPATH")) if x
+        )
+        while True:
+            argv = [sys.executable, "-m", "flinkml_tpu.recovery.fuzz",
+                    "--worker-child", td]
+            if restarts > 0:
+                argv.append("--resume")
+            proc = subprocess.run(
+                argv, env=env, capture_output=True, text=True,
+                timeout=WORKER_CHILD_TIMEOUT_S,
+            )
+            if proc.returncode == 0:
+                break
+            restarts += 1
+            if restarts > max_restarts:
+                failures.append(
+                    f"did not complete within {max_restarts} restarts "
+                    f"(last rc={proc.returncode}); stderr tail: "
+                    f"{proc.stderr[-500:]}"
+                )
+                break
+        # The on-disk ledger, read the same way run_schedule reads it —
+        # it is the only state the NEXT incarnation would honor.
+        from flinkml_tpu.iteration import CheckpointManager
+        from flinkml_tpu.iteration.checkpoint import (
+            CheckpointIntegrityError,
+        )
+
+        recorded = None
+        manager = CheckpointManager(os.path.join(td, "ckpt"),
+                                    max_to_keep=10)
+        epoch = manager.newest_valid_epoch()
+        if epoch is not None:
+            try:
+                recorded = manager.read_extra(epoch).get("quarantine")
+            except CheckpointIntegrityError as e:
+                failures.append(
+                    f"snapshot {epoch} passed verify() but its extra "
+                    f"manifest is unreadable: {e}"
+                )
+        result_path = os.path.join(td, "result.json")
+        if os.path.exists(result_path):
+            with open(result_path) as f:
+                result = json.load(f)
+    from flinkml_tpu.recovery.policy import QuarantineLedger
+
+    disk_ledger = QuarantineLedger.from_json_dict(recorded).indices()
+
+    if result is not None:
+        expected = expected_quarantine(plan)
+        coeff = np.asarray(result["coefficient"])
+        if not result["finite"] or not np.isfinite(coeff).all():
+            failures.append("final model is not finite")
+        want_version = SCENARIO_BATCHES - len(expected)
+        if result["model_version"] != want_version:
+            failures.append(
+                f"model version {result['model_version']} != "
+                f"{want_version} (batches - quarantined: silent fresh "
+                "start across the process boundary)"
+            )
+        seen = set(result["quarantined"]) | set(disk_ledger)
+        if seen != set(expected):
+            failures.append(
+                f"quarantine ledger {sorted(seen)} != poisoned "
+                f"batches {sorted(expected)}"
+            )
+        if not set(disk_ledger) <= set(expected):
+            failures.append(
+                f"on-disk ledger {disk_ledger} names batches no "
+                f"fault poisoned ({sorted(expected)})"
+            )
+        if not failures:
+            ref = golden.model(expected)
+            if not np.array_equal(coeff, np.asarray(ref.coefficient)):
+                failures.append(
+                    "final model != golden run with the quarantined "
+                    "batches excluded (resume across the process "
+                    "boundary diverged)"
+                )
+    elif not failures:
+        failures.append("no result produced")
+    return result, failures, restarts
+
+
+def run_worker_soak(seed: int = 7, budget: int = 4,
+                    wall_budget_s: Optional[float] = None,
+                    fuzz: Optional["faults_mod.FuzzPlan"] = None,
+                    repro_dir: Optional[str] = None,
+                    data_seed: int = 0) -> SoakReport:
+    """The process-boundary soak: ``budget`` schedules over the
+    ``cluster.worker`` seam mixed with the in-loop crash/numerics
+    seams, each run via :func:`run_worker_schedule`. Budget defaults
+    small: every restart pays a full child-interpreter spin-up."""
+    with tempfile.TemporaryDirectory(prefix="fuzz-markers-") as markers:
+        fuzz = fuzz or faults_mod.FuzzPlan(
+            seed=seed,
+            seams=("cluster.worker", "iteration.epoch", "train.step"),
+            budget=budget, horizon=SCENARIO_BATCHES, max_faults=2,
+            marker_dir=markers,
+        )
+        golden = GoldenCache(data_seed)
+        golden.model(frozenset())
+        t0 = time.perf_counter()
+        results: List[ScheduleResult] = []
+        skipped = 0
+        for index, plan in fuzz.schedules():
+            if (wall_budget_s is not None
+                    and time.perf_counter() - t0 > wall_budget_s):
+                skipped = fuzz.budget - index
+                _log.warning(
+                    "worker soak wall budget (%ss) exhausted at "
+                    "schedule %d/%d", wall_budget_s, index, fuzz.budget,
+                )
+                break
+            st = time.perf_counter()
+            descs = [f.describe() for f in plan.faults]
+            _, failures, restarts = run_worker_schedule(
+                plan, golden, data_seed=data_seed
+            )
+            expected = sorted(expected_quarantine(plan))
+            results.append(ScheduleResult(
+                index=index, faults=descs, ok=not failures,
+                failures=failures, restarts=restarts,
+                quarantined=expected if not failures else [],
+                elapsed_s=round(time.perf_counter() - st, 3),
+            ))
+            if failures:
+                _log.error("worker schedule %d FAILED %s: %s",
+                           index, descs, failures)
+                if repro_dir is not None:
+                    minimal = shrink_schedule(
+                        plan,
+                        lambda p: bool(run_worker_schedule(
+                            p, golden, data_seed=data_seed)[1]),
+                    )
+                    os.makedirs(repro_dir, exist_ok=True)
+                    path = os.path.join(
+                        repro_dir,
+                        f"fuzz_worker_repro_seed{seed}_sched{index}.json",
+                    )
+                    with open(path, "w") as f:
+                        f.write(faults_mod.plan_to_json(minimal, extra={
+                            "seed": seed, "schedule": index,
+                            "failures": failures,
+                            "scenario": {
+                                "kind": "worker",
+                                "batches": SCENARIO_BATCHES,
+                                "rows": SCENARIO_ROWS,
+                                "dim": SCENARIO_DIM,
+                                "alpha": SCENARIO_ALPHA,
+                                "checkpoint_interval": SCENARIO_INTERVAL,
+                                "data_seed": data_seed,
+                            },
+                        }))
+                    _log.error(
+                        "minimal worker repro written: %s (%d -> %d "
+                        "faults)", path, len(plan.faults),
+                        len(minimal.faults),
+                    )
+            else:
+                _log.info("worker schedule %d ok %s (restarts=%d)",
+                          index, descs, restarts)
+        report = SoakReport(
+            seed=seed, results=results,
+            elapsed_s=round(time.perf_counter() - t0, 2),
+            budget=fuzz.budget, skipped=skipped,
+        )
+    _log.warning("worker %s", report.summary())
     return report
 
 
@@ -755,8 +1060,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--serving", action="store_true",
                         help="run the serving-pool gray-failure soak "
                              "instead of the trainer soak")
+    parser.add_argument("--worker", action="store_true",
+                        help="run the process-boundary worker-crash soak "
+                             "(each schedule's trainer is a supervised "
+                             "child process)")
+    parser.add_argument("--worker-child", metavar="DIR", default=None,
+                        help=argparse.SUPPRESS)  # internal: one incarnation
+    parser.add_argument("--resume", action="store_true",
+                        help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
-    if args.serving:
+    if args.worker_child:
+        return _worker_child_main(args.worker_child, resume=args.resume)
+    if args.worker:
+        report = run_worker_soak(
+            seed=args.seed,
+            budget=args.budget if args.budget is not None else 4,
+            wall_budget_s=args.wall_budget_s,
+            repro_dir=args.repro_dir,
+        )
+    elif args.serving:
         report = run_serving_soak(
             seed=args.seed,
             budget=args.budget if args.budget is not None else 6,

@@ -1,0 +1,164 @@
+"""Clean-process ClusterPool scenario behind ``tests/test_cluster.py``.
+
+Why a child process: the warm-respawn acceptance ("a respawned worker
+rejoins via compile-cache retarget loads — zero new XLA compiles") is
+serialization-dependent, and the suite conftest's jax persistent cache
+poisons XLA:CPU executable serialization process-wide (the finding
+documented in ``tests/_compile_cache_child.py``). This script runs the
+whole multi-process scenario in a fresh interpreter — which is also the
+production shape — and prints a JSON report the pytest module asserts
+over.
+
+The scenario, end to end:
+
+1. fit a pipeline, serve it from an in-process reference engine AND a
+   2-worker :class:`~flinkml_tpu.cluster.ClusterPool`; predictions must
+   be sha256-bitwise identical across the process boundary;
+2. arm a :class:`~flinkml_tpu.faults.WorkerCrash` inside one worker
+   over the transport (``arm_faults``) and keep closed-loop traffic
+   flowing: the worker hard-exits mid-traffic and ZERO requests are
+   lost (typed ``WorkerDiedError`` → router failover to the survivor);
+3. ``respawn_dead()``: the successor warms from the pool's shared
+   artifact store (aot loads, zero new XLA compiles) and parity holds;
+4. cross-process lease reclaim: a slice lease acquired INSIDE a worker
+   is revoked and released over the wire (the revoke→release handshake
+   carried across the boundary).
+"""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import numpy as np
+
+    from flinkml_tpu import faults
+    from flinkml_tpu.cluster import ClusterPool, reclaim_worker_leases
+    from flinkml_tpu.models.logistic_regression import LogisticRegression
+    from flinkml_tpu.models.scalers import StandardScaler
+    from flinkml_tpu.pipeline import PipelineModel
+    from flinkml_tpu.serving import ServingConfig, ServingEngine
+    from flinkml_tpu.table import Table
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 8))
+    y = (x @ rng.normal(size=8) > 0).astype(np.float64)
+    train = Table({"features": x, "label": y})
+    sc = (StandardScaler().set(StandardScaler.INPUT_COL, "features")
+          .set(StandardScaler.OUTPUT_COL, "scaled").fit(train))
+    (t2,) = sc.transform(train)
+    lr = (LogisticRegression()
+          .set(LogisticRegression.FEATURES_COL, "scaled")
+          .set(LogisticRegression.LABEL_COL, "label")
+          .set_max_iter(3).fit(t2))
+    model = PipelineModel([sc, lr])
+    example = Table({"features": x[:4]})
+    cfg = ServingConfig(max_batch_rows=64, max_queue_rows=4096,
+                        max_wait_ms=1.0, default_timeout_ms=10_000.0)
+
+    ref = ServingEngine(model, example, cfg,
+                        output_cols=("prediction",), name="ref").start()
+    ref_out = np.asarray(
+        ref.predict({"features": x[:32]}).column("prediction")
+    )
+
+    pool = ClusterPool(model, example, config=cfg, n_workers=2,
+                       output_cols=("prediction",), name="smoke").start()
+    out = np.asarray(
+        pool.predict({"features": x[:32]}).column("prediction")
+    )
+    sha_ref = hashlib.sha256(ref_out.tobytes()).hexdigest()
+    sha_pool = hashlib.sha256(out.tobytes()).hexdigest()
+
+    # -- cross-process lease reclaim (stand a REAL lease up inside a
+    # worker, then run the revoke→release handshake over the wire).
+    client0 = pool.worker_clients()[0]
+    acquired = client0.call("lease", {"cmd": "acquire", "n": 1,
+                                      "holder": "child-trainer",
+                                      "cooperative": True})
+    reclaimed = reclaim_worker_leases(
+        client0, device_ids=acquired["devices"], timeout_s=10.0
+    )
+
+    # -- kill one worker MID-TRAFFIC via the cluster.worker fault seam
+    # (a scripted WorkerCrash armed over the transport — a real
+    # os._exit, not a simulated death).
+    victim = pool.replicas[0]
+    marker = os.path.join(victim.engine.process.workdir, "crash.marker")
+    plan_json = faults.plan_to_json(faults.FaultPlan(
+        faults.WorkerCrash(at=1, key="request", exit_code=23,
+                           marker=marker)
+    ))
+    errs, done = [], [0]
+    stop = threading.Event()
+
+    def client_loop():
+        while not stop.is_set():
+            try:
+                r = pool.predict({"features": x[:8]})
+                assert np.array_equal(
+                    np.asarray(r.column("prediction")), ref_out[:8]
+                )
+                done[0] += 1
+            except Exception as e:  # noqa: BLE001 — report, don't mask
+                errs.append(repr(e))
+
+    threads = [threading.Thread(target=client_loop) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    victim.engine.client.call("arm_faults", {"plan_json": plan_json})
+    deadline = time.monotonic() + 20.0
+    while victim.engine.process.alive and time.monotonic() < deadline:
+        time.sleep(0.05)
+    crashed_rc = victim.engine.process.returncode
+    time.sleep(1.0)  # post-crash traffic rides the survivor
+    stop.set()
+    for t in threads:
+        t.join()
+
+    health = {r.name: r.health.state.name for r in pool.replicas}
+
+    # -- warm respawn from the shared artifact store.
+    replaced = pool.respawn_dead()
+    stats = replaced[0].engine.worker_stats()
+    fusion = stats["fusion_counters"]
+    out3 = np.asarray(
+        pool.predict({"features": x[:32]}).column("prediction")
+    )
+
+    snap = pool.cluster_metrics.snapshot()
+    pool.stop()
+    ref.stop()
+
+    print(json.dumps({
+        "sha_ref": sha_ref,
+        "sha_pool": sha_pool,
+        "parity_bitwise": bool(np.array_equal(ref_out, out)),
+        "lease_reclaimed": [
+            {"released": r["released"], "holder": r.get("holder")}
+            for r in reclaimed
+        ],
+        "crashed_rc": crashed_rc,
+        "requests_ok": done[0],
+        "requests_lost": len(errs),
+        "errors_sample": errs[:3],
+        "health_after_crash": health,
+        "respawned": [r.name for r in replaced],
+        "respawn_fusion": {k: fusion.get(k, 0.0)
+                           for k in ("compiles", "aot_loads")},
+        "post_respawn_parity": bool(np.array_equal(ref_out, out3)),
+        "workers_alive_gauge": snap["gauges"].get("workers_alive"),
+        "transport_p99_ms": snap["gauges"].get("p99_ms"),
+        "spawn_ms_samples": len(snap["histories"].get("spawn_ms", [])),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

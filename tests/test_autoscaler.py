@@ -144,15 +144,21 @@ def test_closed_loop_load_triple_recovers_p99_without_operator():
 
     Why a tripwire and not strict improvement on THIS mesh: host-
     platform CPU "devices" share one XLA executor pool and the Python
-    dispatchers share the GIL, so in-process replicas cannot add real
+    dispatchers share the GIL, so IN-PROCESS replicas cannot add real
     capacity (closed-loop p50 scales with 1/throughput — Little's law);
     the true p99-recovery number is the queued DEVICE bench stage's,
-    where each replica owns a chip (the PR 8 precedent). The 2x bound
-    is NOT vacuous: the unbounded per-(rows,bucket) pad-compile bug
-    this PR fixed in ``Table.device_column_padded`` degraded exactly
-    this scenario >10x. (The zero-compile half of the acceptance runs
-    in the clean child process above — the suite conftest's jax pcache
-    forces in-process scale-ups to degrade to compile-only.)"""
+    where each replica owns a chip (the PR 8 precedent). The remedy
+    for the single-process ceiling itself is the multi-process worker
+    pool (``flinkml_tpu.cluster.ClusterPool`` — each replica a real
+    process with its own GIL and executor pool; see
+    ``tests/test_cluster.py`` and ci's ``cluster smoke`` stage), which
+    this scenario deliberately does NOT use so the tripwire keeps
+    watching the in-process path. The 2x bound is NOT vacuous: the
+    unbounded per-(rows,bucket) pad-compile bug this PR fixed in
+    ``Table.device_column_padded`` degraded exactly this scenario >10x.
+    (The zero-compile half of the acceptance runs in the clean child
+    process above — the suite conftest's jax pcache forces in-process
+    scale-ups to degrade to compile-only.)"""
     x, y = _data()
     pm = _chain(x, y)
     pool = _pool(pm, x, n_replicas=1, name="loop_pool",

@@ -900,6 +900,44 @@ def test_chaos_soak_small_budget_green():
     assert len(report.results) == 8
 
 
+def test_worker_soak_restarts_across_process_boundary():
+    """The ``cluster.worker`` seam in the soak: schedules draw REAL
+    ``os._exit`` worker crashes, each trainer incarnation is a child
+    process, and the orchestrator-restart invariants (no silent fresh
+    start, ledger parity, bit-exact coefficients vs golden) hold with
+    nothing shared between incarnations but the checkpoint directory."""
+    from flinkml_tpu.recovery.fuzz import run_worker_soak
+
+    report = run_worker_soak(seed=7, budget=3)
+    assert report.ok, [
+        (r.index, r.faults, r.failures) for r in report.failures
+    ]
+    assert len(report.results) == 3
+    # At least one schedule actually crossed the boundary: a hard exit
+    # answered by a restart (seed 7's draws include WorkerCrash).
+    assert sum(r.restarts for r in report.results) >= 1
+
+
+def test_worker_schedule_crash_then_poison_heals(tmp_path):
+    """One deterministic schedule: a WorkerCrash hard-exits the child
+    mid-stream AND a NaNGrad poisons a later batch — the restarted
+    incarnation resumes (not a fresh start), quarantines the poison,
+    and lands bit-exactly on the golden run minus that batch."""
+    from flinkml_tpu.recovery.fuzz import GoldenCache, run_worker_schedule
+
+    golden = GoldenCache(0)
+    plan = faults.FaultPlan(
+        faults.WorkerCrash(at=4, key="epoch", exit_code=23,
+                           marker=str(tmp_path / "crash.marker")),
+        faults.NaNGrad(6),
+    )
+    result, failures, restarts = run_worker_schedule(plan, golden)
+    assert not failures, failures
+    assert restarts == 1
+    assert result["quarantined"] == [6]
+    assert result["model_version"] == 9  # 10 batches - 1 quarantined
+
+
 def test_shrink_minimizes_to_the_poison(tmp_path):
     from flinkml_tpu.recovery.fuzz import (
         GoldenCache,

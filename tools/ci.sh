@@ -1328,7 +1328,9 @@ stage "sparse smoke (multi-block segsum + spmv parity + FML404 + bench)" \
 # join warm, zero requests lost, the backlog signal recovers, and p99
 # holds a starved-box tripwire (the CPU mesh's virtual devices share one
 # executor, so strict recovery is the queued DEVICE stage's number — the
-# 2x bound catches the >10x pad-compile failure mode this PR fixed);
+# 2x bound catches the >10x pad-compile failure mode this PR fixed; the
+# in-process capacity ceiling itself is lifted by the worker-pool stage,
+# "cluster smoke" below, where each replica is a real process);
 # (2) a batch-tier job over its SLO share is refused TYPED while the
 # interactive tier keeps serving; (3) the int8 PTQ tier's predictions
 # sit within the pinned tolerance of f32; (4) the seeded FML606 fixture
@@ -1860,6 +1862,72 @@ print('freshness smoke: train rows/s', rec['train_rows_per_sec'],
 }
 stage "freshness smoke (hashed stream -> delta-only pool + chaos kill)" \
     freshness_smoke
+
+# Cluster smoke (ISSUE 20 acceptance, device-free): "N replicas" means
+# N worker PROCESSES. (1) tests/_cluster_child.py runs the whole
+# multi-process scenario in a clean interpreter: 2 spawned workers
+# serve sha256-bitwise-identically to the in-process engine, a
+# WorkerCrash (real os._exit) armed OVER the transport kills one
+# mid-closed-loop-traffic with ZERO lost requests (typed
+# WorkerDiedError -> router failover), the respawn rejoins WARM from
+# the pool's shared artifact store (aot loads, zero new XLA compiles),
+# and a slice lease held inside a worker revoke->releases over the
+# wire. (2) A short worker-crash chaos soak: trainer incarnations are
+# supervised CHILD processes, restarts resume from the checkpoint
+# family (no silent fresh start, ledger parity vs golden). (3) Parses
+# bench.py multiproc_pool_cpu — rows/s-per-worker plus the
+# worker-vs-thread speedup ratio; the >= 1.5x acceptance ratio is
+# asserted only when >= 8 host cores back the workers (on a starved
+# box the ratio measures the OS scheduler, not the pool — parity and
+# zero-loss assert unconditionally).
+cluster_smoke() {
+    local out
+    out=$(JAX_PLATFORMS=cpu PYTHONPATH=. timeout 420 \
+        python tests/_cluster_child.py) || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, sys
+rep = json.loads(sys.stdin.read())
+assert rep['parity_bitwise'] is True, rep
+assert rep['sha_ref'] == rep['sha_pool'], rep
+assert rep['crashed_rc'] == 23, rep
+assert rep['requests_ok'] > 0 and rep['requests_lost'] == 0, rep
+assert rep['respawned'], rep
+assert rep['respawn_fusion']['compiles'] == 0.0, rep
+assert rep['respawn_fusion']['aot_loads'] > 0, rep
+assert rep['post_respawn_parity'] is True, rep
+assert rep['lease_reclaimed'] and all(
+    l['released'] for l in rep['lease_reclaimed']), rep
+assert rep['workers_alive_gauge'] == 2.0, rep
+print('cluster smoke: parity sha', rep['sha_pool'][:12],
+      '| crash rc', rep['crashed_rc'], '->', rep['requests_ok'],
+      'requests ok,', rep['requests_lost'], 'lost',
+      '| respawn compiles', rep['respawn_fusion']['compiles'],
+      'aot_loads', rep['respawn_fusion']['aot_loads'],
+      '| lease released', len(rep['lease_reclaimed']))
+" || return 1
+    JAX_PLATFORMS=cpu timeout 420 \
+        python -m flinkml_tpu.recovery.fuzz --worker --seed 7 --budget 4 \
+        --wall-budget-s 300 || return 1
+    out=$(_FLINKML_BENCH_INNER=multiproc_pool_cpu timeout 560 \
+        python bench.py) || return 1
+    printf '%s\n' "$out" | tail -1 | python -c "
+import json, math, sys
+rec = json.loads(sys.stdin.read())
+assert rec['parity_bitwise'] is True, rec
+per = rec['multiproc_rows_per_sec_per_worker']
+assert math.isfinite(per) and per > 0, rec
+if (rec['host_cpu_count'] or 0) >= 8:
+    assert rec['worker_vs_thread_speedup'] >= 1.5, (
+        'process pool lost to the in-process pool on a full host', rec)
+print('cluster smoke bench:', rec['multiproc_rows_per_sec'], 'rows/s',
+      '(', per, 'per worker ) worker/thread',
+      rec['worker_vs_thread_speedup'], 'x on',
+      rec['host_cpu_count'], 'cores (device stage queued in bench',
+      'stage_order)')
+"
+}
+stage "cluster smoke (2-proc parity + kill-mid-traffic + warm respawn)" \
+    cluster_smoke
 
 example_smoke() {
     local ex
